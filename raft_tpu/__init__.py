@@ -1,0 +1,25 @@
+"""raft_tpu — a TPU-native library of ML/IR primitives and ANN vector search.
+
+A ground-up JAX/XLA/Pallas re-design of the capabilities of RAPIDS RAFT
+(reference: cpp/include/raft): pairwise distances, k-selection, dense/sparse
+linear algebra, clustering, statistics, random generation, and ANN indexes
+(brute-force, IVF-Flat, IVF-PQ, CAGRA) — plus a multi-device communicator
+facade over ``jax.lax`` collectives replacing the reference's NCCL/UCX stack.
+
+Layer map (mirrors reference layers, TPU-idiomatic implementations):
+
+- :mod:`raft_tpu.core`       — resources/handle, errors, logging, serialization
+- :mod:`raft_tpu.linalg`     — dense linear algebra API surface (XLA/MXU)
+- :mod:`raft_tpu.matrix`     — select_k (top-k) and matrix utilities
+- :mod:`raft_tpu.random`     — counter-based RNG + data generators
+- :mod:`raft_tpu.distance`   — 20+ pairwise distance metrics, fused L2 argmin
+- :mod:`raft_tpu.sparse`     — COO/CSR ops, semiring distances, Lanczos, MST
+- :mod:`raft_tpu.cluster`    — kmeans, balanced kmeans, single-linkage
+- :mod:`raft_tpu.neighbors`  — brute-force / IVF-Flat / IVF-PQ / CAGRA ANN
+- :mod:`raft_tpu.stats`      — descriptive stats + model/clustering metrics
+- :mod:`raft_tpu.parallel`   — comms facade over lax collectives, sharded search
+"""
+
+__version__ = "0.1.0"
+
+from raft_tpu.core.resources import Resources, DeviceResources  # noqa: F401

@@ -1,0 +1,16 @@
+"""raft_tpu.core — handle/resources, errors, logging, bitset, serialization.
+
+TPU-native counterpart of the reference's core layer
+(cpp/include/raft/core): the mdspan/mdarray machinery collapses into JAX
+arrays (value-semantic, device-placed), streams/vendor handles into XLA's
+async dispatch, and the comms *interface* into raft_tpu.parallel.
+"""
+
+from raft_tpu.core.resources import (  # noqa: F401
+    DeviceResources,
+    Resources,
+    RngKeySource,
+    get_device_resources,
+)
+from raft_tpu.core.errors import RaftError, LogicError, expects, fail  # noqa: F401
+from raft_tpu.core import logging, serialize, bitset  # noqa: F401

@@ -1,0 +1,68 @@
+"""Bitset — packed device bitset for ANN sample pre-filtering.
+
+TPU-native counterpart of ``raft::core::bitset`` (core/bitset.cuh: test :235,
+flip :279). Bits pack little-endian into uint32 words; all ops are pure
+functions on the packed array (value semantics — no in-place mutation),
+which is the idiomatic JAX shape of the reference's device-mutable bitset.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+WORD_BITS = 32
+
+
+def n_words(bitset_len: int) -> int:
+    return (bitset_len + WORD_BITS - 1) // WORD_BITS
+
+
+def create(bitset_len: int, default_value: bool = True) -> jax.Array:
+    """All-set (or all-clear) bitset of ``bitset_len`` bits."""
+    fill = jnp.uint32(0xFFFFFFFF) if default_value else jnp.uint32(0)
+    return jnp.full((n_words(bitset_len),), fill, dtype=jnp.uint32)
+
+
+def from_mask(mask: jax.Array) -> jax.Array:
+    """Pack a boolean vector into a bitset."""
+    n = mask.shape[0]
+    pad = n_words(n) * WORD_BITS - n
+    m = jnp.concatenate([mask.astype(jnp.uint32), jnp.zeros((pad,), jnp.uint32)])
+    m = m.reshape(-1, WORD_BITS)
+    shifts = jnp.arange(WORD_BITS, dtype=jnp.uint32)
+    return jnp.sum(m << shifts, axis=1, dtype=jnp.uint32)
+
+
+def to_mask(bits: jax.Array, bitset_len: int) -> jax.Array:
+    """Unpack into a boolean vector of length ``bitset_len``."""
+    shifts = jnp.arange(WORD_BITS, dtype=jnp.uint32)
+    m = ((bits[:, None] >> shifts) & 1).astype(jnp.bool_).reshape(-1)
+    return m[:bitset_len]
+
+
+def test(bits: jax.Array, idx) -> jax.Array:
+    """Test bit(s) at ``idx`` (reference: bitset::test, core/bitset.cuh:235)."""
+    idx = jnp.asarray(idx)
+    word = bits[idx // WORD_BITS]
+    return ((word >> (idx % WORD_BITS).astype(jnp.uint32)) & 1).astype(jnp.bool_)
+
+
+def set_bits(bits: jax.Array, idx, value: bool = True) -> jax.Array:
+    """Return a new bitset with bit(s) at ``idx`` set/cleared."""
+    idx = jnp.atleast_1d(jnp.asarray(idx))
+    word_idx = idx // WORD_BITS
+    bit = (jnp.uint32(1) << (idx % WORD_BITS).astype(jnp.uint32))
+    if value:
+        return bits.at[word_idx].set(bits[word_idx] | bit)
+    return bits.at[word_idx].set(bits[word_idx] & ~bit)
+
+
+def flip(bits: jax.Array) -> jax.Array:
+    """Flip all bits (reference: bitset::flip, core/bitset.cuh:279)."""
+    return ~bits
+
+
+def count(bits: jax.Array, bitset_len: int) -> jax.Array:
+    """Population count over the valid prefix."""
+    return jnp.sum(to_mask(bits, bitset_len).astype(jnp.int32))

@@ -1,0 +1,39 @@
+"""Error handling — exception hierarchy + validation helpers.
+
+TPU-native counterpart of the reference's error layer
+(cpp/include/raft/core/error.hpp: ``raft::exception``, ``RAFT_EXPECTS``,
+``RAFT_FAIL``). On TPU there is no CUDA error channel; the host-side
+validation story (argument/shape checking with informative messages)
+is what carries over.
+"""
+
+from __future__ import annotations
+
+
+class RaftError(RuntimeError):
+    """Base exception (reference: ``raft::exception``, core/error.hpp:63)."""
+
+
+class LogicError(RaftError):
+    """Invalid argument / precondition violation (``raft::logic_error``)."""
+
+
+class InterruptedError_(RaftError):
+    """Cooperative cancellation (``raft::interrupted_exception``,
+    core/interruptible.hpp)."""
+
+
+def expects(cond: bool, msg: str, *args) -> None:
+    """Validate a precondition (reference: ``RAFT_EXPECTS``, core/error.hpp:152).
+
+    Raises :class:`LogicError` with the formatted message when ``cond`` is
+    falsy. Only call with host (trace-time) booleans — never with traced
+    values inside jit.
+    """
+    if not cond:
+        raise LogicError(msg % args if args else msg)
+
+
+def fail(msg: str, *args) -> None:
+    """Unconditional failure (reference: ``RAFT_FAIL``)."""
+    raise LogicError(msg % args if args else msg)

@@ -1,0 +1,171 @@
+"""Resources / handle — the context object passed to every raft_tpu API.
+
+TPU-native re-design of the reference's handle stack:
+
+- ``raft::resources`` (core/resources.hpp:47-136): a type-indexed registry of
+  lazily-created resources via registered factories. Reproduced here as a
+  string-keyed factory registry on :class:`Resources`.
+- ``raft::device_resources`` (core/device_resources.hpp:61): the concrete
+  handle carrying stream/BLAS handles/comms. On TPU, streams and vendor-library
+  handles do not exist (XLA owns scheduling), so :class:`DeviceResources`
+  carries what *does* matter on TPU: the target :class:`jax.Device`, the
+  device :class:`~jax.sharding.Mesh` (for distributed work), a counter-based
+  PRNG key source, the matmul precision policy, and an optional comms facade.
+- ``device_resources_manager`` (core/device_resources_manager.hpp:79):
+  process-wide per-device handle pool → :func:`get_device_resources`.
+
+There is deliberately no stream-sync machinery: XLA dispatch is async and
+value-semantic; :meth:`Resources.sync` maps to ``block_until_ready`` on
+user-held arrays and exists for API parity.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, Callable, Dict, Optional
+
+import jax
+import numpy as np
+
+from raft_tpu.core import logging as _log
+from raft_tpu.core.errors import expects
+
+
+class Resources:
+    """Type-indexed lazy resource registry (reference: core/resources.hpp:47).
+
+    Factories are registered under a string key; the resource is created on
+    first :meth:`get_resource` and cached. This mirrors the reference's
+    ``add_resource_factory``/``get_resource`` design without C++ type tokens.
+    """
+
+    def __init__(self) -> None:
+        self._factories: Dict[str, Callable[[], Any]] = {}
+        self._resources: Dict[str, Any] = {}
+        self._lock = threading.Lock()
+
+    def add_resource_factory(self, key: str, factory: Callable[[], Any]) -> None:
+        with self._lock:
+            self._factories[key] = factory
+            self._resources.pop(key, None)
+
+    def has_resource_factory(self, key: str) -> bool:
+        return key in self._factories or key in self._resources
+
+    def get_resource(self, key: str) -> Any:
+        with self._lock:
+            if key not in self._resources:
+                expects(key in self._factories, "no resource factory for %r", key)
+                self._resources[key] = self._factories[key]()
+            return self._resources[key]
+
+    def set_resource(self, key: str, value: Any) -> None:
+        with self._lock:
+            self._resources[key] = value
+
+
+class RngKeySource:
+    """Stateful wrapper over JAX's counter-based (threefry) PRNG.
+
+    The reference's ``rng_state`` (random/rng_state.hpp:29) carries
+    seed+subsequence so kernels are reproducible-stateless; JAX's key-splitting
+    is the native version of the same idea. This source hands out fresh
+    subkeys for APIs that take a handle instead of an explicit key.
+    """
+
+    def __init__(self, seed: int = 0):
+        self._key = jax.random.PRNGKey(seed)
+        self._lock = threading.Lock()
+
+    def next_key(self) -> jax.Array:
+        with self._lock:
+            self._key, sub = jax.random.split(self._key)
+            return sub
+
+    def reseed(self, seed: int) -> None:
+        with self._lock:
+            self._key = jax.random.PRNGKey(seed)
+
+
+class DeviceResources(Resources):
+    """The raft_tpu handle (reference: core/device_resources.hpp:61).
+
+    Parameters
+    ----------
+    device : jax.Device, optional
+        Target device; defaults to ``jax.devices()[0]``.
+    mesh : jax.sharding.Mesh, optional
+        Device mesh for distributed algorithms (replaces the reference's
+        comms-in-handle; see raft_tpu.parallel).
+    seed : int
+        Seed for the handle's PRNG key source.
+    precision : str
+        Default matmul precision ("default" | "high" | "highest"); the TPU
+        analog of cuBLAS math-mode selection.
+    """
+
+    def __init__(
+        self,
+        device: Optional[jax.Device] = None,
+        mesh: Optional["jax.sharding.Mesh"] = None,
+        seed: int = 0,
+        precision: str = "highest",
+    ) -> None:
+        super().__init__()
+        self._device = device
+        self.precision = precision
+        self.add_resource_factory("rng", lambda: RngKeySource(seed))
+        if mesh is not None:
+            self.set_resource("mesh", mesh)
+
+    # -- accessors mirroring core/resource/*.hpp ---------------------------
+    @property
+    def device(self) -> jax.Device:
+        if self._device is None:
+            self._device = jax.devices()[0]
+        return self._device
+
+    @property
+    def mesh(self) -> Optional["jax.sharding.Mesh"]:
+        return self._resources.get("mesh")
+
+    def set_mesh(self, mesh: "jax.sharding.Mesh") -> None:
+        self.set_resource("mesh", mesh)
+
+    @property
+    def comms(self):
+        """Injected communicator facade (reference: core/resource/comms.hpp)."""
+        return self._resources.get("comms")
+
+    def set_comms(self, comms) -> None:
+        self.set_resource("comms", comms)
+
+    def next_rng_key(self) -> jax.Array:
+        return self.get_resource("rng").next_key()
+
+    def sync(self, *arrays) -> None:
+        """Wait for async dispatch (reference: ``sync_stream``). Value-
+        semantics means there is nothing global to sync; block on the given
+        arrays if provided."""
+        for a in arrays:
+            jax.block_until_ready(a)
+
+    def logger(self):
+        return _log.get_logger()
+
+
+_default_handles: Dict[int, DeviceResources] = {}
+_default_lock = threading.Lock()
+
+
+def get_device_resources(device: Optional[jax.Device] = None) -> DeviceResources:
+    """Process-wide per-device handle pool
+    (reference: core/device_resources_manager.hpp:79)."""
+    if device is None:
+        device = jax.devices()[0]
+    with _default_lock:
+        h = _default_handles.get(device.id)
+        if h is None:
+            h = DeviceResources(device=device, seed=int(np.uint32(device.id)))
+            _default_handles[device.id] = h
+        return h

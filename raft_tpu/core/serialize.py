@@ -1,0 +1,102 @@
+"""Serialization — .npy-based array + header streaming for index save/load.
+
+TPU-native counterpart of the reference's mdspan serializer
+(core/serialize.hpp:35 ``serialize_mdspan``,
+core/detail/mdspan_numpy_serializer.hpp): arrays stream as standard NumPy
+``.npy`` records, scalars/POD headers as little-endian fixed-width fields.
+Index checkpoint files produced here are self-describing and versioned
+(cf. ``serialization_version`` in ivf_pq_types.hpp).
+"""
+
+from __future__ import annotations
+
+import io
+import json
+import struct
+from typing import Any, BinaryIO, Dict
+
+import jax
+import numpy as np
+
+MAGIC = b"RAFTTPU\x00"
+
+
+def serialize_scalar(f: BinaryIO, value) -> None:
+    """Write one little-endian scalar (int64/float64/bool) with a type tag."""
+    if isinstance(value, (bool, np.bool_)):
+        f.write(b"b" + struct.pack("<?", bool(value)))
+    elif isinstance(value, (int, np.integer)):
+        f.write(b"i" + struct.pack("<q", int(value)))
+    elif isinstance(value, (float, np.floating)):
+        f.write(b"f" + struct.pack("<d", float(value)))
+    elif isinstance(value, str):
+        raw = value.encode("utf-8")
+        f.write(b"s" + struct.pack("<q", len(raw)) + raw)
+    else:
+        raise TypeError(f"unsupported scalar type: {type(value)}")
+
+
+def deserialize_scalar(f: BinaryIO):
+    tag = f.read(1)
+    if tag == b"b":
+        return struct.unpack("<?", f.read(1))[0]
+    if tag == b"i":
+        return struct.unpack("<q", f.read(8))[0]
+    if tag == b"f":
+        return struct.unpack("<d", f.read(8))[0]
+    if tag == b"s":
+        (n,) = struct.unpack("<q", f.read(8))
+        return f.read(n).decode("utf-8")
+    raise ValueError(f"bad scalar tag: {tag!r}")
+
+
+def serialize_array(f: BinaryIO, arr) -> None:
+    """Stream one array as a standard .npy record
+    (reference: serialize_mdspan, core/serialize.hpp:35)."""
+    np.save(f, np.asarray(jax.device_get(arr)), allow_pickle=False)
+
+
+def deserialize_array(f: BinaryIO) -> np.ndarray:
+    return np.load(f, allow_pickle=False)
+
+
+def serialize_header(f: BinaryIO, kind: str, version: int, meta: Dict[str, Any]) -> None:
+    """Write the container header: magic, kind, version, JSON metadata."""
+    f.write(MAGIC)
+    serialize_scalar(f, kind)
+    serialize_scalar(f, version)
+    serialize_scalar(f, json.dumps(meta, sort_keys=True))
+
+
+def deserialize_header(f: BinaryIO, expected_kind: str):
+    magic = f.read(len(MAGIC))
+    if magic != MAGIC:
+        raise ValueError("not a raft_tpu serialized file (bad magic)")
+    kind = deserialize_scalar(f)
+    if kind != expected_kind:
+        raise ValueError(f"expected {expected_kind!r} file, got {kind!r}")
+    version = deserialize_scalar(f)
+    meta = json.loads(deserialize_scalar(f))
+    return version, meta
+
+
+def save_arrays(path: str, kind: str, version: int, meta: Dict[str, Any], arrays: Dict[str, Any]) -> None:
+    """Save a named-array container (one file per index)."""
+    with open(path, "wb") as f:
+        serialize_header(f, kind, version, meta)
+        serialize_scalar(f, len(arrays))
+        for name, arr in arrays.items():
+            serialize_scalar(f, name)
+            serialize_array(f, arr)
+
+
+def load_arrays(path: str, kind: str):
+    """Load a named-array container → (version, meta, {name: np.ndarray})."""
+    with open(path, "rb") as f:
+        version, meta = deserialize_header(f, kind)
+        n = deserialize_scalar(f)
+        arrays = {}
+        for _ in range(n):
+            name = deserialize_scalar(f)
+            arrays[name] = deserialize_array(f)
+    return version, meta, arrays

@@ -1,0 +1,12 @@
+"""raft_tpu.distance — pairwise distances, fused L2 argmin, Gram kernels.
+
+Counterpart of the reference distance layer (cpp/include/raft/distance).
+"""
+
+from raft_tpu.distance.types import DistanceType, SELECT_MIN, resolve_metric  # noqa: F401
+from raft_tpu.distance.pairwise import distance, pairwise_distance  # noqa: F401
+from raft_tpu.distance.fused_l2_nn import (  # noqa: F401
+    fused_l2_nn_argmin,
+    masked_l2_nn_argmin,
+)
+from raft_tpu.distance.kernels import KernelParams, KernelType, gram_matrix  # noqa: F401

@@ -1,0 +1,102 @@
+"""Fused L2 nearest-neighbor (1-NN argmin) — kmeans' inner loop.
+
+TPU-native counterpart of ``raft::distance::fused_l2_nn``
+(distance/fused_l2_nn.cuh, detail/fused_distance_nn/): the L2 distance and
+the argmin reduce are fused so the full [m, n] distance matrix is never
+materialized in HBM. Here the fusion is expressed as a ``lax.scan`` over
+column tiles of ``y`` with a running (min, argmin) carry — XLA fuses the
+Gram matmul, epilogue, and reduction per tile; HBM cost is O(m·tile).
+Also provides the masked variant (reference: distance/masked_nn.cuh).
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from raft_tpu.utils.precision import get_precision
+
+# Column-tile width of the running-argmin scan: large enough to keep the MXU
+# busy, small enough that m×tile stays cheap in HBM.
+_DEFAULT_TILE = 4096
+
+
+def _dist_block(x, yb, x_sq, yb_sq, sqrt):
+    d2 = x_sq[:, None] + yb_sq[None, :] - 2.0 * lax.dot_general(
+        x, yb, (((1,), (1,)), ((), ())), precision=get_precision(),
+        preferred_element_type=jnp.float32)
+    d2 = jnp.maximum(d2, 0.0)
+    return jnp.sqrt(d2) if sqrt else d2
+
+
+def fused_l2_nn_argmin(
+    x: jax.Array,
+    y: jax.Array,
+    sqrt: bool = False,
+    tile: int = _DEFAULT_TILE,
+) -> Tuple[jax.Array, jax.Array]:
+    """For each row of x, the L2 distance and index of its nearest row of y.
+
+    Counterpart of ``fused_l2_nn``/``fused_l2_nn_min_reduce``
+    (distance/fused_l2_nn.cuh). Returns (min_dists [m], argmins [m]).
+    """
+    m, d = x.shape
+    n = y.shape[0]
+    xf = x.astype(jnp.float32)
+    x_sq = jnp.sum(xf * xf, axis=1)
+
+    if n <= tile:
+        dists = _dist_block(xf, y.astype(jnp.float32), x_sq,
+                            jnp.sum(y.astype(jnp.float32) ** 2, axis=1), sqrt)
+        return jnp.min(dists, axis=1), jnp.argmin(dists, axis=1).astype(jnp.int32)
+
+    n_tiles = -(-n // tile)
+    pad = n_tiles * tile - n
+    yp = jnp.pad(y.astype(jnp.float32), ((0, pad), (0, 0)))
+    y_blocks = yp.reshape(n_tiles, tile, d)
+    y_sq = jnp.sum(y_blocks * y_blocks, axis=2)
+    # mask out padded rows so they never win the argmin
+    valid = (jnp.arange(n_tiles * tile).reshape(n_tiles, tile) < n)
+
+    def step(carry, inp):
+        best_d, best_i = carry
+        yb, yb_sq, vmask, base = inp
+        dblk = _dist_block(xf, yb, x_sq, yb_sq, sqrt)
+        dblk = jnp.where(vmask[None, :], dblk, jnp.inf)
+        blk_min = jnp.min(dblk, axis=1)
+        blk_arg = jnp.argmin(dblk, axis=1).astype(jnp.int32) + base
+        take = blk_min < best_d
+        return (jnp.where(take, blk_min, best_d), jnp.where(take, blk_arg, best_i)), None
+
+    init = (jnp.full((m,), jnp.inf, jnp.float32), jnp.zeros((m,), jnp.int32))
+    bases = (jnp.arange(n_tiles) * tile).astype(jnp.int32)
+    (best_d, best_i), _ = lax.scan(step, init, (y_blocks, y_sq, valid, bases))
+    return best_d, best_i
+
+
+def masked_l2_nn_argmin(
+    x: jax.Array,
+    y: jax.Array,
+    adj: jax.Array,
+    group_idx: Optional[jax.Array] = None,
+    sqrt: bool = False,
+) -> Tuple[jax.Array, jax.Array]:
+    """Masked L2 argmin (reference: distance/masked_nn.cuh).
+
+    ``adj`` is a [m, n_groups] boolean adjacency: row i may only match
+    columns whose group is admitted. ``group_idx`` maps each y row to its
+    group (default: one group per y row, i.e. adj is [m, n]).
+    """
+    dists = _dist_block(
+        x.astype(jnp.float32), y.astype(jnp.float32),
+        jnp.sum(x.astype(jnp.float32) ** 2, 1), jnp.sum(y.astype(jnp.float32) ** 2, 1),
+        sqrt)
+    if group_idx is not None:
+        col_mask = jnp.take(adj, group_idx, axis=1)  # [m, n]
+    else:
+        col_mask = adj
+    dists = jnp.where(col_mask, dists, jnp.inf)
+    return jnp.min(dists, axis=1), jnp.argmin(dists, axis=1).astype(jnp.int32)

@@ -1,0 +1,60 @@
+"""Gram / kernel matrices (SVM-style kernels).
+
+TPU-native counterpart of the reference's Gram kernel layer
+(distance/kernels.cuh, detail/kernels/{gram_matrix,kernel_factory}.cuh):
+linear, polynomial, RBF, and tanh kernels over row-major data. All are a
+single MXU Gram matmul plus elementwise epilogue — XLA fuses the epilogue.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import enum
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+class KernelType(enum.Enum):
+    LINEAR = "linear"
+    POLYNOMIAL = "polynomial"
+    RBF = "rbf"
+    TANH = "tanh"
+
+
+@dataclasses.dataclass
+class KernelParams:
+    """Reference: ``raft::distance::kernels::KernelParams``."""
+
+    kernel: KernelType = KernelType.LINEAR
+    degree: int = 3
+    gamma: float = 1.0
+    coef0: float = 0.0
+
+
+from raft_tpu.utils.precision import get_precision
+
+
+def _gram(x, y):
+    return lax.dot_general(x, y, (((1,), (1,)), ((), ())),
+                           precision=get_precision(),
+                           preferred_element_type=jnp.float32)
+
+
+def gram_matrix(x: jax.Array, y: jax.Array, params: KernelParams) -> jax.Array:
+    """Evaluate the kernel Gram matrix K[i,j] = k(x_i, y_j)
+    (reference: detail/kernels/gram_matrix.cuh ``evaluate``)."""
+    k = _gram(x, y)
+    if params.kernel == KernelType.LINEAR:
+        return k
+    if params.kernel == KernelType.POLYNOMIAL:
+        return (params.gamma * k + params.coef0) ** params.degree
+    if params.kernel == KernelType.TANH:
+        return jnp.tanh(params.gamma * k + params.coef0)
+    if params.kernel == KernelType.RBF:
+        xs = jnp.sum(x.astype(jnp.float32) ** 2, 1)
+        ys = jnp.sum(y.astype(jnp.float32) ** 2, 1)
+        d2 = jnp.maximum(xs[:, None] + ys[None, :] - 2.0 * k, 0.0)
+        return jnp.exp(-params.gamma * d2)
+    raise ValueError(f"unknown kernel {params.kernel}")

@@ -1,0 +1,274 @@
+"""Pairwise distances — expanded (MXU) and generic tiled (VPU) engines.
+
+TPU-native counterpart of the reference's distance layer
+(distance/distance-inl.cuh:67 ``distance()``, :238 ``pairwise_distance()``;
+per-metric ops distance/detail/distance_ops/*.cuh; tiled engine
+distance/detail/pairwise_matrix/). Design mapping:
+
+- *expanded* metrics (L2/cosine/IP/correlation/hellinger/jaccard/dice/
+  russelrao) decompose into one ``dot_general`` Gram matrix plus a cheap
+  norm epilogue → pure XLA, runs on the MXU, fused by the compiler. This
+  replaces the reference's CUTLASS sm80 path.
+- *unexpanded* metrics (L1/Linf/Canberra/Lp/BrayCurtis/JS/Hamming/KL) run
+  through a generic row-tiled engine: per-element ``core`` accumulated over
+  the feature axis, mirroring the reference's distance_ops functor design
+  (pairwise_matrix/kernel_sm60.cuh) with XLA doing the tiling/fusion.
+
+Row tiling bounds peak memory exactly like the reference's tile-size
+heuristic (knn_brute_force.cuh:80) — tile count is computed at trace time
+from static shapes.
+"""
+
+from __future__ import annotations
+
+import math
+from functools import partial
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from raft_tpu.core.errors import expects
+from raft_tpu.distance.types import DistanceType, resolve_metric
+from raft_tpu.utils.precision import get_precision
+
+# Peak elements per broadcast block in the generic engine (~256 MB f32).
+_GENERIC_BUDGET_ELEMS = 1 << 26
+
+
+# ---------------------------------------------------------------------------
+# expanded family: Gram matmul + epilogue (MXU path)
+# ---------------------------------------------------------------------------
+
+def _gram(x: jax.Array, y: jax.Array, precision=None) -> jax.Array:
+    return lax.dot_general(
+        x, y, (((1,), (1,)), ((), ())),
+        precision=get_precision(precision), preferred_element_type=jnp.float32,
+    )
+
+
+def _sq_norms(x: jax.Array) -> jax.Array:
+    return jnp.sum(x.astype(jnp.float32) ** 2, axis=1)
+
+
+def l2_expanded(x, y, sqrt: bool, precision=None):
+    """||x-y||² = ||x||² + ||y||² − 2⟨x,y⟩ (distance_ops/l2_exp.cuh)."""
+    d2 = _sq_norms(x)[:, None] + _sq_norms(y)[None, :] - 2.0 * _gram(x, y, precision)
+    d2 = jnp.maximum(d2, 0.0)
+    return jnp.sqrt(d2) if sqrt else d2
+
+
+def cosine_expanded(x, y, precision=None):
+    """1 − ⟨x,y⟩ / (‖x‖‖y‖) (distance_ops/cosine.cuh)."""
+    nx = jnp.sqrt(jnp.maximum(_sq_norms(x), 1e-30))
+    ny = jnp.sqrt(jnp.maximum(_sq_norms(y), 1e-30))
+    return 1.0 - _gram(x, y, precision) / (nx[:, None] * ny[None, :])
+
+
+def inner_product(x, y, precision=None):
+    """Raw inner product — a similarity; select with ``select_min=False``
+    (distance_ops/ip.cuh)."""
+    return _gram(x, y, precision)
+
+
+def correlation_expanded(x, y, precision=None):
+    """1 − Pearson correlation = cosine of row-centered data
+    (distance_ops/correlation.cuh)."""
+    xc = x - jnp.mean(x, axis=1, keepdims=True)
+    yc = y - jnp.mean(y, axis=1, keepdims=True)
+    return cosine_expanded(xc, yc, precision)
+
+
+def hellinger_expanded(x, y, precision=None):
+    """sqrt(1 − Σ √(xᵢyᵢ)) via the Gram of √x (distance_ops/hellinger.cuh)."""
+    g = _gram(jnp.sqrt(jnp.maximum(x, 0.0)), jnp.sqrt(jnp.maximum(y, 0.0)), precision)
+    return jnp.sqrt(jnp.maximum(1.0 - g, 0.0))
+
+
+def jaccard_expanded(x, y, precision=None):
+    """1 − |x∩y| / |x∪y| on non-zero supports (distance_ops/jaccard… via
+    binarized Gram)."""
+    xb = (x != 0).astype(jnp.float32)
+    yb = (y != 0).astype(jnp.float32)
+    inter = _gram(xb, yb, precision)
+    union = jnp.sum(xb, 1)[:, None] + jnp.sum(yb, 1)[None, :] - inter
+    return jnp.where(union > 0, 1.0 - inter / jnp.maximum(union, 1.0), 0.0)
+
+
+def dice_expanded(x, y, precision=None):
+    """1 − 2|x∩y| / (|x|+|y|) on non-zero supports (distance_ops/dice.cuh)."""
+    xb = (x != 0).astype(jnp.float32)
+    yb = (y != 0).astype(jnp.float32)
+    inter = _gram(xb, yb, precision)
+    denom = jnp.sum(xb, 1)[:, None] + jnp.sum(yb, 1)[None, :]
+    return jnp.where(denom > 0, 1.0 - 2.0 * inter / jnp.maximum(denom, 1.0), 0.0)
+
+
+def russelrao_expanded(x, y, precision=None):
+    """(d − Σ xᵢyᵢ) / d for binary data (distance_ops/russel_rao.cuh)."""
+    d = x.shape[1]
+    return (d - _gram(x, y, precision)) / d
+
+
+# ---------------------------------------------------------------------------
+# generic tiled engine (unexpanded metrics)
+# ---------------------------------------------------------------------------
+
+def _row_tile(m: int, n: int, d: int) -> int:
+    per_row = max(n * d, 1)
+    bm = max(1, _GENERIC_BUDGET_ELEMS // per_row)
+    bm = min(m, bm)
+    if bm >= 8:
+        bm -= bm % 8
+    return max(bm, 1)
+
+
+def _tiled_over_rows(x: jax.Array, y: jax.Array, block_fn) -> jax.Array:
+    """Apply block_fn(x_block[bm,d], y[n,d]) -> [bm,n] over row tiles of x,
+    bounding the broadcast intermediate (the reference's tiling heuristic,
+    knn_brute_force.cuh:80)."""
+    m, d = x.shape
+    n = y.shape[0]
+    bm = _row_tile(m, n, d)
+    n_tiles = -(-m // bm)
+    if n_tiles == 1:
+        return block_fn(x, y)
+    pad = n_tiles * bm - m
+    xp = jnp.pad(x, ((0, pad), (0, 0)))
+    blocks = xp.reshape(n_tiles, bm, d)
+    out = lax.map(lambda xb: block_fn(xb, y), blocks)
+    return out.reshape(n_tiles * bm, n)[:m]
+
+
+def _core_l1(a, b):
+    return jnp.sum(jnp.abs(a - b), axis=-1)
+
+
+def _core_l2(a, b):
+    diff = a - b
+    return jnp.sum(diff * diff, axis=-1)
+
+
+def _core_linf(a, b):
+    return jnp.max(jnp.abs(a - b), axis=-1)
+
+
+def _core_canberra(a, b):
+    num = jnp.abs(a - b)
+    den = jnp.abs(a) + jnp.abs(b)
+    return jnp.sum(jnp.where(den > 0, num / jnp.maximum(den, 1e-30), 0.0), axis=-1)
+
+
+def _core_lp(a, b, p):
+    return jnp.sum(jnp.abs(a - b) ** p, axis=-1) ** (1.0 / p)
+
+
+def _core_braycurtis(a, b):
+    num = jnp.sum(jnp.abs(a - b), axis=-1)
+    den = jnp.sum(jnp.abs(a + b), axis=-1)
+    return jnp.where(den > 0, num / jnp.maximum(den, 1e-30), 0.0)
+
+
+def _xlogx_over(p, q):
+    """p·log(p/q) with the 0·log0 → 0 convention."""
+    safe = (p > 0) & (q > 0)
+    return jnp.where(safe, p * jnp.log(jnp.maximum(p, 1e-30) / jnp.maximum(q, 1e-30)), 0.0)
+
+
+def _core_jensenshannon(a, b):
+    m = 0.5 * (a + b)
+    s = jnp.sum(_xlogx_over(a, m) + _xlogx_over(b, m), axis=-1)
+    return jnp.sqrt(jnp.maximum(0.5 * s, 0.0))
+
+
+def _core_hamming(a, b):
+    return jnp.mean((a != b).astype(jnp.float32), axis=-1)
+
+
+def _core_kl(a, b):
+    return jnp.sum(_xlogx_over(a, b), axis=-1)
+
+
+def _make_block(core):
+    def block_fn(xb, y):
+        return core(xb[:, None, :].astype(jnp.float32), y[None, :, :].astype(jnp.float32))
+    return block_fn
+
+
+def haversine(x, y):
+    """Great-circle distance on (lat, lon) radians pairs
+    (spatial/knn/detail/haversine_distance.cuh). Feature dim must be 2."""
+    expects(x.shape[1] == 2 and y.shape[1] == 2, "haversine requires 2-D points")
+    lat1, lon1 = x[:, 0][:, None], x[:, 1][:, None]
+    lat2, lon2 = y[:, 0][None, :], y[:, 1][None, :]
+    sdlat = jnp.sin(0.5 * (lat2 - lat1))
+    sdlon = jnp.sin(0.5 * (lon2 - lon1))
+    a = sdlat**2 + jnp.cos(lat1) * jnp.cos(lat2) * sdlon**2
+    return 2.0 * jnp.arcsin(jnp.sqrt(jnp.clip(a, 0.0, 1.0)))
+
+
+# ---------------------------------------------------------------------------
+# public API
+# ---------------------------------------------------------------------------
+
+def pairwise_distance(
+    x: jax.Array,
+    y: jax.Array,
+    metric="euclidean",
+    metric_arg: float = 2.0,
+    precision: Optional[str] = None,
+) -> jax.Array:
+    """All-pairs distance matrix [m, n] between rows of x [m,d] and y [n,d].
+
+    Counterpart of ``raft::distance::pairwise_distance``
+    (distance/distance-inl.cuh:238) with runtime metric dispatch. ``metric``
+    accepts a :class:`DistanceType` or a friendly alias ("euclidean",
+    "cosine", …). ``metric_arg`` is the Minkowski p for the "lp" metric.
+    """
+    mt = resolve_metric(metric)
+    expects(x.ndim == 2 and y.ndim == 2, "inputs must be 2-D [rows, features]")
+    expects(x.shape[1] == y.shape[1], "feature dims differ: %d vs %d", x.shape[1], y.shape[1])
+
+    if mt == DistanceType.L2Expanded:
+        return l2_expanded(x, y, sqrt=False, precision=precision)
+    if mt == DistanceType.L2SqrtExpanded:
+        return l2_expanded(x, y, sqrt=True, precision=precision)
+    if mt == DistanceType.CosineExpanded:
+        return cosine_expanded(x, y, precision)
+    if mt == DistanceType.InnerProduct:
+        return inner_product(x, y, precision)
+    if mt == DistanceType.CorrelationExpanded:
+        return correlation_expanded(x, y, precision)
+    if mt == DistanceType.HellingerExpanded:
+        return hellinger_expanded(x, y, precision)
+    if mt == DistanceType.JaccardExpanded:
+        return jaccard_expanded(x, y, precision)
+    if mt == DistanceType.DiceExpanded:
+        return dice_expanded(x, y, precision)
+    if mt == DistanceType.RusselRaoExpanded:
+        return russelrao_expanded(x, y, precision)
+    if mt == DistanceType.Haversine:
+        return haversine(x, y)
+    if mt == DistanceType.Precomputed:
+        raise ValueError("Precomputed is a marker metric; pass distances directly")
+
+    cores = {
+        DistanceType.L1: _core_l1,
+        DistanceType.L2Unexpanded: _core_l2,
+        DistanceType.L2SqrtUnexpanded: lambda a, b: jnp.sqrt(_core_l2(a, b)),
+        DistanceType.Linf: _core_linf,
+        DistanceType.Canberra: _core_canberra,
+        DistanceType.LpUnexpanded: partial(_core_lp, p=metric_arg),
+        DistanceType.BrayCurtis: _core_braycurtis,
+        DistanceType.JensenShannon: _core_jensenshannon,
+        DistanceType.HammingUnexpanded: _core_hamming,
+        DistanceType.KLDivergence: _core_kl,
+    }
+    return _tiled_over_rows(x, y, _make_block(cores[mt]))
+
+
+def distance(x, y, metric="euclidean", metric_arg: float = 2.0):
+    """Alias matching the reference's ``raft::distance::distance``
+    (distance/distance-inl.cuh:67)."""
+    return pairwise_distance(x, y, metric=metric, metric_arg=metric_arg)

@@ -1,0 +1,22 @@
+"""raft_tpu.matrix — select_k and matrix utilities.
+
+Counterpart of the reference's matrix layer (cpp/include/raft/matrix):
+``select_k`` plus argmax/argmin, gather/scatter, slice, norms, sort, etc.
+Most utilities are thin, named XLA surfaces — the point is API parity;
+XLA already emits optimal code for them.
+"""
+
+from raft_tpu.matrix.select_k import select_k, merge_parts  # noqa: F401
+from raft_tpu.matrix.ops import (  # noqa: F401
+    argmax,
+    argmin,
+    col_wise_sort,
+    gather,
+    linewise_op,
+    norm,
+    reverse,
+    scatter,
+    sign_flip,
+    slice_matrix,
+    triangular_upper,
+)

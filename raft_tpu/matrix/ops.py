@@ -1,0 +1,85 @@
+"""Matrix utilities (reference: cpp/include/raft/matrix/*.cuh).
+
+Thin named XLA surfaces over the reference's per-file matrix ops: argmax/
+argmin (matrix/argmax.cuh), gather/scatter (matrix/gather.cuh), col_wise_sort
+(matrix/col_wise_sort.cuh), linewise_op (matrix/linewise_op.cuh), slice
+(matrix/slice.cuh), norm (matrix/norm.cuh), reverse, sign_flip, triangular.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def argmax(m: jax.Array) -> jax.Array:
+    """Row-wise argmax (reference: matrix/argmax.cuh)."""
+    return jnp.argmax(m, axis=1)
+
+
+def argmin(m: jax.Array) -> jax.Array:
+    """Row-wise argmin (reference: matrix/argmin.cuh)."""
+    return jnp.argmin(m, axis=1)
+
+
+def gather(m: jax.Array, indices: jax.Array) -> jax.Array:
+    """Gather rows by index (reference: matrix/gather.cuh)."""
+    return jnp.take(m, indices, axis=0)
+
+
+def scatter(m: jax.Array, indices: jax.Array, rows: jax.Array) -> jax.Array:
+    """Scatter rows into a copy of ``m`` (reference: matrix/scatter.cuh —
+    value-semantic here)."""
+    return m.at[indices].set(rows)
+
+
+def col_wise_sort(m: jax.Array, ascending: bool = True) -> jax.Array:
+    """Sort each column (reference: matrix/col_wise_sort.cuh)."""
+    s = jnp.sort(m, axis=0)
+    return s if ascending else s[::-1]
+
+
+def slice_matrix(m: jax.Array, r0: int, c0: int, r1: int, c1: int) -> jax.Array:
+    """Sub-matrix [r0:r1, c0:c1] (reference: matrix/slice.cuh)."""
+    return m[r0:r1, c0:c1]
+
+
+def norm(m: jax.Array, norm_type: str = "l2", axis: int = 1) -> jax.Array:
+    """Row/col norms (reference: matrix/norm.cuh): "l1" | "l2" | "l2sqrt" | "linf"."""
+    if norm_type == "l1":
+        return jnp.sum(jnp.abs(m), axis=axis)
+    if norm_type == "l2":
+        return jnp.sum(m * m, axis=axis)
+    if norm_type == "l2sqrt":
+        return jnp.sqrt(jnp.sum(m * m, axis=axis))
+    if norm_type == "linf":
+        return jnp.max(jnp.abs(m), axis=axis)
+    raise ValueError(f"unknown norm type {norm_type!r}")
+
+
+def linewise_op(m: jax.Array, vec: jax.Array, op, along_rows: bool = True) -> jax.Array:
+    """Apply a binary op between each matrix line and a vector
+    (reference: matrix/linewise_op.cuh)."""
+    if along_rows:
+        return op(m, vec[None, :])
+    return op(m, vec[:, None])
+
+
+def reverse(m: jax.Array, axis: int = 0) -> jax.Array:
+    """Reverse along an axis (reference: matrix/reverse.cuh)."""
+    return jnp.flip(m, axis=axis)
+
+
+def sign_flip(m: jax.Array) -> jax.Array:
+    """Flip column signs so the max-|.| element of each column is positive
+    (reference: matrix/detail/math.cuh signFlip — deterministic eigenvector
+    orientation)."""
+    idx = jnp.argmax(jnp.abs(m), axis=0)
+    signs = jnp.sign(m[idx, jnp.arange(m.shape[1])])
+    signs = jnp.where(signs == 0, 1.0, signs)
+    return m * signs[None, :]
+
+
+def triangular_upper(m: jax.Array) -> jax.Array:
+    """Upper-triangular copy (reference: matrix/triangular.cuh)."""
+    return jnp.triu(m)

@@ -1,0 +1,7 @@
+"""raft_tpu.neighbors — ANN vector search indexes.
+
+Counterpart of the reference neighbors layer (cpp/include/raft/neighbors):
+brute-force, IVF-Flat, IVF-PQ, CAGRA, NN-Descent, refine, filtering.
+"""
+
+from raft_tpu.neighbors import brute_force  # noqa: F401

@@ -1,0 +1,172 @@
+"""Brute-force exact kNN — tiled over query×index to bound memory.
+
+TPU-native counterpart of ``raft::neighbors::brute_force``
+(neighbors/brute_force-inl.cuh:156 ``knn``; detail/knn_brute_force.cuh:58
+``tiled_brute_force_knn``, :320 ``brute_force_knn_impl``; index type with
+cached norms brute_force_types.hpp). Design mapping:
+
+- the reference's stream-pool parallelism over index chunks → one fused XLA
+  program: per-tile Gram matmul (MXU) + per-tile ``select_k`` + cross-tile
+  merge ``select_k``, scheduled by XLA;
+- the fused-L2 small-D fast path (fused_l2_knn-inl.cuh) → same scan-fused
+  shape, since XLA fuses distance epilogue into the matmul tile;
+- distributed (sharded-index) search lives in raft_tpu.parallel and merges
+  per-shard results with :func:`raft_tpu.matrix.merge_parts`.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from raft_tpu.core.errors import expects
+from raft_tpu.distance import pairwise_distance, resolve_metric, DistanceType, SELECT_MIN
+from raft_tpu.matrix import select_k as _select_k
+from raft_tpu.matrix.select_k import merge_parts
+from raft_tpu.utils.precision import get_precision
+
+# Max elements of one [query_tile, index_tile] distance block (~256 MB f32).
+_TILE_BUDGET_ELEMS = 1 << 26
+
+
+@dataclasses.dataclass
+class BruteForceIndex:
+    """Brute-force index: the dataset plus cached norms
+    (reference: brute_force_types.hpp ``brute_force::index``)."""
+
+    dataset: jax.Array          # [n, d]
+    norms: Optional[jax.Array]  # [n] cached squared L2 norms (L2/cosine only)
+    metric: DistanceType
+    metric_arg: float = 2.0
+
+    @property
+    def size(self) -> int:
+        return self.dataset.shape[0]
+
+    @property
+    def dim(self) -> int:
+        return self.dataset.shape[1]
+
+
+def build(dataset: jax.Array, metric="euclidean", metric_arg: float = 2.0) -> BruteForceIndex:
+    """Build a brute-force index (reference: brute_force::build).
+
+    Caches squared norms for expanded metrics so repeated searches skip
+    recomputing them (brute_force_types.hpp norms caching).
+    """
+    mt = resolve_metric(metric)
+    norms = None
+    if mt in (DistanceType.L2Expanded, DistanceType.L2SqrtExpanded,
+              DistanceType.CosineExpanded):
+        ds = dataset.astype(jnp.float32)
+        norms = jnp.sum(ds * ds, axis=1)
+    return BruteForceIndex(dataset=dataset, norms=norms, metric=mt, metric_arg=metric_arg)
+
+
+def _choose_tiles(m: int, n: int, d: int) -> Tuple[int, int]:
+    """Tile-size heuristic (reference: knn_brute_force.cuh:80): bound the
+    [qt, it] distance block; favor wide index tiles (longer MXU contractions
+    per select)."""
+    if m * n <= _TILE_BUDGET_ELEMS:
+        return m, n
+    it = min(n, max(1 << 14, _TILE_BUDGET_ELEMS // max(m, 1)))
+    qt = max(1, _TILE_BUDGET_ELEMS // it)
+    return min(m, qt), it
+
+
+def _expanded_block(q, db, q_sq, db_sq, metric):
+    g = lax.dot_general(q, db, (((1,), (1,)), ((), ())),
+                        precision=get_precision(),
+                        preferred_element_type=jnp.float32)
+    if metric == DistanceType.InnerProduct:
+        return g
+    if metric == DistanceType.CosineExpanded:
+        nq = jnp.sqrt(jnp.maximum(q_sq, 1e-30))
+        nd = jnp.sqrt(jnp.maximum(db_sq, 1e-30))
+        return 1.0 - g / (nq[:, None] * nd[None, :])
+    d2 = jnp.maximum(q_sq[:, None] + db_sq[None, :] - 2.0 * g, 0.0)
+    if metric == DistanceType.L2SqrtExpanded:
+        return jnp.sqrt(d2)
+    return d2
+
+
+def knn(
+    index: BruteForceIndex,
+    queries: jax.Array,
+    k: int,
+) -> Tuple[jax.Array, jax.Array]:
+    """Exact k nearest neighbors (reference: brute_force::knn,
+    brute_force-inl.cuh:156). Returns (distances [m,k], indices [m,k])."""
+    expects(queries.ndim == 2, "queries must be [m, d]")
+    expects(queries.shape[1] == index.dim, "query dim %d != index dim %d",
+            queries.shape[1], index.dim)
+    m, d = queries.shape
+    n = index.size
+    expects(k <= n, "k=%d > index size %d", k, n)
+    mt = index.metric
+    select_min = SELECT_MIN[mt]
+
+    fast = mt in (DistanceType.L2Expanded, DistanceType.L2SqrtExpanded,
+                  DistanceType.CosineExpanded, DistanceType.InnerProduct)
+
+    qt, it = _choose_tiles(m, n, d)
+
+    if fast:
+        q = queries.astype(jnp.float32)
+        q_sq = jnp.sum(q * q, axis=1)
+        db = index.dataset.astype(jnp.float32)
+        db_sq = index.norms if index.norms is not None else jnp.sum(db * db, axis=1)
+
+        if it >= n:
+            dists = _expanded_block(q, db, q_sq, db_sq, mt)
+            return _select_k(dists, k, select_min=select_min)
+
+        # scan over index tiles with a running top-k merge — never holds the
+        # full [m, n] matrix (tiled_brute_force_knn:234-276).
+        n_tiles = -(-n // it)
+        pad = n_tiles * it - n
+        pad_val = jnp.inf if select_min else -jnp.inf
+        dbp = jnp.pad(db, ((0, pad), (0, 0)))
+        dbp_sq = jnp.pad(db_sq, (0, pad), constant_values=pad_val)
+        db_blocks = dbp.reshape(n_tiles, it, d)
+        sq_blocks = dbp_sq.reshape(n_tiles, it)
+        kk = min(k, it)
+
+        def step(carry, inp):
+            best_v, best_i = carry
+            db_blk, sq_blk, base = inp
+            dists = _expanded_block(q, db_blk, q_sq, sq_blk, mt)
+            tv, ti = _select_k(dists, kk, select_min=select_min)
+            ti = ti.astype(jnp.int32) + base
+            cat_v = jnp.concatenate([best_v, tv], axis=1)
+            cat_i = jnp.concatenate([best_i, ti], axis=1)
+            nv, pos = _select_k(cat_v, k, select_min=select_min)
+            ni = jnp.take_along_axis(cat_i, pos, axis=1)
+            return (nv, ni), None
+
+        init_v = jnp.full((m, k), pad_val, jnp.float32)
+        init_i = jnp.zeros((m, k), jnp.int32)
+        bases = (jnp.arange(n_tiles) * it).astype(jnp.int32)
+        (vals, idx), _ = lax.scan(step, (init_v, init_i), (db_blocks, sq_blocks, bases))
+        return vals, idx
+
+    # general metrics: full pairwise (row-tiled internally) + select
+    dists = pairwise_distance(queries, index.dataset, metric=mt,
+                              metric_arg=index.metric_arg)
+    return _select_k(dists, k, select_min=select_min)
+
+
+def knn_arrays(
+    dataset: jax.Array,
+    queries: jax.Array,
+    k: int,
+    metric="euclidean",
+    metric_arg: float = 2.0,
+) -> Tuple[jax.Array, jax.Array]:
+    """One-shot build+search convenience (mirrors pylibraft's functional
+    ``brute_force.knn``)."""
+    return knn(build(dataset, metric=metric, metric_arg=metric_arg), queries, k)

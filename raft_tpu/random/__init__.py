@@ -1,0 +1,30 @@
+"""raft_tpu.random — counter-based RNG surface + data generators.
+
+Counterpart of the reference random layer (cpp/include/raft/random):
+the reference's Philox/PCG engines with seed+subsequence
+(random/rng_state.hpp:29) map onto JAX's native counter-based threefry
+keys — the same reproducible-stateless philosophy, provided by the
+platform instead of hand-rolled kernels.
+"""
+
+from raft_tpu.random.rng import (  # noqa: F401
+    RngState,
+    bernoulli,
+    cauchy,
+    exponential,
+    gumbel,
+    laplace,
+    lognormal,
+    normal,
+    rayleigh,
+    uniform,
+    uniform_int,
+)
+from raft_tpu.random.generators import (  # noqa: F401
+    make_blobs,
+    make_regression,
+    permute,
+    rmat_rectangular,
+    sample_without_replacement,
+    subsample,
+)

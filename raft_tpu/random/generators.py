@@ -1,0 +1,136 @@
+"""Data generators (reference: random/make_blobs.cuh, make_regression.cuh,
+rmat_rectangular_generator.cuh, permute.cuh, sample_without_replacement.cuh).
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from raft_tpu.random.rng import RngState, _as_key
+
+
+def make_blobs(
+    n_samples: int,
+    n_features: int,
+    n_clusters: int = 3,
+    cluster_std: float = 1.0,
+    center_box: Tuple[float, float] = (-10.0, 10.0),
+    centers: Optional[jax.Array] = None,
+    shuffle: bool = True,
+    state: RngState | jax.Array = RngState(0),
+    dtype=jnp.float32,
+) -> Tuple[jax.Array, jax.Array]:
+    """Clustered isotropic gaussians (reference: random/make_blobs.cuh).
+
+    Returns (X [n_samples, n_features], labels [n_samples]).
+    """
+    key = _as_key(state)
+    k_centers, k_labels, k_noise, k_perm = jax.random.split(key, 4)
+    if centers is None:
+        centers = jax.random.uniform(
+            k_centers, (n_clusters, n_features), dtype,
+            center_box[0], center_box[1])
+    else:
+        n_clusters = centers.shape[0]
+    labels = jax.random.randint(k_labels, (n_samples,), 0, n_clusters)
+    noise = cluster_std * jax.random.normal(k_noise, (n_samples, n_features), dtype)
+    x = jnp.take(centers, labels, axis=0) + noise
+    if shuffle:
+        perm = jax.random.permutation(k_perm, n_samples)
+        x, labels = x[perm], labels[perm]
+    return x, labels.astype(jnp.int32)
+
+
+def make_regression(
+    n_samples: int,
+    n_features: int,
+    n_informative: Optional[int] = None,
+    n_targets: int = 1,
+    bias: float = 0.0,
+    noise: float = 0.0,
+    state: RngState | jax.Array = RngState(0),
+    dtype=jnp.float32,
+) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    """Linear-model regression data (reference: random/make_regression.cuh).
+
+    Returns (X, y, coef)."""
+    if n_informative is None:
+        n_informative = n_features
+    key = _as_key(state)
+    kx, kw, kn = jax.random.split(key, 3)
+    x = jax.random.normal(kx, (n_samples, n_features), dtype)
+    w = jnp.zeros((n_features, n_targets), dtype)
+    w = w.at[:n_informative].set(
+        100.0 * jax.random.uniform(kw, (n_informative, n_targets), dtype))
+    y = x @ w + bias
+    if noise > 0:
+        y = y + noise * jax.random.normal(kn, y.shape, dtype)
+    return x, y, w
+
+
+def rmat_rectangular(
+    state: RngState | jax.Array,
+    n_edges: int,
+    r_scale: int,
+    c_scale: int,
+    theta: Tuple[float, float, float, float] = (0.57, 0.19, 0.19, 0.05),
+) -> jax.Array:
+    """RMAT graph edge generator (reference:
+    random/rmat_rectangular_generator.cuh). Returns [n_edges, 2] int32
+    (src, dst) with src < 2^r_scale, dst < 2^c_scale."""
+    key = _as_key(state)
+    a, b, c, d = theta
+    scale = max(r_scale, c_scale)
+    # per-level quadrant draws: one uniform per (edge, level)
+    u = jax.random.uniform(key, (n_edges, scale))
+    p_top = a + b          # probability of top half (row bit = 0)
+    p_left_top = a / (a + b)
+    p_left_bot = c / (c + d)
+    row_bit = (u >= p_top).astype(jnp.int32)
+    # second draw per level for the column bit
+    u2 = jax.random.uniform(jax.random.fold_in(key, 1), (n_edges, scale))
+    p_left = jnp.where(row_bit == 0, p_left_top, p_left_bot)
+    col_bit = (u2 >= p_left).astype(jnp.int32)
+    levels = jnp.arange(scale)
+    src = jnp.sum(jnp.where(levels < r_scale, row_bit << levels, 0), axis=1)
+    dst = jnp.sum(jnp.where(levels < c_scale, col_bit << levels, 0), axis=1)
+    return jnp.stack([src, dst], axis=1).astype(jnp.int32)
+
+
+def permute(x: jax.Array, state: RngState | jax.Array = RngState(0)) -> jax.Array:
+    """Random row permutation (reference: random/permute.cuh)."""
+    perm = jax.random.permutation(_as_key(state), x.shape[0])
+    return jnp.take(x, perm, axis=0)
+
+
+def sample_without_replacement(
+    state: RngState | jax.Array,
+    items: jax.Array,
+    n_samples: int,
+    weights: Optional[jax.Array] = None,
+) -> jax.Array:
+    """Weighted sampling without replacement via Gumbel top-k
+    (reference: random/sample_without_replacement.cuh)."""
+    key = _as_key(state)
+    n = items.shape[0]
+    g = jax.random.gumbel(key, (n,))
+    if weights is not None:
+        g = g + jnp.log(jnp.maximum(weights, 1e-30))
+    _, idx = jax.lax.top_k(g, n_samples)
+    return jnp.take(items, idx, axis=0)
+
+
+def subsample(
+    state: RngState | jax.Array,
+    n_rows: int,
+    n_samples: int,
+) -> jax.Array:
+    """Uniform row-index subsample without replacement
+    (reference: random/subsample — used by IVF trainset selection)."""
+    key = _as_key(state)
+    g = jax.random.gumbel(key, (n_rows,))
+    _, idx = jax.lax.top_k(g, n_samples)
+    return jnp.sort(idx)

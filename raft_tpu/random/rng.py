@@ -1,0 +1,75 @@
+"""RNG engines + distributions (reference: random/rng.cuh, rng_state.hpp).
+
+``RngState`` mirrors the reference's seed+subsequence state
+(random/rng_state.hpp:29); each draw derives a fresh fold of the key so
+sequences are reproducible and order-independent — the counter-based
+design the reference approximates with Philox, native to JAX.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+
+
+@dataclasses.dataclass
+class RngState:
+    """Reproducible RNG state (reference: random/rng_state.hpp:29)."""
+
+    seed: int = 0
+    subsequence: int = 0
+
+    def key(self) -> jax.Array:
+        return jax.random.fold_in(jax.random.PRNGKey(self.seed), self.subsequence)
+
+    def advance(self, n: int = 1) -> "RngState":
+        return RngState(self.seed, self.subsequence + n)
+
+
+def _as_key(state) -> jax.Array:
+    if isinstance(state, RngState):
+        return state.key()
+    return state  # already a PRNG key
+
+
+def uniform(state, shape, lo=0.0, hi=1.0, dtype=jnp.float32):
+    return jax.random.uniform(_as_key(state), shape, dtype, lo, hi)
+
+
+def uniform_int(state, shape, lo, hi, dtype=jnp.int32):
+    return jax.random.randint(_as_key(state), shape, lo, hi, dtype)
+
+
+def normal(state, shape, mu=0.0, sigma=1.0, dtype=jnp.float32):
+    return mu + sigma * jax.random.normal(_as_key(state), shape, dtype)
+
+
+def lognormal(state, shape, mu=0.0, sigma=1.0, dtype=jnp.float32):
+    return jnp.exp(normal(state, shape, mu, sigma, dtype))
+
+
+def gumbel(state, shape, mu=0.0, beta=1.0, dtype=jnp.float32):
+    return mu + beta * jax.random.gumbel(_as_key(state), shape, dtype)
+
+
+def laplace(state, shape, mu=0.0, scale=1.0, dtype=jnp.float32):
+    return mu + scale * jax.random.laplace(_as_key(state), shape, dtype)
+
+
+def exponential(state, shape, lam=1.0, dtype=jnp.float32):
+    return jax.random.exponential(_as_key(state), shape, dtype) / lam
+
+
+def rayleigh(state, shape, sigma=1.0, dtype=jnp.float32):
+    u = jax.random.uniform(_as_key(state), shape, dtype, 1e-7, 1.0)
+    return sigma * jnp.sqrt(-2.0 * jnp.log(u))
+
+
+def cauchy(state, shape, mu=0.0, scale=1.0, dtype=jnp.float32):
+    return mu + scale * jax.random.cauchy(_as_key(state), shape, dtype)
+
+
+def bernoulli(state, shape, p=0.5):
+    return jax.random.bernoulli(_as_key(state), p, shape)

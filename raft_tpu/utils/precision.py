@@ -1,0 +1,47 @@
+"""Matmul precision policy.
+
+The reference computes distances in fp32 via cuBLAS/CUTLASS; the TPU MXU
+defaults to bfloat16 passes, which costs ~1% relative error on distances.
+raft_tpu defaults every distance/Gram contraction to HIGHEST (fp32-accurate
+via multi-pass bf16) to preserve the reference's recall semantics, and lets
+perf-sensitive callers opt down to "default" (single-pass bf16) where
+approximate distances are acceptable (e.g. coarse IVF probing).
+"""
+
+from __future__ import annotations
+
+import contextlib
+
+from jax import lax
+
+_DEFAULT = lax.Precision.HIGHEST
+
+
+def get_precision(override=None):
+    """Resolve a precision argument: None → global default."""
+    if override is None:
+        return _DEFAULT
+    if isinstance(override, str):
+        return {
+            "default": lax.Precision.DEFAULT,
+            "high": lax.Precision.HIGH,
+            "highest": lax.Precision.HIGHEST,
+        }[override]
+    return override
+
+
+def set_default_precision(precision) -> None:
+    global _DEFAULT
+    _DEFAULT = get_precision(precision)
+
+
+@contextlib.contextmanager
+def precision_scope(precision):
+    """Temporarily change the global matmul precision."""
+    global _DEFAULT
+    old = _DEFAULT
+    _DEFAULT = get_precision(precision)
+    try:
+        yield
+    finally:
+        _DEFAULT = old

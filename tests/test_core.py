@@ -1,0 +1,101 @@
+"""Core layer tests (reference test model: cpp/test/core/)."""
+
+import os
+import tempfile
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from raft_tpu.core import (
+    DeviceResources,
+    LogicError,
+    Resources,
+    bitset,
+    expects,
+    get_device_resources,
+    serialize,
+)
+
+
+class TestResources:
+    def test_factory_lazy(self):
+        r = Resources()
+        calls = []
+        r.add_resource_factory("x", lambda: calls.append(1) or 42)
+        assert calls == []
+        assert r.get_resource("x") == 42
+        assert r.get_resource("x") == 42
+        assert calls == [1]
+
+    def test_missing_factory_raises(self):
+        r = Resources()
+        with pytest.raises(LogicError):
+            r.get_resource("nope")
+
+    def test_device_resources_rng(self):
+        h = DeviceResources(seed=7)
+        k1 = h.next_rng_key()
+        k2 = h.next_rng_key()
+        assert not np.array_equal(np.asarray(k1), np.asarray(k2))
+
+    def test_handle_pool(self):
+        h1 = get_device_resources()
+        h2 = get_device_resources()
+        assert h1 is h2
+
+    def test_expects(self):
+        expects(True, "fine")
+        with pytest.raises(LogicError, match="bad thing 3"):
+            expects(False, "bad thing %d", 3)
+
+
+class TestBitset:
+    def test_roundtrip(self, rng):
+        mask = rng.random(100) < 0.5
+        bits = bitset.from_mask(jnp.asarray(mask))
+        out = np.asarray(bitset.to_mask(bits, 100))
+        np.testing.assert_array_equal(out, mask)
+
+    def test_set_test_flip_count(self):
+        bits = bitset.create(70, default_value=False)
+        bits = bitset.set_bits(bits, jnp.array([0, 33, 69]))
+        assert bool(bitset.test(bits, 33))
+        assert not bool(bitset.test(bits, 34))
+        assert int(bitset.count(bits, 70)) == 3
+        flipped = bitset.flip(bits)
+        assert int(bitset.count(flipped, 70)) == 67
+
+
+class TestSerialize:
+    def test_scalar_roundtrip(self, tmp_path):
+        import io
+
+        buf = io.BytesIO()
+        for v in [True, 17, 3.5, "hello"]:
+            serialize.serialize_scalar(buf, v)
+        buf.seek(0)
+        assert serialize.deserialize_scalar(buf) is True
+        assert serialize.deserialize_scalar(buf) == 17
+        assert serialize.deserialize_scalar(buf) == 3.5
+        assert serialize.deserialize_scalar(buf) == "hello"
+
+    def test_container_roundtrip(self, tmp_path, rng):
+        path = os.path.join(tmp_path, "idx.bin")
+        arrays = {
+            "data": jnp.asarray(rng.random((10, 4), dtype=np.float32)),
+            "ids": jnp.arange(10, dtype=jnp.int32),
+        }
+        serialize.save_arrays(path, "test_index", 3, {"metric": "l2"}, arrays)
+        version, meta, loaded = serialize.load_arrays(path, "test_index")
+        assert version == 3
+        assert meta == {"metric": "l2"}
+        np.testing.assert_allclose(loaded["data"], np.asarray(arrays["data"]))
+        np.testing.assert_array_equal(loaded["ids"], np.arange(10))
+
+    def test_kind_mismatch(self, tmp_path):
+        path = os.path.join(tmp_path, "idx.bin")
+        serialize.save_arrays(path, "a", 1, {}, {})
+        with pytest.raises(ValueError, match="expected"):
+            serialize.load_arrays(path, "b")

@@ -1,0 +1,185 @@
+"""Pairwise distance correctness vs scipy (reference test model:
+cpp/test/distance/ — device kernels vs naive host loops; pylibraft
+test_distance.py validates vs scipy.spatial.distance.cdist)."""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from scipy.spatial import distance as spd
+from scipy.spatial.distance import cdist
+
+from raft_tpu.distance import (
+    DistanceType,
+    fused_l2_nn_argmin,
+    masked_l2_nn_argmin,
+    gram_matrix,
+    KernelParams,
+    KernelType,
+    pairwise_distance,
+)
+
+M, N, D = 33, 47, 19
+
+
+def _data(rng, positive=False, binary=False):
+    x = rng.random((M, D), dtype=np.float32)
+    y = rng.random((N, D), dtype=np.float32)
+    if binary:
+        return (x > 0.5).astype(np.float32), (y > 0.5).astype(np.float32)
+    if positive:
+        x /= x.sum(1, keepdims=True)
+        y /= y.sum(1, keepdims=True)
+    return x, y
+
+
+SCIPY_METRICS = [
+    ("euclidean", "euclidean", {}),
+    ("sqeuclidean", "sqeuclidean", {}),
+    ("cityblock", "cityblock", {}),
+    ("chebyshev", "chebyshev", {}),
+    ("canberra", "canberra", {}),
+    ("cosine", "cosine", {}),
+    ("correlation", "correlation", {}),
+    ("braycurtis", "braycurtis", {}),
+    ("minkowski", "minkowski", {"p": 3.0}),
+]
+
+
+@pytest.mark.parametrize("ours,scipy_name,kw", SCIPY_METRICS)
+def test_vs_scipy(rng, ours, scipy_name, kw):
+    x, y = _data(rng)
+    got = np.asarray(pairwise_distance(jnp.asarray(x), jnp.asarray(y),
+                                       metric=ours, metric_arg=kw.get("p", 2.0)))
+    ref = cdist(x, y, metric=scipy_name, **kw)
+    np.testing.assert_allclose(got, ref, rtol=2e-4, atol=2e-5)
+
+
+def test_inner_product(rng):
+    x, y = _data(rng)
+    got = np.asarray(pairwise_distance(jnp.asarray(x), jnp.asarray(y),
+                                       metric="inner_product"))
+    np.testing.assert_allclose(got, x @ y.T, rtol=1e-5)
+
+
+def test_hellinger(rng):
+    x, y = _data(rng, positive=True)
+    got = np.asarray(pairwise_distance(jnp.asarray(x), jnp.asarray(y),
+                                       metric="hellinger"))
+    ref = np.sqrt(np.maximum(1.0 - np.sqrt(x)[:, None, :] @ np.sqrt(y)[None].transpose(0, 2, 1), 0)).squeeze()
+    ref = np.sqrt(np.maximum(1.0 - np.einsum("id,jd->ij", np.sqrt(x), np.sqrt(y)), 0))
+    np.testing.assert_allclose(got, ref, rtol=1e-4, atol=1e-5)
+
+
+def test_jensenshannon(rng):
+    x, y = _data(rng, positive=True)
+    got = np.asarray(pairwise_distance(jnp.asarray(x), jnp.asarray(y),
+                                       metric="jensenshannon"))
+    ref = cdist(x, y, metric="jensenshannon")
+    np.testing.assert_allclose(got, ref, rtol=1e-3, atol=1e-5)
+
+
+def test_kl_divergence(rng):
+    x, y = _data(rng, positive=True)
+    got = np.asarray(pairwise_distance(jnp.asarray(x), jnp.asarray(y),
+                                       metric="kl_divergence"))
+    ref = np.einsum("ijd->ij", x[:, None, :] * np.log(x[:, None, :] / y[None, :, :]))
+    np.testing.assert_allclose(got, ref, rtol=1e-4, atol=1e-5)
+
+
+def test_hamming(rng):
+    x, y = _data(rng, binary=True)
+    got = np.asarray(pairwise_distance(jnp.asarray(x), jnp.asarray(y),
+                                       metric="hamming"))
+    ref = cdist(x, y, metric="hamming")
+    np.testing.assert_allclose(got, ref, rtol=1e-5, atol=1e-6)
+
+
+def test_jaccard_dice_russelrao(rng):
+    x, y = _data(rng, binary=True)
+    for ours, scipy_name in [("jaccard", "jaccard"), ("dice", "dice"),
+                             ("russelrao", "russellrao")]:
+        got = np.asarray(pairwise_distance(jnp.asarray(x), jnp.asarray(y),
+                                           metric=ours))
+        ref = cdist(x.astype(bool), y.astype(bool), metric=scipy_name)
+        np.testing.assert_allclose(got, ref, rtol=1e-4, atol=1e-5,
+                                   err_msg=ours)
+
+
+def test_haversine(rng):
+    x = (rng.random((10, 2)).astype(np.float32) - 0.5) * np.array([np.pi, 2 * np.pi], np.float32)
+    y = (rng.random((8, 2)).astype(np.float32) - 0.5) * np.array([np.pi, 2 * np.pi], np.float32)
+    got = np.asarray(pairwise_distance(jnp.asarray(x), jnp.asarray(y),
+                                       metric="haversine"))
+
+    def hav(a, b):
+        sdlat = np.sin(0.5 * (b[0] - a[0]))
+        sdlon = np.sin(0.5 * (b[1] - a[1]))
+        return 2 * np.arcsin(np.sqrt(sdlat**2 + np.cos(a[0]) * np.cos(b[0]) * sdlon**2))
+
+    ref = np.array([[hav(a, b) for b in y] for a in x])
+    np.testing.assert_allclose(got, ref, rtol=1e-4, atol=1e-5)
+
+
+def test_l2_unexpanded_matches_expanded(rng):
+    x, y = _data(rng)
+    e = np.asarray(pairwise_distance(jnp.asarray(x), jnp.asarray(y), metric="sqeuclidean"))
+    u = np.asarray(pairwise_distance(jnp.asarray(x), jnp.asarray(y), metric="l2_unexpanded"))
+    np.testing.assert_allclose(e, u, rtol=1e-4, atol=1e-5)
+
+
+class TestFusedL2NN:
+    def test_matches_naive(self, rng):
+        x, y = _data(rng)
+        d, i = fused_l2_nn_argmin(jnp.asarray(x), jnp.asarray(y))
+        full = cdist(x, y, "sqeuclidean")
+        np.testing.assert_array_equal(np.asarray(i), full.argmin(1))
+        np.testing.assert_allclose(np.asarray(d), full.min(1), rtol=1e-4, atol=1e-5)
+
+    def test_tiled_path(self, rng):
+        x = rng.random((20, 8), dtype=np.float32)
+        y = rng.random((1000, 8), dtype=np.float32)
+        d, i = fused_l2_nn_argmin(jnp.asarray(x), jnp.asarray(y), tile=128)
+        full = cdist(x, y, "sqeuclidean")
+        np.testing.assert_array_equal(np.asarray(i), full.argmin(1))
+        np.testing.assert_allclose(np.asarray(d), full.min(1), rtol=1e-4, atol=1e-5)
+
+    def test_sqrt(self, rng):
+        x, y = _data(rng)
+        d, _ = fused_l2_nn_argmin(jnp.asarray(x), jnp.asarray(y), sqrt=True)
+        full = cdist(x, y, "euclidean")
+        np.testing.assert_allclose(np.asarray(d), full.min(1), rtol=1e-4, atol=1e-5)
+
+
+def test_masked_l2_nn(rng):
+    x, y = _data(rng)
+    adj = rng.random((M, N)) < 0.3
+    adj[:, 0] = True  # every row has at least one admitted column
+    d, i = masked_l2_nn_argmin(jnp.asarray(x), jnp.asarray(y), jnp.asarray(adj))
+    full = cdist(x, y, "sqeuclidean")
+    full[~adj] = np.inf
+    np.testing.assert_array_equal(np.asarray(i), full.argmin(1))
+
+
+class TestGram:
+    def test_linear(self, rng):
+        x, y = _data(rng)
+        got = np.asarray(gram_matrix(jnp.asarray(x), jnp.asarray(y),
+                                     KernelParams(KernelType.LINEAR)))
+        np.testing.assert_allclose(got, x @ y.T, rtol=1e-5)
+
+    def test_rbf(self, rng):
+        x, y = _data(rng)
+        gamma = 0.5
+        got = np.asarray(gram_matrix(jnp.asarray(x), jnp.asarray(y),
+                                     KernelParams(KernelType.RBF, gamma=gamma)))
+        ref = np.exp(-gamma * cdist(x, y, "sqeuclidean"))
+        np.testing.assert_allclose(got, ref, rtol=1e-4, atol=1e-5)
+
+    def test_poly_tanh(self, rng):
+        x, y = _data(rng)
+        p = KernelParams(KernelType.POLYNOMIAL, degree=2, gamma=0.1, coef0=1.0)
+        got = np.asarray(gram_matrix(jnp.asarray(x), jnp.asarray(y), p))
+        np.testing.assert_allclose(got, (0.1 * (x @ y.T) + 1.0) ** 2, rtol=1e-4)
+        p = KernelParams(KernelType.TANH, gamma=0.1, coef0=0.5)
+        got = np.asarray(gram_matrix(jnp.asarray(x), jnp.asarray(y), p))
+        np.testing.assert_allclose(got, np.tanh(0.1 * (x @ y.T) + 0.5), rtol=1e-4)

@@ -1,0 +1,78 @@
+"""select_k correctness vs reference sort (reference test model:
+cpp/test/matrix/select_k.cu — compare against a host sort)."""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from raft_tpu.matrix import select_k, merge_parts
+
+
+def _ref_select(scores, k, select_min):
+    order = np.argsort(scores, axis=1, kind="stable")
+    if not select_min:
+        order = order[:, ::-1]
+    idx = order[:, :k]
+    vals = np.take_along_axis(scores, idx, axis=1)
+    return vals, idx
+
+
+@pytest.mark.parametrize("batch,length,k", [(1, 10, 3), (7, 100, 10),
+                                            (16, 1000, 32), (3, 257, 257)])
+@pytest.mark.parametrize("select_min", [True, False])
+def test_select_k_matches_sort(rng, batch, length, k, select_min):
+    scores = rng.random((batch, length), dtype=np.float32)
+    vals, idx = select_k(jnp.asarray(scores), k, select_min=select_min)
+    ref_vals, _ = _ref_select(scores, k, select_min)
+    np.testing.assert_allclose(np.sort(np.asarray(vals), 1),
+                               np.sort(ref_vals, 1), rtol=1e-6)
+    # returned indices must address the returned values
+    np.testing.assert_allclose(
+        np.take_along_axis(scores, np.asarray(idx), axis=1),
+        np.asarray(vals), rtol=1e-6)
+
+
+@pytest.mark.parametrize("length,tile", [(1000, 128), (513, 100), (2048, 2048)])
+def test_select_k_tiled_matches(rng, length, tile):
+    scores = rng.random((5, length), dtype=np.float32)
+    v1, i1 = select_k(jnp.asarray(scores), 17, len_tile=tile)
+    v2, i2 = select_k(jnp.asarray(scores), 17)
+    np.testing.assert_allclose(np.sort(np.asarray(v1), 1),
+                               np.sort(np.asarray(v2), 1), rtol=1e-6)
+
+
+def test_select_k_input_indices(rng):
+    scores = rng.random((4, 50), dtype=np.float32)
+    ids = rng.integers(0, 10_000, (4, 50))
+    vals, idx = select_k(jnp.asarray(scores), 5,
+                         input_indices=jnp.asarray(ids))
+    ref_vals, ref_pos = _ref_select(scores, 5, True)
+    np.testing.assert_allclose(np.sort(np.asarray(vals), 1),
+                               np.sort(ref_vals, 1), rtol=1e-6)
+    ref_ids = np.take_along_axis(ids, ref_pos, axis=1)
+    np.testing.assert_array_equal(np.sort(np.asarray(idx), 1),
+                                  np.sort(ref_ids, 1))
+
+
+def test_merge_parts(rng):
+    # simulate 3 shards each holding local top-4 with global ids
+    full = rng.random((2, 30), dtype=np.float32)
+    parts_v, parts_i = [], []
+    for s in range(3):
+        chunk = full[:, s * 10:(s + 1) * 10]
+        v, i = _ref_select(chunk, 4, True)
+        parts_v.append(v)
+        parts_i.append(i + s * 10)
+    pv = jnp.asarray(np.stack(parts_v))
+    pi = jnp.asarray(np.stack(parts_i))
+    vals, idx = merge_parts(pv, pi, k=5)
+    ref_vals, ref_idx = _ref_select(full, 5, True)
+    np.testing.assert_allclose(np.sort(np.asarray(vals), 1),
+                               np.sort(ref_vals, 1), rtol=1e-6)
+    np.testing.assert_array_equal(np.sort(np.asarray(idx), 1),
+                                  np.sort(ref_idx, 1))
+
+
+def test_k_too_large_raises(rng):
+    with pytest.raises(ValueError):
+        select_k(jnp.zeros((2, 5)), 6)
