@@ -1,0 +1,8 @@
+"""raft_tpu.cluster — kmeans, balanced kmeans, single-linkage HAC.
+
+Counterpart of the reference cluster layer (cpp/include/raft/cluster).
+"""
+
+from raft_tpu.cluster import kmeans, kmeans_balanced  # noqa: F401
+from raft_tpu.cluster.kmeans import KMeansParams  # noqa: F401
+from raft_tpu.cluster.kmeans_balanced import KMeansBalancedParams  # noqa: F401

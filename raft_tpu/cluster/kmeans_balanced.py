@@ -1,0 +1,193 @@
+"""Balanced (hierarchical) k-means — the trainer behind every IVF index.
+
+TPU-native counterpart of ``raft::cluster::kmeans_balanced``
+(cluster/kmeans_balanced.cuh:76 fit, detail/kmeans_balanced.cuh — 1097 LoC:
+mesocluster hierarchy :758, adjust_centers balancing). Same two-level
+design, TPU-shaped execution:
+
+1. fit ~√k *mesoclusters* with plain Lloyd;
+2. partition each mesocluster's rows into fine clusters (count ∝ meso
+   size), fitting per-meso Lloyd on padded, weight-masked row blocks
+   (static shapes per meso — the TPU version of the reference's
+   variable-size mesocluster kernels);
+3. finish with joint Lloyd sweeps over all fine centers, re-seeding
+   under-populated clusters from the fattest clusters' far points each
+   sweep (the reference's ``adjust_centers`` balancing pass).
+
+Balance matters doubly on TPU: IVF lists are padded blocks, so variance in
+list size is wasted HBM *and* wasted scan FLOPs.
+
+Supports metric="l2" and "cosine" (rows are L2-normalized first, as the
+reference does for spherical kmeans).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from functools import partial
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from raft_tpu.core.errors import expects
+from raft_tpu.distance.fused_l2_nn import fused_l2_nn_argmin
+from raft_tpu.cluster.kmeans import _update_centroids, init_random
+from raft_tpu.random.rng import RngState
+
+
+@dataclasses.dataclass
+class KMeansBalancedParams:
+    """reference: ``kmeans_balanced_params`` (cluster/kmeans_balanced_types.hpp)."""
+
+    n_iters: int = 20
+    metric: str = "l2"  # "l2" | "cosine"
+    seed: int = 0
+    mesocluster_factor: float = 1.0  # n_meso = factor * sqrt(k)
+
+
+def _maybe_normalize(x: jax.Array, metric: str) -> jax.Array:
+    if metric == "cosine":
+        n = jnp.sqrt(jnp.maximum(jnp.sum(x * x, axis=-1, keepdims=True), 1e-12))
+        return x / n
+    return x
+
+
+@partial(jax.jit, static_argnames=("n_clusters", "n_iters"))
+def _balanced_lloyd(x, w, c0, n_clusters: int, n_iters: int, key):
+    """Lloyd sweeps with per-sweep re-seeding of starved clusters from the
+    largest clusters' farthest points (reference: adjust_centers,
+    detail/kmeans_balanced.cuh)."""
+    xf = x.astype(jnp.float32)
+    wf = w.astype(jnp.float32)
+    total_w = jnp.maximum(jnp.sum(wf), 1e-12)
+    # a cluster is "starved" below this fraction of the average mass
+    starve_thresh = 0.25 * total_w / n_clusters
+
+    def body(i, centroids):
+        d2, labels = fused_l2_nn_argmin(xf, centroids)
+        new_c, counts = _update_centroids(xf, wf, labels, n_clusters, centroids)
+        # re-seed starved clusters at the globally farthest (weighted) points
+        starved = counts < starve_thresh
+        n_starved_slots = jnp.minimum(n_clusters, xf.shape[0])
+        far_score = jnp.where(wf > 0, d2, -jnp.inf)
+        _, far_idx = lax.top_k(far_score, n_clusters)
+        # rank starved clusters; the j-th starved cluster takes the j-th
+        # farthest point as its new center
+        starved_rank = jnp.cumsum(starved.astype(jnp.int32)) - 1
+        take_idx = far_idx[jnp.clip(starved_rank, 0, n_clusters - 1)]
+        reseeded = xf[take_idx]
+        new_c = jnp.where(starved[:, None], reseeded, new_c)
+        return new_c
+
+    return lax.fori_loop(0, n_iters, body, c0.astype(jnp.float32))
+
+
+def build_clusters(
+    x: jax.Array,
+    n_clusters: int,
+    params: Optional[KMeansBalancedParams] = None,
+    sample_weights: Optional[jax.Array] = None,
+) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    """Single-level balanced clustering → (centers, labels, sizes).
+
+    Counterpart of ``kmeans_balanced::helpers::build_clusters``
+    (cluster/kmeans_balanced.cuh) — used directly for PQ codebook training.
+    """
+    if params is None:
+        params = KMeansBalancedParams()
+    xn = _maybe_normalize(jnp.asarray(x, jnp.float32), params.metric)
+    n = xn.shape[0]
+    w = jnp.ones((n,), jnp.float32) if sample_weights is None else sample_weights
+    key = RngState(params.seed).key()
+    c0 = init_random(key, xn, n_clusters)
+    centers = _balanced_lloyd(xn, w, c0, n_clusters, params.n_iters, key)
+    centers = _maybe_normalize(centers, params.metric)
+    _, labels = fused_l2_nn_argmin(xn, centers)
+    sizes = jax.ops.segment_sum(jnp.ones_like(w), labels, num_segments=n_clusters)
+    return centers, labels, sizes.astype(jnp.int32)
+
+
+def fit(
+    x: jax.Array,
+    n_clusters: int,
+    params: Optional[KMeansBalancedParams] = None,
+) -> jax.Array:
+    """Hierarchical balanced fit → centers [n_clusters, d]
+    (reference: kmeans_balanced::fit, cluster/kmeans_balanced.cuh:76)."""
+    if params is None:
+        params = KMeansBalancedParams()
+    x = jnp.asarray(x, jnp.float32)
+    xn = _maybe_normalize(x, params.metric)
+    n, d = xn.shape
+    expects(n_clusters <= n, "n_clusters=%d > n_samples=%d", n_clusters, n)
+    key = RngState(params.seed).key()
+
+    n_meso = max(1, min(n_clusters,
+                        int(params.mesocluster_factor * math.isqrt(n_clusters))))
+    if n_meso <= 1 or n_clusters <= 8:
+        c0 = init_random(key, xn, n_clusters)
+        w = jnp.ones((n,), jnp.float32)
+        centers = _balanced_lloyd(xn, w, c0, n_clusters, params.n_iters, key)
+        return _maybe_normalize(centers, params.metric)
+
+    # level 1: mesoclusters (reference: detail/kmeans_balanced.cuh:758)
+    w = jnp.ones((n,), jnp.float32)
+    meso_c0 = init_random(key, xn, n_meso)
+    meso_centers = _balanced_lloyd(xn, w, meso_c0, n_meso, params.n_iters, key)
+    _, meso_labels = fused_l2_nn_argmin(xn, meso_centers)
+    meso_labels_h = np.asarray(meso_labels)
+    sizes = np.bincount(meso_labels_h, minlength=n_meso)
+
+    # fine cluster counts ∝ mesocluster size, summing exactly to n_clusters
+    quota = sizes / max(sizes.sum(), 1) * n_clusters
+    fine_k = np.maximum(1, np.floor(quota).astype(np.int64))
+    # distribute the remainder by largest fractional part
+    while fine_k.sum() > n_clusters:
+        fine_k[np.argmax(fine_k)] -= 1
+    rem = n_clusters - fine_k.sum()
+    if rem > 0:
+        order = np.argsort(-(quota - np.floor(quota)))
+        for j in order[:rem]:
+            fine_k[j] += 1
+
+    # level 2: per-mesocluster fine clustering on padded, masked row blocks
+    max_sz = int(sizes.max())
+    pad_to = max(8, 1 << (max_sz - 1).bit_length())  # one compile per size pow2
+    fine_centers = []
+    for m in range(n_meso):
+        rows = np.nonzero(meso_labels_h == m)[0]
+        if len(rows) == 0:
+            continue
+        k_m = int(min(fine_k[m], len(rows)))
+        sub = np.zeros((pad_to, d), np.float32)
+        sub[:len(rows)] = np.asarray(xn)[rows]
+        mask = np.zeros((pad_to,), np.float32)
+        mask[:len(rows)] = 1.0
+        sub_j = jnp.asarray(sub)
+        c0 = jnp.asarray(np.asarray(xn)[rows[np.linspace(0, len(rows) - 1, k_m).astype(int)]])
+        cm = _balanced_lloyd(sub_j, jnp.asarray(mask), c0, k_m,
+                             params.n_iters, jax.random.fold_in(key, m + 1))
+        fine_centers.append(np.asarray(cm))
+    centers = jnp.asarray(np.concatenate(fine_centers, axis=0))
+    if centers.shape[0] < n_clusters:  # lost slots to empty mesoclusters
+        extra = init_random(jax.random.fold_in(key, 999), xn,
+                            n_clusters - centers.shape[0])
+        centers = jnp.concatenate([centers, extra], axis=0)
+
+    # final joint balancing sweeps over the full data
+    centers = _balanced_lloyd(xn, w, centers, n_clusters,
+                              max(2, params.n_iters // 4), key)
+    return _maybe_normalize(centers, params.metric)
+
+
+def predict(centers: jax.Array, x: jax.Array,
+            params: Optional[KMeansBalancedParams] = None) -> jax.Array:
+    """Nearest balanced-center labels (reference: kmeans_balanced::predict)."""
+    metric = params.metric if params is not None else "l2"
+    xn = _maybe_normalize(jnp.asarray(x, jnp.float32), metric)
+    _, labels = fused_l2_nn_argmin(xn, centers)
+    return labels

@@ -1,0 +1,15 @@
+"""raft_tpu.parallel — distributed: comms facade, meshes, sharded search.
+
+Replaces the reference's entire comms stack (raft/comms NCCL/UCX/MPI +
+raft-dask bootstrap) with JAX-native SPMD: ``Mesh`` + ``shard_map`` +
+``lax`` collectives over ICI/DCN.
+"""
+
+from raft_tpu.parallel.comms import Comms, Op, Status, initialize_distributed  # noqa: F401
+from raft_tpu.parallel.mesh import (  # noqa: F401
+    make_hybrid_mesh,
+    make_mesh,
+    replicate,
+    shard_rows,
+)
+from raft_tpu.parallel.knn import replicated_knn, sharded_knn  # noqa: F401

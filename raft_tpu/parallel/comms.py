@@ -1,0 +1,148 @@
+"""Communicator facade — comms_t-shaped API over ``jax.lax`` collectives.
+
+TPU-native re-design of the reference's comms stack (SURVEY.md §5.8):
+
+- abstract ``comms_iface``/``comms_t`` (core/comms.hpp:123,242) → :class:`Comms`,
+  a thin named-axis wrapper whose methods are the same verbs (allreduce /
+  bcast / reduce / allgather / reducescatter / alltoall / send-recv /
+  comm_split) lowered to ``lax.psum`` / ``lax.all_gather`` / ``ppermute`` /
+  etc. **Methods must be called inside** ``shard_map`` (or jitted code with
+  the axis bound) — XLA then schedules them on ICI/DCN;
+- NCCL/UCX backends (comms/std_comms.hpp) → none needed: the XLA runtime is
+  the backend;
+- bootstrap (raft-dask Comms.init, NCCL uid exchange) →
+  :func:`initialize_distributed` wrapping ``jax.distributed.initialize``;
+- sub-communicators (core/resource/sub_comms.hpp, comm_split) → operating
+  over a subset of mesh axis names;
+- stream-sync failure propagation (comms_t::sync_stream, core/comms.hpp:290)
+  → XLA surfaces collective failures as program errors; :meth:`sync_stream`
+  exists for API parity.
+
+Reduction ops mirror ``op_t`` (core/comms.hpp:36): SUM, PROD, MIN, MAX.
+"""
+
+from __future__ import annotations
+
+import enum
+from typing import Optional, Sequence, Union
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+class Op(enum.Enum):
+    """Reduction op (reference: core/comms.hpp:36 ``op_t``)."""
+
+    SUM = "sum"
+    PROD = "prod"
+    MIN = "min"
+    MAX = "max"
+
+
+class Status(enum.Enum):
+    """Collective status (reference: core/comms.hpp:39 ``status_t``)."""
+
+    SUCCESS = 0
+    ERROR = 1
+    ABORT = 2
+
+
+_REDUCERS = {
+    Op.SUM: lax.psum,
+    Op.MAX: lax.pmax,
+    Op.MIN: lax.pmin,
+}
+
+
+class Comms:
+    """Named-axis communicator (reference: ``comms_t``, core/comms.hpp:242).
+
+    Bound to one or more mesh axis names; all methods are collective and must
+    run inside the matching ``shard_map``/``pjit`` scope.
+    """
+
+    def __init__(self, axis_name: Union[str, Sequence[str]]):
+        self.axis_name = axis_name
+
+    # -- topology ----------------------------------------------------------
+    def get_size(self) -> jax.Array:
+        return lax.axis_size(self.axis_name)
+
+    def get_rank(self) -> jax.Array:
+        return lax.axis_index(self.axis_name)
+
+    def comm_split(self, axis_name: Union[str, Sequence[str]]) -> "Comms":
+        """Sub-communicator over a subset of mesh axes (reference:
+        comms_t::comm_split, std_comms.hpp:145 — here: zero-cost renaming)."""
+        return Comms(axis_name)
+
+    # -- collectives -------------------------------------------------------
+    def allreduce(self, x, op: Op = Op.SUM):
+        """reference: comms_t::allreduce (core/comms.hpp:344)."""
+        if op == Op.PROD:
+            return jnp.exp(lax.psum(jnp.log(x), self.axis_name))  # rarely used
+        return _REDUCERS[op](x, self.axis_name)
+
+    def reduce(self, x, root: int = 0, op: Op = Op.SUM):
+        """reference: comms_t::reduce — XLA has no rooted reduce; allreduce
+        and mask off non-roots (same wire cost on ICI)."""
+        full = self.allreduce(x, op)
+        rank = self.get_rank()
+        return jnp.where(rank == root, full, jnp.zeros_like(full))
+
+    def bcast(self, x, root: int = 0):
+        """reference: comms_t::bcast — select the root's shard and replicate."""
+        n = lax.axis_size(self.axis_name)
+        gathered = lax.all_gather(x, self.axis_name, axis=0)
+        return gathered[root]
+
+    def allgather(self, x, axis: int = 0, tiled: bool = False):
+        """reference: comms_t::allgather."""
+        return lax.all_gather(x, self.axis_name, axis=axis, tiled=tiled)
+
+    def gather(self, x, root: int = 0, axis: int = 0):
+        """reference: comms_t::gather — SPMD programs have no cheaper rooted
+        gather; all ranks hold the result and root semantics are a no-op."""
+        return lax.all_gather(x, self.axis_name, axis=axis)
+
+    def reducescatter(self, x, op: Op = Op.SUM, scatter_dimension: int = 0):
+        """reference: comms_t::reducescatter."""
+        return lax.psum_scatter(x, self.axis_name,
+                                scatter_dimension=scatter_dimension, tiled=True)
+
+    def alltoall(self, x, split_axis: int = 0, concat_axis: int = 0):
+        """reference: std_comms nccl alltoall (device_multicast analog)."""
+        return lax.all_to_all(x, self.axis_name, split_axis=split_axis,
+                              concat_axis=concat_axis, tiled=True)
+
+    def ppermute(self, x, perm):
+        """Point-to-point ring/permute transfer — the structured replacement
+        for comms_t::device_send/device_recv pairs (core/comms.hpp:505,531):
+        SPMD programs express p2p as a permutation collective."""
+        return lax.ppermute(x, self.axis_name, perm=perm)
+
+    def send_recv_ring(self, x, shift: int = 1):
+        """Ring shift by ``shift`` (send to rank+shift, recv from rank-shift).
+        Axis sizes are static at trace time, so the permutation is concrete."""
+        size = int(lax.axis_size(self.axis_name))
+        perm = [(i, (i + shift) % size) for i in range(size)]
+        return lax.ppermute(x, self.axis_name, perm=perm)
+
+    def sync_stream(self) -> Status:
+        """reference: comms_t::sync_stream (core/comms.hpp:283-290) — XLA
+        surfaces collective failure by failing the program; parity no-op."""
+        return Status.SUCCESS
+
+
+def initialize_distributed(coordinator_address: Optional[str] = None,
+                           num_processes: Optional[int] = None,
+                           process_id: Optional[int] = None) -> None:
+    """Multi-host bootstrap (reference: raft-dask ``Comms.init``,
+    comms.py:172 — NCCL uid exchange over Dask RPC). On TPU this is one
+    call into JAX's distributed runtime; no uid plumbing exists."""
+    kwargs = {}
+    if coordinator_address is not None:
+        kwargs = dict(coordinator_address=coordinator_address,
+                      num_processes=num_processes, process_id=process_id)
+    jax.distributed.initialize(**kwargs)

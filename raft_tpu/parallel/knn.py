@@ -1,0 +1,111 @@
+"""Distributed ANN search patterns — sharded & replicated index search.
+
+The multi-GPU patterns the reference enables downstream (SURVEY.md §2.15):
+*sharded-index* search = per-shard top-k + cross-shard merge via
+``knn_merge_parts``, and *replicated-index* search = data-parallel query
+fan-out. Here both are single SPMD programs: ``shard_map`` over a mesh axis
+with ``lax`` collectives doing the merge on ICI — no NCCL, no Dask.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+from jax import shard_map
+
+from raft_tpu.core.errors import expects
+from raft_tpu.distance import DistanceType, SELECT_MIN, resolve_metric
+from raft_tpu.matrix import select_k as _select_k
+from raft_tpu.neighbors import brute_force
+
+
+def _pad_rows(x: jax.Array, multiple: int) -> Tuple[jax.Array, int]:
+    n = x.shape[0]
+    padded = -(-n // multiple) * multiple
+    if padded == n:
+        return x, n
+    return jnp.pad(x, ((0, padded - n), (0, 0))), n
+
+
+def sharded_knn(
+    dataset: jax.Array,
+    queries: jax.Array,
+    k: int,
+    mesh: Mesh,
+    axis: str = "shard",
+    metric="sqeuclidean",
+) -> Tuple[jax.Array, jax.Array]:
+    """Exact kNN over an index sharded across a mesh axis.
+
+    Each device scans its local shard (tiled brute force on the MXU), takes
+    a local top-k, all-gathers the [n_dev, m, k] candidates over ICI, and
+    merges with a final select_k — the reference's sharded-index pattern
+    (per-shard select + ``knn_merge_parts``, knn_brute_force.cuh:276)
+    as one SPMD program.
+
+    Returns replicated (distances [m, k], global indices [m, k]).
+    """
+    mt = resolve_metric(metric)
+    select_min = SELECT_MIN[mt]
+    n_dev = mesh.shape[axis]
+    n = dataset.shape[0]
+    padded, _ = _pad_rows(dataset, n_dev)
+    shard_size = padded.shape[0] // n_dev
+    expects(k <= shard_size, "k=%d exceeds shard size %d", k, shard_size)
+    pad_val = jnp.inf if select_min else -jnp.inf
+
+    def local_search(ds_shard, q):
+        rank = lax.axis_index(axis)
+        idx = brute_force.build(ds_shard, metric=mt)
+        vals, ids = brute_force.knn(idx, q, k)
+        gids = ids.astype(jnp.int32) + rank.astype(jnp.int32) * shard_size
+        vals = jnp.where(gids < n, vals, pad_val)  # mask padded rows
+        # cross-shard merge: gather all candidates, select final top-k
+        all_vals = lax.all_gather(vals, axis)        # [n_dev, m, k]
+        all_ids = lax.all_gather(gids, axis)
+        m = q.shape[0]
+        flat_v = jnp.transpose(all_vals, (1, 0, 2)).reshape(m, n_dev * k)
+        flat_i = jnp.transpose(all_ids, (1, 0, 2)).reshape(m, n_dev * k)
+        return _select_k(flat_v, k, select_min=select_min, input_indices=flat_i)
+
+    fn = shard_map(
+        local_search, mesh=mesh,
+        in_specs=(P(axis, None), P()),
+        out_specs=(P(), P()),
+        check_vma=False,
+    )
+    return fn(padded, queries)
+
+
+def replicated_knn(
+    dataset: jax.Array,
+    queries: jax.Array,
+    k: int,
+    mesh: Mesh,
+    axis: str = "shard",
+    metric="sqeuclidean",
+) -> Tuple[jax.Array, jax.Array]:
+    """Exact kNN with a replicated index and queries sharded over the mesh —
+    the reference's replicated-index throughput pattern (each worker holds
+    the full index, queries split). Returns sharded (dists, indices)."""
+    mt = resolve_metric(metric)
+    n_dev = mesh.shape[axis]
+    q_padded, m = _pad_rows(queries, n_dev)
+
+    def local_search(q_shard, ds):
+        idx = brute_force.build(ds, metric=mt)
+        return brute_force.knn(idx, q_shard, k)
+
+    fn = shard_map(
+        local_search, mesh=mesh,
+        in_specs=(P(axis, None), P()),
+        out_specs=(P(axis, None), P(axis, None)),
+        check_vma=False,
+    )
+    vals, ids = fn(q_padded, dataset)
+    return vals[:m], ids[:m]

@@ -1,0 +1,56 @@
+"""Device mesh construction — ICI/DCN-aware mesh helpers.
+
+Replaces the reference's communicator-clique construction (raft-dask worker
+enumeration + NCCL clique): on TPU the topology object is a
+``jax.sharding.Mesh``; intra-slice axes ride ICI, the inter-slice axis
+rides DCN (``create_hybrid_device_mesh``). Algorithms take a mesh + axis
+names instead of a comms handle.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+def make_mesh(shape: Optional[Sequence[int]] = None,
+              axis_names: Sequence[str] = ("shard",),
+              devices=None) -> Mesh:
+    """Build a mesh over the available devices.
+
+    Default: one flat "shard" axis over all devices — the data/index
+    sharding axis used by distributed kmeans and sharded ANN search (the
+    TPU analog of the reference's one-GPU-per-Dask-worker clique).
+    """
+    if devices is None:
+        devices = jax.devices()
+    if shape is None:
+        shape = (len(devices),)
+    arr = np.asarray(devices).reshape(tuple(shape))
+    return Mesh(arr, tuple(axis_names))
+
+
+def make_hybrid_mesh(ici_shape: Sequence[int], dcn_shape: Sequence[int],
+                     axis_names: Sequence[str]) -> Mesh:
+    """Multi-slice mesh: leading axes over DCN, trailing over ICI
+    (wraps ``jax.experimental.mesh_utils.create_hybrid_device_mesh``)."""
+    from jax.experimental import mesh_utils
+
+    devices = mesh_utils.create_hybrid_device_mesh(
+        tuple(ici_shape), tuple(dcn_shape))
+    return Mesh(devices, tuple(axis_names))
+
+
+def shard_rows(x: jax.Array, mesh: Mesh, axis: str = "shard") -> jax.Array:
+    """Place a [n, …] array row-sharded over ``axis`` (replicated on the
+    rest). Pads implicitly via XLA if n is not divisible."""
+    spec = P(axis, *([None] * (x.ndim - 1)))
+    return jax.device_put(x, NamedSharding(mesh, spec))
+
+
+def replicate(x: jax.Array, mesh: Mesh) -> jax.Array:
+    """Fully replicate an array over the mesh."""
+    return jax.device_put(x, NamedSharding(mesh, P()))

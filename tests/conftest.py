@@ -18,6 +18,9 @@ if "xla_force_host_platform_device_count" not in xla_flags:
 
 import jax  # noqa: E402
 
+# The environment's axon site-hook pins JAX_PLATFORMS; the config update
+# after import is what actually lands the CPU platform here.
+jax.config.update("jax_platforms", "cpu")
 jax.config.update("jax_enable_x64", False)
 
 import numpy as np  # noqa: E402
