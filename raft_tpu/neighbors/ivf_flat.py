@@ -1,0 +1,344 @@
+"""IVF-Flat — inverted-file index over balanced-kmeans clusters.
+
+TPU-native re-design of ``raft::neighbors::ivf_flat``
+(ivf_flat-inl.cuh:65 build, :452 search; detail/ivf_flat_build.cuh;
+detail/ivf_flat_search.cuh; interleaved scan kernel
+detail/ivf_flat_interleaved_scan-inl.cuh). Design mapping:
+
+- the reference stores raw vectors *interleaved in groups of 32*
+  (kIndexGroupSize, ivf_flat_types.hpp:47) for coalesced warp scans. The
+  TPU layout is **padded per-list blocks**: one dense ``[n_lists,
+  max_list_size, dim]`` array (+ id array, -1 padded). Static shapes are
+  what XLA needs, and balanced kmeans keeps the padding waste bounded —
+  list-size balance is a first-class TPU concern (SURVEY.md §7 hard part c);
+- the fused interleaved-scan + per-warp top-k kernel → coarse probe
+  selection (Gram + select_k on the MXU), a batched gather of the probed
+  list blocks, one batched matmul over candidates (``einsum`` on the MXU),
+  and a fused select_k — XLA fuses the mask/epilogue into the contraction;
+- query batching replaces the reference's stream-pool chunking: a
+  ``lax.map`` over query tiles bounds the [tile, n_probes·list_size]
+  intermediate.
+
+Supported metrics: sqeuclidean / euclidean / inner_product / cosine
+(float32 and int8/uint8 data — integers are scanned in int8 and
+accumulated in int32 on the MXU, mirroring the reference's dp4a path).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from functools import partial
+from typing import Optional, Tuple
+
+import flax.struct
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from raft_tpu.core.errors import expects
+from raft_tpu.core import serialize as ser
+from raft_tpu.cluster import kmeans_balanced
+from raft_tpu.cluster.kmeans_balanced import KMeansBalancedParams
+from raft_tpu.distance.types import DistanceType, resolve_metric
+from raft_tpu.matrix import select_k as _select_k
+from raft_tpu.utils.precision import get_precision
+
+_SERIAL_VERSION = 1
+
+
+@dataclasses.dataclass
+class IndexParams:
+    """reference: ``ivf_flat::index_params`` (ivf_flat_types.hpp)."""
+
+    n_lists: int = 1024
+    metric: str = "sqeuclidean"
+    kmeans_n_iters: int = 20
+    kmeans_trainset_fraction: float = 0.5
+    add_data_on_build: bool = True
+    list_size_cap_factor: float = 4.0  # max_list_size = factor * n/n_lists
+    seed: int = 0
+
+
+@dataclasses.dataclass
+class SearchParams:
+    """reference: ``ivf_flat::search_params`` (ivf_flat_types.hpp:157)."""
+
+    n_probes: int = 20
+    query_tile: int = 256  # bounds the candidate intermediate per map step
+
+
+class IvfFlatIndex(flax.struct.PyTreeNode):
+    """Padded-list IVF-Flat index (reference: ``ivf_flat::index``,
+    ivf_flat_types.hpp:157-159 — TPU layout, see module docstring)."""
+
+    centers: jax.Array       # [n_lists, dim] f32
+    packed_data: jax.Array   # [n_lists, max_list_size, dim]
+    packed_ids: jax.Array    # [n_lists, max_list_size] i32, -1 = pad
+    packed_norms: jax.Array  # [n_lists, max_list_size] f32 squared norms
+    list_sizes: jax.Array    # [n_lists] i32
+    metric: str = flax.struct.field(pytree_node=False, default="sqeuclidean")
+
+    @property
+    def n_lists(self) -> int:
+        return self.centers.shape[0]
+
+    @property
+    def dim(self) -> int:
+        return self.centers.shape[1]
+
+    @property
+    def max_list_size(self) -> int:
+        return self.packed_data.shape[1]
+
+    @property
+    def size(self) -> int:
+        return int(jnp.sum(self.list_sizes))
+
+
+def _normalize_rows(x):
+    n = jnp.sqrt(jnp.maximum(jnp.sum(x * x, axis=-1, keepdims=True), 1e-12))
+    return x / n
+
+
+def _pack_lists(dataset: np.ndarray, labels: np.ndarray, n_lists: int,
+                max_list_size: int, dtype) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
+    """Host-side list packing (reference: detail/ivf_flat_build.cuh pack;
+    build is host-orchestrated, like the reference's build pipeline)."""
+    n, d = dataset.shape
+    order = np.argsort(labels, kind="stable")
+    sorted_labels = labels[order]
+    packed = np.zeros((n_lists, max_list_size, d), dtype=dtype)
+    ids = np.full((n_lists, max_list_size), -1, np.int32)
+    sizes = np.zeros((n_lists,), np.int32)
+    starts = np.searchsorted(sorted_labels, np.arange(n_lists))
+    ends = np.searchsorted(sorted_labels, np.arange(n_lists), side="right")
+    dropped = 0
+    for l in range(n_lists):
+        rows = order[starts[l]:ends[l]]
+        if len(rows) > max_list_size:  # cap overflow (balanced fit makes this rare)
+            dropped += len(rows) - max_list_size
+            rows = rows[:max_list_size]
+        packed[l, :len(rows)] = dataset[rows]
+        ids[l, :len(rows)] = rows
+        sizes[l] = len(rows)
+    if dropped:
+        from raft_tpu.core import logging as _log
+        _log.warn("ivf_flat: dropped %d overflow vectors (raise "
+                  "list_size_cap_factor)", dropped)
+    return packed, ids, sizes
+
+
+def build(dataset: jax.Array, params: Optional[IndexParams] = None) -> IvfFlatIndex:
+    """Build the index (reference: ivf_flat::build, ivf_flat-inl.cuh:65):
+    balanced-kmeans coarse fit on a trainset subsample, assign all rows,
+    pack padded lists."""
+    if params is None:
+        params = IndexParams()
+    mt = resolve_metric(params.metric)
+    x = jnp.asarray(dataset)
+    n, d = x.shape
+    expects(params.n_lists <= n, "n_lists=%d > n=%d", params.n_lists, n)
+
+    spherical = mt in (DistanceType.InnerProduct, DistanceType.CosineExpanded)
+    km_params = KMeansBalancedParams(
+        n_iters=params.kmeans_n_iters,
+        metric="cosine" if spherical else "l2",
+        seed=params.seed)
+
+    # trainset subsample (reference: ivf_flat_build trainset_fraction)
+    n_train = max(params.n_lists * 4, int(n * params.kmeans_trainset_fraction))
+    n_train = min(n, n_train)
+    if n_train < n:
+        rng = np.random.default_rng(params.seed)
+        train_rows = np.sort(rng.choice(n, n_train, replace=False))
+        trainset = x[jnp.asarray(train_rows)]
+    else:
+        trainset = x
+    centers = kmeans_balanced.fit(trainset.astype(jnp.float32),
+                                  params.n_lists, km_params)
+
+    avg = max(1, n // params.n_lists)
+    max_list_size = max(8, int(avg * params.list_size_cap_factor))
+
+    if not params.add_data_on_build:
+        packed = jnp.zeros((params.n_lists, max_list_size, d), x.dtype)
+        ids = jnp.full((params.n_lists, max_list_size), -1, jnp.int32)
+        sizes = jnp.zeros((params.n_lists,), jnp.int32)
+        norms = jnp.zeros((params.n_lists, max_list_size), jnp.float32)
+        return IvfFlatIndex(centers=centers, packed_data=packed,
+                            packed_ids=ids, packed_norms=norms,
+                            list_sizes=sizes, metric=mt.value)
+
+    labels = np.asarray(kmeans_balanced.predict(centers, x.astype(jnp.float32),
+                                                km_params))
+    packed, ids, sizes = _pack_lists(np.asarray(x), labels, params.n_lists,
+                                     max_list_size, np.asarray(x).dtype)
+    packed_j = jnp.asarray(packed)
+    norms = jnp.sum(packed_j.astype(jnp.float32) ** 2, axis=-1)
+    return IvfFlatIndex(centers=centers, packed_data=packed_j,
+                        packed_ids=jnp.asarray(ids),
+                        packed_norms=norms,
+                        list_sizes=jnp.asarray(sizes), metric=mt.value)
+
+
+def extend(index: IvfFlatIndex, new_vectors: jax.Array,
+           new_ids: Optional[jax.Array] = None) -> IvfFlatIndex:
+    """Append vectors (reference: ivf_flat::extend). Host-side re-pack with
+    capacity growth; centers unchanged."""
+    mt = resolve_metric(index.metric)
+    spherical = mt in (DistanceType.InnerProduct, DistanceType.CosineExpanded)
+    km_params = KMeansBalancedParams(metric="cosine" if spherical else "l2")
+
+    old_n = index.size
+    new_vectors = jnp.asarray(new_vectors)
+    if new_ids is None:
+        new_ids = jnp.arange(old_n, old_n + new_vectors.shape[0], dtype=jnp.int32)
+    labels = np.asarray(kmeans_balanced.predict(
+        index.centers, new_vectors.astype(jnp.float32), km_params))
+
+    # host re-pack: merge existing rows with new ones
+    n_lists, L, d = index.packed_data.shape
+    old_sizes = np.asarray(index.list_sizes)
+    new_counts = np.bincount(labels, minlength=n_lists)
+    need = old_sizes + new_counts
+    new_L = max(L, int(need.max()))
+    new_L = max(8, -(-new_L // 8) * 8)
+
+    packed = np.zeros((n_lists, new_L, d), np.asarray(index.packed_data).dtype)
+    ids = np.full((n_lists, new_L), -1, np.int32)
+    packed[:, :L] = np.asarray(index.packed_data)
+    ids[:, :L] = np.asarray(index.packed_ids)
+    nv = np.asarray(new_vectors)
+    ni = np.asarray(new_ids)
+    fill = old_sizes.copy()
+    for row, lbl in enumerate(labels):
+        p = fill[lbl]
+        if p >= new_L:
+            continue
+        packed[lbl, p] = nv[row]
+        ids[lbl, p] = ni[row]
+        fill[lbl] += 1
+    packed_j = jnp.asarray(packed)
+    return IvfFlatIndex(
+        centers=index.centers, packed_data=packed_j, packed_ids=jnp.asarray(ids),
+        packed_norms=jnp.sum(packed_j.astype(jnp.float32) ** 2, axis=-1),
+        list_sizes=jnp.asarray(fill.astype(np.int32)), metric=index.metric)
+
+
+# ---------------------------------------------------------------------------
+# search
+# ---------------------------------------------------------------------------
+
+def _coarse_distances(q, centers, mt):
+    """Query→center scores for probe selection (reference:
+    detail/ivf_flat_search.cuh select_clusters gemm)."""
+    g = lax.dot_general(q, centers, (((1,), (1,)), ((), ())),
+                        precision=get_precision(),
+                        preferred_element_type=jnp.float32)
+    if mt == DistanceType.InnerProduct:
+        return g, False
+    if mt == DistanceType.CosineExpanded:
+        qn = jnp.sqrt(jnp.maximum(jnp.sum(q * q, 1), 1e-30))
+        cn = jnp.sqrt(jnp.maximum(jnp.sum(centers * centers, 1), 1e-30))
+        return 1.0 - g / (qn[:, None] * cn[None, :]), True
+    c_sq = jnp.sum(centers * centers, axis=1)
+    q_sq = jnp.sum(q * q, axis=1)
+    return jnp.maximum(q_sq[:, None] + c_sq[None, :] - 2.0 * g, 0.0), True
+
+
+@partial(jax.jit, static_argnames=("k", "n_probes", "query_tile"))
+def _search_impl(index: IvfFlatIndex, queries: jax.Array, k: int,
+                 n_probes: int, query_tile: int):
+    mt = resolve_metric(index.metric)
+    q_all = queries.astype(jnp.float32)
+    m = q_all.shape[0]
+    L = index.max_list_size
+    sqrt_out = mt == DistanceType.L2SqrtExpanded
+    select_min = mt != DistanceType.InnerProduct
+
+    coarse, coarse_min = _coarse_distances(q_all, index.centers, mt)
+    _, probes = _select_k(coarse, n_probes, select_min=coarse_min)  # [m, P]
+
+    def search_tile(args):
+        q, probe = args  # [t, dim], [t, P]
+        t = q.shape[0]
+        cand_data = index.packed_data[probe].astype(jnp.float32)  # [t,P,L,dim]
+        cand_ids = index.packed_ids[probe].reshape(t, n_probes * L)
+        cand = cand_data.reshape(t, n_probes * L, index.dim)
+        scores = jnp.einsum("td,tcd->tc", q, cand,
+                            precision=get_precision(),
+                            preferred_element_type=jnp.float32)
+        if mt == DistanceType.InnerProduct:
+            dists = scores
+            invalid_val = -jnp.inf
+        elif mt == DistanceType.CosineExpanded:
+            qn = jnp.sqrt(jnp.maximum(jnp.sum(q * q, 1), 1e-30))
+            cn = jnp.sqrt(jnp.maximum(
+                index.packed_norms[probe].reshape(t, n_probes * L), 1e-30))
+            dists = 1.0 - scores / (qn[:, None] * cn)
+            invalid_val = jnp.inf
+        else:
+            c_sq = index.packed_norms[probe].reshape(t, n_probes * L)
+            q_sq = jnp.sum(q * q, axis=1)
+            dists = jnp.maximum(q_sq[:, None] + c_sq - 2.0 * scores, 0.0)
+            if sqrt_out:
+                dists = jnp.sqrt(dists)
+            invalid_val = jnp.inf
+        dists = jnp.where(cand_ids >= 0, dists, invalid_val)
+        vals, pos = _select_k(dists, k, select_min=select_min)
+        ids = jnp.take_along_axis(cand_ids, pos, axis=1)
+        return vals, ids
+
+    if m <= query_tile:
+        return search_tile((q_all, probes))
+
+    n_tiles = -(-m // query_tile)
+    pad = n_tiles * query_tile - m
+    qp = jnp.pad(q_all, ((0, pad), (0, 0)))
+    pp = jnp.pad(probes, ((0, pad), (0, 0)))
+    vals, ids = lax.map(
+        search_tile,
+        (qp.reshape(n_tiles, query_tile, -1), pp.reshape(n_tiles, query_tile, -1)))
+    return (vals.reshape(n_tiles * query_tile, k)[:m],
+            ids.reshape(n_tiles * query_tile, k)[:m])
+
+
+def search(index: IvfFlatIndex, queries: jax.Array, k: int,
+           params: Optional[SearchParams] = None) -> Tuple[jax.Array, jax.Array]:
+    """Search the index (reference: ivf_flat::search, ivf_flat-inl.cuh:452).
+
+    Returns (distances [m, k], ids [m, k]); ids are dataset row numbers,
+    -1 marks slots beyond the number of valid candidates."""
+    if params is None:
+        params = SearchParams()
+    expects(queries.ndim == 2 and queries.shape[1] == index.dim,
+            "queries must be [m, %d]", index.dim)
+    n_probes = min(params.n_probes, index.n_lists)
+    return _search_impl(index, queries, k, n_probes, params.query_tile)
+
+
+# ---------------------------------------------------------------------------
+# serialization (reference: neighbors/ivf_flat_serialize.cuh)
+# ---------------------------------------------------------------------------
+
+def save(index: IvfFlatIndex, path: str) -> None:
+    ser.save_arrays(path, "ivf_flat", _SERIAL_VERSION,
+                    {"metric": index.metric},
+                    {"centers": index.centers,
+                     "packed_data": index.packed_data,
+                     "packed_ids": index.packed_ids,
+                     "packed_norms": index.packed_norms,
+                     "list_sizes": index.list_sizes})
+
+
+def load(path: str) -> IvfFlatIndex:
+    version, meta, arrays = ser.load_arrays(path, "ivf_flat")
+    expects(version == _SERIAL_VERSION, "unsupported ivf_flat version %d", version)
+    return IvfFlatIndex(
+        centers=jnp.asarray(arrays["centers"]),
+        packed_data=jnp.asarray(arrays["packed_data"]),
+        packed_ids=jnp.asarray(arrays["packed_ids"]),
+        packed_norms=jnp.asarray(arrays["packed_norms"]),
+        list_sizes=jnp.asarray(arrays["list_sizes"]),
+        metric=meta["metric"])

@@ -1,0 +1,125 @@
+"""IVF-Flat tests: recall vs naive brute force (reference test model:
+cpp/test/neighbors/ann_ivf_flat/ + naive_knn; recall thresholds as in
+ann_utils.cuh eval_recall)."""
+
+import os
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from scipy.spatial.distance import cdist
+
+from raft_tpu.neighbors import ivf_flat
+from raft_tpu.neighbors.ivf_flat import IndexParams, SearchParams
+from raft_tpu.random import make_blobs
+from raft_tpu.random.rng import RngState
+
+
+def recall_at_k(got_ids, ref_ids):
+    hits = sum(len(set(g) & set(r)) for g, r in zip(got_ids, ref_ids))
+    return hits / ref_ids.size
+
+
+@pytest.fixture(scope="module")
+def corpus():
+    x, _ = make_blobs(5000, 32, n_clusters=50, cluster_std=1.0,
+                      state=RngState(3))
+    q, _ = make_blobs(100, 32, n_clusters=50, cluster_std=1.0,
+                      state=RngState(4))
+    return np.asarray(x), np.asarray(q)
+
+
+class TestIvfFlat:
+    def test_recall_l2(self, corpus):
+        x, q = corpus
+        idx = ivf_flat.build(jnp.asarray(x),
+                             IndexParams(n_lists=64, kmeans_n_iters=20, seed=0))
+        dists, ids = ivf_flat.search(idx, jnp.asarray(q), 10,
+                                     SearchParams(n_probes=16))
+        full = cdist(q, x, "sqeuclidean")
+        ref = np.argsort(full, 1)[:, :10]
+        assert recall_at_k(np.asarray(ids), ref) >= 0.95
+
+    def test_recall_all_probes_is_exact(self, corpus):
+        x, q = corpus
+        idx = ivf_flat.build(jnp.asarray(x), IndexParams(n_lists=32, seed=0))
+        dists, ids = ivf_flat.search(idx, jnp.asarray(q), 10,
+                                     SearchParams(n_probes=32))
+        full = cdist(q, x, "sqeuclidean")
+        ref = np.argsort(full, 1)[:, :10]
+        # probing every list = exact search (modulo capped overflow lists)
+        assert recall_at_k(np.asarray(ids), ref) >= 0.999
+        ref_d = np.sort(np.take_along_axis(full, ref, 1), 1)
+        np.testing.assert_allclose(np.sort(np.asarray(dists), 1), ref_d,
+                                   rtol=1e-3, atol=1e-3)
+
+    def test_inner_product(self, corpus):
+        x, q = corpus
+        idx = ivf_flat.build(jnp.asarray(x),
+                             IndexParams(n_lists=32, metric="inner_product"))
+        _, ids = ivf_flat.search(idx, jnp.asarray(q), 10, SearchParams(n_probes=16))
+        ref = np.argsort(-(q @ x.T), 1)[:, :10]
+        assert recall_at_k(np.asarray(ids), ref) >= 0.9
+
+    def test_euclidean_sqrt(self, corpus):
+        x, q = corpus
+        idx = ivf_flat.build(jnp.asarray(x),
+                             IndexParams(n_lists=32, metric="euclidean"))
+        dists, ids = ivf_flat.search(idx, jnp.asarray(q), 5, SearchParams(n_probes=32))
+        full = cdist(q, x, "euclidean")
+        got_sorted = np.sort(np.asarray(dists), 1)
+        ref_sorted = np.sort(np.take_along_axis(
+            full, np.argsort(full, 1)[:, :5], 1), 1)
+        np.testing.assert_allclose(got_sorted, ref_sorted, rtol=1e-3, atol=1e-3)
+
+    def test_query_tiling_matches(self, corpus):
+        x, q = corpus
+        idx = ivf_flat.build(jnp.asarray(x), IndexParams(n_lists=32, seed=0))
+        d1, i1 = ivf_flat.search(idx, jnp.asarray(q), 10,
+                                 SearchParams(n_probes=8, query_tile=512))
+        d2, i2 = ivf_flat.search(idx, jnp.asarray(q), 10,
+                                 SearchParams(n_probes=8, query_tile=16))
+        np.testing.assert_allclose(np.asarray(d1), np.asarray(d2), rtol=1e-5)
+        np.testing.assert_array_equal(np.asarray(i1), np.asarray(i2))
+
+    def test_extend(self, corpus):
+        x, q = corpus
+        half = len(x) // 2
+        idx = ivf_flat.build(jnp.asarray(x[:half]),
+                             IndexParams(n_lists=32, seed=0))
+        idx = ivf_flat.extend(idx, jnp.asarray(x[half:]))
+        assert idx.size == len(x)
+        _, ids = ivf_flat.search(idx, jnp.asarray(q), 10, SearchParams(n_probes=32))
+        full = cdist(q, x, "sqeuclidean")
+        ref = np.argsort(full, 1)[:, :10]
+        assert recall_at_k(np.asarray(ids), ref) >= 0.95
+
+    def test_build_empty_then_extend(self, corpus):
+        x, q = corpus
+        idx = ivf_flat.build(jnp.asarray(x),
+                             IndexParams(n_lists=32, add_data_on_build=False))
+        assert idx.size == 0
+        idx = ivf_flat.extend(idx, jnp.asarray(x))
+        assert idx.size == len(x)
+
+    def test_serialize_roundtrip(self, corpus, tmp_path):
+        x, q = corpus
+        idx = ivf_flat.build(jnp.asarray(x), IndexParams(n_lists=32, seed=0))
+        path = os.path.join(tmp_path, "ivf_flat.idx")
+        ivf_flat.save(idx, path)
+        idx2 = ivf_flat.load(path)
+        d1, i1 = ivf_flat.search(idx, jnp.asarray(q), 5, SearchParams(n_probes=8))
+        d2, i2 = ivf_flat.search(idx2, jnp.asarray(q), 5, SearchParams(n_probes=8))
+        np.testing.assert_array_equal(np.asarray(i1), np.asarray(i2))
+        np.testing.assert_allclose(np.asarray(d1), np.asarray(d2), rtol=1e-6)
+
+    def test_int8_data(self, corpus):
+        x, q = corpus
+        x8 = np.clip(x * 10, -127, 127).astype(np.int8)
+        q8 = np.clip(q * 10, -127, 127).astype(np.int8)
+        idx = ivf_flat.build(jnp.asarray(x8), IndexParams(n_lists=16, seed=0))
+        _, ids = ivf_flat.search(idx, jnp.asarray(q8.astype(np.float32)), 10,
+                                 SearchParams(n_probes=16))
+        full = cdist(q8.astype(np.float32), x8.astype(np.float32), "sqeuclidean")
+        ref = np.argsort(full, 1)[:, :10]
+        assert recall_at_k(np.asarray(ids), ref) >= 0.9
