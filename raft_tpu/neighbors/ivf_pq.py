@@ -1,0 +1,494 @@
+"""IVF-PQ — inverted-file index with product-quantized residuals.
+
+TPU-native re-design of ``raft::neighbors::ivf_pq``
+(ivf_pq-inl.cuh:272 build, :478 search; detail/ivf_pq_build.cuh:1511;
+detail/ivf_pq_search.cuh; the compute_similarity scan kernel
+detail/ivf_pq_compute_similarity-inl.cuh). Design mapping and the one
+deliberate algorithmic change:
+
+- coarse quantizer: balanced kmeans (as the reference, ivf_pq_build.cuh:1618);
+- random rotation: QR of a Gaussian (ivf_pq_build.cuh:122) giving an
+  orthonormal embedding dim → rot_dim = pq_dim·pq_len;
+- codebooks: PER_SUBSPACE kmeans over residual sub-vectors — all pq_dim
+  subspace kmeans runs execute as ONE vmapped Lloyd (the reference loops
+  subspaces, ivf_pq_build.cuh:404-407);
+- storage: padded per-list blocks of uint8 codes (the TPU analog of the
+  reference's packed interleaved n-bit lists) + ids;
+- **search restructure**: the reference builds a LUT per (query, probe)
+  over *residual* distances, then scans packed codes in shared memory.
+  A per-(query,probe) LUT is hostile to XLA (dynamic, smem-sized). We
+  decompose the asymmetric distance instead:
+      ‖q − (c + d)‖² = ‖q‖² − 2⟨q,c⟩ − 2⟨q,d⟩ + ‖c + d‖²
+  where d = decoded PQ residual. ‖c+d‖² is a per-candidate scalar
+  **precomputed at build**; ⟨q,c⟩ falls out of coarse probing; and
+  ⟨q,d⟩ = Σ_s QLUT[s, code_s] needs only a *query-only* LUT
+  [pq_dim, 2^bits] built by one batched MXU contraction. The list scan
+  is then a pure gather+sum — the Pallas kernel target — with identical
+  math to the reference's fused scan.
+
+Supported metrics: sqeuclidean / euclidean / inner_product / cosine
+(cosine = inner product over L2-normalized vectors, as the reference).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from functools import partial
+from typing import Optional, Tuple
+
+import flax.struct
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from raft_tpu.core.errors import expects
+from raft_tpu.core import serialize as ser
+from raft_tpu.cluster import kmeans_balanced
+from raft_tpu.cluster.kmeans_balanced import KMeansBalancedParams
+from raft_tpu.distance.types import DistanceType, resolve_metric
+from raft_tpu.matrix import select_k as _select_k
+from raft_tpu.utils.precision import get_precision
+
+_SERIAL_VERSION = 1
+
+
+@dataclasses.dataclass
+class IndexParams:
+    """reference: ``ivf_pq::index_params`` (ivf_pq_types.hpp:48-148)."""
+
+    n_lists: int = 1024
+    metric: str = "sqeuclidean"
+    pq_dim: int = 0           # 0 → dim/2 rounded to a multiple of 8 (reference default heuristic)
+    pq_bits: int = 8          # 4..8 (codebook size 2^bits)
+    kmeans_n_iters: int = 20
+    kmeans_trainset_fraction: float = 0.5
+    codebook_kind: str = "per_subspace"  # | "per_cluster"
+    add_data_on_build: bool = True
+    list_size_cap_factor: float = 4.0
+    seed: int = 0
+
+
+@dataclasses.dataclass
+class SearchParams:
+    """reference: ``ivf_pq::search_params``."""
+
+    n_probes: int = 20
+    query_tile: int = 64
+
+
+class IvfPqIndex(flax.struct.PyTreeNode):
+    """IVF-PQ index (reference: ``ivf_pq::index``, ivf_pq_types.hpp)."""
+
+    centers: jax.Array        # [n_lists, dim] f32 (original space)
+    centers_rot: jax.Array    # [n_lists, rot_dim] f32
+    rotation: jax.Array       # [rot_dim, dim] f32, orthonormal rows' columns
+    codebooks: jax.Array      # [pq_dim, 2^bits, pq_len] f32 (per-subspace)
+    packed_codes: jax.Array   # [n_lists, L, pq_dim] u8
+    packed_ids: jax.Array     # [n_lists, L] i32, -1 pad
+    packed_norms: jax.Array   # [n_lists, L] f32: ‖c + decoded‖²
+    list_sizes: jax.Array     # [n_lists] i32
+    metric: str = flax.struct.field(pytree_node=False, default="sqeuclidean")
+
+    @property
+    def n_lists(self) -> int:
+        return self.centers.shape[0]
+
+    @property
+    def dim(self) -> int:
+        return self.centers.shape[1]
+
+    @property
+    def rot_dim(self) -> int:
+        return self.rotation.shape[0]
+
+    @property
+    def pq_dim(self) -> int:
+        return self.codebooks.shape[0]
+
+    @property
+    def pq_len(self) -> int:
+        return self.codebooks.shape[2]
+
+    @property
+    def pq_book_size(self) -> int:
+        return self.codebooks.shape[1]
+
+    @property
+    def max_list_size(self) -> int:
+        return self.packed_codes.shape[1]
+
+    @property
+    def size(self) -> int:
+        return int(jnp.sum(self.list_sizes))
+
+
+# ---------------------------------------------------------------------------
+# build
+# ---------------------------------------------------------------------------
+
+def _default_pq_dim(dim: int) -> int:
+    """Reference heuristic (ivf_pq_types.hpp pq_dim=0 doc): ~dim/2, rounded
+    to a multiple of 8, at least 8."""
+    return max(8, (dim // 2 + 7) // 8 * 8 if dim >= 16 else dim)
+
+
+def make_rotation_matrix(key: jax.Array, rot_dim: int, dim: int) -> jax.Array:
+    """Random orthonormal embedding R [rot_dim, dim], RᵀR = I_dim
+    (reference: make_rotation_matrix, ivf_pq_build.cuh:122 — QR of a
+    Gaussian). Rotation preserves inner products and L2 distances."""
+    g = jax.random.normal(key, (rot_dim, dim), jnp.float32)
+    q, _ = jnp.linalg.qr(g, mode="reduced")  # [rot_dim, dim] for rot_dim>=dim
+    return q
+
+
+@partial(jax.jit, static_argnames=("k", "n_iters"))
+def _vmapped_lloyd(data, k: int, n_iters: int, key):
+    """Independent kmeans per subspace, one vmapped program
+    (reference loops kmeans_balanced per subspace, ivf_pq_build.cuh:404)."""
+    S, n, d = data.shape
+
+    def one(sub_data, subkey):
+        idx = jax.random.choice(subkey, n, (k,), replace=False)
+        c0 = sub_data[idx]
+
+        def body(i, c):
+            d2 = (jnp.sum(sub_data**2, 1)[:, None] + jnp.sum(c**2, 1)[None, :]
+                  - 2.0 * sub_data @ c.T)
+            labels = jnp.argmin(d2, axis=1)
+            sums = jax.ops.segment_sum(sub_data, labels, num_segments=k)
+            counts = jax.ops.segment_sum(jnp.ones((n,), jnp.float32), labels,
+                                         num_segments=k)
+            return jnp.where(counts[:, None] > 0,
+                             sums / jnp.maximum(counts[:, None], 1e-12), c)
+
+        return lax.fori_loop(0, n_iters, body, c0)
+
+    keys = jax.random.split(key, S)
+    return jax.vmap(one)(data, keys)
+
+
+def _encode_rows(rot_rows: jax.Array, centers_rot: jax.Array,
+                 labels: jax.Array, codebooks: jax.Array,
+                 block: int = 4096) -> jax.Array:
+    """PQ-encode rotated rows against their cluster's residual
+    (reference: encode+pack, ivf_pq_build.cuh:1411-1432).
+    Returns codes [n, pq_dim] uint8."""
+    S, K, P = codebooks.shape
+    n = rot_rows.shape[0]
+
+    def encode_block(args):
+        rows, lbls = args
+        res = rows - centers_rot[lbls]                    # [b, rot_dim]
+        sub = res.reshape(res.shape[0], S, P)             # [b, S, P]
+        # ‖sub − cb‖² argmin over K: [b, S, K]
+        d2 = (jnp.sum(sub**2, -1)[..., None]
+              + jnp.sum(codebooks**2, -1)[None]
+              - 2.0 * jnp.einsum("bsp,skp->bsk", sub, codebooks,
+                                 precision=get_precision()))
+        return jnp.argmin(d2, axis=-1).astype(jnp.uint8)  # [b, S]
+
+    if n <= block:
+        return encode_block((rot_rows, labels))
+    n_blocks = -(-n // block)
+    pad = n_blocks * block - n
+    rows_p = jnp.pad(rot_rows, ((0, pad), (0, 0)))
+    lbls_p = jnp.pad(labels, (0, pad))
+    out = lax.map(encode_block, (rows_p.reshape(n_blocks, block, -1),
+                                 lbls_p.reshape(n_blocks, block)))
+    return out.reshape(n_blocks * block, S)[:n]
+
+
+def _decode_codes(codes: jax.Array, codebooks: jax.Array) -> jax.Array:
+    """codes [..., S] u8 → decoded residuals [..., S*P] f32."""
+    S, K, P = codebooks.shape
+    gathered = codebooks[jnp.arange(S), codes.astype(jnp.int32)]  # [..., S, P]
+    return gathered.reshape(*codes.shape[:-1], S * P)
+
+
+def _pack_codes(codes: np.ndarray, labels: np.ndarray, norms: np.ndarray,
+                n_lists: int, max_list_size: int, row_ids: np.ndarray):
+    n, S = codes.shape
+    order = np.argsort(labels, kind="stable")
+    sorted_labels = labels[order]
+    packed = np.zeros((n_lists, max_list_size, S), np.uint8)
+    ids = np.full((n_lists, max_list_size), -1, np.int32)
+    pnorm = np.zeros((n_lists, max_list_size), np.float32)
+    sizes = np.zeros((n_lists,), np.int32)
+    starts = np.searchsorted(sorted_labels, np.arange(n_lists))
+    ends = np.searchsorted(sorted_labels, np.arange(n_lists), side="right")
+    dropped = 0
+    for l in range(n_lists):
+        rows = order[starts[l]:ends[l]]
+        if len(rows) > max_list_size:
+            dropped += len(rows) - max_list_size
+            rows = rows[:max_list_size]
+        packed[l, :len(rows)] = codes[rows]
+        ids[l, :len(rows)] = row_ids[rows]
+        pnorm[l, :len(rows)] = norms[rows]
+        sizes[l] = len(rows)
+    if dropped:
+        from raft_tpu.core import logging as _log
+        _log.warn("ivf_pq: dropped %d overflow vectors", dropped)
+    return packed, ids, pnorm, sizes
+
+
+def build(dataset: jax.Array, params: Optional[IndexParams] = None) -> IvfPqIndex:
+    """Build the index (reference: ivf_pq::build, detail/ivf_pq_build.cuh:1511)."""
+    if params is None:
+        params = IndexParams()
+    mt = resolve_metric(params.metric)
+    expects(params.codebook_kind == "per_subspace",
+            "only per_subspace codebooks are implemented (per_cluster: TODO)")
+    expects(4 <= params.pq_bits <= 8, "pq_bits must be in [4, 8]")
+
+    x = jnp.asarray(dataset, jnp.float32)
+    n, dim = x.shape
+    spherical = mt in (DistanceType.InnerProduct, DistanceType.CosineExpanded)
+    if mt == DistanceType.CosineExpanded:
+        x = x / jnp.sqrt(jnp.maximum(jnp.sum(x * x, -1, keepdims=True), 1e-12))
+
+    pq_dim = params.pq_dim or _default_pq_dim(dim)
+    pq_len = -(-dim // pq_dim)
+    rot_dim = pq_dim * pq_len
+    K = 1 << params.pq_bits
+    key = jax.random.PRNGKey(params.seed)
+
+    # 1. coarse centers (balanced kmeans on a trainset subsample)
+    n_train = min(n, max(params.n_lists * 4,
+                         int(n * params.kmeans_trainset_fraction)))
+    if n_train < n:
+        rng = np.random.default_rng(params.seed)
+        tr = jnp.asarray(np.sort(rng.choice(n, n_train, replace=False)))
+        trainset = x[tr]
+    else:
+        trainset = x
+    km = KMeansBalancedParams(n_iters=params.kmeans_n_iters,
+                              metric="cosine" if spherical else "l2",
+                              seed=params.seed)
+    centers = kmeans_balanced.fit(trainset, params.n_lists, km)
+
+    # 2. rotation
+    rotation = make_rotation_matrix(jax.random.fold_in(key, 1), rot_dim, dim)
+    centers_rot = centers @ rotation.T
+
+    # 3. per-subspace codebooks on trainset residuals
+    tr_labels = kmeans_balanced.predict(centers, trainset, km)
+    tr_rot = trainset @ rotation.T
+    tr_res = tr_rot - centers_rot[tr_labels]
+    sub = jnp.transpose(tr_res.reshape(n_train, pq_dim, pq_len), (1, 0, 2))
+    codebooks = _vmapped_lloyd(sub, K, params.kmeans_n_iters,
+                               jax.random.fold_in(key, 2))
+
+    avg = max(1, n // params.n_lists)
+    max_list_size = max(8, int(avg * params.list_size_cap_factor))
+
+    if not params.add_data_on_build:
+        return IvfPqIndex(
+            centers=centers, centers_rot=centers_rot, rotation=rotation,
+            codebooks=codebooks,
+            packed_codes=jnp.zeros((params.n_lists, max_list_size, pq_dim), jnp.uint8),
+            packed_ids=jnp.full((params.n_lists, max_list_size), -1, jnp.int32),
+            packed_norms=jnp.zeros((params.n_lists, max_list_size), jnp.float32),
+            list_sizes=jnp.zeros((params.n_lists,), jnp.int32),
+            metric=mt.value)
+
+    # 4. encode + pack all rows
+    labels = kmeans_balanced.predict(centers, x, km)
+    x_rot = x @ rotation.T
+    codes = _encode_rows(x_rot, centers_rot, labels, codebooks)
+    decoded = _decode_codes(codes, codebooks)
+    recon = centers_rot[labels] + decoded
+    norms = jnp.sum(recon * recon, axis=1)
+
+    packed, ids, pnorm, sizes = _pack_codes(
+        np.asarray(codes), np.asarray(labels), np.asarray(norms),
+        params.n_lists, max_list_size, np.arange(n))
+    return IvfPqIndex(
+        centers=centers, centers_rot=centers_rot, rotation=rotation,
+        codebooks=codebooks, packed_codes=jnp.asarray(packed),
+        packed_ids=jnp.asarray(ids), packed_norms=jnp.asarray(pnorm),
+        list_sizes=jnp.asarray(sizes), metric=mt.value)
+
+
+def extend(index: IvfPqIndex, new_vectors: jax.Array,
+           new_ids: Optional[jax.Array] = None) -> IvfPqIndex:
+    """Append vectors (reference: ivf_pq::extend): encode against existing
+    centers/codebooks, host re-pack with capacity growth."""
+    mt = resolve_metric(index.metric)
+    spherical = mt in (DistanceType.InnerProduct, DistanceType.CosineExpanded)
+    km = KMeansBalancedParams(metric="cosine" if spherical else "l2")
+    x = jnp.asarray(new_vectors, jnp.float32)
+    if mt == DistanceType.CosineExpanded:
+        x = x / jnp.sqrt(jnp.maximum(jnp.sum(x * x, -1, keepdims=True), 1e-12))
+    old_n = index.size
+    if new_ids is None:
+        new_ids = jnp.arange(old_n, old_n + x.shape[0], dtype=jnp.int32)
+
+    labels = kmeans_balanced.predict(index.centers, x, km)
+    x_rot = x @ index.rotation.T
+    codes = _encode_rows(x_rot, index.centers_rot, labels, index.codebooks)
+    decoded = _decode_codes(codes, index.codebooks)
+    recon = index.centers_rot[labels] + decoded
+    norms = jnp.sum(recon * recon, axis=1)
+
+    n_lists, L, S = index.packed_codes.shape
+    old_sizes = np.asarray(index.list_sizes)
+    labels_h = np.asarray(labels)
+    need = old_sizes + np.bincount(labels_h, minlength=n_lists)
+    new_L = max(L, max(8, -(-int(need.max()) // 8) * 8))
+
+    packed = np.zeros((n_lists, new_L, S), np.uint8)
+    ids = np.full((n_lists, new_L), -1, np.int32)
+    pnorm = np.zeros((n_lists, new_L), np.float32)
+    packed[:, :L] = np.asarray(index.packed_codes)
+    ids[:, :L] = np.asarray(index.packed_ids)
+    pnorm[:, :L] = np.asarray(index.packed_norms)
+    codes_h, norms_h, nid_h = np.asarray(codes), np.asarray(norms), np.asarray(new_ids)
+    fill = old_sizes.copy()
+    for row, lbl in enumerate(labels_h):
+        p = fill[lbl]
+        if p >= new_L:
+            continue
+        packed[lbl, p] = codes_h[row]
+        ids[lbl, p] = nid_h[row]
+        pnorm[lbl, p] = norms_h[row]
+        fill[lbl] += 1
+    return IvfPqIndex(
+        centers=index.centers, centers_rot=index.centers_rot,
+        rotation=index.rotation, codebooks=index.codebooks,
+        packed_codes=jnp.asarray(packed), packed_ids=jnp.asarray(ids),
+        packed_norms=jnp.asarray(pnorm),
+        list_sizes=jnp.asarray(fill.astype(np.int32)), metric=index.metric)
+
+
+# ---------------------------------------------------------------------------
+# search
+# ---------------------------------------------------------------------------
+
+@partial(jax.jit, static_argnames=("k", "n_probes", "query_tile"))
+def _search_impl(index: IvfPqIndex, queries: jax.Array, k: int,
+                 n_probes: int, query_tile: int):
+    mt = resolve_metric(index.metric)
+    q_all = jnp.asarray(queries, jnp.float32)
+    if mt == DistanceType.CosineExpanded:
+        q_all = q_all / jnp.sqrt(jnp.maximum(
+            jnp.sum(q_all * q_all, -1, keepdims=True), 1e-12))
+    m = q_all.shape[0]
+    S, K, P = index.codebooks.shape
+    L = index.max_list_size
+    ip_like = mt in (DistanceType.InnerProduct, DistanceType.CosineExpanded)
+    sqrt_out = mt == DistanceType.L2SqrtExpanded
+    select_min = not ip_like
+
+    # probe selection on q·c (select_clusters, ivf_pq_search.cuh:70-156)
+    qc = lax.dot_general(q_all, index.centers, (((1,), (1,)), ((), ())),
+                         precision=get_precision(),
+                         preferred_element_type=jnp.float32)  # [m, n_lists]
+    if ip_like:
+        coarse = qc
+        _, probes = _select_k(coarse, n_probes, select_min=False)
+    else:
+        c_sq = jnp.sum(index.centers**2, axis=1)
+        coarse = c_sq[None, :] - 2.0 * qc
+        _, probes = _select_k(coarse, n_probes, select_min=True)
+
+    q_rot_all = q_all @ index.rotation.T
+    q_sq_all = jnp.sum(q_rot_all * q_rot_all, axis=1)
+    qc_probed_all = jnp.take_along_axis(qc, probes, axis=1)  # [m, P] ⟨q,c⟩
+
+    def search_tile(args):
+        q_rot, probe, qc_probed, q_sq = args
+        t = q_rot.shape[0]
+        # query-only LUT: ⟨q_s, cb[s,k]⟩ — one batched MXU contraction
+        q_sub = q_rot.reshape(t, S, P)
+        qlut = jnp.einsum("tsp,skp->tsk", q_sub, index.codebooks,
+                          precision=get_precision())      # [t, S, K]
+        codes = index.packed_codes[probe]                 # [t, Pr, L, S]
+        cand_ids = index.packed_ids[probe].reshape(t, n_probes * L)
+        cand_norms = index.packed_norms[probe].reshape(t, n_probes * L)
+        # ⟨q, d⟩ via gather+sum over subspaces (the reference's fused scan;
+        # Pallas target): qd[t,c] = Σ_s qlut[t, s, codes[t,c,s]]
+        idx = codes.reshape(t, n_probes * L, S).astype(jnp.int32)
+        idx_t = jnp.transpose(idx, (0, 2, 1))             # [t, S, C]
+        gath = jnp.take_along_axis(qlut, idx_t, axis=2)   # [t, S, C]
+        qd = jnp.sum(gath, axis=1)                        # [t, C]
+        qcand = jnp.broadcast_to(qc_probed[:, :, None],
+                                 (t, n_probes, L)).reshape(t, n_probes * L)
+        if ip_like:
+            dists = qcand + qd
+            invalid = -jnp.inf
+            final_min = False
+        else:
+            dists = jnp.maximum(
+                q_sq[:, None] - 2.0 * (qcand + qd) + cand_norms, 0.0)
+            if sqrt_out:
+                dists = jnp.sqrt(dists)
+            invalid = jnp.inf
+            final_min = True
+        dists = jnp.where(cand_ids >= 0, dists, invalid)
+        vals, pos = _select_k(dists, k, select_min=final_min)
+        ids = jnp.take_along_axis(cand_ids, pos, axis=1)
+        if ip_like and mt == DistanceType.CosineExpanded:
+            vals = 1.0 - vals  # report cosine distance
+        return vals, ids
+
+    if m <= query_tile:
+        return search_tile((q_rot_all, probes, qc_probed_all, q_sq_all))
+
+    n_tiles = -(-m // query_tile)
+    pad = n_tiles * query_tile - m
+    qr = jnp.pad(q_rot_all, ((0, pad), (0, 0)))
+    pr = jnp.pad(probes, ((0, pad), (0, 0)))
+    qp = jnp.pad(qc_probed_all, ((0, pad), (0, 0)))
+    qs = jnp.pad(q_sq_all, (0, pad))
+    vals, ids = lax.map(search_tile, (
+        qr.reshape(n_tiles, query_tile, -1),
+        pr.reshape(n_tiles, query_tile, -1),
+        qp.reshape(n_tiles, query_tile, -1),
+        qs.reshape(n_tiles, query_tile)))
+    return (vals.reshape(-1, k)[:m], ids.reshape(-1, k)[:m])
+
+
+def search(index: IvfPqIndex, queries: jax.Array, k: int,
+           params: Optional[SearchParams] = None) -> Tuple[jax.Array, jax.Array]:
+    """Search (reference: ivf_pq::search, ivf_pq-inl.cuh:478). Distances are
+    PQ-approximate (as the reference's); use neighbors.refine for exact
+    re-ranking."""
+    if params is None:
+        params = SearchParams()
+    expects(queries.ndim == 2 and queries.shape[1] == index.dim,
+            "queries must be [m, %d]", index.dim)
+    n_probes = min(params.n_probes, index.n_lists)
+    return _search_impl(index, queries, k, n_probes, params.query_tile)
+
+
+# ---------------------------------------------------------------------------
+# serialization (reference: neighbors/ivf_pq_serialize.cuh)
+# ---------------------------------------------------------------------------
+
+def save(index: IvfPqIndex, path: str) -> None:
+    ser.save_arrays(path, "ivf_pq", _SERIAL_VERSION, {"metric": index.metric},
+                    {"centers": index.centers,
+                     "centers_rot": index.centers_rot,
+                     "rotation": index.rotation,
+                     "codebooks": index.codebooks,
+                     "packed_codes": index.packed_codes,
+                     "packed_ids": index.packed_ids,
+                     "packed_norms": index.packed_norms,
+                     "list_sizes": index.list_sizes})
+
+
+def load(path: str) -> IvfPqIndex:
+    version, meta, a = ser.load_arrays(path, "ivf_pq")
+    expects(version == _SERIAL_VERSION, "unsupported ivf_pq version %d", version)
+    return IvfPqIndex(
+        centers=jnp.asarray(a["centers"]),
+        centers_rot=jnp.asarray(a["centers_rot"]),
+        rotation=jnp.asarray(a["rotation"]),
+        codebooks=jnp.asarray(a["codebooks"]),
+        packed_codes=jnp.asarray(a["packed_codes"]),
+        packed_ids=jnp.asarray(a["packed_ids"]),
+        packed_norms=jnp.asarray(a["packed_norms"]),
+        list_sizes=jnp.asarray(a["list_sizes"]),
+        metric=meta["metric"])

@@ -1,0 +1,79 @@
+"""Refine — exact re-ranking of ANN candidate lists.
+
+TPU-native counterpart of ``raft::neighbors::refine`` (refine-inl.cuh;
+device kernel detail/refine_device.cuh, host/OpenMP variant
+detail/refine_host-inl.hpp). Gathers each query's candidate rows and
+recomputes exact distances (one batched MXU contraction), then selects the
+top-k. Used after IVF-PQ search to recover recall lost to quantization
+(the reference's refinement_rate pattern: search k·rate candidates,
+refine down to k).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from raft_tpu.core.errors import expects
+from raft_tpu.distance.types import DistanceType, resolve_metric
+from raft_tpu.matrix import select_k as _select_k
+from raft_tpu.utils.precision import get_precision
+
+
+@partial(jax.jit, static_argnames=("k", "metric"))
+def _refine_impl(dataset, queries, candidates, k: int, metric: str):
+    mt = resolve_metric(metric)
+    q = jnp.asarray(queries, jnp.float32)
+    m, n_cand = candidates.shape
+    safe_cand = jnp.maximum(candidates, 0)
+    cand_rows = dataset[safe_cand].astype(jnp.float32)    # [m, C, d]
+    scores = jnp.einsum("md,mcd->mc", q, cand_rows,
+                        precision=get_precision(),
+                        preferred_element_type=jnp.float32)
+    if mt == DistanceType.InnerProduct:
+        dists = scores
+        invalid = -jnp.inf
+        select_min = False
+    elif mt == DistanceType.CosineExpanded:
+        qn = jnp.sqrt(jnp.maximum(jnp.sum(q * q, 1), 1e-30))
+        cn = jnp.sqrt(jnp.maximum(jnp.sum(cand_rows**2, -1), 1e-30))
+        dists = 1.0 - scores / (qn[:, None] * cn)
+        invalid = jnp.inf
+        select_min = True
+    else:
+        q_sq = jnp.sum(q * q, axis=1)
+        c_sq = jnp.sum(cand_rows**2, axis=-1)
+        dists = jnp.maximum(q_sq[:, None] + c_sq - 2.0 * scores, 0.0)
+        if mt == DistanceType.L2SqrtExpanded:
+            dists = jnp.sqrt(dists)
+        invalid = jnp.inf
+        select_min = True
+    dists = jnp.where(candidates >= 0, dists, invalid)
+    vals, pos = _select_k(dists, k, select_min=select_min)
+    ids = jnp.take_along_axis(candidates, pos, axis=1)
+    return vals, ids
+
+
+def refine(
+    dataset: jax.Array,
+    queries: jax.Array,
+    candidates: jax.Array,
+    k: int,
+    metric="sqeuclidean",
+) -> Tuple[jax.Array, jax.Array]:
+    """Re-rank ``candidates`` [m, n_cand] (row ids into ``dataset``, -1 =
+    invalid) down to the exact top-k (reference: refine-inl.cuh).
+
+    Returns (distances [m, k], ids [m, k]).
+    """
+    expects(candidates.ndim == 2, "candidates must be [m, n_candidates]")
+    expects(queries.shape[0] == candidates.shape[0],
+            "queries/candidates row mismatch")
+    expects(k <= candidates.shape[1], "k=%d > n_candidates=%d",
+            k, candidates.shape[1])
+    mt = resolve_metric(metric)
+    return _refine_impl(dataset, queries, candidates, k, mt.value)

@@ -1,0 +1,141 @@
+"""IVF-PQ tests: recall vs naive + refine recovery (reference test model:
+cpp/test/neighbors/ann_ivf_pq.cuh:193 — recall vs naive_knn thresholds)."""
+
+import os
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from scipy.spatial.distance import cdist
+
+from raft_tpu.neighbors import ivf_pq, refine
+from raft_tpu.neighbors.ivf_pq import IndexParams, SearchParams
+from raft_tpu.random import make_blobs
+from raft_tpu.random.rng import RngState
+
+
+def recall_at_k(got_ids, ref_ids):
+    hits = sum(len(set(g) & set(r)) for g, r in zip(got_ids, ref_ids))
+    return hits / ref_ids.size
+
+
+@pytest.fixture(scope="module")
+def corpus():
+    x, _ = make_blobs(5000, 32, n_clusters=40, cluster_std=1.0,
+                      state=RngState(11))
+    q, _ = make_blobs(100, 32, n_clusters=40, cluster_std=1.0,
+                      state=RngState(12))
+    return np.asarray(x), np.asarray(q)
+
+
+class TestIvfPq:
+    def test_recall_l2(self, corpus):
+        x, q = corpus
+        idx = ivf_pq.build(jnp.asarray(x),
+                           IndexParams(n_lists=32, pq_dim=16, pq_bits=8,
+                                       kmeans_n_iters=20, seed=0))
+        _, ids = ivf_pq.search(idx, jnp.asarray(q), 10, SearchParams(n_probes=16))
+        full = cdist(q, x, "sqeuclidean")
+        ref = np.argsort(full, 1)[:, :10]
+        assert recall_at_k(np.asarray(ids), ref) >= 0.8  # PQ is lossy
+
+    def test_full_dim_codebooks_near_exact(self, corpus):
+        """pq_dim == dim (pq_len=1, 256 entries/subspace) ≈ fine scalar
+        quantization → near-exact recall with all probes."""
+        x, q = corpus
+        idx = ivf_pq.build(jnp.asarray(x),
+                           IndexParams(n_lists=16, pq_dim=32, pq_bits=8, seed=0))
+        _, ids = ivf_pq.search(idx, jnp.asarray(q), 10, SearchParams(n_probes=16))
+        full = cdist(q, x, "sqeuclidean")
+        ref = np.argsort(full, 1)[:, :10]
+        assert recall_at_k(np.asarray(ids), ref) >= 0.93
+
+    def test_refine_recovers_recall(self, corpus):
+        x, q = corpus
+        idx = ivf_pq.build(jnp.asarray(x),
+                           IndexParams(n_lists=32, pq_dim=8, pq_bits=8, seed=0))
+        # low pq_dim → lossy; search 5x candidates then refine to k
+        _, cand = ivf_pq.search(idx, jnp.asarray(q), 50, SearchParams(n_probes=16))
+        d_ref, ids_ref = refine.refine(jnp.asarray(x), jnp.asarray(q),
+                                       cand, 10, metric="sqeuclidean")
+        _, ids_raw = ivf_pq.search(idx, jnp.asarray(q), 10, SearchParams(n_probes=16))
+        full = cdist(q, x, "sqeuclidean")
+        ref = np.argsort(full, 1)[:, :10]
+        r_raw = recall_at_k(np.asarray(ids_raw), ref)
+        r_ref = recall_at_k(np.asarray(ids_ref), ref)
+        assert r_ref >= r_raw
+        assert r_ref >= 0.85
+
+    def test_approx_distance_error_bounded(self, corpus):
+        x, q = corpus
+        idx = ivf_pq.build(jnp.asarray(x),
+                           IndexParams(n_lists=16, pq_dim=16, pq_bits=8, seed=0))
+        dists, ids = ivf_pq.search(idx, jnp.asarray(q), 5, SearchParams(n_probes=16))
+        full = cdist(q, x, "sqeuclidean")
+        exact = np.take_along_axis(full, np.asarray(ids), axis=1)
+        got = np.asarray(dists)
+        rel_err = np.abs(got - exact) / np.maximum(exact, 1e-6)
+        assert np.median(rel_err) < 0.15
+
+    def test_inner_product(self, corpus):
+        x, q = corpus
+        # MIPS top-k has many near-ties; full-dim codebooks keep the
+        # quantization error below the tie margin
+        idx = ivf_pq.build(jnp.asarray(x),
+                           IndexParams(n_lists=16, pq_dim=32,
+                                       metric="inner_product", seed=0))
+        _, ids = ivf_pq.search(idx, jnp.asarray(q), 10, SearchParams(n_probes=16))
+        ref = np.argsort(-(q @ x.T), 1)[:, :10]
+        assert recall_at_k(np.asarray(ids), ref) >= 0.75
+
+    def test_cosine(self, corpus):
+        x, q = corpus
+        idx = ivf_pq.build(jnp.asarray(x),
+                           IndexParams(n_lists=16, pq_dim=32,
+                                       metric="cosine", seed=0))
+        dists, ids = ivf_pq.search(idx, jnp.asarray(q), 10, SearchParams(n_probes=16))
+        ref = np.argsort(cdist(q, x, "cosine"), 1)[:, :10]
+        assert recall_at_k(np.asarray(ids), ref) >= 0.75
+        assert np.asarray(dists).min() >= -0.01  # cosine distances ≥ 0
+
+    def test_query_tiling_matches(self, corpus):
+        x, q = corpus
+        idx = ivf_pq.build(jnp.asarray(x), IndexParams(n_lists=16, pq_dim=16, seed=0))
+        d1, i1 = ivf_pq.search(idx, jnp.asarray(q), 5,
+                               SearchParams(n_probes=8, query_tile=256))
+        d2, i2 = ivf_pq.search(idx, jnp.asarray(q), 5,
+                               SearchParams(n_probes=8, query_tile=16))
+        np.testing.assert_allclose(np.asarray(d1), np.asarray(d2), rtol=1e-5)
+        np.testing.assert_array_equal(np.asarray(i1), np.asarray(i2))
+
+    def test_extend(self, corpus):
+        x, q = corpus
+        half = len(x) // 2
+        idx = ivf_pq.build(jnp.asarray(x[:half]),
+                           IndexParams(n_lists=16, pq_dim=16, seed=0))
+        idx = ivf_pq.extend(idx, jnp.asarray(x[half:]))
+        assert idx.size == len(x)
+        _, ids = ivf_pq.search(idx, jnp.asarray(q), 10, SearchParams(n_probes=16))
+        full = cdist(q, x, "sqeuclidean")
+        ref = np.argsort(full, 1)[:, :10]
+        assert recall_at_k(np.asarray(ids), ref) >= 0.75
+
+    def test_serialize_roundtrip(self, corpus, tmp_path):
+        x, q = corpus
+        idx = ivf_pq.build(jnp.asarray(x), IndexParams(n_lists=16, pq_dim=16, seed=0))
+        path = os.path.join(tmp_path, "ivf_pq.idx")
+        ivf_pq.save(idx, path)
+        idx2 = ivf_pq.load(path)
+        d1, i1 = ivf_pq.search(idx, jnp.asarray(q), 5, SearchParams(n_probes=8))
+        d2, i2 = ivf_pq.search(idx2, jnp.asarray(q), 5, SearchParams(n_probes=8))
+        np.testing.assert_array_equal(np.asarray(i1), np.asarray(i2))
+        np.testing.assert_allclose(np.asarray(d1), np.asarray(d2), rtol=1e-6)
+
+    def test_rotation_orthonormal(self):
+        import jax
+
+        from raft_tpu.neighbors.ivf_pq import make_rotation_matrix
+
+        r = make_rotation_matrix(jax.random.PRNGKey(0), 40, 32)
+        np.testing.assert_allclose(np.asarray(r.T @ r), np.eye(32),
+                                   atol=1e-5)
