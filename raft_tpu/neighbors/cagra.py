@@ -1,0 +1,339 @@
+"""CAGRA — graph-based ANN: knn-graph build + greedy traversal search.
+
+TPU-native re-design of ``raft::neighbors::cagra`` (cagra.cuh:354;
+build detail/cagra/cagra_build.cuh:47-89; optimize graph_core.cuh;
+search cagra_search.cuh:105 + search_single_cta_kernel-inl.cuh). Paper:
+arXiv:2308.15136 (cited in reference README.md:348). Design mapping:
+
+- **build**: knn-graph from IVF-PQ search over the dataset itself + exact
+  refine (the reference's default path, cagra_build.cuh:89-173), then
+  ``optimize``: rank-based detourable-edge pruning + reverse-edge
+  augmentation (graph_core.cuh) — expressed as batched gather/compare
+  tensor ops instead of per-edge CUDA kernels;
+- **search**: the reference runs one CTA per query doing a data-dependent
+  greedy walk with a visited hashmap and a bitonic itopk buffer. A
+  lockstep-SIMD machine wants *fixed-shape* iterations: we batch all
+  queries and run a ``lax.while_loop`` whose body expands
+  ``search_width`` parents per query (gather graph rows → gather vectors
+  → one batched MXU contraction → mask-dedupe against the itopk buffer →
+  ``top_k`` merge), with per-entry visited bits replacing the hashmap.
+  Iterations stop when every query's top-k is settled (all-parents-
+  visited), bounded by ``max_iterations``.
+
+The itopk buffer doubles as the visited-dedup set: a candidate already in
+the buffer is marked +inf before the merge. Entries are (dist, id,
+visited-bit); parents are the best unvisited entries.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from functools import partial
+from typing import Optional, Tuple
+
+import flax.struct
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from raft_tpu.core.errors import expects
+from raft_tpu.core import serialize as ser
+from raft_tpu.distance.types import DistanceType, resolve_metric
+from raft_tpu.matrix import select_k as _select_k
+from raft_tpu.neighbors import ivf_pq as _ivf_pq
+from raft_tpu.neighbors.refine import refine as _refine
+from raft_tpu.utils.precision import get_precision
+
+_SERIAL_VERSION = 1
+
+
+@dataclasses.dataclass
+class IndexParams:
+    """reference: ``cagra::index_params`` (cagra_types.hpp:47-60)."""
+
+    intermediate_graph_degree: int = 128
+    graph_degree: int = 64
+    metric: str = "sqeuclidean"
+    build_algo: str = "ivf_pq"  # | "nn_descent"
+    nn_descent_niter: int = 20
+    seed: int = 0
+
+
+@dataclasses.dataclass
+class SearchParams:
+    """reference: ``cagra::search_params`` (cagra_types.hpp:54-112)."""
+
+    itopk_size: int = 64
+    search_width: int = 4
+    max_iterations: int = 0   # 0 → auto: ceil(itopk/search_width) * 2
+    query_tile: int = 256
+
+
+class CagraIndex(flax.struct.PyTreeNode):
+    """reference: ``cagra::index`` (cagra_types.hpp)."""
+
+    dataset: jax.Array   # [n, dim]
+    graph: jax.Array     # [n, graph_degree] i32
+    metric: str = flax.struct.field(pytree_node=False, default="sqeuclidean")
+
+    @property
+    def size(self) -> int:
+        return self.dataset.shape[0]
+
+    @property
+    def dim(self) -> int:
+        return self.dataset.shape[1]
+
+    @property
+    def graph_degree(self) -> int:
+        return self.graph.shape[1]
+
+
+# ---------------------------------------------------------------------------
+# build
+# ---------------------------------------------------------------------------
+
+def build_knn_graph(dataset: jax.Array, k: int, metric: str = "sqeuclidean",
+                    seed: int = 0) -> jax.Array:
+    """k-NN graph via IVF-PQ self-search + exact refine
+    (reference: cagra_build.cuh:89 build_knn_graph — ivf_pq::build, batched
+    search with gpu_top_k = k·refine_rate :102, refine :173)."""
+    x = jnp.asarray(dataset, jnp.float32)
+    n, d = x.shape
+    n_lists = max(8, min(1024, int(np.sqrt(n) / 2) or 8))
+    pq_dim = max(8, min(d, -(-d // 2 // 8) * 8))
+    idx = _ivf_pq.build(x, _ivf_pq.IndexParams(
+        n_lists=n_lists, pq_dim=pq_dim, pq_bits=8, metric=metric,
+        kmeans_trainset_fraction=min(1.0, 10.0 * n_lists / n + 0.1),
+        seed=seed))
+    gpu_top_k = min(n, 2 * (k + 1))  # refine_rate 2
+    n_probes = max(2, n_lists // 8)
+    _, cand = _ivf_pq.search(idx, x, gpu_top_k,
+                             _ivf_pq.SearchParams(n_probes=n_probes))
+    _, knn_ids = _refine(x, x, cand, k + 1, metric=metric)
+    # drop self-edges: if a row's first hit is itself, skip it, else drop last
+    self_col = knn_ids == jnp.arange(n, dtype=knn_ids.dtype)[:, None]
+    # stable partition: non-self entries first, keep k of them
+    order = jnp.argsort(self_col, axis=1, stable=True)  # False (non-self) first
+    cleaned = jnp.take_along_axis(knn_ids, order, axis=1)[:, :k]
+    return cleaned.astype(jnp.int32)
+
+
+@partial(jax.jit, static_argnames=("out_degree",))
+def optimize_graph(knn_graph: jax.Array, out_degree: int) -> jax.Array:
+    """Detourable-edge pruning + reverse-edge augmentation
+    (reference: graph_core.cuh optimize, 572 LoC; CAGRA paper §4.1).
+
+    Edge u→v (rank i in u's list) is *detourable* through w (rank j<i) if
+    v also appears in w's own neighbor list at a rank < i — i.e. the
+    two-hop path u→w→v uses strictly closer edges. Edges with the fewest
+    detour paths are kept; half the output degree is then filled with
+    reverse edges (incoming links), which CAGRA shows is what makes the
+    graph navigable.
+    """
+    n, D = knn_graph.shape
+    d_half = out_degree // 2
+
+    def detour_counts(u_list):
+        # u_list: [D] neighbor ids sorted by distance rank
+        nbr_lists = knn_graph[u_list]                     # [D, D] lists of w=u_list[j]
+        # pos[j, i] = rank of u_list[i] in w_j's list (D if absent)
+        eq = nbr_lists[:, :, None] == u_list[None, None, :]  # [D(j), D(pos), D(i)]
+        pos = jnp.min(jnp.where(eq, jnp.arange(D)[None, :, None], D), axis=1)  # [D(j), D(i)]
+        ranks = jnp.arange(D)
+        # detour via w_j for edge i: j < i  AND  pos[j, i] < i
+        detour = (ranks[:, None] < ranks[None, :]) & (pos < ranks[None, :])
+        return jnp.sum(detour, axis=0)                    # [D] counts per edge i
+
+    counts = lax.map(detour_counts, knn_graph, batch_size=256)  # [n, D]
+    # keep lowest-detour-count edges, tie-broken by distance rank
+    score = counts.astype(jnp.int32) * D + jnp.arange(D, dtype=jnp.int32)[None, :]
+    keep = jnp.argsort(score, axis=1)[:, :out_degree]
+    pruned = jnp.take_along_axis(knn_graph, keep, axis=1)  # [n, out_degree]
+
+    # reverse-edge augmentation: for each node, gather up to d_half incoming
+    # edges (from the pruned forward graph) and splice them after the
+    # d_half best forward edges (graph_core.cuh rev_graph).
+    fwd = pruned[:, :d_half]
+    src = jnp.repeat(jnp.arange(n, dtype=jnp.int32)[:, None], d_half, 1).reshape(-1)
+    dst = fwd.reshape(-1)
+    # count and slot reverse edges per destination node
+    order = jnp.argsort(dst, stable=True)
+    dst_s, src_s = dst[order], src[order]
+    # position of each edge within its destination group
+    first_idx = jnp.searchsorted(dst_s, jnp.arange(n))
+    slot = jnp.arange(dst_s.shape[0]) - first_idx[dst_s]
+    rev = jnp.full((n, d_half), -1, jnp.int32)
+    valid = slot < d_half
+    # out-of-quota reverse edges write to row n → dropped
+    rev = rev.at[jnp.where(valid, dst_s, n),
+                 jnp.clip(slot, 0, d_half - 1)].set(src_s, mode="drop")
+    # final graph: best forward half + reverse half (fall back to forward
+    # edges where no reverse edge exists)
+    fallback = pruned[:, d_half:out_degree]
+    merged = jnp.where(rev >= 0, rev, fallback)
+    return jnp.concatenate([fwd, merged], axis=1)
+
+
+def build(dataset: jax.Array, params: Optional[IndexParams] = None) -> CagraIndex:
+    """Build (reference: cagra::build, cagra.cuh — knn-graph + optimize)."""
+    if params is None:
+        params = IndexParams()
+    mt = resolve_metric(params.metric)
+    expects(mt in (DistanceType.L2Expanded, DistanceType.L2SqrtExpanded,
+                   DistanceType.InnerProduct),
+            "cagra supports sqeuclidean/euclidean/inner_product")
+    x = jnp.asarray(dataset, jnp.float32)
+    n = x.shape[0]
+    inter_d = min(params.intermediate_graph_degree, n - 1)
+    out_d = min(params.graph_degree, inter_d)
+    if params.build_algo == "nn_descent":
+        from raft_tpu.neighbors.nn_descent import build_knn_graph as _nnd
+        knn = _nnd(x, inter_d, metric=mt.value, n_iters=params.nn_descent_niter,
+                   seed=params.seed)
+    else:
+        knn = build_knn_graph(x, inter_d, metric=mt.value, seed=params.seed)
+    graph = optimize_graph(knn, out_d)
+    return CagraIndex(dataset=x, graph=graph, metric=mt.value)
+
+
+# ---------------------------------------------------------------------------
+# search
+# ---------------------------------------------------------------------------
+
+@partial(jax.jit, static_argnames=("k", "itopk_size", "search_width",
+                                   "max_iterations", "query_tile"))
+def _search_impl(index: CagraIndex, queries: jax.Array, k: int,
+                 itopk_size: int, search_width: int, max_iterations: int,
+                 query_tile: int):
+    mt = resolve_metric(index.metric)
+    ip = mt == DistanceType.InnerProduct
+    sqrt_out = mt == DistanceType.L2SqrtExpanded
+    x = index.dataset
+    n, d = x.shape
+    deg = index.graph_degree
+    m = queries.shape[0]
+    q_all = jnp.asarray(queries, jnp.float32)
+    BIG = jnp.float32(jnp.inf)
+    x_sq = jnp.sum(x * x, axis=1)
+
+    def dists_to(q, ids):
+        """q [t, d], ids [t, C] → metric scores [t, C] (lower = better)."""
+        rows = x[ids]                                     # [t, C, d]
+        s = jnp.einsum("td,tcd->tc", q, rows,
+                       precision=get_precision(),
+                       preferred_element_type=jnp.float32)
+        if ip:
+            return -s
+        return jnp.maximum(jnp.sum(q * q, 1)[:, None] + x_sq[ids] - 2.0 * s, 0.0)
+
+    def search_tile(q):
+        t = q.shape[0]
+        key = jax.random.PRNGKey(0)
+        # random entry points (reference: random_sampling of initial itopk)
+        init_ids = jax.random.choice(key, n, (itopk_size,), replace=False)
+        init_ids = jnp.broadcast_to(init_ids[None, :], (t, itopk_size))
+        buf_d = dists_to(q, init_ids)
+        buf_i = init_ids.astype(jnp.int32)
+        order = jnp.argsort(buf_d, axis=1)
+        buf_d = jnp.take_along_axis(buf_d, order, 1)
+        buf_i = jnp.take_along_axis(buf_i, order, 1)
+        buf_v = jnp.zeros((t, itopk_size), jnp.bool_)     # visited bits
+
+        def cond(state):
+            _, _, buf_v, it = state
+            # stop when every query's whole itopk buffer is visited
+            # (the reference iterates until the itopk converges)
+            return (it < max_iterations) & ~jnp.all(buf_v)
+
+        def body(state):
+            buf_d, buf_i, buf_v, it = state
+            # freeze settled queries (whole buffer visited): their updates
+            # are discarded, so results don't depend on query tiling
+            frozen = jnp.all(buf_v, axis=1)
+            old = (buf_d, buf_i, buf_v)
+            # 1. pick search_width best unvisited parents
+            cand_score = jnp.where(buf_v, BIG, buf_d)
+            _, parent_pos = lax.top_k(-cand_score, search_width)   # [t, W]
+            parent_ids = jnp.take_along_axis(buf_i, parent_pos, 1)
+            parent_valid = jnp.take_along_axis(cand_score, parent_pos, 1) < BIG
+            # mark visited
+            buf_v = buf_v.at[jnp.arange(t)[:, None], parent_pos].set(True)
+            # 2. expand: gather graph rows of parents → [t, W·deg]
+            nbrs = index.graph[jnp.clip(parent_ids, 0, n - 1)]     # [t, W, deg]
+            nbrs = nbrs.reshape(t, search_width * deg)
+            nbrs = jnp.where(jnp.repeat(parent_valid, deg, axis=1), nbrs, 0)
+            # 3. distances on the MXU
+            nd = dists_to(q, nbrs)
+            nd = jnp.where(jnp.repeat(parent_valid, deg, axis=1), nd, BIG)
+            # 4. dedupe against the buffer (the visited-hashmap stand-in)
+            dup = jnp.any(nbrs[:, :, None] == buf_i[:, None, :], axis=2)
+            nd = jnp.where(dup, BIG, nd)
+            # dedupe within the candidate set (first occurrence wins)
+            eq = nbrs[:, :, None] == nbrs[:, None, :]
+            earlier = jnp.tril(jnp.ones((search_width * deg,) * 2, jnp.bool_), -1)
+            nd = jnp.where(jnp.any(eq & earlier[None], axis=2), BIG, nd)
+            # 5. merge into itopk: concat + select
+            all_d = jnp.concatenate([buf_d, nd], axis=1)
+            all_i = jnp.concatenate([buf_i, nbrs.astype(jnp.int32)], axis=1)
+            all_v = jnp.concatenate(
+                [buf_v, jnp.zeros_like(nd, dtype=jnp.bool_)], axis=1)
+            _, pos = lax.top_k(-all_d, itopk_size)
+            buf_d = jnp.take_along_axis(all_d, pos, 1)
+            buf_i = jnp.take_along_axis(all_i, pos, 1)
+            buf_v = jnp.take_along_axis(all_v, pos, 1)
+            buf_d = jnp.where(frozen[:, None], old[0], buf_d)
+            buf_i = jnp.where(frozen[:, None], old[1], buf_i)
+            buf_v = jnp.where(frozen[:, None], old[2], buf_v)
+            return buf_d, buf_i, buf_v, it + 1
+
+        buf_d, buf_i, _, _ = lax.while_loop(
+            cond, body, (buf_d, buf_i, buf_v, jnp.array(0, jnp.int32)))
+        out_d, out_i = buf_d[:, :k], buf_i[:, :k]
+        if ip:
+            out_d = -out_d
+        elif sqrt_out:
+            out_d = jnp.sqrt(out_d)
+        return out_d, out_i
+
+    if m <= query_tile:
+        return search_tile(q_all)
+    n_tiles = -(-m // query_tile)
+    pad = n_tiles * query_tile - m
+    qp = jnp.pad(q_all, ((0, pad), (0, 0)))
+    vals, ids = lax.map(search_tile, qp.reshape(n_tiles, query_tile, d))
+    return vals.reshape(-1, k)[:m], ids.reshape(-1, k)[:m]
+
+
+def search(index: CagraIndex, queries: jax.Array, k: int,
+           params: Optional[SearchParams] = None) -> Tuple[jax.Array, jax.Array]:
+    """Search (reference: cagra::search → search_main, cagra_search.cuh:105)."""
+    if params is None:
+        params = SearchParams()
+    expects(queries.ndim == 2 and queries.shape[1] == index.dim,
+            "queries must be [m, %d]", index.dim)
+    itopk = max(params.itopk_size, k)
+    max_it = params.max_iterations or 2 * (-(-itopk // params.search_width))
+    return _search_impl(index, queries, k, itopk, params.search_width,
+                        max_it, params.query_tile)
+
+
+# ---------------------------------------------------------------------------
+# serialization (reference: neighbors/cagra_serialize.cuh)
+# ---------------------------------------------------------------------------
+
+def save(index: CagraIndex, path: str, include_dataset: bool = True) -> None:
+    arrays = {"graph": index.graph}
+    if include_dataset:
+        arrays["dataset"] = index.dataset
+    ser.save_arrays(path, "cagra", _SERIAL_VERSION,
+                    {"metric": index.metric}, arrays)
+
+
+def load(path: str, dataset: Optional[jax.Array] = None) -> CagraIndex:
+    version, meta, a = ser.load_arrays(path, "cagra")
+    expects(version == _SERIAL_VERSION, "unsupported cagra version %d", version)
+    ds = jnp.asarray(a["dataset"]) if "dataset" in a else jnp.asarray(dataset)
+    return CagraIndex(dataset=ds, graph=jnp.asarray(a["graph"]),
+                      metric=meta["metric"])

@@ -1,0 +1,119 @@
+"""CAGRA tests: graph quality + search recall vs naive (reference test
+model: cpp/test/neighbors/ann_cagra/ recall thresholds)."""
+
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from scipy.spatial.distance import cdist
+
+from raft_tpu.neighbors import cagra
+from raft_tpu.neighbors.cagra import IndexParams, SearchParams
+from raft_tpu.random import make_blobs
+from raft_tpu.random.rng import RngState
+
+
+def recall_at_k(got_ids, ref_ids):
+    hits = sum(len(set(g) & set(r)) for g, r in zip(got_ids, ref_ids))
+    return hits / ref_ids.size
+
+
+@pytest.fixture(scope="module")
+def corpus():
+    x, _ = make_blobs(3000, 24, n_clusters=30, cluster_std=1.2,
+                      state=RngState(21))
+    q, _ = make_blobs(80, 24, n_clusters=30, cluster_std=1.2,
+                      state=RngState(22))
+    return np.asarray(x), np.asarray(q)
+
+
+@pytest.fixture(scope="module")
+def built_index(corpus):
+    x, _ = corpus
+    return cagra.build(jnp.asarray(x),
+                       IndexParams(intermediate_graph_degree=48,
+                                   graph_degree=24, seed=0))
+
+
+class TestCagraBuild:
+    def test_graph_shape_and_validity(self, built_index, corpus):
+        x, _ = corpus
+        g = np.asarray(built_index.graph)
+        assert g.shape == (len(x), 24)
+        assert (g >= 0).all() and (g < len(x)).all()
+        # no self-loops in the forward half
+        assert (g[:, :12] != np.arange(len(x))[:, None]).all()
+
+    def test_knn_graph_quality(self, corpus):
+        """The intermediate knn graph must mostly agree with exact knn."""
+        x, _ = corpus
+        knn = np.asarray(cagra.build_knn_graph(jnp.asarray(x), 10))
+        full = cdist(x, x, "sqeuclidean")
+        np.fill_diagonal(full, np.inf)
+        ref = np.argsort(full, 1)[:, :10]
+        assert recall_at_k(knn, ref) >= 0.9
+
+    def test_optimize_graph_connectivity(self, built_index, corpus):
+        """Reverse-edge augmentation keeps in-degree spread reasonable."""
+        x, _ = corpus
+        g = np.asarray(built_index.graph)
+        indeg = np.bincount(g.reshape(-1), minlength=len(x))
+        assert (indeg > 0).mean() > 0.95  # nearly every node reachable
+
+
+class TestCagraSearch:
+    def test_recall(self, built_index, corpus):
+        x, q = corpus
+        dists, ids = cagra.search(built_index, jnp.asarray(q), 10,
+                                  SearchParams(itopk_size=64, search_width=4))
+        full = cdist(q, x, "sqeuclidean")
+        ref = np.argsort(full, 1)[:, :10]
+        assert recall_at_k(np.asarray(ids), ref) >= 0.9
+
+    def test_distances_are_exact_for_found_ids(self, built_index, corpus):
+        x, q = corpus
+        dists, ids = cagra.search(built_index, jnp.asarray(q), 5,
+                                  SearchParams(itopk_size=32))
+        full = cdist(q, x, "sqeuclidean")
+        exact = np.take_along_axis(full, np.asarray(ids), axis=1)
+        np.testing.assert_allclose(np.asarray(dists), exact, rtol=1e-3,
+                                   atol=1e-3)
+
+    def test_wider_search_improves_recall(self, built_index, corpus):
+        x, q = corpus
+        full = cdist(q, x, "sqeuclidean")
+        ref = np.argsort(full, 1)[:, :10]
+        _, ids_small = cagra.search(built_index, jnp.asarray(q), 10,
+                                    SearchParams(itopk_size=16, max_iterations=4))
+        _, ids_big = cagra.search(built_index, jnp.asarray(q), 10,
+                                  SearchParams(itopk_size=96, search_width=8))
+        assert (recall_at_k(np.asarray(ids_big), ref)
+                >= recall_at_k(np.asarray(ids_small), ref))
+
+    def test_query_tiling_matches(self, built_index, corpus):
+        x, q = corpus
+        d1, i1 = cagra.search(built_index, jnp.asarray(q), 5,
+                              SearchParams(itopk_size=32, query_tile=512))
+        d2, i2 = cagra.search(built_index, jnp.asarray(q), 5,
+                              SearchParams(itopk_size=32, query_tile=16))
+        np.testing.assert_array_equal(np.asarray(i1), np.asarray(i2))
+
+    def test_serialize_roundtrip(self, built_index, corpus, tmp_path):
+        x, q = corpus
+        path = os.path.join(tmp_path, "cagra.idx")
+        cagra.save(built_index, path)
+        idx2 = cagra.load(path)
+        d1, i1 = cagra.search(built_index, jnp.asarray(q), 5)
+        d2, i2 = cagra.search(idx2, jnp.asarray(q), 5)
+        np.testing.assert_array_equal(np.asarray(i1), np.asarray(i2))
+
+    def test_serialize_without_dataset(self, built_index, corpus, tmp_path):
+        x, q = corpus
+        path = os.path.join(tmp_path, "cagra_nods.idx")
+        cagra.save(built_index, path, include_dataset=False)
+        idx2 = cagra.load(path, dataset=jnp.asarray(x))
+        _, i2 = cagra.search(idx2, jnp.asarray(q), 5)
+        _, i1 = cagra.search(built_index, jnp.asarray(q), 5)
+        np.testing.assert_array_equal(np.asarray(i1), np.asarray(i2))
