@@ -4,4 +4,11 @@ Counterpart of the reference neighbors layer (cpp/include/raft/neighbors):
 brute-force, IVF-Flat, IVF-PQ, CAGRA, NN-Descent, refine, filtering.
 """
 
-from raft_tpu.neighbors import brute_force, ivf_flat, ivf_pq, refine  # noqa: F401
+from raft_tpu.neighbors import (  # noqa: F401
+    brute_force,
+    cagra,
+    ivf_flat,
+    ivf_pq,
+    nn_descent,
+    refine,
+)

@@ -1,0 +1,123 @@
+"""NN-Descent (GNND) — iterative knn-graph construction.
+
+TPU-native counterpart of ``raft::neighbors::nn_descent``
+(detail/nn_descent.cuh, 1452 LoC; GNND = GPU-parallel variant of Dong et
+al.'s NN-Descent). Used as CAGRA's alternate graph-build backend
+(cagra_types.hpp:47). Design mapping:
+
+- the reference's per-node sampled local join (new/old neighbor lists,
+  reverse-neighbor sampling, lock-free list updates) becomes a batched
+  fixed-shape iteration: sample ``n_samples`` current neighbors per node,
+  gather *their* neighbor lists (neighbor-of-neighbor candidates) plus a
+  sampled set of reverse neighbors, compute all candidate distances with
+  one MXU contraction, and merge into the running top-k with ``top_k`` —
+  value-semantic instead of lock-free mutation;
+- convergence: fixed ``n_iters`` sweeps (the reference's update-counter
+  early exit maps to choosing n_iters; each sweep is cheap and fully
+  fused).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from raft_tpu.distance.types import DistanceType, resolve_metric
+from raft_tpu.utils.precision import get_precision
+
+
+@partial(jax.jit, static_argnames=("k", "n_iters", "n_samples", "metric"))
+def _nn_descent_impl(x: jax.Array, k: int, n_iters: int, n_samples: int,
+                     metric: str, key: jax.Array):
+    mt = resolve_metric(metric)
+    ip = mt == DistanceType.InnerProduct
+    n, d = x.shape
+    xf = x.astype(jnp.float32)
+    x_sq = jnp.sum(xf * xf, axis=1)
+    BIG = jnp.float32(jnp.inf)
+
+    def dists_to(ids):
+        """ids [n, C] → distance(u, ids[u]) [n, C] (lower = better)."""
+        rows = xf[ids]                                   # [n, C, d]
+        s = jnp.einsum("nd,ncd->nc", xf, rows,
+                       precision=get_precision(),
+                       preferred_element_type=jnp.float32)
+        if ip:
+            return -s
+        return jnp.maximum(x_sq[:, None] + x_sq[ids] - 2.0 * s, 0.0)
+
+    def merge(ids_a, d_a, ids_b, d_b):
+        """Merge candidate lists, dropping duplicates and self-edges."""
+        ids = jnp.concatenate([ids_a, ids_b], axis=1)
+        dd = jnp.concatenate([d_a, d_b], axis=1)
+        dd = jnp.where(ids == jnp.arange(n)[:, None], BIG, dd)
+        # first-occurrence dedupe
+        eq = ids[:, :, None] == ids[:, None, :]
+        C = ids.shape[1]
+        earlier = jnp.tril(jnp.ones((C, C), jnp.bool_), -1)
+        dd = jnp.where(jnp.any(eq & earlier[None], axis=2), BIG, dd)
+        nd, pos = lax.top_k(-dd, k)
+        return jnp.take_along_axis(ids, pos, axis=1), -nd
+
+    # init: random graph
+    k0, key = jax.random.split(key)
+    init_ids = jax.random.randint(k0, (n, k), 0, n, jnp.int32)
+    graph_ids, graph_d = merge(init_ids, dists_to(init_ids),
+                               init_ids, jnp.full((n, k), BIG))
+
+    def body(i, carry):
+        graph_ids, graph_d = carry
+        ki = jax.random.fold_in(key, i)
+        # sample n_samples current neighbors per node
+        sample_pos = jax.random.randint(ki, (n, n_samples), 0, k)
+        sampled = jnp.take_along_axis(graph_ids, sample_pos, axis=1)  # [n, S]
+        # neighbor-of-neighbor candidates
+        non = graph_ids[sampled].reshape(n, n_samples * k)
+        # reverse-neighbor candidates: nodes that sampled-point to u —
+        # approximate with a random permutation splice of forward edges
+        kr = jax.random.fold_in(ki, 1)
+        rev_perm = jax.random.permutation(kr, n)
+        rev = sampled[rev_perm]                           # [n, S] pseudo-reverse
+        cand = jnp.concatenate([non, rev], axis=1)
+        cd = dists_to(cand)
+        return merge(graph_ids, graph_d, cand, cd)
+
+    graph_ids, graph_d = lax.fori_loop(0, n_iters, body, (graph_ids, graph_d))
+    return graph_ids, graph_d
+
+
+def build_knn_graph(
+    dataset: jax.Array,
+    k: int,
+    metric: str = "sqeuclidean",
+    n_iters: int = 20,
+    n_samples: int = 8,
+    seed: int = 0,
+) -> jax.Array:
+    """Build an approximate knn graph [n, k]
+    (reference: nn_descent::build → index.graph())."""
+    x = jnp.asarray(dataset, jnp.float32)
+    ids, _ = _nn_descent_impl(x, k, n_iters, n_samples,
+                              resolve_metric(metric).value,
+                              jax.random.PRNGKey(seed))
+    return ids
+
+
+def build_knn_graph_with_distances(
+    dataset: jax.Array,
+    k: int,
+    metric: str = "sqeuclidean",
+    n_iters: int = 20,
+    n_samples: int = 8,
+    seed: int = 0,
+) -> Tuple[jax.Array, jax.Array]:
+    """As :func:`build_knn_graph` but also returns distances [n, k]."""
+    x = jnp.asarray(dataset, jnp.float32)
+    ids, dists = _nn_descent_impl(x, k, n_iters, n_samples,
+                                  resolve_metric(metric).value,
+                                  jax.random.PRNGKey(seed))
+    return ids, dists

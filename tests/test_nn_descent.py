@@ -1,0 +1,76 @@
+"""NN-Descent tests (reference test model: cpp/test/neighbors/ann_nn_descent/
+— graph recall vs exact knn)."""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from scipy.spatial.distance import cdist
+
+from raft_tpu.neighbors import cagra, nn_descent
+from raft_tpu.random import make_blobs
+from raft_tpu.random.rng import RngState
+
+
+def graph_recall(got, ref):
+    hits = sum(len(set(g) & set(r)) for g, r in zip(got, ref))
+    return hits / ref.size
+
+
+@pytest.fixture(scope="module")
+def corpus():
+    x, _ = make_blobs(2000, 16, n_clusters=20, cluster_std=1.0,
+                      state=RngState(31))
+    return np.asarray(x)
+
+
+def test_graph_recall(corpus):
+    x = corpus
+    ids = np.asarray(nn_descent.build_knn_graph(jnp.asarray(x), 10,
+                                                n_iters=30))
+    full = cdist(x, x, "sqeuclidean")
+    np.fill_diagonal(full, np.inf)
+    ref = np.argsort(full, 1)[:, :10]
+    assert graph_recall(ids, ref) >= 0.85
+
+
+def test_no_self_edges_no_dups(corpus):
+    x = corpus
+    ids = np.asarray(nn_descent.build_knn_graph(jnp.asarray(x), 8, n_iters=10))
+    assert (ids != np.arange(len(x))[:, None]).all()
+    for row in ids[:100]:
+        assert len(set(row)) == len(row)
+
+
+def test_distances_match_ids(corpus):
+    x = corpus
+    ids, dists = nn_descent.build_knn_graph_with_distances(
+        jnp.asarray(x), 8, n_iters=10)
+    full = cdist(x, x, "sqeuclidean")
+    exact = np.take_along_axis(full, np.asarray(ids), axis=1)
+    np.testing.assert_allclose(np.asarray(dists), exact, rtol=1e-3, atol=1e-3)
+
+
+def test_more_iters_improves(corpus):
+    x = corpus
+    full = cdist(x, x, "sqeuclidean")
+    np.fill_diagonal(full, np.inf)
+    ref = np.argsort(full, 1)[:, :10]
+    r1 = graph_recall(np.asarray(
+        nn_descent.build_knn_graph(jnp.asarray(x), 10, n_iters=2)), ref)
+    r2 = graph_recall(np.asarray(
+        nn_descent.build_knn_graph(jnp.asarray(x), 10, n_iters=25)), ref)
+    assert r2 >= r1
+
+
+def test_cagra_with_nn_descent_backend(corpus):
+    x = corpus
+    q = x[:50] + 0.05
+    idx = cagra.build(jnp.asarray(x),
+                      cagra.IndexParams(intermediate_graph_degree=32,
+                                        graph_degree=16,
+                                        build_algo="nn_descent"))
+    _, ids = cagra.search(idx, jnp.asarray(q), 10,
+                          cagra.SearchParams(itopk_size=64))
+    full = cdist(q, x, "sqeuclidean")
+    ref = np.argsort(full, 1)[:, :10]
+    assert graph_recall(np.asarray(ids), ref) >= 0.85
