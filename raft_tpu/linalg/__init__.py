@@ -1,0 +1,44 @@
+"""raft_tpu.linalg — dense linear algebra API surface.
+
+Counterpart of the reference linalg layer (cpp/include/raft/linalg,
+15.9k LoC). Per SURVEY.md §2.3, ~80% of that layer exists to re-implement
+what XLA provides natively; here each reference API is a named, tested
+surface over the XLA op so ported algorithm code reads the same — the MXU
+tiling the reference hand-builds (contractions.cuh) is XLA ``dot_general``.
+"""
+
+from raft_tpu.linalg.blas import axpy, dot, gemm, gemv  # noqa: F401
+from raft_tpu.linalg.solvers import (  # noqa: F401
+    cholesky_r1_update,
+    eig_dc,
+    eig_jacobi,
+    lstsq,
+    qr,
+    rsvd,
+    svd,
+)
+from raft_tpu.linalg.map_reduce import (  # noqa: F401
+    binary_op,
+    coalesced_reduction,
+    map_offset,
+    map_op,
+    map_then_reduce,
+    matrix_vector_op,
+    mean_squared_error,
+    normalize_rows,
+    reduce_cols_by_key,
+    reduce_op,
+    reduce_rows_by_key,
+    strided_reduction,
+    ternary_op,
+    unary_op,
+)
+from raft_tpu.linalg.eltwise import (  # noqa: F401
+    add,
+    divide,
+    eltwise_multiply,
+    power,
+    sqrt,
+    subtract,
+    transpose,
+)

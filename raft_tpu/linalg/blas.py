@@ -1,0 +1,46 @@
+"""BLAS-level ops (reference: linalg/gemm.cuh, gemv.cuh, axpy.cuh, dot.cuh —
+cuBLAS wrappers, detail/cublas_wrappers.hpp). On TPU these lower straight
+to MXU ``dot_general``; the named wrappers keep ported code source-
+compatible and pin fp32 accumulation."""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from raft_tpu.utils.precision import get_precision
+
+
+def gemm(a: jax.Array, b: jax.Array, alpha: float = 1.0, beta: float = 0.0,
+         c: jax.Array | None = None, trans_a: bool = False,
+         trans_b: bool = False) -> jax.Array:
+    """C = α·op(A)·op(B) + β·C (reference: linalg/gemm.cuh)."""
+    if trans_a:
+        a = a.T
+    if trans_b:
+        b = b.T
+    out = alpha * jnp.matmul(a, b, precision=get_precision())
+    if c is not None and beta != 0.0:
+        out = out + beta * c
+    return out
+
+
+def gemv(a: jax.Array, x: jax.Array, alpha: float = 1.0, beta: float = 0.0,
+         y: jax.Array | None = None, trans: bool = False) -> jax.Array:
+    """y = α·op(A)·x + β·y (reference: linalg/gemv.cuh)."""
+    m = a.T if trans else a
+    out = alpha * (m @ x)
+    if y is not None and beta != 0.0:
+        out = out + beta * y
+    return out
+
+
+def axpy(alpha: float, x: jax.Array, y: jax.Array) -> jax.Array:
+    """y ← α·x + y (reference: linalg/axpy.cuh)."""
+    return alpha * x + y
+
+
+def dot(x: jax.Array, y: jax.Array) -> jax.Array:
+    """⟨x, y⟩ (reference: linalg/dot.cuh)."""
+    return jnp.dot(x, y, precision=get_precision())

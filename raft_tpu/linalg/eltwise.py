@@ -1,0 +1,43 @@
+"""Elementwise arithmetic surfaces (reference: linalg/add.cuh,
+subtract.cuh, multiply.cuh, divide.cuh, power.cuh, sqrt.cuh,
+transpose.cuh)."""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def add(x, y):
+    """reference: linalg/add.cuh."""
+    return jnp.add(x, y)
+
+
+def subtract(x, y):
+    """reference: linalg/subtract.cuh."""
+    return jnp.subtract(x, y)
+
+
+def eltwise_multiply(x, y):
+    """reference: linalg/multiply.cuh (eltwiseMultiply)."""
+    return jnp.multiply(x, y)
+
+
+def divide(x, y):
+    """reference: linalg/divide.cuh."""
+    return jnp.divide(x, y)
+
+
+def power(x, y):
+    """reference: linalg/power.cuh."""
+    return jnp.power(x, y)
+
+
+def sqrt(x):
+    """reference: linalg/sqrt.cuh."""
+    return jnp.sqrt(x)
+
+
+def transpose(m: jax.Array) -> jax.Array:
+    """reference: linalg/transpose.cuh."""
+    return m.T

@@ -1,0 +1,114 @@
+"""Elementwise map + reduction surfaces (reference: linalg/map.cuh,
+unary_op.cuh, binary_op.cuh, ternary_op.cuh, matrix_vector_op.cuh,
+normalize.cuh, reduce.cuh, coalesced_reduction.cuh, strided_reduction.cuh,
+map_reduce.cuh, reduce_rows_by_key.cuh, reduce_cols_by_key.cuh,
+mean_squared_error.cuh). All are thin named XLA surfaces — XLA fuses them;
+the names keep ported algorithm code readable against the reference."""
+
+from __future__ import annotations
+
+from typing import Callable, Optional
+
+import jax
+import jax.numpy as jnp
+
+
+def map_op(fn: Callable, *arrays) -> jax.Array:
+    """Elementwise map over arrays (reference: linalg/map.cuh ``map``)."""
+    return fn(*arrays)
+
+
+def map_offset(fn: Callable[[jax.Array], jax.Array], shape) -> jax.Array:
+    """Map over flat element offsets (reference: map.cuh ``map_offset``)."""
+    n = 1
+    for s in shape:
+        n *= s
+    return fn(jnp.arange(n)).reshape(shape)
+
+
+def unary_op(fn: Callable, x: jax.Array) -> jax.Array:
+    """reference: linalg/unary_op.cuh."""
+    return fn(x)
+
+
+def binary_op(fn: Callable, x: jax.Array, y: jax.Array) -> jax.Array:
+    """reference: linalg/binary_op.cuh."""
+    return fn(x, y)
+
+
+def ternary_op(fn: Callable, x, y, z) -> jax.Array:
+    """reference: linalg/ternary_op.cuh."""
+    return fn(x, y, z)
+
+
+def matrix_vector_op(m: jax.Array, v: jax.Array, op: Callable,
+                     along_rows: bool = True) -> jax.Array:
+    """Broadcast a vector op over matrix lines
+    (reference: linalg/matrix_vector_op.cuh)."""
+    return op(m, v[None, :] if along_rows else v[:, None])
+
+
+def normalize_rows(m: jax.Array, norm: str = "l2", eps: float = 1e-12) -> jax.Array:
+    """Row normalization (reference: linalg/normalize.cuh row_normalize)."""
+    if norm == "l2":
+        d = jnp.sqrt(jnp.maximum(jnp.sum(m * m, axis=1, keepdims=True), eps))
+    elif norm == "l1":
+        d = jnp.maximum(jnp.sum(jnp.abs(m), axis=1, keepdims=True), eps)
+    elif norm == "linf":
+        d = jnp.maximum(jnp.max(jnp.abs(m), axis=1, keepdims=True), eps)
+    else:
+        raise ValueError(f"unknown norm {norm!r}")
+    return m / d
+
+
+def reduce_op(m: jax.Array, axis: int = 1, op: str = "sum",
+              main_op: Optional[Callable] = None) -> jax.Array:
+    """Row/col reduce with optional pre-map (reference: linalg/reduce.cuh:
+    ``reduce(..., main_op, reduce_op)``)."""
+    x = main_op(m) if main_op is not None else m
+    if op == "sum":
+        return jnp.sum(x, axis=axis)
+    if op == "max":
+        return jnp.max(x, axis=axis)
+    if op == "min":
+        return jnp.min(x, axis=axis)
+    raise ValueError(f"unknown reduce op {op!r}")
+
+
+def coalesced_reduction(m: jax.Array, op: str = "sum",
+                        main_op: Optional[Callable] = None) -> jax.Array:
+    """Reduce along the contiguous (last) axis
+    (reference: linalg/coalesced_reduction.cuh). Layout is an XLA concern;
+    semantically a row reduce."""
+    return reduce_op(m, axis=-1, op=op, main_op=main_op)
+
+
+def strided_reduction(m: jax.Array, op: str = "sum",
+                      main_op: Optional[Callable] = None) -> jax.Array:
+    """Reduce along the strided (first) axis
+    (reference: linalg/strided_reduction.cuh)."""
+    return reduce_op(m, axis=0, op=op, main_op=main_op)
+
+
+def map_then_reduce(fn: Callable, *arrays, axis=None) -> jax.Array:
+    """reference: linalg/map_reduce.cuh ``map_reduce``."""
+    return jnp.sum(fn(*arrays), axis=axis)
+
+
+def reduce_rows_by_key(m: jax.Array, keys: jax.Array, n_keys: int,
+                       weights: Optional[jax.Array] = None) -> jax.Array:
+    """Sum rows grouped by key (reference: linalg/reduce_rows_by_key.cuh —
+    kmeans' centroid accumulation)."""
+    x = m if weights is None else m * weights[:, None]
+    return jax.ops.segment_sum(x, keys, num_segments=n_keys)
+
+
+def reduce_cols_by_key(m: jax.Array, keys: jax.Array, n_keys: int) -> jax.Array:
+    """Sum columns grouped by key (reference: linalg/reduce_cols_by_key.cuh)."""
+    return jax.ops.segment_sum(m.T, keys, num_segments=n_keys).T
+
+
+def mean_squared_error(a: jax.Array, b: jax.Array) -> jax.Array:
+    """reference: linalg/mean_squared_error.cuh."""
+    d = a - b
+    return jnp.mean(d * d)

@@ -1,0 +1,93 @@
+"""Dense solvers (reference: linalg/eig.cuh, svd.cuh, rsvd.cuh, qr.cuh,
+lstsq.cuh, cholesky_r1_update.cuh — cuSOLVER-backed). On TPU these lower
+to XLA's LAPACK-equivalent decompositions; rsvd is implemented as the
+standard randomized range-finder (Halko et al.), matching the reference's
+randomized SVD semantics."""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from raft_tpu.utils.precision import get_precision
+
+
+def eig_dc(a: jax.Array) -> Tuple[jax.Array, jax.Array]:
+    """Symmetric eigendecomposition, divide & conquer
+    (reference: linalg/eig.cuh eig_dc). Returns (eigenvalues asc,
+    eigenvectors as columns)."""
+    w, v = jnp.linalg.eigh(a)
+    return w, v
+
+
+def eig_jacobi(a: jax.Array, tol: float = 1e-7) -> Tuple[jax.Array, jax.Array]:
+    """Jacobi-method symmetric eig (reference: linalg/eig.cuh eig_jacobi).
+    XLA's eigh is already iterative-stable; the tol parameter is accepted
+    for API parity."""
+    return eig_dc(a)
+
+
+def svd(a: jax.Array, full_matrices: bool = False
+        ) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    """SVD → (U, S, Vᵀ) (reference: linalg/svd.cuh svd_qr)."""
+    u, s, vt = jnp.linalg.svd(a, full_matrices=full_matrices)
+    return u, s, vt
+
+
+def rsvd(a: jax.Array, k: int, p: int = 10, n_iter: int = 2,
+         key: Optional[jax.Array] = None
+         ) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    """Randomized SVD (reference: linalg/rsvd.cuh): range-finder with
+    ``p`` oversampling columns and ``n_iter`` power iterations."""
+    if key is None:
+        key = jax.random.PRNGKey(0)
+    m, n = a.shape
+    l = min(n, k + p)
+    omega = jax.random.normal(key, (n, l), a.dtype)
+    y = a @ omega
+    # re-orthonormalize between power iterations: in fp32 the subspace
+    # otherwise collapses onto the dominant direction
+    for _ in range(n_iter):
+        q, _ = jnp.linalg.qr(y)
+        y = a @ (a.T @ q)
+    q, _ = jnp.linalg.qr(y)
+    b = q.T @ a
+    ub, s, vt = jnp.linalg.svd(b, full_matrices=False)
+    u = q @ ub
+    return u[:, :k], s[:k], vt[:k]
+
+
+def qr(a: jax.Array) -> Tuple[jax.Array, jax.Array]:
+    """QR decomposition (reference: linalg/qr.cuh)."""
+    return jnp.linalg.qr(a)
+
+
+def lstsq(a: jax.Array, b: jax.Array) -> jax.Array:
+    """Least-squares solve min‖Ax − b‖ (reference: linalg/lstsq.cuh)."""
+    sol, _, _, _ = jnp.linalg.lstsq(a, b)
+    return sol
+
+
+def cholesky_r1_update(l: jax.Array, v: jax.Array) -> jax.Array:
+    """Rank-1 Cholesky update: chol(LLᵀ + vvᵀ)
+    (reference: linalg/cholesky_r1_update.cuh). Classic hyperbolic-rotation
+    update, expressed as a scan over columns."""
+    n = l.shape[0]
+
+    def body(carry, j):
+        l, v = carry
+        ljj = l[j, j]
+        r = jnp.sqrt(ljj * ljj + v[j] * v[j])
+        c, s = r / ljj, v[j] / ljj
+        col = l[:, j]
+        new_col = (col + s * v) / c
+        new_v = c * v - s * new_col
+        mask = jnp.arange(n) >= j
+        l = l.at[:, j].set(jnp.where(mask, new_col, col))
+        v = jnp.where(mask, new_v, v)
+        return (l, v), None
+
+    (l_out, _), _ = jax.lax.scan(body, (l, v), jnp.arange(n))
+    return l_out

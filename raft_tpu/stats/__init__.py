@@ -1,0 +1,36 @@
+"""raft_tpu.stats — descriptive statistics + model/clustering metrics.
+
+Counterpart of the reference stats layer (cpp/include/raft/stats, 7.5k LoC).
+"""
+
+from raft_tpu.stats.descriptive import (  # noqa: F401
+    cov,
+    histogram,
+    mean,
+    mean_center,
+    meanvar,
+    minmax,
+    stddev,
+    sum_op,
+    weighted_mean,
+)
+from raft_tpu.stats.metrics import (  # noqa: F401
+    InformationCriterion,
+    accuracy,
+    adjusted_rand_index,
+    completeness_score,
+    contingency_matrix,
+    dispersion,
+    entropy,
+    homogeneity_score,
+    information_criterion_batched,
+    kl_divergence,
+    mutual_info_score,
+    neighborhood_recall,
+    r2_score,
+    rand_index,
+    regression_metrics,
+    silhouette_score,
+    trustworthiness_score,
+    v_measure,
+)

@@ -1,0 +1,69 @@
+"""Descriptive statistics (reference: stats/mean.cuh, meanvar.cuh,
+stddev.cuh, sum.cuh, minmax.cuh, cov.cuh, histogram.cuh,
+weighted_mean.cuh, mean_center.cuh)."""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from raft_tpu.utils.precision import get_precision
+
+
+def mean(x: jax.Array, axis: int = 0) -> jax.Array:
+    """Column/row means (reference: stats/mean.cuh)."""
+    return jnp.mean(x, axis=axis)
+
+
+def meanvar(x: jax.Array, axis: int = 0, sample: bool = True
+            ) -> Tuple[jax.Array, jax.Array]:
+    """Mean + variance in one pass (reference: stats/meanvar.cuh)."""
+    mu = jnp.mean(x, axis=axis)
+    var = jnp.var(x, axis=axis, ddof=1 if sample else 0)
+    return mu, var
+
+
+def stddev(x: jax.Array, axis: int = 0, sample: bool = True) -> jax.Array:
+    """reference: stats/stddev.cuh."""
+    return jnp.std(x, axis=axis, ddof=1 if sample else 0)
+
+
+def sum_op(x: jax.Array, axis: int = 0) -> jax.Array:
+    """reference: stats/sum.cuh."""
+    return jnp.sum(x, axis=axis)
+
+
+def minmax(x: jax.Array, axis: int = 0) -> Tuple[jax.Array, jax.Array]:
+    """reference: stats/minmax.cuh."""
+    return jnp.min(x, axis=axis), jnp.max(x, axis=axis)
+
+
+def cov(x: jax.Array, center: bool = True, sample: bool = True) -> jax.Array:
+    """Covariance matrix of rows-as-samples (reference: stats/cov.cuh)."""
+    n = x.shape[0]
+    xc = x - jnp.mean(x, axis=0, keepdims=True) if center else x
+    denom = (n - 1) if sample else n
+    return jnp.matmul(xc.T, xc, precision=get_precision()) / denom
+
+
+def histogram(x: jax.Array, n_bins: int, lo: float, hi: float) -> jax.Array:
+    """Fixed-range histogram (reference: stats/histogram.cuh)."""
+    edges = (x - lo) / (hi - lo) * n_bins
+    idx = jnp.clip(jnp.floor(edges).astype(jnp.int32), 0, n_bins - 1)
+    valid = (x >= lo) & (x <= hi)
+    return jax.ops.segment_sum(valid.astype(jnp.int32).reshape(-1),
+                               idx.reshape(-1), num_segments=n_bins)
+
+
+def weighted_mean(x: jax.Array, weights: jax.Array, axis: int = 0) -> jax.Array:
+    """reference: stats/weighted_mean.cuh."""
+    if axis == 0:
+        return jnp.sum(x * weights[:, None], axis=0) / jnp.sum(weights)
+    return jnp.sum(x * weights[None, :], axis=1) / jnp.sum(weights)
+
+
+def mean_center(x: jax.Array, axis: int = 0) -> jax.Array:
+    """reference: stats/mean_center.cuh."""
+    return x - jnp.mean(x, axis=axis, keepdims=True)

@@ -1,0 +1,247 @@
+"""Model & clustering quality metrics (reference: stats/accuracy.cuh,
+r2_score.cuh, regression_metrics.cuh, silhouette_score.cuh,
+trustworthiness_score.cuh, adjusted_rand_index.cuh, rand_index.cuh,
+mutual_info_score.cuh, entropy.cuh, homogeneity_score.cuh,
+completeness_score.cuh, v_measure.cuh, kl_divergence.cuh,
+information_criterion.cuh, dispersion.cuh, contingency_matrix.cuh,
+neighborhood_recall.cuh)."""
+
+from __future__ import annotations
+
+import enum
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from raft_tpu.distance.pairwise import l2_expanded
+from raft_tpu.utils.precision import get_precision
+
+
+# ---------------------------------------------------------------------------
+# regression / classification
+# ---------------------------------------------------------------------------
+
+def accuracy(pred: jax.Array, ref: jax.Array) -> jax.Array:
+    """reference: stats/accuracy.cuh."""
+    return jnp.mean((pred == ref).astype(jnp.float32))
+
+
+def r2_score(y: jax.Array, y_hat: jax.Array) -> jax.Array:
+    """reference: stats/r2_score.cuh."""
+    ss_res = jnp.sum((y - y_hat) ** 2)
+    ss_tot = jnp.sum((y - jnp.mean(y)) ** 2)
+    return 1.0 - ss_res / jnp.maximum(ss_tot, 1e-30)
+
+
+def regression_metrics(pred: jax.Array, ref: jax.Array
+                       ) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    """(mean_abs_error, mean_squared_error, median_abs_error)
+    (reference: stats/regression_metrics.cuh)."""
+    err = pred - ref
+    return (jnp.mean(jnp.abs(err)), jnp.mean(err * err),
+            jnp.median(jnp.abs(err)))
+
+
+# ---------------------------------------------------------------------------
+# clustering comparison metrics (contingency-based)
+# ---------------------------------------------------------------------------
+
+def contingency_matrix(a: jax.Array, b: jax.Array, n_classes_a: int,
+                       n_classes_b: int) -> jax.Array:
+    """reference: stats/contingency_matrix.cuh."""
+    idx = a.astype(jnp.int32) * n_classes_b + b.astype(jnp.int32)
+    flat = jax.ops.segment_sum(jnp.ones_like(idx, jnp.float32), idx,
+                               num_segments=n_classes_a * n_classes_b)
+    return flat.reshape(n_classes_a, n_classes_b)
+
+
+def _comb2(x):
+    return x * (x - 1.0) / 2.0
+
+
+def rand_index(a: jax.Array, b: jax.Array, n_classes: int) -> jax.Array:
+    """reference: stats/rand_index.cuh."""
+    c = contingency_matrix(a, b, n_classes, n_classes)
+    n = a.shape[0]
+    sum_comb = jnp.sum(_comb2(c))
+    sum_a = jnp.sum(_comb2(jnp.sum(c, axis=1)))
+    sum_b = jnp.sum(_comb2(jnp.sum(c, axis=0)))
+    total = _comb2(jnp.float32(n))
+    return (total + 2.0 * sum_comb - sum_a - sum_b) / total
+
+
+def adjusted_rand_index(a: jax.Array, b: jax.Array, n_classes: int) -> jax.Array:
+    """reference: stats/adjusted_rand_index.cuh."""
+    c = contingency_matrix(a, b, n_classes, n_classes)
+    n = a.shape[0]
+    sum_comb = jnp.sum(_comb2(c))
+    sum_a = jnp.sum(_comb2(jnp.sum(c, axis=1)))
+    sum_b = jnp.sum(_comb2(jnp.sum(c, axis=0)))
+    total = _comb2(jnp.float32(n))
+    expected = sum_a * sum_b / jnp.maximum(total, 1e-30)
+    max_index = 0.5 * (sum_a + sum_b)
+    return (sum_comb - expected) / jnp.maximum(max_index - expected, 1e-30)
+
+
+def entropy(labels: jax.Array, n_classes: int) -> jax.Array:
+    """reference: stats/entropy.cuh."""
+    counts = jax.ops.segment_sum(jnp.ones_like(labels, jnp.float32),
+                                 labels.astype(jnp.int32),
+                                 num_segments=n_classes)
+    p = counts / jnp.maximum(jnp.sum(counts), 1e-30)
+    return -jnp.sum(jnp.where(p > 0, p * jnp.log(jnp.maximum(p, 1e-30)), 0.0))
+
+
+def mutual_info_score(a: jax.Array, b: jax.Array, n_classes: int) -> jax.Array:
+    """reference: stats/mutual_info_score.cuh."""
+    c = contingency_matrix(a, b, n_classes, n_classes)
+    n = jnp.sum(c)
+    pij = c / jnp.maximum(n, 1e-30)
+    pi = jnp.sum(pij, axis=1, keepdims=True)
+    pj = jnp.sum(pij, axis=0, keepdims=True)
+    ratio = pij / jnp.maximum(pi * pj, 1e-30)
+    return jnp.sum(jnp.where(pij > 0,
+                             pij * jnp.log(jnp.maximum(ratio, 1e-30)), 0.0))
+
+
+def homogeneity_score(truth: jax.Array, pred: jax.Array, n_classes: int) -> jax.Array:
+    """reference: stats/homogeneity_score.cuh."""
+    h_c = entropy(truth, n_classes)
+    mi = mutual_info_score(truth, pred, n_classes)
+    return jnp.where(h_c > 0, mi / jnp.maximum(h_c, 1e-30), 1.0)
+
+
+def completeness_score(truth: jax.Array, pred: jax.Array, n_classes: int) -> jax.Array:
+    """reference: stats/completeness_score.cuh."""
+    return homogeneity_score(pred, truth, n_classes)
+
+
+def v_measure(truth: jax.Array, pred: jax.Array, n_classes: int,
+              beta: float = 1.0) -> jax.Array:
+    """reference: stats/v_measure.cuh."""
+    h = homogeneity_score(truth, pred, n_classes)
+    c = completeness_score(truth, pred, n_classes)
+    return (1 + beta) * h * c / jnp.maximum(beta * h + c, 1e-30)
+
+
+def kl_divergence(p: jax.Array, q: jax.Array) -> jax.Array:
+    """reference: stats/kl_divergence.cuh."""
+    safe = (p > 0) & (q > 0)
+    return jnp.sum(jnp.where(
+        safe, p * jnp.log(jnp.maximum(p, 1e-30) / jnp.maximum(q, 1e-30)), 0.0))
+
+
+# ---------------------------------------------------------------------------
+# cluster-quality metrics
+# ---------------------------------------------------------------------------
+
+def dispersion(x: jax.Array, centroids: jax.Array, labels: jax.Array) -> jax.Array:
+    """Global cluster dispersion (reference: stats/dispersion.cuh): sum of
+    squared distances of cluster centers to the global mean, weighted by
+    cluster size."""
+    k = centroids.shape[0]
+    counts = jax.ops.segment_sum(jnp.ones_like(labels, jnp.float32),
+                                 labels.astype(jnp.int32), num_segments=k)
+    g_mean = jnp.mean(x, axis=0)
+    d2 = jnp.sum((centroids - g_mean[None, :]) ** 2, axis=1)
+    return jnp.sum(counts * d2)
+
+
+def silhouette_score(x: jax.Array, labels: jax.Array, n_clusters: int) -> jax.Array:
+    """Mean silhouette coefficient (reference: stats/silhouette_score.cuh).
+
+    Uses the per-cluster mean-distance formulation: for each sample, mean
+    distance to every cluster via one [n, k] segment-reduced distance
+    matrix — O(n²) distances but O(n·k) memory, the batched analog of the
+    reference's batched variant."""
+    n = x.shape[0]
+    d = jnp.sqrt(jnp.maximum(l2_expanded(x, x, sqrt=False), 0.0))
+    onehot = jax.nn.one_hot(labels, n_clusters, dtype=jnp.float32)  # [n, k]
+    sums = jnp.matmul(d, onehot, precision=get_precision())         # [n, k]
+    counts = jnp.sum(onehot, axis=0)                                # [k]
+    own = labels.astype(jnp.int32)
+    own_count = counts[own]
+    # a: mean distance to own cluster (excluding self)
+    a = jnp.where(own_count > 1,
+                  jnp.take_along_axis(sums, own[:, None], 1)[:, 0]
+                  / jnp.maximum(own_count - 1, 1),
+                  0.0)
+    # b: min over other clusters of mean distance
+    mean_to = sums / jnp.maximum(counts[None, :], 1.0)
+    mean_to = mean_to.at[jnp.arange(n), own].set(jnp.inf)
+    mean_to = jnp.where(counts[None, :] > 0, mean_to, jnp.inf)
+    b = jnp.min(mean_to, axis=1)
+    s = jnp.where(own_count > 1,
+                  (b - a) / jnp.maximum(jnp.maximum(a, b), 1e-30), 0.0)
+    return jnp.mean(s)
+
+
+def trustworthiness_score(x: jax.Array, x_embedded: jax.Array,
+                          n_neighbors: int) -> jax.Array:
+    """Trustworthiness of an embedding (reference:
+    stats/trustworthiness_score.cuh): penalizes embedded-space neighbors
+    that are far in the original space."""
+    n = x.shape[0]
+    d_orig = l2_expanded(x, x, sqrt=False)
+    d_emb = l2_expanded(x_embedded, x_embedded, sqrt=False)
+    big = jnp.finfo(jnp.float32).max
+    d_orig = d_orig.at[jnp.arange(n), jnp.arange(n)].set(big)
+    d_emb = d_emb.at[jnp.arange(n), jnp.arange(n)].set(big)
+    # rank of each point j in i's original-space ordering
+    orig_order = jnp.argsort(d_orig, axis=1)
+    ranks = jnp.zeros((n, n), jnp.int32)
+    ranks = jnp.take_along_axis(
+        jnp.broadcast_to(jnp.arange(n, dtype=jnp.int32)[None, :], (n, n)),
+        jnp.argsort(orig_order, axis=1), axis=1)
+    emb_knn = jnp.argsort(d_emb, axis=1)[:, :n_neighbors]
+    r = jnp.take_along_axis(ranks, emb_knn, axis=1)  # orig ranks of emb nbrs
+    penalty = jnp.maximum(r - n_neighbors + 1, 0).astype(jnp.float32)
+    t = 1.0 - (2.0 / (n * n_neighbors * (2.0 * n - 3.0 * n_neighbors - 1.0))
+               ) * jnp.sum(penalty)
+    return t
+
+
+class InformationCriterion(enum.Enum):
+    """reference: stats/information_criterion.cuh ``IC_Type``."""
+
+    AIC = "aic"
+    AICc = "aicc"
+    BIC = "bic"
+
+
+def information_criterion_batched(log_likelihood: jax.Array, n_params: int,
+                                  n_samples: int,
+                                  ic: InformationCriterion = InformationCriterion.AIC
+                                  ) -> jax.Array:
+    """reference: stats/information_criterion.cuh."""
+    ll = log_likelihood
+    k = jnp.float32(n_params)
+    n = jnp.float32(n_samples)
+    if ic == InformationCriterion.AIC:
+        return -2.0 * ll + 2.0 * k
+    if ic == InformationCriterion.AICc:
+        return -2.0 * ll + 2.0 * k + 2.0 * k * (k + 1) / jnp.maximum(n - k - 1, 1e-30)
+    return -2.0 * ll + k * jnp.log(n)
+
+
+# ---------------------------------------------------------------------------
+# ANN quality
+# ---------------------------------------------------------------------------
+
+def neighborhood_recall(got_indices: jax.Array, ref_indices: jax.Array,
+                        got_distances: Optional[jax.Array] = None,
+                        ref_distances: Optional[jax.Array] = None,
+                        eps: float = 1e-3) -> jax.Array:
+    """ANN recall@k (reference: stats/neighborhood_recall.cuh): fraction of
+    reference neighbors found, counting distance-ties as hits when
+    distances are provided."""
+    m, k = got_indices.shape
+    match = got_indices[:, :, None] == ref_indices[:, None, :]
+    hit = jnp.any(match, axis=1)  # [m, k] per reference entry
+    if got_distances is not None and ref_distances is not None:
+        # a ref entry also counts if some returned distance ties it
+        tie = jnp.any(jnp.abs(got_distances[:, :, None]
+                              - ref_distances[:, None, :]) <= eps, axis=1)
+        hit = hit | tie
+    return jnp.mean(hit.astype(jnp.float32))

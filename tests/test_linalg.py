@@ -1,0 +1,130 @@
+"""linalg tests vs numpy/scipy (reference test model: cpp/test/linalg/)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from raft_tpu import linalg
+
+
+@pytest.fixture()
+def mats(rng):
+    a = rng.random((20, 12), dtype=np.float32)
+    b = rng.random((12, 9), dtype=np.float32)
+    return a, b
+
+
+class TestBlas:
+    def test_gemm(self, mats):
+        a, b = mats
+        np.testing.assert_allclose(
+            np.asarray(linalg.gemm(jnp.asarray(a), jnp.asarray(b))),
+            a @ b, rtol=1e-5)
+
+    def test_gemm_trans_beta(self, mats, rng):
+        a, b = mats
+        c = rng.random((12, 12), dtype=np.float32)
+        out = linalg.gemm(jnp.asarray(a), jnp.asarray(a), alpha=2.0,
+                          beta=0.5, c=jnp.asarray(c), trans_a=True)
+        np.testing.assert_allclose(np.asarray(out), 2 * a.T @ a + 0.5 * c,
+                                   rtol=1e-5)
+
+    def test_gemv_axpy_dot(self, mats, rng):
+        a, _ = mats
+        x = rng.random(12, dtype=np.float32)
+        np.testing.assert_allclose(np.asarray(linalg.gemv(jnp.asarray(a), jnp.asarray(x))),
+                                   a @ x, rtol=1e-5)
+        y = rng.random(12, dtype=np.float32)
+        np.testing.assert_allclose(np.asarray(linalg.axpy(2.0, jnp.asarray(x), jnp.asarray(y))),
+                                   2 * x + y, rtol=1e-6)
+        np.testing.assert_allclose(float(linalg.dot(jnp.asarray(x), jnp.asarray(y))),
+                                   x @ y, rtol=1e-5)
+
+
+class TestSolvers:
+    def test_eig(self, rng):
+        a = rng.random((10, 10), dtype=np.float32)
+        s = (a + a.T) / 2
+        w, v = linalg.eig_dc(jnp.asarray(s))
+        np.testing.assert_allclose(np.asarray(s @ np.asarray(v)),
+                                   np.asarray(v) * np.asarray(w)[None, :],
+                                   atol=1e-4)
+
+    def test_svd_reconstruct(self, mats):
+        a, _ = mats
+        u, s, vt = linalg.svd(jnp.asarray(a))
+        np.testing.assert_allclose(
+            np.asarray(u) @ np.diag(np.asarray(s)) @ np.asarray(vt), a,
+            atol=1e-4)
+
+    def test_rsvd_top_singular_values(self, rng):
+        # low-rank + noise: rsvd should recover the top singular values
+        u = rng.random((50, 5), dtype=np.float32)
+        v = rng.random((5, 30), dtype=np.float32)
+        a = u @ v
+        _, s_r, _ = linalg.rsvd(jnp.asarray(a), k=5, n_iter=3)
+        s_full = np.linalg.svd(a, compute_uv=False)[:5]
+        np.testing.assert_allclose(np.asarray(s_r), s_full, rtol=1e-3)
+
+    def test_qr(self, mats):
+        a, _ = mats
+        q, r = linalg.qr(jnp.asarray(a))
+        np.testing.assert_allclose(np.asarray(q) @ np.asarray(r), a, atol=1e-4)
+        np.testing.assert_allclose(np.asarray(q).T @ np.asarray(q),
+                                   np.eye(12), atol=1e-4)
+
+    def test_lstsq(self, rng):
+        a = rng.random((30, 5), dtype=np.float32)
+        x_true = rng.random(5, dtype=np.float32)
+        b = a @ x_true
+        x = linalg.lstsq(jnp.asarray(a), jnp.asarray(b))
+        np.testing.assert_allclose(np.asarray(x), x_true, atol=1e-3)
+
+    def test_cholesky_r1_update(self, rng):
+        a = rng.random((6, 6), dtype=np.float32)
+        spd = a @ a.T + 6 * np.eye(6, dtype=np.float32)
+        l = np.linalg.cholesky(spd)
+        v = rng.random(6, dtype=np.float32)
+        l_up = linalg.cholesky_r1_update(jnp.asarray(l), jnp.asarray(v))
+        expected = np.linalg.cholesky(spd + np.outer(v, v))
+        np.testing.assert_allclose(np.asarray(l_up), expected, atol=1e-3)
+
+
+class TestMapReduce:
+    def test_normalize_rows(self, mats):
+        a, _ = mats
+        out = np.asarray(linalg.normalize_rows(jnp.asarray(a)))
+        np.testing.assert_allclose(np.linalg.norm(out, axis=1), 1.0, rtol=1e-5)
+
+    def test_reduce_rows_by_key(self, rng):
+        m = rng.random((10, 4), dtype=np.float32)
+        keys = np.array([0, 1, 0, 2, 1, 0, 2, 2, 1, 0])
+        out = np.asarray(linalg.reduce_rows_by_key(
+            jnp.asarray(m), jnp.asarray(keys), 3))
+        for k in range(3):
+            np.testing.assert_allclose(out[k], m[keys == k].sum(0), rtol=1e-5)
+
+    def test_reduce_cols_by_key(self, rng):
+        m = rng.random((4, 6), dtype=np.float32)
+        keys = np.array([0, 1, 1, 0, 2, 2])
+        out = np.asarray(linalg.reduce_cols_by_key(
+            jnp.asarray(m), jnp.asarray(keys), 3))
+        for k in range(3):
+            np.testing.assert_allclose(out[:, k], m[:, keys == k].sum(1),
+                                       rtol=1e-5)
+
+    def test_reduce_with_main_op(self, mats):
+        a, _ = mats
+        out = np.asarray(linalg.reduce_op(jnp.asarray(a), axis=1, op="sum",
+                                          main_op=lambda x: x * x))
+        np.testing.assert_allclose(out, (a * a).sum(1), rtol=1e-5)
+
+    def test_mse_map_offset(self, mats):
+        a, _ = mats
+        b = a + 0.1
+        np.testing.assert_allclose(
+            float(linalg.mean_squared_error(jnp.asarray(a), jnp.asarray(b))),
+            0.01, rtol=1e-3)
+        out = np.asarray(linalg.map_offset(lambda i: i * 2, (3, 4)))
+        np.testing.assert_array_equal(out, (np.arange(12) * 2).reshape(3, 4))

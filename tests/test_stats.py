@@ -1,0 +1,156 @@
+"""stats tests vs sklearn/scipy (reference test model: cpp/test/stats/ +
+pylibraft validations vs sklearn)."""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from scipy import stats as sps
+from sklearn import metrics as skm
+
+from raft_tpu import stats
+
+
+@pytest.fixture()
+def labels(rng):
+    a = rng.integers(0, 4, 200)
+    b = rng.integers(0, 4, 200)
+    return a, b
+
+
+class TestDescriptive:
+    def test_mean_var_std(self, rng):
+        x = rng.random((50, 8), dtype=np.float32)
+        mu, var = stats.meanvar(jnp.asarray(x))
+        np.testing.assert_allclose(np.asarray(mu), x.mean(0), rtol=1e-5)
+        np.testing.assert_allclose(np.asarray(var), x.var(0, ddof=1), rtol=1e-4)
+        np.testing.assert_allclose(np.asarray(stats.stddev(jnp.asarray(x))),
+                                   x.std(0, ddof=1), rtol=1e-4)
+
+    def test_cov(self, rng):
+        x = rng.random((100, 5), dtype=np.float32)
+        np.testing.assert_allclose(np.asarray(stats.cov(jnp.asarray(x))),
+                                   np.cov(x, rowvar=False), rtol=1e-3,
+                                   atol=1e-5)
+
+    def test_histogram(self, rng):
+        x = rng.random(1000).astype(np.float32)
+        got = np.asarray(stats.histogram(jnp.asarray(x), 10, 0.0, 1.0))
+        ref, _ = np.histogram(x, bins=10, range=(0, 1))
+        # edge-bin rounding can differ by ±1
+        np.testing.assert_allclose(got, ref, atol=1)
+
+    def test_weighted_mean_minmax(self, rng):
+        x = rng.random((30, 4), dtype=np.float32)
+        w = rng.random(30).astype(np.float32)
+        np.testing.assert_allclose(
+            np.asarray(stats.weighted_mean(jnp.asarray(x), jnp.asarray(w))),
+            (x * w[:, None]).sum(0) / w.sum(), rtol=1e-4)
+        lo, hi = stats.minmax(jnp.asarray(x))
+        np.testing.assert_allclose(np.asarray(lo), x.min(0))
+        np.testing.assert_allclose(np.asarray(hi), x.max(0))
+
+
+class TestClusteringMetrics:
+    def test_rand_and_adjusted_rand(self, labels):
+        a, b = labels
+        np.testing.assert_allclose(
+            float(stats.adjusted_rand_index(jnp.asarray(a), jnp.asarray(b), 4)),
+            skm.adjusted_rand_score(a, b), atol=1e-4)
+
+    def test_mutual_info(self, labels):
+        a, b = labels
+        np.testing.assert_allclose(
+            float(stats.mutual_info_score(jnp.asarray(a), jnp.asarray(b), 4)),
+            skm.mutual_info_score(a, b), atol=1e-4)
+
+    def test_entropy(self, labels):
+        a, _ = labels
+        counts = np.bincount(a)
+        np.testing.assert_allclose(
+            float(stats.entropy(jnp.asarray(a), 4)),
+            sps.entropy(counts / counts.sum()), atol=1e-4)
+
+    def test_homogeneity_completeness_v(self, labels):
+        a, b = labels
+        h = float(stats.homogeneity_score(jnp.asarray(a), jnp.asarray(b), 4))
+        c = float(stats.completeness_score(jnp.asarray(a), jnp.asarray(b), 4))
+        v = float(stats.v_measure(jnp.asarray(a), jnp.asarray(b), 4))
+        hr, cr, vr = skm.homogeneity_completeness_v_measure(a, b)
+        np.testing.assert_allclose([h, c, v], [hr, cr, vr], atol=1e-4)
+
+    def test_silhouette(self, rng):
+        from raft_tpu.random import make_blobs
+        from raft_tpu.random.rng import RngState
+
+        x, lbl = make_blobs(300, 6, n_clusters=4, cluster_std=0.5,
+                            state=RngState(5))
+        got = float(stats.silhouette_score(jnp.asarray(np.asarray(x)),
+                                           jnp.asarray(np.asarray(lbl)), 4))
+        ref = skm.silhouette_score(np.asarray(x), np.asarray(lbl))
+        np.testing.assert_allclose(got, ref, atol=1e-3)
+
+    def test_trustworthiness(self, rng):
+        from sklearn.manifold import trustworthiness as sk_trust
+
+        x = rng.random((80, 10), dtype=np.float32)
+        emb = x[:, :2] + 0.01 * rng.random((80, 2)).astype(np.float32)
+        got = float(stats.trustworthiness_score(jnp.asarray(x),
+                                                jnp.asarray(emb), 5))
+        ref = sk_trust(x, emb, n_neighbors=5)
+        np.testing.assert_allclose(got, ref, atol=1e-2)
+
+
+class TestModelMetrics:
+    def test_accuracy_r2(self, rng):
+        y = rng.random(50).astype(np.float32)
+        yh = y + 0.1 * rng.random(50).astype(np.float32)
+        np.testing.assert_allclose(
+            float(stats.r2_score(jnp.asarray(y), jnp.asarray(yh))),
+            skm.r2_score(y, yh), atol=1e-4)
+        p = rng.integers(0, 2, 50)
+        np.testing.assert_allclose(
+            float(stats.accuracy(jnp.asarray(p), jnp.asarray(p))), 1.0)
+
+    def test_regression_metrics(self, rng):
+        y = rng.random(50).astype(np.float32)
+        yh = y + rng.normal(0, 0.1, 50).astype(np.float32)
+        mae, mse, medae = stats.regression_metrics(jnp.asarray(yh), jnp.asarray(y))
+        np.testing.assert_allclose(float(mae), skm.mean_absolute_error(y, yh),
+                                   atol=1e-5)
+        np.testing.assert_allclose(float(mse), skm.mean_squared_error(y, yh),
+                                   atol=1e-5)
+
+    def test_information_criterion(self):
+        ll = jnp.asarray(-120.0)
+        aic = stats.information_criterion_batched(ll, 3, 100,
+                                                  stats.InformationCriterion.AIC)
+        np.testing.assert_allclose(float(aic), 246.0)
+        bic = stats.information_criterion_batched(ll, 3, 100,
+                                                  stats.InformationCriterion.BIC)
+        np.testing.assert_allclose(float(bic), 240.0 + 3 * np.log(100), rtol=1e-5)
+
+    def test_kl_divergence(self, rng):
+        p = rng.random(20).astype(np.float32)
+        q = rng.random(20).astype(np.float32)
+        p, q = p / p.sum(), q / q.sum()
+        from scipy.special import rel_entr
+
+        np.testing.assert_allclose(
+            float(stats.kl_divergence(jnp.asarray(p), jnp.asarray(q))),
+            float(np.sum(rel_entr(p, q))), atol=1e-5)
+
+
+class TestNeighborhoodRecall:
+    def test_perfect_and_partial(self):
+        ref = jnp.asarray([[0, 1, 2], [3, 4, 5]])
+        got = jnp.asarray([[2, 1, 0], [3, 4, 9]])
+        np.testing.assert_allclose(
+            float(stats.neighborhood_recall(got, ref)), 5 / 6, atol=1e-6)
+
+    def test_distance_ties_count(self):
+        ref_i = jnp.asarray([[0, 1]])
+        got_i = jnp.asarray([[0, 7]])
+        ref_d = jnp.asarray([[0.0, 1.0]])
+        got_d = jnp.asarray([[0.0, 1.0]])  # id 7 ties ref distance 1.0
+        np.testing.assert_allclose(
+            float(stats.neighborhood_recall(got_i, ref_i, got_d, ref_d)), 1.0)
