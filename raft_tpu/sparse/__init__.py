@@ -1,0 +1,35 @@
+"""Sparse formats, ops, linalg, distances, solvers — TPU-native
+counterpart of the reference's `cpp/include/raft/sparse` (SURVEY.md §2.7).
+"""
+
+from . import linalg, ops, types
+from .types import (
+    COO,
+    CSR,
+    coo_from_dense,
+    coo_to_csr,
+    csr_from_dense,
+    csr_to_coo,
+    from_scipy,
+    make_coo,
+    make_csr,
+    to_dense,
+    to_scipy,
+)
+
+__all__ = [
+    "COO",
+    "CSR",
+    "coo_from_dense",
+    "coo_to_csr",
+    "csr_from_dense",
+    "csr_to_coo",
+    "from_scipy",
+    "linalg",
+    "make_coo",
+    "make_csr",
+    "ops",
+    "to_dense",
+    "to_scipy",
+    "types",
+]

@@ -1,0 +1,113 @@
+"""Structural sparse ops — sort, dedup, filter, slice, row ops.
+
+TPU-native counterpart of the reference's `sparse/op/` family
+(sparse/op/{sort,filter,slice,row_op,reduce}.hpp).  Structural ops whose
+output size is data-dependent run host-side (build-time, mirrors the
+reference's thrust passes); per-nnz numerical transforms are jittable.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .types import COO, CSR, coo_to_csr, make_coo
+
+
+def _host(arr):
+    return np.asarray(jax.device_get(arr))
+
+
+def coo_sort(coo: COO) -> COO:
+    """Sort COO entries by (row, col) — reference: sparse/op/sort.hpp."""
+    rows, cols, data = _host(coo.rows), _host(coo.cols), _host(coo.data)
+    order = np.lexsort((cols, rows))
+    return make_coo(rows[order], cols[order], data[order], coo.shape)
+
+
+def sum_duplicates(coo: COO) -> COO:
+    """Merge duplicate (row, col) entries by summation
+    (reference: sparse/op/reduce.hpp max_duplicates / compute_duplicates)."""
+    rows, cols, data = _host(coo.rows), _host(coo.cols), _host(coo.data)
+    order = np.lexsort((cols, rows))
+    rows, cols, data = rows[order], cols[order], data[order]
+    if rows.size == 0:
+        return coo
+    key_change = np.empty(rows.size, dtype=bool)
+    key_change[0] = True
+    key_change[1:] = (rows[1:] != rows[:-1]) | (cols[1:] != cols[:-1])
+    group = np.cumsum(key_change) - 1
+    out_data = np.zeros(int(group[-1]) + 1, dtype=data.dtype)
+    np.add.at(out_data, group, data)
+    return make_coo(rows[key_change], cols[key_change], out_data, coo.shape)
+
+
+def remove_zeros(coo: COO, tol: float = 0.0) -> COO:
+    """Drop entries with |value| <= tol — reference: sparse/op/filter.hpp
+    (coo_remove_zeros / coo_remove_scalar)."""
+    rows, cols, data = _host(coo.rows), _host(coo.cols), _host(coo.data)
+    keep = np.abs(data) > tol
+    return make_coo(rows[keep], cols[keep], data[keep], coo.shape)
+
+
+def slice_rows(csr: CSR, start: int, stop: int) -> CSR:
+    """Row-range slice of a CSR matrix — reference: sparse/op/slice.hpp
+    (csr_row_slice_indptr/_populate)."""
+    indptr = _host(csr.indptr)
+    lo, hi = int(indptr[start]), int(indptr[stop])
+    new_indptr = indptr[start : stop + 1] - lo
+    return CSR(
+        jnp.asarray(new_indptr, jnp.int32),
+        csr.indices[lo:hi],
+        csr.data[lo:hi],
+        (stop - start, csr.shape[1]),
+    )
+
+
+def row_op(csr: CSR, fn) -> CSR:
+    """Apply ``fn(row_id, values) -> values`` across rows without
+    materializing the dense matrix (jittable when fn is; reference:
+    sparse/op/row_op.hpp csr_row_op).  ``fn`` receives the per-nnz row-id
+    vector and the data vector."""
+    new_data = fn(csr.row_ids, csr.data)
+    return CSR(csr.indptr, csr.indices, new_data, csr.shape)
+
+
+def degree(m) -> jnp.ndarray:
+    """Per-row nnz counts (jittable) — reference: sparse/linalg/degree.hpp."""
+    if isinstance(m, CSR):
+        return (m.indptr[1:] - m.indptr[:-1]).astype(jnp.int32)
+    return jax.ops.segment_sum(
+        jnp.ones_like(m.rows, dtype=jnp.int32), m.rows, num_segments=m.shape[0]
+    )
+
+
+def symmetrize(coo: COO, mode: str = "max") -> CSR:
+    """Build a symmetric adjacency from a directed one
+    (reference: sparse/linalg/symmetrize.hpp — used on knn graphs before
+    MST/linkage).  mode: 'max' (A ∨ Aᵀ keeping max weight), 'sum', 'mean'."""
+    rows, cols, data = _host(coo.rows), _host(coo.cols), _host(coo.data)
+    r = np.concatenate([rows, cols])
+    c = np.concatenate([cols, rows])
+    d = np.concatenate([data, data])
+    order = np.lexsort((c, r))
+    r, c, d = r[order], c[order], d[order]
+    key_change = np.empty(r.size, dtype=bool)
+    key_change[0] = True
+    key_change[1:] = (r[1:] != r[:-1]) | (c[1:] != c[:-1])
+    group = np.cumsum(key_change) - 1
+    n_out = int(group[-1]) + 1 if r.size else 0
+    if mode == "max":
+        out = np.full(n_out, -np.inf, dtype=d.dtype)
+        np.maximum.at(out, group, d)
+    elif mode in ("sum", "mean"):
+        out = np.zeros(n_out, dtype=d.dtype)
+        np.add.at(out, group, d)
+        if mode == "mean":
+            cnt = np.zeros(n_out, dtype=np.int64)
+            np.add.at(cnt, group, 1)
+            out = out / cnt
+    else:
+        raise ValueError(f"unknown symmetrize mode: {mode}")
+    return coo_to_csr(make_coo(r[key_change], c[key_change], out, coo.shape))

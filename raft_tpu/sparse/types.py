@@ -1,0 +1,171 @@
+"""Sparse formats — COO / CSR containers, TPU-first.
+
+TPU-native counterpart of the reference's sparse matrix types
+(core/{coo_matrix,csr_matrix}.hpp, sparse/coo.hpp, sparse/csr.hpp).
+
+Design: a sparse matrix is an immutable pytree of flat arrays with a
+*static* nnz.  Structural mutations (sorting, dedup, symmetrize,
+format conversion) happen host-side at build time — the analog of the
+reference running thrust sorts on construction — while numerical
+consumers (spmv/spmm, reductions, semiring distances) are pure jittable
+functions over the flat arrays, which XLA lowers to gathers +
+segment-sums on the VPU/MXU.  Rows/cols are int32 (TPU-native lane
+width); indptr is int32 as well (nnz < 2^31 per shard — larger matrices
+shard over a mesh axis first).
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+class COO(NamedTuple):
+    """Coordinate-format sparse matrix (reference: sparse/coo.hpp).
+
+    ``rows``/``cols``/``data`` are parallel 1-D arrays of length nnz.
+    ``shape`` is static Python metadata (not traced).
+    """
+
+    rows: jnp.ndarray
+    cols: jnp.ndarray
+    data: jnp.ndarray
+    shape: Tuple[int, int]
+
+    @property
+    def nnz(self) -> int:
+        return int(self.data.shape[0])
+
+
+class CSR(NamedTuple):
+    """Compressed-sparse-row matrix (reference: sparse/csr.hpp).
+
+    ``indptr`` has length n_rows+1; ``indices``/``data`` length nnz,
+    sorted by row (column order within a row is unspecified unless a
+    structural op sorted it).
+    """
+
+    indptr: jnp.ndarray
+    indices: jnp.ndarray
+    data: jnp.ndarray
+    shape: Tuple[int, int]
+
+    @property
+    def nnz(self) -> int:
+        return int(self.data.shape[0])
+
+    @property
+    def row_ids(self) -> jnp.ndarray:
+        """Expand indptr back to a per-nnz row-id array (jittable;
+        reference: sparse/convert/csr.hpp csr_to_coo rows)."""
+        n_rows = self.shape[0]
+        # searchsorted over indptr: row of nnz slot j is the last i with
+        # indptr[i] <= j.
+        return (
+            jnp.searchsorted(
+                self.indptr, jnp.arange(self.data.shape[0], dtype=jnp.int32), side="right"
+            ).astype(jnp.int32)
+            - 1
+        )
+
+
+# Pytree registration: shape rides in the aux data so jit treats it as
+# static, matching the reference's compile-time extents.
+jax.tree_util.register_pytree_node(
+    COO,
+    lambda m: ((m.rows, m.cols, m.data), m.shape),
+    lambda shape, leaves: COO(*leaves, shape=shape),
+)
+jax.tree_util.register_pytree_node(
+    CSR,
+    lambda m: ((m.indptr, m.indices, m.data), m.shape),
+    lambda shape, leaves: CSR(*leaves, shape=shape),
+)
+
+
+def make_coo(rows, cols, data, shape) -> COO:
+    return COO(
+        jnp.asarray(rows, jnp.int32),
+        jnp.asarray(cols, jnp.int32),
+        jnp.asarray(data),
+        (int(shape[0]), int(shape[1])),
+    )
+
+
+def make_csr(indptr, indices, data, shape) -> CSR:
+    return CSR(
+        jnp.asarray(indptr, jnp.int32),
+        jnp.asarray(indices, jnp.int32),
+        jnp.asarray(data),
+        (int(shape[0]), int(shape[1])),
+    )
+
+
+def coo_from_dense(dense) -> COO:
+    """Host-side dense→COO (reference: sparse/convert/coo.hpp)."""
+    a = np.asarray(jax.device_get(dense))
+    rows, cols = np.nonzero(a)
+    return make_coo(rows, cols, a[rows, cols], a.shape)
+
+
+def csr_from_dense(dense) -> CSR:
+    """Host-side dense→CSR (reference: sparse/convert/csr.hpp)."""
+    return coo_to_csr(coo_from_dense(dense))
+
+
+def coo_to_csr(coo: COO) -> CSR:
+    """Host-side COO→CSR: stable sort by row, prefix-sum row counts
+    (reference: sparse/convert/csr.hpp sorted_coo_to_csr)."""
+    rows = np.asarray(jax.device_get(coo.rows))
+    cols = np.asarray(jax.device_get(coo.cols))
+    data = np.asarray(jax.device_get(coo.data))
+    order = np.argsort(rows, kind="stable")
+    rows, cols, data = rows[order], cols[order], data[order]
+    counts = np.bincount(rows, minlength=coo.shape[0]).astype(np.int64)
+    indptr = np.zeros(coo.shape[0] + 1, dtype=np.int64)
+    np.cumsum(counts, out=indptr[1:])
+    return make_csr(indptr, cols, data, coo.shape)
+
+
+def csr_to_coo(csr: CSR) -> COO:
+    """CSR→COO (jittable — row expansion via searchsorted)."""
+    return COO(csr.row_ids, csr.indices, csr.data, csr.shape)
+
+
+def to_dense(m) -> jnp.ndarray:
+    """COO/CSR → dense (jittable scatter; reference: sparse/convert/dense.hpp)."""
+    if isinstance(m, CSR):
+        m = csr_to_coo(m)
+    out = jnp.zeros(m.shape, dtype=m.data.dtype)
+    return out.at[m.rows, m.cols].add(m.data)
+
+
+def to_scipy(m):
+    """Export to scipy.sparse for interop/testing."""
+    import scipy.sparse as sp
+
+    if isinstance(m, CSR):
+        return sp.csr_matrix(
+            (
+                np.asarray(jax.device_get(m.data)),
+                np.asarray(jax.device_get(m.indices)),
+                np.asarray(jax.device_get(m.indptr)),
+            ),
+            shape=m.shape,
+        )
+    return sp.coo_matrix(
+        (
+            np.asarray(jax.device_get(m.data)),
+            (np.asarray(jax.device_get(m.rows)), np.asarray(jax.device_get(m.cols))),
+        ),
+        shape=m.shape,
+    )
+
+
+def from_scipy(m) -> CSR:
+    """Import any scipy.sparse matrix as CSR."""
+    m = m.tocsr()
+    return make_csr(m.indptr, m.indices, m.data, m.shape)

@@ -1,0 +1,140 @@
+"""Sparse formats / ops / linalg vs scipy references.
+
+Mirrors the reference's test strategy (SURVEY.md §4): device results
+compared against host reference implementations (cpp/test/sparse/*).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+import scipy.sparse as sp
+
+from raft_tpu import sparse
+from raft_tpu.sparse import linalg as slinalg
+from raft_tpu.sparse import ops as sops
+
+
+def _random_csr(rng, n, m, density=0.1):
+    mat = sp.random(n, m, density=density, random_state=np.random.RandomState(7), format="csr", dtype=np.float32)
+    return sparse.from_scipy(mat), mat
+
+
+def test_dense_roundtrip(rng):
+    a = rng.random((13, 9), dtype=np.float32)
+    a[a < 0.6] = 0.0
+    csr = sparse.csr_from_dense(a)
+    np.testing.assert_allclose(np.asarray(sparse.to_dense(csr)), a, rtol=1e-6)
+    coo = sparse.coo_from_dense(a)
+    np.testing.assert_allclose(np.asarray(sparse.to_dense(coo)), a, rtol=1e-6)
+
+
+def test_coo_csr_roundtrip(rng):
+    csr, ref = _random_csr(rng, 20, 15)
+    coo = sparse.csr_to_coo(csr)
+    back = sparse.coo_to_csr(coo)
+    np.testing.assert_allclose(sparse.to_scipy(back).toarray(), ref.toarray(), rtol=1e-6)
+
+
+def test_row_ids_jittable(rng):
+    csr, ref = _random_csr(rng, 10, 10)
+    rids = jax.jit(lambda c: c.row_ids)(csr)
+    expected = ref.tocoo().row
+    np.testing.assert_array_equal(np.asarray(rids), expected)
+
+
+def test_sum_duplicates():
+    coo = sparse.make_coo([0, 0, 1, 2, 2], [1, 1, 0, 2, 2], [1.0, 2.0, 3.0, 4.0, 5.0], (3, 3))
+    out = sops.sum_duplicates(coo)
+    assert out.nnz == 3
+    dense = np.asarray(sparse.to_dense(out))
+    np.testing.assert_allclose(dense[0, 1], 3.0)
+    np.testing.assert_allclose(dense[2, 2], 9.0)
+
+
+def test_remove_zeros():
+    coo = sparse.make_coo([0, 1, 2], [0, 1, 2], [0.0, 2.0, 0.0], (3, 3))
+    out = sops.remove_zeros(coo)
+    assert out.nnz == 1
+    assert float(out.data[0]) == 2.0
+
+
+def test_slice_rows(rng):
+    csr, ref = _random_csr(rng, 30, 12)
+    sl = sops.slice_rows(csr, 5, 17)
+    np.testing.assert_allclose(sparse.to_scipy(sl).toarray(), ref[5:17].toarray(), rtol=1e-6)
+
+
+def test_degree(rng):
+    csr, ref = _random_csr(rng, 25, 25)
+    np.testing.assert_array_equal(np.asarray(sops.degree(csr)), np.diff(ref.indptr))
+
+
+def test_symmetrize_max():
+    coo = sparse.make_coo([0, 1], [1, 2], [3.0, 1.0], (3, 3))
+    out = sops.symmetrize(coo, mode="max")
+    dense = np.asarray(sparse.to_dense(out))
+    assert dense[0, 1] == dense[1, 0] == 3.0
+    assert dense[1, 2] == dense[2, 1] == 1.0
+
+
+@pytest.mark.parametrize("norm", ["l1", "l2", "linf"])
+def test_row_norm(rng, norm):
+    csr, ref = _random_csr(rng, 18, 11)
+    got = np.asarray(slinalg.row_norm(csr, norm))
+    dense = ref.toarray()
+    if norm == "l1":
+        want = np.abs(dense).sum(axis=1)
+    elif norm == "l2":
+        want = (dense**2).sum(axis=1)
+    else:
+        want = np.abs(dense).max(axis=1, initial=0)
+    np.testing.assert_allclose(got, want, rtol=1e-5)
+
+
+def test_spmv_spmm(rng):
+    csr, ref = _random_csr(rng, 22, 17)
+    x = rng.random(17, dtype=np.float32)
+    b = rng.random((17, 5), dtype=np.float32)
+    np.testing.assert_allclose(np.asarray(slinalg.spmv(csr, jnp.asarray(x))), ref @ x, rtol=1e-4)
+    np.testing.assert_allclose(np.asarray(jax.jit(slinalg.spmm)(csr, jnp.asarray(b))), ref @ b, rtol=1e-4)
+
+
+def test_transpose(rng):
+    csr, ref = _random_csr(rng, 9, 14)
+    np.testing.assert_allclose(sparse.to_scipy(slinalg.transpose(csr)).toarray(), ref.T.toarray(), rtol=1e-6)
+
+
+def test_add(rng):
+    a, ra = _random_csr(rng, 12, 12, 0.15)
+    b_sp = sp.random(12, 12, density=0.15, random_state=np.random.RandomState(11), format="csr", dtype=np.float32)
+    b = sparse.from_scipy(b_sp)
+    np.testing.assert_allclose(
+        sparse.to_scipy(slinalg.add(a, b)).toarray(), (ra + b_sp).toarray(), rtol=1e-5
+    )
+
+
+def test_row_normalize(rng):
+    csr, ref = _random_csr(rng, 10, 10)
+    out = slinalg.row_normalize(csr, "l1")
+    sums = np.abs(sparse.to_scipy(out).toarray()).sum(axis=1)
+    nz = np.diff(ref.indptr) > 0
+    np.testing.assert_allclose(sums[nz], 1.0, rtol=1e-5)
+
+
+def test_laplacian_normalized(rng):
+    adj_coo = sparse.csr_to_coo(_random_csr(rng, 15, 15, 0.2)[0])
+    sym = sops.symmetrize(adj_coo, mode="max")
+    lap = slinalg.laplacian(sym, normalized=True)
+    dense = np.asarray(sparse.to_dense(lap), dtype=np.float64)
+    np.testing.assert_allclose(dense, dense.T, atol=1e-6)
+    evals = np.linalg.eigvalsh(dense)
+    assert evals.min() > -1e-5  # PSD
+    assert abs(evals.min()) < 1e-4  # 0 eigenvalue exists
+
+
+def test_row_op(rng):
+    csr, ref = _random_csr(rng, 8, 8)
+    out = sops.row_op(csr, lambda rid, vals: vals * (rid + 1).astype(vals.dtype))
+    want = ref.toarray() * (np.arange(8) + 1)[:, None]
+    np.testing.assert_allclose(np.asarray(sparse.to_dense(out)), want, rtol=1e-5)
