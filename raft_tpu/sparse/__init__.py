@@ -2,7 +2,7 @@
 counterpart of the reference's `cpp/include/raft/sparse` (SURVEY.md §2.7).
 """
 
-from . import linalg, ops, types
+from . import distance, linalg, neighbors, ops, types
 from .types import (
     COO,
     CSR,
@@ -24,8 +24,10 @@ __all__ = [
     "coo_to_csr",
     "csr_from_dense",
     "csr_to_coo",
+    "distance",
     "from_scipy",
     "linalg",
+    "neighbors",
     "make_coo",
     "make_csr",
     "ops",

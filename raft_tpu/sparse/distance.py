@@ -1,0 +1,345 @@
+"""Semiring pairwise distances over CSR matrices.
+
+TPU-native counterpart of the reference's sparse distance engine
+(sparse/distance/distance.cuh; semiring coo_spmv in
+sparse/distance/detail/coo_spmv.cuh:73-86; paper arXiv:2104.06357).
+Supports the reference's 18-metric set (distance.cuh:38-56).
+
+Three compute paths, chosen per metric — the TPU re-think of the
+reference's dense-shared-memory vs hashmap strategies:
+
+1. **expanded** (L2/cosine/IP/Hellinger/Jaccard/Dice/RusselRao/
+   Correlation): a sparse Gram A·Bᵀ — per A-row-tile, the tile is
+   densified and contracted against B via gather+segment-sum spmm;
+   norms/sums/nnz row aggregates provide the epilogue, mirroring the
+   dense expanded family's Gram+epilogue split.
+2. **semiring-sum** (L1/L2-unexpanded/Canberra/Lp/Hamming/JS/KL):
+   for elementwise kernels f summed over features,
+   dist[i,j] = Σ_d f(aᵢd, 0) + Σ_{d∈supp(bⱼ)} (f(aᵢd, bⱼd) − f(aᵢd, 0)) —
+   an exact union-support evaluation that only does work on B's nnz
+   (the product_f/accum_f semiring of coo_spmv.cuh expressed as
+   gather + segment_sum).
+3. **dense-tile** (Linf and any max-accumulated kernel, where the
+   zero-correction trick doesn't distribute): both tiles densify and
+   run through the dense pairwise engine.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..distance.types import DistanceType, resolve_metric
+from .types import CSR
+
+# metrics the reference's sparse engine supports (distance.cuh:38-56)
+SUPPORTED = {
+    DistanceType.L2Expanded,
+    DistanceType.L2SqrtExpanded,
+    DistanceType.L2Unexpanded,
+    DistanceType.L2SqrtUnexpanded,
+    DistanceType.InnerProduct,
+    DistanceType.CosineExpanded,
+    DistanceType.HellingerExpanded,
+    DistanceType.JaccardExpanded,
+    DistanceType.DiceExpanded,
+    DistanceType.RusselRaoExpanded,
+    DistanceType.CorrelationExpanded,
+    DistanceType.L1,
+    DistanceType.Linf,
+    DistanceType.Canberra,
+    DistanceType.LpUnexpanded,
+    DistanceType.HammingUnexpanded,
+    DistanceType.JensenShannon,
+    DistanceType.KLDivergence,
+}
+
+
+def _densify_host(csr: CSR, start: int, stop: int) -> np.ndarray:
+    """Host-side densification of a row range (build-time; keeps the
+    jitted cores' shapes static across tiles so they compile once)."""
+    indptr = np.asarray(jax.device_get(csr.indptr))
+    indices = np.asarray(jax.device_get(csr.indices))
+    data = np.asarray(jax.device_get(csr.data))
+    lo, hi = int(indptr[start]), int(indptr[stop])
+    out = np.zeros((stop - start, csr.shape[1]), dtype=np.float32)
+    rows_local = (
+        np.searchsorted(indptr, np.arange(lo, hi), side="right") - 1 - start
+    )
+    out[rows_local, indices[lo:hi]] = data[lo:hi]
+    return out
+
+
+# ---------------------------------------------------------------------------
+# path 1: expanded — sparse Gram + row-aggregate epilogue
+# ---------------------------------------------------------------------------
+
+@partial(jax.jit, static_argnames=("n_rows",))
+def _gram_tile(ad: jax.Array, b_row_ids, b_indices, b_data, n_rows: int):
+    """G[t, n] = AD · Bᵀ via gather over B's nnz + segment-sum by B-row."""
+    # [nnz, t]: value of each B entry times the matching AD column
+    contrib = ad[:, b_indices].T * b_data[:, None]
+    return jax.ops.segment_sum(contrib, b_row_ids, num_segments=n_rows).T
+
+
+def _row_aggregates(csr: CSR):
+    data = csr.data.astype(jnp.float32)
+    n = csr.shape[0]
+    rid = csr.row_ids
+    sq = jax.ops.segment_sum(data * data, rid, num_segments=n)
+    s = jax.ops.segment_sum(data, rid, num_segments=n)
+    # count true non-zeros, not stored slots (stored explicit zeros would
+    # otherwise skew Jaccard/Dice supports vs the densified A side)
+    nnz = jax.ops.segment_sum((data != 0).astype(jnp.float32), rid, num_segments=n)
+    return sq, s, nnz
+
+
+def _expanded_epilogue(mt, g, agg_a_tile, agg_b, d, metric_arg):
+    sq_a, sum_a, nnz_a = agg_a_tile
+    sq_b, sum_b, nnz_b = agg_b
+    if mt in (DistanceType.L2Expanded, DistanceType.L2SqrtExpanded):
+        d2 = jnp.maximum(sq_a[:, None] + sq_b[None, :] - 2.0 * g, 0.0)
+        return jnp.sqrt(d2) if mt == DistanceType.L2SqrtExpanded else d2
+    if mt == DistanceType.InnerProduct:
+        return g
+    if mt == DistanceType.CosineExpanded:
+        na = jnp.sqrt(jnp.maximum(sq_a, 1e-30))
+        nb = jnp.sqrt(jnp.maximum(sq_b, 1e-30))
+        return 1.0 - g / (na[:, None] * nb[None, :])
+    if mt == DistanceType.HellingerExpanded:
+        # caller passed sqrt-transformed data, so g = Σ√(ab)
+        return jnp.sqrt(jnp.maximum(1.0 - g, 0.0))
+    if mt == DistanceType.JaccardExpanded:
+        union = nnz_a[:, None] + nnz_b[None, :] - g
+        return jnp.where(union > 0, 1.0 - g / jnp.maximum(union, 1.0), 0.0)
+    if mt == DistanceType.DiceExpanded:
+        denom = nnz_a[:, None] + nnz_b[None, :]
+        return jnp.where(denom > 0, 1.0 - 2.0 * g / jnp.maximum(denom, 1.0), 0.0)
+    if mt == DistanceType.RusselRaoExpanded:
+        return (d - g) / d
+    if mt == DistanceType.CorrelationExpanded:
+        # centered Gram from raw moments: ⟨a−ā, b−b̄⟩ = g − d·ā·b̄
+        ma, mb = sum_a / d, sum_b / d
+        gc = g - d * ma[:, None] * mb[None, :]
+        sqc_a = jnp.maximum(sq_a - d * ma * ma, 1e-30)
+        sqc_b = jnp.maximum(sq_b - d * mb * mb, 1e-30)
+        return 1.0 - gc / jnp.sqrt(sqc_a[:, None] * sqc_b[None, :])
+    raise AssertionError(mt)
+
+
+# ---------------------------------------------------------------------------
+# path 2: semiring-sum — f(a,0) base + per-nnz correction
+# ---------------------------------------------------------------------------
+
+def _f_l1(a, b):
+    return jnp.abs(a - b)
+
+
+def _f_l2(a, b):
+    diff = a - b
+    return diff * diff
+
+
+def _f_canberra(a, b):
+    den = jnp.abs(a) + jnp.abs(b)
+    return jnp.where(den > 0, jnp.abs(a - b) / jnp.maximum(den, 1e-30), 0.0)
+
+
+def _f_lp(a, b, p):
+    return jnp.abs(a - b) ** p
+
+
+def _f_hamming(a, b):
+    return (a != b).astype(jnp.float32)
+
+
+def _xlogx_over(p, q):
+    safe = (p > 0) & (q > 0)
+    return jnp.where(
+        safe, p * jnp.log(jnp.maximum(p, 1e-30) / jnp.maximum(q, 1e-30)), 0.0
+    )
+
+
+def _f_js(a, b):
+    m = 0.5 * (a + b)
+    return _xlogx_over(a, m) + _xlogx_over(b, m)
+
+
+def _f_kl(a, b):
+    return _xlogx_over(a, b)
+
+
+_SEMIRING_F = {
+    DistanceType.L1: _f_l1,
+    DistanceType.L2Unexpanded: _f_l2,
+    DistanceType.L2SqrtUnexpanded: _f_l2,
+    DistanceType.Canberra: _f_canberra,
+    DistanceType.LpUnexpanded: _f_lp,
+    DistanceType.HammingUnexpanded: _f_hamming,
+    DistanceType.JensenShannon: _f_js,
+    DistanceType.KLDivergence: _f_kl,
+}
+
+
+@partial(jax.jit, static_argnames=("f", "n_rows"))
+def _semiring_tile(ad: jax.Array, b_row_ids, b_indices, b_data, f, n_rows: int):
+    """dist[t, n] = Σ_d f(a,0)  +  Σ_{nnz of B} [f(a,bval) − f(a,0)]."""
+    base = jnp.sum(f(ad, jnp.zeros((), jnp.float32)), axis=1)  # [t]
+    a_cols = ad[:, b_indices].T  # [nnz, t]
+    delta = f(a_cols, b_data[:, None]) - f(a_cols, jnp.zeros((), jnp.float32))
+    corr = jax.ops.segment_sum(delta, b_row_ids, num_segments=n_rows)  # [n, t]
+    return base[:, None] + corr.T
+
+
+def _semiring_final(mt, out, d, metric_arg):
+    if mt == DistanceType.L2SqrtUnexpanded:
+        return jnp.sqrt(jnp.maximum(out, 0.0))
+    if mt == DistanceType.LpUnexpanded:
+        return jnp.maximum(out, 0.0) ** (1.0 / metric_arg)
+    if mt == DistanceType.HammingUnexpanded:
+        return out / d
+    if mt == DistanceType.JensenShannon:
+        return jnp.sqrt(jnp.maximum(0.5 * out, 0.0))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# public API
+# ---------------------------------------------------------------------------
+
+_EXPANDED = frozenset(
+    (
+        DistanceType.L2Expanded,
+        DistanceType.L2SqrtExpanded,
+        DistanceType.InnerProduct,
+        DistanceType.CosineExpanded,
+        DistanceType.HellingerExpanded,
+        DistanceType.JaccardExpanded,
+        DistanceType.DiceExpanded,
+        DistanceType.RusselRaoExpanded,
+        DistanceType.CorrelationExpanded,
+    )
+)
+
+# Keep one partial per Lp exponent so jit's static-arg cache hits across
+# tiles and calls (partials hash by identity).
+_LP_PARTIALS: dict = {}
+
+
+class _PreparedIndex:
+    """Index-side (B) preparation, done once and reused across query
+    tiles/batches: row-id expansion, metric-specific data transform, row
+    aggregates, and — for the dense-tile path — the densified matrix."""
+
+    def __init__(self, b: CSR, mt: DistanceType, metric_arg: float):
+        self.b = b
+        self.mt = mt
+        self.metric_arg = metric_arg
+        self.expanded = mt in _EXPANDED
+        self.semiring = mt in _SEMIRING_F
+        # Jaccard/Dice binarize supports; RusselRao (like the dense
+        # engine) grams raw values — binary inputs are the caller's
+        # contract.
+        self.binary = mt in (DistanceType.JaccardExpanded, DistanceType.DiceExpanded)
+        self.bd_dense = None
+        if self.expanded or self.semiring:
+            self.row_ids = b.row_ids
+            data = b.data.astype(jnp.float32)
+            if self.binary:
+                data = (data != 0).astype(jnp.float32)
+            elif mt == DistanceType.HellingerExpanded:
+                data = jnp.sqrt(jnp.maximum(data, 0.0))
+            self.data = data
+            self.agg = _row_aggregates(b) if self.expanded else None
+            if self.semiring:
+                if mt == DistanceType.LpUnexpanded:
+                    self.f = _LP_PARTIALS.setdefault(
+                        float(metric_arg), partial(_f_lp, p=float(metric_arg))
+                    )
+                else:
+                    self.f = _SEMIRING_F[mt]
+        else:  # dense-tile path (Linf): densify B once
+            self.bd_dense = jnp.asarray(_densify_host(b, 0, b.shape[0]))
+
+    def tile(self, ad: jnp.ndarray) -> jnp.ndarray:
+        """Distances [tile, n_index] for one densified query tile."""
+        mt, b = self.mt, self.b
+        n, d = b.shape[0], b.shape[1]
+        if self.expanded:
+            if self.binary:
+                ad = (ad != 0).astype(jnp.float32)
+            elif mt == DistanceType.HellingerExpanded:
+                ad = jnp.sqrt(jnp.maximum(ad, 0.0))
+            g = _gram_tile(ad, self.row_ids, b.indices, self.data, n)
+            sq = jnp.sum(ad * ad, axis=1)
+            s = jnp.sum(ad, axis=1)
+            nnz = jnp.sum((ad != 0).astype(jnp.float32), axis=1)
+            return _expanded_epilogue(mt, g, (sq, s, nnz), self.agg, d, self.metric_arg)
+        if self.semiring:
+            raw = _semiring_tile(ad, self.row_ids, b.indices, self.data, self.f, n)
+            return _semiring_final(mt, raw, d, self.metric_arg)
+        from ..distance.pairwise import pairwise_distance as dense_pw
+
+        return dense_pw(ad, self.bd_dense, metric=mt, metric_arg=self.metric_arg)
+
+
+def pairwise_distance(
+    a: CSR,
+    b: CSR,
+    metric="euclidean",
+    metric_arg: float = 2.0,
+    tile_rows: int = 4096,
+) -> jnp.ndarray:
+    """All-pairs [a.n_rows, b.n_rows] distance matrix between CSR rows —
+    counterpart of ``raft::sparse::distance::pairwiseDistance``
+    (sparse/distance/distance.cuh:62)."""
+    mt = resolve_metric(metric)
+    if mt not in SUPPORTED:
+        raise ValueError(f"metric {mt} unsupported for sparse inputs")
+    if a.shape[1] != b.shape[1]:
+        raise ValueError("feature dims differ")
+    prep = _PreparedIndex(b, mt, metric_arg)
+    m = a.shape[0]
+    out_tiles = []
+    for start in range(0, m, tile_rows):
+        stop = min(start + tile_rows, m)
+        ad = jnp.asarray(_densify_host(a, start, stop))
+        out_tiles.append(prep.tile(ad))
+    return jnp.concatenate(out_tiles, axis=0)
+
+
+def brute_force_knn(
+    index: CSR,
+    queries: CSR,
+    k: int,
+    metric="euclidean",
+    metric_arg: float = 2.0,
+    batch_size: int = 2048,
+) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Exact kNN over sparse data — counterpart of
+    ``raft::sparse::neighbors::brute_force_knn``
+    (sparse/neighbors/brute_force.cuh): batched pairwise distance +
+    per-batch select_k."""
+    from ..distance.types import SELECT_MIN
+    from ..matrix.select_k import select_k
+
+    mt = resolve_metric(metric)
+    if mt not in SUPPORTED:
+        raise ValueError(f"metric {mt} unsupported for sparse inputs")
+    if index.shape[1] != queries.shape[1]:
+        raise ValueError("feature dims differ")
+    select_min = SELECT_MIN[mt]
+    prep = _PreparedIndex(index, mt, metric_arg)  # index prep amortized over batches
+    dists_out, ids_out = [], []
+    for start in range(0, queries.shape[0], batch_size):
+        stop = min(start + batch_size, queries.shape[0])
+        qd = jnp.asarray(_densify_host(queries, start, stop))
+        dmat = prep.tile(qd)
+        vals, idx = select_k(dmat, k, select_min=select_min)
+        dists_out.append(vals)
+        ids_out.append(idx)
+    return jnp.concatenate(dists_out, axis=0), jnp.concatenate(ids_out, axis=0)

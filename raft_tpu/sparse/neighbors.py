@@ -1,0 +1,41 @@
+"""Sparse-neighbors utilities: knn-graph construction.
+
+TPU-native counterpart of the reference's `sparse/neighbors/knn_graph.cuh`
+(dense input → symmetric COO knn graph, the input to MST/single-linkage)
+and `sparse/neighbors/brute_force.cuh` (see :func:`..distance.brute_force_knn`).
+`cross_component_nn` (connect_components) lives in this module too.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .types import COO, make_coo
+
+
+def knn_graph(dataset, n_neighbors: int, metric="sqeuclidean") -> COO:
+    """Build a directed knn graph as COO [n, n] with distance weights —
+    counterpart of ``raft::sparse::neighbors::knn_graph``
+    (sparse/neighbors/knn_graph.cuh:103).  Self-loops are dropped."""
+    from ..neighbors import brute_force
+
+    n = dataset.shape[0]
+    index = brute_force.build(jnp.asarray(dataset), metric=metric)
+    # k+1 because the point itself comes back as its own 0-distance NN
+    dists, ids = brute_force.knn(index, jnp.asarray(dataset), n_neighbors + 1)
+    dists = np.asarray(jax.device_get(dists))
+    ids = np.asarray(jax.device_get(ids))
+    rows = np.repeat(np.arange(n), n_neighbors + 1)
+    cols = ids.reshape(-1)
+    vals = dists.reshape(-1)
+    keep = rows != cols
+    rows, cols, vals = rows[keep], cols[keep], vals[keep]
+    # keep at most n_neighbors per row (self-drop may leave k+1 for rows
+    # whose own id wasn't in the list due to ties)
+    order = np.lexsort((vals, rows))
+    rows, cols, vals = rows[order], cols[order], vals[order]
+    rank = np.arange(rows.size) - np.searchsorted(rows, rows, side="left")
+    keep = rank < n_neighbors
+    return make_coo(rows[keep], cols[keep], vals[keep], (n, n))

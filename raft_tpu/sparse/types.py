@@ -166,6 +166,9 @@ def to_scipy(m):
 
 
 def from_scipy(m) -> CSR:
-    """Import any scipy.sparse matrix as CSR."""
-    m = m.tocsr()
+    """Import any scipy.sparse matrix as canonical CSR (duplicates summed,
+    explicit zeros dropped — consumers assume canonical structure)."""
+    m = m.tocsr().copy()
+    m.sum_duplicates()
+    m.eliminate_zeros()
     return make_csr(m.indptr, m.indices, m.data, m.shape)
