@@ -6,3 +6,8 @@ Counterpart of the reference cluster layer (cpp/include/raft/cluster).
 from raft_tpu.cluster import kmeans, kmeans_balanced  # noqa: F401
 from raft_tpu.cluster.kmeans import KMeansParams  # noqa: F401
 from raft_tpu.cluster.kmeans_balanced import KMeansBalancedParams  # noqa: F401
+from raft_tpu.cluster import single_linkage as single_linkage_mod  # noqa: F401
+from raft_tpu.cluster.single_linkage import (  # noqa: F401
+    SingleLinkageOutput,
+    single_linkage,
+)

@@ -1,0 +1,140 @@
+"""Single-linkage agglomerative clustering (HAC).
+
+TPU-native counterpart of the reference's
+``raft::cluster::single_linkage`` (cluster/single_linkage.cuh:53;
+detail/{connectivities,mst,agglomerative,single_linkage}.cuh; cuSLINK
+paper README.md:334-341).  Pipeline:
+
+  knn-graph  →  symmetrize  →  connect components (cross_component_nn
+  rounds until one component)  →  Boruvka MST  →  dendrogram (host
+  union-find over weight-sorted MST edges — O(n α(n)) scalar work, the
+  TPU analog of the reference's host-side agglomerative relabeling)  →
+  flat cut at n_clusters.
+
+The knn-graph connectivity (``LinkageDistance::KNN_GRAPH``) is the
+reference's scalable default; pass ``n_neighbors >= n-1`` for the exact
+pairwise construction (``LinkageDistance::PAIRWISE``).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+@dataclass
+class SingleLinkageOutput:
+    """Reference: linkage_output (cluster/single_linkage_types.hpp)."""
+
+    labels: jnp.ndarray  # [n] flat cluster assignment
+    children: np.ndarray  # [n-1, 2] merged cluster ids per dendrogram step
+    distances: np.ndarray  # [n-1] merge heights
+    sizes: np.ndarray  # [n-1] merged cluster sizes
+    n_clusters: int
+
+
+def _dendrogram(src, dst, w, n):
+    """Host union-find over ascending-weight MST edges → scipy-style
+    linkage rows (reference: detail/agglomerative.cuh build_dendrogram_host)."""
+    order = np.argsort(w, kind="stable")
+    src, dst, w = src[order], dst[order], w[order]
+    parent = np.arange(n)
+    cluster_id = np.arange(n, dtype=np.int64)  # cluster id held at each root
+    size = np.ones(n, dtype=np.int64)  # subtree size held at each root
+
+    def find(x):
+        root = x
+        while parent[root] != root:
+            root = parent[root]
+        while parent[x] != root:
+            parent[x], x = root, parent[x]
+        return root
+
+    children = np.zeros((len(src), 2), dtype=np.int64)
+    heights = np.zeros(len(src), dtype=np.float64)
+    sizes = np.zeros(len(src), dtype=np.int64)
+    for i in range(len(src)):
+        a, b = find(src[i]), find(dst[i])
+        ca, cb = cluster_id[a], cluster_id[b]
+        children[i] = (min(ca, cb), max(ca, cb))
+        heights[i] = w[i]
+        parent[b] = a
+        size[a] += size[b]
+        sizes[i] = size[a]
+        cluster_id[a] = n + i
+    return children, heights, sizes
+
+
+def _cut(children, n, n_clusters):
+    """Flat labels from the first n - n_clusters merges
+    (reference: detail/agglomerative.cuh extract_flattened_clusters)."""
+    parent = np.arange(2 * n - 1)
+
+    def find(x):
+        root = x
+        while parent[root] != root:
+            root = parent[root]
+        while parent[x] != root:
+            parent[x], x = root, parent[x]
+        return root
+
+    for i in range(n - n_clusters):
+        a, b = children[i]
+        new = n + i
+        parent[find(a)] = new
+        parent[find(b)] = new
+    roots = np.array([find(v) for v in range(n)])
+    _, labels = np.unique(roots, return_inverse=True)
+    return labels
+
+
+def single_linkage(
+    dataset,
+    n_clusters: int,
+    metric: str = "sqeuclidean",
+    n_neighbors: int = 15,
+) -> SingleLinkageOutput:
+    """Fit single-linkage HAC and cut into ``n_clusters`` flat clusters —
+    counterpart of ``raft::cluster::single_linkage``
+    (cluster/single_linkage.cuh:53)."""
+    from ..label import connected_components
+    from ..sparse.neighbors import cross_component_nn, knn_graph
+    from ..sparse.ops import symmetrize
+    from ..sparse.solver import mst
+    from ..sparse.types import csr_to_coo, make_coo
+
+    x = jnp.asarray(dataset)
+    n = int(x.shape[0])
+    if not 1 <= n_clusters <= n:
+        raise ValueError(f"n_clusters={n_clusters} out of range [1, {n}]")
+    k = min(n_neighbors, n - 1)
+    graph = knn_graph(x, k, metric=metric)
+    sym = symmetrize(graph, mode="max")
+
+    # stitch components until the graph is connected (each round links
+    # every component to its nearest neighbor component — halves count)
+    for _ in range(32):
+        labels, n_comp = connected_components(sym)
+        if n_comp == 1:
+            break
+        bridge = cross_component_nn(x, labels, metric=metric)
+        merged = csr_to_coo(sym)
+        rows = jnp.concatenate([merged.rows, bridge.rows])
+        cols = jnp.concatenate([merged.cols, bridge.cols])
+        data = jnp.concatenate([merged.data, bridge.data.astype(merged.data.dtype)])
+        sym = symmetrize(make_coo(rows, cols, data, sym.shape), mode="max")
+
+    tree = mst(sym)
+    children, heights, sizes = _dendrogram(tree.src, tree.dst, tree.weights, n)
+    labels = _cut(children, n, n_clusters)
+    return SingleLinkageOutput(
+        labels=jnp.asarray(labels, jnp.int32),
+        children=children,
+        distances=heights,
+        sizes=sizes,
+        n_clusters=n_clusters,
+    )

@@ -1,0 +1,15 @@
+"""Label utilities — TPU-native counterpart of `raft/label/` (SURVEY.md §2.7)."""
+
+from .classlabels import (
+    connected_components,
+    make_monotonic,
+    merge_labels,
+    unique_labels,
+)
+
+__all__ = [
+    "connected_components",
+    "make_monotonic",
+    "merge_labels",
+    "unique_labels",
+]

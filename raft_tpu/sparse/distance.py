@@ -78,9 +78,22 @@ def _densify_host(csr: CSR, start: int, stop: int) -> np.ndarray:
 # path 1: expanded — sparse Gram + row-aggregate epilogue
 # ---------------------------------------------------------------------------
 
+# cap on the [chunk_nnz, tile_rows] intermediate each kernel call builds
+# (f32 elements); B's nnz is chunked to stay under it, bounding memory at
+# ~256 MB regardless of index size
+_CHUNK_BUDGET_ELEMS = 1 << 26
+
+
+def _nnz_chunks(tile_rows: int, nnz: int):
+    """Host-side chunk boundaries over B's nnz arrays."""
+    chunk = max(1, _CHUNK_BUDGET_ELEMS // max(tile_rows, 1))
+    return [(s, min(s + chunk, nnz)) for s in range(0, nnz, chunk)]
+
+
 @partial(jax.jit, static_argnames=("n_rows",))
-def _gram_tile(ad: jax.Array, b_row_ids, b_indices, b_data, n_rows: int):
-    """G[t, n] = AD · Bᵀ via gather over B's nnz + segment-sum by B-row."""
+def _gram_tile_chunk(ad: jax.Array, b_row_ids, b_indices, b_data, n_rows: int):
+    """Partial G[t, n] = AD · Bᵀ over one nnz chunk of B:
+    gather + segment-sum by B-row."""
     # [nnz, t]: value of each B entry times the matching AD column
     contrib = ad[:, b_indices].T * b_data[:, None]
     return jax.ops.segment_sum(contrib, b_row_ids, num_segments=n_rows).T
@@ -186,13 +199,11 @@ _SEMIRING_F = {
 
 
 @partial(jax.jit, static_argnames=("f", "n_rows"))
-def _semiring_tile(ad: jax.Array, b_row_ids, b_indices, b_data, f, n_rows: int):
-    """dist[t, n] = Σ_d f(a,0)  +  Σ_{nnz of B} [f(a,bval) − f(a,0)]."""
-    base = jnp.sum(f(ad, jnp.zeros((), jnp.float32)), axis=1)  # [t]
-    a_cols = ad[:, b_indices].T  # [nnz, t]
+def _semiring_tile_chunk(ad: jax.Array, b_row_ids, b_indices, b_data, f, n_rows: int):
+    """Correction term Σ_{nnz chunk of B} [f(a,bval) − f(a,0)] → [t, n]."""
+    a_cols = ad[:, b_indices].T  # [chunk_nnz, t]
     delta = f(a_cols, b_data[:, None]) - f(a_cols, jnp.zeros((), jnp.float32))
-    corr = jax.ops.segment_sum(delta, b_row_ids, num_segments=n_rows)  # [n, t]
-    return base[:, None] + corr.T
+    return jax.ops.segment_sum(delta, b_row_ids, num_segments=n_rows).T  # [t, n]
 
 
 def _semiring_final(mt, out, d, metric_arg):
@@ -266,7 +277,9 @@ class _PreparedIndex:
             self.bd_dense = jnp.asarray(_densify_host(b, 0, b.shape[0]))
 
     def tile(self, ad: jnp.ndarray) -> jnp.ndarray:
-        """Distances [tile, n_index] for one densified query tile."""
+        """Distances [tile, n_index] for one densified query tile.  The
+        contraction over B is chunked along its nnz so the gathered
+        intermediate stays under _CHUNK_BUDGET_ELEMS."""
         mt, b = self.mt, self.b
         n, d = b.shape[0], b.shape[1]
         if self.expanded:
@@ -274,13 +287,23 @@ class _PreparedIndex:
                 ad = (ad != 0).astype(jnp.float32)
             elif mt == DistanceType.HellingerExpanded:
                 ad = jnp.sqrt(jnp.maximum(ad, 0.0))
-            g = _gram_tile(ad, self.row_ids, b.indices, self.data, n)
+            g = jnp.zeros((ad.shape[0], n), jnp.float32)
+            for lo, hi in _nnz_chunks(ad.shape[0], int(b.data.shape[0])):
+                g = g + _gram_tile_chunk(
+                    ad, self.row_ids[lo:hi], b.indices[lo:hi], self.data[lo:hi], n
+                )
             sq = jnp.sum(ad * ad, axis=1)
             s = jnp.sum(ad, axis=1)
             nnz = jnp.sum((ad != 0).astype(jnp.float32), axis=1)
             return _expanded_epilogue(mt, g, (sq, s, nnz), self.agg, d, self.metric_arg)
         if self.semiring:
-            raw = _semiring_tile(ad, self.row_ids, b.indices, self.data, self.f, n)
+            base = jnp.sum(self.f(ad, jnp.zeros((), jnp.float32)), axis=1)  # [t]
+            raw = jnp.broadcast_to(base[:, None], (ad.shape[0], n))
+            for lo, hi in _nnz_chunks(ad.shape[0], int(b.data.shape[0])):
+                raw = raw + _semiring_tile_chunk(
+                    ad, self.row_ids[lo:hi], b.indices[lo:hi], self.data[lo:hi],
+                    self.f, n,
+                )
             return _semiring_final(mt, raw, d, self.metric_arg)
         from ..distance.pairwise import pairwise_distance as dense_pw
 

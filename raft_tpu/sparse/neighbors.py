@@ -39,3 +39,54 @@ def knn_graph(dataset, n_neighbors: int, metric="sqeuclidean") -> COO:
     rank = np.arange(rows.size) - np.searchsorted(rows, rows, side="left")
     keep = rank < n_neighbors
     return make_coo(rows[keep], cols[keep], vals[keep], (n, n))
+
+
+def cross_component_nn(dataset, labels, metric="sqeuclidean", tile_rows: int = 2048):
+    """Minimum cross-component edge per component — counterpart of
+    ``raft::sparse::neighbors::cross_component_nn`` (a.k.a.
+    connect_components, sparse/neighbors/cross_component_nn.cuh), the step
+    that stitches a disconnected knn graph before MST/single-linkage.
+
+    For every component, finds its nearest vertex pair reaching a
+    *different* component (masked argmin over tiled pairwise distances —
+    the reference's masked fused-L2-NN).  Returns COO edges (one per
+    component: src, dst, dist)."""
+    import jax.numpy as jnp
+
+    from ..distance.pairwise import pairwise_distance
+
+    x = jnp.asarray(dataset)
+    lab = jnp.asarray(labels, jnp.int32)
+    n = x.shape[0]
+    n_comp = int(np.asarray(jax.device_get(lab)).max()) + 1
+
+    best_dist = jnp.full((n_comp,), jnp.inf, jnp.float32)
+    best_src = jnp.zeros((n_comp,), jnp.int32)
+    best_dst = jnp.zeros((n_comp,), jnp.int32)
+    for start in range(0, n, tile_rows):
+        stop = min(start + tile_rows, n)
+        d = pairwise_distance(x[start:stop], x, metric=metric)
+        mask = lab[start:stop, None] == lab[None, :]
+        d = jnp.where(mask, jnp.inf, d)
+        row_min = jnp.min(d, axis=1)
+        row_arg = jnp.argmin(d, axis=1).astype(jnp.int32)
+        seg = lab[start:stop]
+        tile_best = jax.ops.segment_min(row_min, seg, num_segments=n_comp)
+        improved = tile_best < best_dist
+        # recover argmin row per component for improved entries
+        is_best = (row_min == tile_best[seg]) & improved[seg]
+        rows_global = jnp.arange(start, stop, dtype=jnp.int32)
+        big = jnp.asarray(np.iinfo(np.int32).max, jnp.int32)
+        src_cand = jax.ops.segment_min(
+            jnp.where(is_best, rows_global, big), seg, num_segments=n_comp
+        )
+        take = improved & (src_cand < big)
+        chosen_src = jnp.where(take, src_cand, best_src)
+        # dst = argmin column of the chosen src row (gather, drop-safe)
+        chosen_dst = jnp.where(
+            take, row_arg[jnp.clip(chosen_src - start, 0, stop - start - 1)], best_dst
+        )
+        best_dist = jnp.where(take, tile_best, best_dist)
+        best_src, best_dst = chosen_src, chosen_dst
+
+    return make_coo(best_src, best_dst, best_dist, (n, n))

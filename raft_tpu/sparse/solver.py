@@ -1,0 +1,246 @@
+"""Sparse solvers — Lanczos eigenpairs and Boruvka MST.
+
+TPU-native counterpart of the reference's `sparse/solver/`:
+- Lanczos smallest/largest eigenpairs
+  (sparse/solver/detail/lanczos.cuh:748 computeSmallestEigenvectors,
+  :1095 computeLargestEigenvectors) — here a fixed-iteration
+  `lax.fori_loop` Lanczos with full reorthogonalization (the TPU-shaped
+  choice: static shapes, one fused loop body, spmv on segment-sums),
+  followed by a dense eigh of the small tridiagonal.
+- Boruvka minimum spanning tree (sparse/solver/mst.cuh:47,
+  mst_solver.cuh; cuSLINK paper README.md:334-341) — per-round
+  per-component minimum outgoing edge via two-pass segment-min (exact
+  index tie-break instead of the reference's weight-alteration trick),
+  then pointer-jumping contraction.  Rounds are a host loop (component
+  count at least halves per round); each round's body is pure jnp.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import NamedTuple, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .linalg import spmv
+from .types import CSR
+
+
+# ---------------------------------------------------------------------------
+# Lanczos
+# ---------------------------------------------------------------------------
+
+@partial(jax.jit, static_argnames=("m",))
+def _lanczos_basis(a: CSR, v0: jnp.ndarray, restarts: jnp.ndarray, m: int):
+    """Run m Lanczos steps with full reorthogonalization; returns
+    (V [m, n], alpha [m], beta [m]) with beta[i] = ||w_i|| linking step
+    i to i+1.
+
+    Deflation guard: when the Krylov space exhausts (beta ~ 0 — e.g. a
+    matrix with few distinct eigenvalues), the next basis vector is
+    drawn from ``restarts`` and orthogonalized against the basis so far,
+    and beta is recorded as exactly 0.  T then becomes block-diagonal —
+    still a valid Rayleigh-Ritz projection, so eigh(T) keeps giving true
+    eigenpairs instead of spurious zeros from a zero tail block."""
+    n = v0.shape[0]
+    v0 = v0 / jnp.linalg.norm(v0)
+    V0 = jnp.zeros((m, n), jnp.float32).at[0].set(v0)
+
+    def body(i, state):
+        V, alpha, beta = state
+        v = V[i]
+        w = spmv(a, v)
+        a_i = jnp.dot(w, v)
+        w = w - a_i * v
+        # full reorthogonalization against the basis built so far (rows
+        # past i are zero, so the projection is a no-op there)
+        w = w - V.T @ (V @ w)
+        w = w - V.T @ (V @ w)  # second pass for fp32 robustness
+        b_i = jnp.linalg.norm(w)
+        deflated = b_i <= 1e-5
+        # restart vector: orthogonalize a fresh random direction
+        r = restarts[i] - V.T @ (V @ restarts[i])
+        r = r - V.T @ (V @ r)
+        r = r / jnp.maximum(jnp.linalg.norm(r), 1e-30)
+        v_next = jnp.where(deflated, r, w / jnp.maximum(b_i, 1e-30))
+        b_rec = jnp.where(deflated, 0.0, b_i)
+        V = jax.lax.cond(
+            i + 1 < m, lambda V: V.at[i + 1].set(v_next), lambda V: V, V
+        )
+        return V, alpha.at[i].set(a_i), beta.at[i].set(b_rec)
+
+    V, alpha, beta = jax.lax.fori_loop(
+        0, m, body, (V0, jnp.zeros(m, jnp.float32), jnp.zeros(m, jnp.float32))
+    )
+    return V, alpha, beta
+
+
+def lanczos_eigsh(
+    a: CSR,
+    k: int,
+    which: str = "smallest",
+    max_iter: int | None = None,
+    seed: int = 0,
+) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """k extremal eigenpairs of a sparse symmetric matrix.
+
+    Counterpart of ``raft::sparse::solver::lanczos_solver_t`` usage in
+    spectral partitioning (sparse/solver/detail/lanczos.cuh:748,1095).
+    Returns (eigenvalues [k], eigenvectors [n, k]), ascending for
+    ``which="smallest"``, descending for ``which="largest"``.
+    """
+    n = a.shape[0]
+    if k >= n:
+        raise ValueError(f"k={k} must be < n={n}")
+    m = max_iter or min(n, max(4 * k + 8, 32))
+    m = min(m, n)
+    key = jax.random.PRNGKey(seed)
+    k0, k1 = jax.random.split(key)
+    v0 = jax.random.normal(k0, (n,), jnp.float32)
+    restarts = jax.random.normal(k1, (m, n), jnp.float32)
+    V, alpha, beta = _lanczos_basis(a, v0, restarts, m)
+    # small dense tridiagonal eig (host-scale work)
+    T = (
+        jnp.diag(alpha)
+        + jnp.diag(beta[: m - 1], 1)
+        + jnp.diag(beta[: m - 1], -1)
+    )
+    evals, evecs = jnp.linalg.eigh(T)  # ascending
+    if which == "smallest":
+        sel = jnp.arange(k)
+    elif which == "largest":
+        sel = jnp.arange(m - 1, m - 1 - k, -1)
+    else:
+        raise ValueError("which must be 'smallest' or 'largest'")
+    ritz_vals = evals[sel]
+    ritz_vecs = V.T @ evecs[:, sel]  # [n, k]
+    # normalize (guards the deflated/0-beta case)
+    norms = jnp.linalg.norm(ritz_vecs, axis=0)
+    ritz_vecs = ritz_vecs / jnp.maximum(norms, 1e-30)
+    return ritz_vals, ritz_vecs
+
+
+# ---------------------------------------------------------------------------
+# Boruvka MST
+# ---------------------------------------------------------------------------
+
+class MSTResult(NamedTuple):
+    """Reference: Graph_COO returned by raft::sparse::solver::mst
+    (mst_solver.cuh) — MST edges + final component color per vertex."""
+
+    src: np.ndarray
+    dst: np.ndarray
+    weights: np.ndarray
+    color: np.ndarray
+
+    @property
+    def n_edges(self) -> int:
+        return int(self.src.shape[0])
+
+
+@jax.jit
+def _boruvka_round(comp, rows, cols, w, edge_ids):
+    """One Boruvka round: pick each component's cheapest outgoing edge
+    (two-pass segment-min with exact edge-id tie-break), merge via
+    pointer jumping.  Returns (new_comp, selected_edge_mask)."""
+    n = comp.shape[0]
+    crow = comp[rows]
+    ccol = comp[cols]
+    cross = crow != ccol
+    big = jnp.asarray(jnp.inf, w.dtype)
+    # pass 1: min weight per source component over crossing edges
+    wmasked = jnp.where(cross, w, big)
+    wmin = jax.ops.segment_min(wmasked, crow, num_segments=n)
+    # pass 2: min canonical edge id among weight-minimal crossing edges —
+    # the canonical id gives a *global* total order on undirected edges,
+    # so equal-weight ties resolve identically from both endpoints (the
+    # acyclicity argument the reference gets from weight alteration)
+    is_cand = cross & (w == wmin[crow])
+    id_big = jnp.asarray(jnp.iinfo(jnp.int32).max, jnp.int32)
+    idmasked = jnp.where(is_cand, edge_ids, id_big)
+    idmin = jax.ops.segment_min(idmasked, crow, num_segments=n)
+    has_edge = idmin < id_big
+    # pass 3: recover the array position of the chosen edge copy
+    pos = jnp.arange(edge_ids.shape[0], dtype=jnp.int32)
+    pos_cand = is_cand & (edge_ids == idmin[crow])
+    posmasked = jnp.where(pos_cand, pos, id_big)
+    posmin = jax.ops.segment_min(posmasked, crow, num_segments=n)
+
+    # parent[c] = component across c's chosen edge
+    chosen = jnp.where(has_edge, posmin, 0)
+    parent = jnp.where(has_edge, ccol[chosen], jnp.arange(n, dtype=comp.dtype))
+    # break 2-cycles (mutual picks): keep the smaller label as root
+    gp = parent[parent]
+    parent = jnp.where((gp == jnp.arange(n)) & (parent < jnp.arange(n)),
+                       jnp.arange(n, dtype=comp.dtype), parent)
+    # pointer jumping to fixpoint (log n hops bounded by 32)
+    def jump(_, p):
+        return p[p]
+    parent = jax.lax.fori_loop(0, 32, jump, parent)
+
+    # scatter True only for components that picked an edge: edge-less
+    # components get an out-of-bounds index, which scatter drops (writing
+    # False at position `chosen`=0 could clobber a real selection)
+    chosen_or_oob = jnp.where(has_edge, posmin, edge_ids.shape[0])
+    selected = (
+        jnp.zeros(edge_ids.shape[0], bool)
+        .at[chosen_or_oob]
+        .set(True, mode="drop")
+    )
+    return parent[comp], selected
+
+
+def mst(adj: CSR) -> MSTResult:
+    """Minimum spanning forest of a symmetric weighted adjacency —
+    counterpart of ``raft::sparse::solver::mst`` (sparse/solver/mst.cuh:47).
+
+    Ties are broken by edge index (deterministic), replacing the
+    reference's random weight-alteration pass.  Returns undirected MST
+    edges (each once, src < dst) and the vertex coloring (connected
+    component of the forest)."""
+    from .types import csr_to_coo
+
+    coo = csr_to_coo(adj)
+    rows = jnp.asarray(coo.rows, jnp.int32)
+    cols = jnp.asarray(coo.cols, jnp.int32)
+    w = coo.data.astype(jnp.float32)
+    # canonical undirected edge id: both directed copies of one edge get
+    # the same id, so mutual picks dedupe naturally
+    n = adj.shape[0]
+    # host-side int64 canonical key (jnp would truncate to int32 without
+    # x64 mode, overflowing past n ≈ 46K vertices)
+    rows_h = np.asarray(jax.device_get(rows), dtype=np.int64)
+    cols_h = np.asarray(jax.device_get(cols), dtype=np.int64)
+    canon_np = np.minimum(rows_h, cols_h) * n + np.maximum(rows_h, cols_h)
+    # rank canonical keys to compact int32 ids (host sort, build-time)
+    uniq, edge_ids_np = np.unique(canon_np, return_inverse=True)
+    edge_ids = jnp.asarray(edge_ids_np.astype(np.int32))
+
+    comp = jnp.arange(n, dtype=jnp.int32)
+    selected = np.zeros(coo.data.shape[0], dtype=bool)
+    max_rounds = int(np.ceil(np.log2(max(n, 2)))) + 1
+    for _ in range(max_rounds):
+        comp, sel = _boruvka_round(comp, rows, cols, w, edge_ids)
+        sel = np.asarray(jax.device_get(sel))
+        if not sel.any():
+            break
+        selected |= sel
+
+    rows_np = np.asarray(jax.device_get(rows))
+    cols_np = np.asarray(jax.device_get(cols))
+    w_np = np.asarray(jax.device_get(w))
+    # dedupe the two directed copies of each undirected selected edge
+    sel_idx = np.nonzero(selected)[0]
+    _, first = np.unique(canon_np[sel_idx], return_index=True)
+    keep = sel_idx[first]
+    src, dst = rows_np[keep], cols_np[keep]
+    flip = src > dst
+    src, dst = np.where(flip, dst, src), np.where(flip, src, dst)
+    return MSTResult(
+        src=src,
+        dst=dst,
+        weights=w_np[keep],
+        color=np.asarray(jax.device_get(comp)),
+    )

@@ -81,7 +81,15 @@ def adjusted_rand_index(a: jax.Array, b: jax.Array, n_classes: int) -> jax.Array
     total = _comb2(jnp.float32(n))
     expected = sum_a * sum_b / jnp.maximum(total, 1e-30)
     max_index = 0.5 * (sum_a + sum_b)
-    return (sum_comb - expected) / jnp.maximum(max_index - expected, 1e-30)
+    # degenerate case (both labelings a single class, or all singletons):
+    # numerator and denominator are both 0 → perfect agreement by
+    # convention (matches sklearn)
+    denom = max_index - expected
+    return jnp.where(
+        jnp.abs(denom) < 1e-12,
+        1.0,
+        (sum_comb - expected) / jnp.maximum(denom, 1e-30),
+    )
 
 
 def entropy(labels: jax.Array, n_classes: int) -> jax.Array:

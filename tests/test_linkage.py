@@ -1,0 +1,84 @@
+"""label / connected-components / single-linkage vs scipy references
+(reference tests: cpp/test/label/label.cu, cpp/test/cluster/linkage.cu).
+"""
+
+import numpy as np
+import pytest
+import scipy.cluster.hierarchy as sch
+import scipy.sparse as sp
+import scipy.sparse.csgraph as csgraph
+
+from raft_tpu import label as rlabel
+from raft_tpu import sparse
+from raft_tpu.cluster import single_linkage
+from raft_tpu.sparse import ops as sops
+from raft_tpu.stats.metrics import adjusted_rand_index as _ari
+
+
+def adjusted_rand_index(a, b):
+    n_classes = int(max(a.max(), b.max())) + 1
+    return _ari(np.asarray(a), np.asarray(b), n_classes)
+
+
+def test_make_monotonic():
+    lab, k = rlabel.make_monotonic([5, 9, 5, 3, 9])
+    assert k == 3
+    np.testing.assert_array_equal(np.asarray(lab), [1, 2, 1, 0, 2])
+
+
+def test_make_monotonic_ignore():
+    lab, k = rlabel.make_monotonic([7, -1, 7, 2, -1], ignore=-1)
+    assert k == 2
+    np.testing.assert_array_equal(np.asarray(lab), [1, -1, 1, 0, -1])
+
+
+def test_connected_components_vs_scipy():
+    # sparse enough that multiple components exist
+    rs = np.random.RandomState(0)
+    a = sp.random(80, 80, density=0.006, random_state=rs, format="coo", dtype=np.float32)
+    a.data[:] = 1.0
+    adj = sops.symmetrize(sparse.make_coo(a.row, a.col, a.data, (80, 80)), mode="max")
+    want_k, want = csgraph.connected_components(sparse.to_scipy(adj), directed=False)
+    got, k = rlabel.connected_components(adj)
+    assert k == want_k
+    assert float(adjusted_rand_index(np.asarray(got), want)) == pytest.approx(1.0)
+
+
+def test_merge_labels():
+    # two labelings in vertex-id space: merging {0,1} with {1,2} unions all
+    a = np.array([0, 0, 2, 3], dtype=np.int32)
+    b = np.array([0, 1, 1, 3], dtype=np.int32)
+    got = np.asarray(rlabel.merge_labels(a, b))
+    assert got[0] == got[1] == got[2]
+    assert got[3] != got[0]
+
+
+def _blobs(rng, n=60, d=2, c=3, spread=0.05):
+    centers = rng.random((c, d)).astype(np.float32) * 10
+    pts = np.concatenate(
+        [centers[i] + spread * rng.standard_normal((n // c, d)).astype(np.float32) for i in range(c)]
+    )
+    truth = np.repeat(np.arange(c), n // c)
+    return pts, truth
+
+
+def test_single_linkage_exact_vs_scipy(rng):
+    pts, _ = _blobs(rng)
+    # exact pairwise construction (n_neighbors >= n-1) must match scipy
+    out = single_linkage(pts, n_clusters=3, metric="euclidean", n_neighbors=len(pts) - 1)
+    z = sch.linkage(pts, method="single", metric="euclidean")
+    want = sch.fcluster(z, t=3, criterion="maxclust")
+    assert float(adjusted_rand_index(np.asarray(out.labels), want)) == pytest.approx(1.0)
+    assert out.children.shape == (len(pts) - 1, 2)
+    assert (np.diff(out.distances) >= -1e-6).all()  # monotone merge heights
+
+
+def test_single_linkage_knn_graph(rng):
+    pts, truth = _blobs(rng, n=90, c=3)
+    out = single_linkage(pts, n_clusters=3, metric="euclidean", n_neighbors=8)
+    assert float(adjusted_rand_index(np.asarray(out.labels), truth)) == pytest.approx(1.0)
+
+
+def test_single_linkage_validates():
+    with pytest.raises(ValueError):
+        single_linkage(np.zeros((5, 2), np.float32), n_clusters=9)

@@ -1,0 +1,110 @@
+"""Lanczos + Boruvka MST vs scipy/numpy references
+(reference tests: cpp/test/sparse/mst.cu, cpp/test/sparse/solver/lanczos.cu).
+"""
+
+import numpy as np
+import pytest
+import scipy.sparse as sp
+import scipy.sparse.csgraph as csgraph
+
+from raft_tpu import sparse
+from raft_tpu.sparse import ops as sops
+from raft_tpu.sparse.solver import lanczos_eigsh, mst
+
+
+def _sym_graph(n, density, seed, unique=True):
+    rs = np.random.RandomState(seed)
+    a = sp.random(n, n, density=density, random_state=rs, format="coo", dtype=np.float32)
+    a.data = np.abs(a.data) + 0.01
+    if unique:
+        a.data = a.data + rs.permutation(a.data.size).astype(np.float32) * 1e-4
+    coo = sparse.make_coo(a.row, a.col, a.data, (n, n))
+    return sops.symmetrize(coo, mode="max")
+
+
+@pytest.mark.parametrize("n,density,seed", [(30, 0.3, 0), (100, 0.1, 1), (64, 0.5, 2)])
+def test_mst_weight_matches_scipy(n, density, seed):
+    adj = _sym_graph(n, density, seed)
+    ref = csgraph.minimum_spanning_tree(sparse.to_scipy(adj))
+    got = mst(adj)
+    n_comp, _ = csgraph.connected_components(sparse.to_scipy(adj), directed=False)
+    assert got.n_edges == n - n_comp
+    np.testing.assert_allclose(got.weights.sum(), ref.sum(), rtol=1e-5)
+
+
+def test_mst_tied_weights_acyclic():
+    # all-equal weights: tie-break must still produce a spanning tree
+    n = 40
+    rs = np.random.RandomState(3)
+    a = sp.random(n, n, density=0.3, random_state=rs, format="coo", dtype=np.float32)
+    a.data = np.ones_like(a.data)
+    adj = sops.symmetrize(sparse.make_coo(a.row, a.col, a.data, (n, n)), mode="max")
+    n_comp, _ = csgraph.connected_components(sparse.to_scipy(adj), directed=False)
+    got = mst(adj)
+    assert got.n_edges == n - n_comp
+    # spanning forest: selected edges must connect everything (same n_comp)
+    forest = sp.coo_matrix((got.weights, (got.src, got.dst)), shape=(n, n))
+    fc, _ = csgraph.connected_components(forest, directed=False)
+    assert fc == n_comp
+
+
+def test_mst_disconnected_forest():
+    # two cliques, no bridge
+    n = 20
+    rows, cols = [], []
+    for block in (range(0, 10), range(10, 20)):
+        for i in block:
+            for j in block:
+                if i < j:
+                    rows.append(i)
+                    cols.append(j)
+    w = np.arange(1, len(rows) + 1, dtype=np.float32)
+    adj = sops.symmetrize(sparse.make_coo(rows, cols, w, (n, n)), mode="max")
+    got = mst(adj)
+    assert got.n_edges == n - 2
+    ref = csgraph.minimum_spanning_tree(sparse.to_scipy(adj))
+    np.testing.assert_allclose(got.weights.sum(), ref.sum(), rtol=1e-6)
+    # colors: two components
+    assert len(np.unique(got.color)) == 2
+
+
+@pytest.mark.parametrize("which", ["smallest", "largest"])
+def test_lanczos_eigsh(which):
+    adj = _sym_graph(60, 0.2, 5)
+    lap = sparse.linalg.laplacian(adj, normalized=True)
+    dense = np.asarray(sparse.to_dense(lap), dtype=np.float64)
+    want = np.linalg.eigvalsh(dense)
+    k = 4
+    vals, vecs = lanczos_eigsh(lap, k, which=which, max_iter=60)
+    vals = np.asarray(vals, dtype=np.float64)
+    if which == "smallest":
+        np.testing.assert_allclose(vals, want[:k], atol=2e-3)
+    else:
+        np.testing.assert_allclose(vals, want[::-1][:k], atol=2e-3)
+    # residual check ||Av - λv||
+    for i in range(k):
+        v = np.asarray(vecs[:, i], dtype=np.float64)
+        r = dense @ v - vals[i] * v
+        assert np.linalg.norm(r) < 5e-3
+
+
+def test_lanczos_k_too_big():
+    adj = _sym_graph(10, 0.5, 7)
+    lap = sparse.linalg.laplacian(adj)
+    with pytest.raises(ValueError):
+        lanczos_eigsh(lap, 10)
+
+
+def test_lanczos_deflation_complete_graph():
+    """Krylov exhaustion (few distinct eigenvalues) must not yield
+    spurious zero eigenpairs (review regression): normalized Laplacian of
+    K_12 has eigenvalues {0, 13/12 x11}."""
+    n = 12
+    rows, cols = np.nonzero(~np.eye(n, dtype=bool))
+    adj = sparse.coo_to_csr(sparse.make_coo(rows, cols, np.ones(rows.size, np.float32), (n, n)))
+    lap = sparse.linalg.laplacian(adj, normalized=True)
+    vals, vecs = lanczos_eigsh(lap, 4, which="smallest", max_iter=32)
+    vals = np.asarray(vals, dtype=np.float64)
+    want = np.linalg.eigvalsh(np.asarray(sparse.to_dense(lap), dtype=np.float64))[:4]
+    np.testing.assert_allclose(vals, want, atol=5e-3)
+    assert (np.linalg.norm(np.asarray(vecs), axis=0) > 0.9).all()
