@@ -49,13 +49,23 @@ def test(bits: jax.Array, idx) -> jax.Array:
 
 
 def set_bits(bits: jax.Array, idx, value: bool = True) -> jax.Array:
-    """Return a new bitset with bit(s) at ``idx`` set/cleared."""
+    """Return a new bitset with bit(s) at ``idx`` set/cleared.
+
+    Implemented as a segment-reduction over words (OR of the per-index
+    one-hot patterns), not a scatter of read-modify-write words: with
+    several indices landing in the same word a plain ``.at[word].set``
+    keeps only one of the conflicting writes."""
     idx = jnp.atleast_1d(jnp.asarray(idx))
-    word_idx = idx // WORD_BITS
-    bit = (jnp.uint32(1) << (idx % WORD_BITS).astype(jnp.uint32))
+    word_idx = (idx // WORD_BITS).astype(jnp.int32)
+    # scatter True into a [n_words, 32] boolean grid (duplicate targets
+    # all write the same value, so collisions are harmless), then pack
+    # each word's row into the OR-pattern
+    updates = jnp.zeros((bits.shape[0], WORD_BITS), jnp.bool_)
+    updates = updates.at[word_idx, (idx % WORD_BITS)].set(True)
+    pattern = from_mask(updates.reshape(-1))
     if value:
-        return bits.at[word_idx].set(bits[word_idx] | bit)
-    return bits.at[word_idx].set(bits[word_idx] & ~bit)
+        return bits | pattern
+    return bits & ~pattern
 
 
 def flip(bits: jax.Array) -> jax.Array:

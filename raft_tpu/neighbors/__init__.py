@@ -1,14 +1,18 @@
 """raft_tpu.neighbors — ANN vector search indexes.
 
 Counterpart of the reference neighbors layer (cpp/include/raft/neighbors):
-brute-force, IVF-Flat, IVF-PQ, CAGRA, NN-Descent, refine, filtering.
+brute-force, IVF-Flat, IVF-PQ, CAGRA, NN-Descent, refine, ball-cover,
+epsilon-neighborhood, sample filtering.
 """
 
 from raft_tpu.neighbors import (  # noqa: F401
+    ball_cover,
     brute_force,
     cagra,
+    epsilon_neighborhood,
     ivf_flat,
     ivf_pq,
     nn_descent,
     refine,
+    sample_filter,
 )

@@ -98,9 +98,13 @@ def knn(
     index: BruteForceIndex,
     queries: jax.Array,
     k: int,
+    filter_bitset: Optional[jax.Array] = None,
 ) -> Tuple[jax.Array, jax.Array]:
     """Exact k nearest neighbors (reference: brute_force::knn,
-    brute_force-inl.cuh:156). Returns (distances [m,k], indices [m,k])."""
+    brute_force-inl.cuh:156). Returns (distances [m,k], indices [m,k]).
+
+    ``filter_bitset``: optional packed bitset over index rows (see
+    neighbors.sample_filter) — cleared bits are excluded from results."""
     expects(queries.ndim == 2, "queries must be [m, d]")
     expects(queries.shape[1] == index.dim, "query dim %d != index dim %d",
             queries.shape[1], index.dim)
@@ -115,6 +119,22 @@ def knn(
 
     qt, it = _choose_tiles(m, n, d)
 
+    # optional pre-filter mask over index rows (cleared bit → excluded)
+    fmask = None
+    if filter_bitset is not None:
+        from raft_tpu.core import bitset as _bitset
+
+        fmask = _bitset.to_mask(filter_bitset, n)
+
+    def _finalize(vals, ids):
+        """With a filter, fewer than k candidates may survive: the inf
+        slots would otherwise carry arbitrary ids — mark them -1 (the
+        same pad convention the IVF searches use)."""
+        if fmask is None:
+            return vals, ids
+        bad = jnp.isinf(vals)
+        return vals, jnp.where(bad, -1, ids)
+
     if fast:
         q = queries.astype(jnp.float32)
         q_sq = jnp.sum(q * q, axis=1)
@@ -123,7 +143,10 @@ def knn(
 
         if it >= n:
             dists = _expanded_block(q, db, q_sq, db_sq, mt)
-            return _select_k(dists, k, select_min=select_min)
+            if fmask is not None:
+                dists = jnp.where(fmask[None, :], dists,
+                                  jnp.inf if select_min else -jnp.inf)
+            return _finalize(*_select_k(dists, k, select_min=select_min))
 
         # scan over index tiles with a running top-k merge — never holds the
         # full [m, n] matrix (tiled_brute_force_knn:234-276).
@@ -136,10 +159,16 @@ def knn(
         sq_blocks = dbp_sq.reshape(n_tiles, it)
         kk = min(k, it)
 
+        if fmask is not None:
+            fmask_blocks = jnp.pad(fmask, (0, pad)).reshape(n_tiles, it)
+        else:
+            fmask_blocks = jnp.ones((n_tiles, it), jnp.bool_)
+
         def step(carry, inp):
             best_v, best_i = carry
-            db_blk, sq_blk, base = inp
+            db_blk, sq_blk, base, mask_blk = inp
             dists = _expanded_block(q, db_blk, q_sq, sq_blk, mt)
+            dists = jnp.where(mask_blk[None, :], dists, pad_val)
             tv, ti = _select_k(dists, kk, select_min=select_min)
             ti = ti.astype(jnp.int32) + base
             cat_v = jnp.concatenate([best_v, tv], axis=1)
@@ -151,13 +180,17 @@ def knn(
         init_v = jnp.full((m, k), pad_val, jnp.float32)
         init_i = jnp.zeros((m, k), jnp.int32)
         bases = (jnp.arange(n_tiles) * it).astype(jnp.int32)
-        (vals, idx), _ = lax.scan(step, (init_v, init_i), (db_blocks, sq_blocks, bases))
-        return vals, idx
+        (vals, idx), _ = lax.scan(
+            step, (init_v, init_i), (db_blocks, sq_blocks, bases, fmask_blocks))
+        return _finalize(vals, idx)
 
     # general metrics: full pairwise (row-tiled internally) + select
     dists = pairwise_distance(queries, index.dataset, metric=mt,
                               metric_arg=index.metric_arg)
-    return _select_k(dists, k, select_min=select_min)
+    if fmask is not None:
+        dists = jnp.where(fmask[None, :], dists,
+                          jnp.inf if select_min else -jnp.inf)
+    return _finalize(*_select_k(dists, k, select_min=select_min))
 
 
 def knn_arrays(

@@ -206,7 +206,7 @@ def build(dataset: jax.Array, params: Optional[IndexParams] = None) -> CagraInde
                                    "max_iterations", "query_tile"))
 def _search_impl(index: CagraIndex, queries: jax.Array, k: int,
                  itopk_size: int, search_width: int, max_iterations: int,
-                 query_tile: int):
+                 query_tile: int, filter_bits=None):
     mt = resolve_metric(index.metric)
     ip = mt == DistanceType.InnerProduct
     sqrt_out = mt == DistanceType.L2SqrtExpanded
@@ -235,6 +235,13 @@ def _search_impl(index: CagraIndex, queries: jax.Array, k: int,
         init_ids = jax.random.choice(key, n, (itopk_size,), replace=False)
         init_ids = jnp.broadcast_to(init_ids[None, :], (t, itopk_size))
         buf_d = dists_to(q, init_ids)
+        if filter_bits is not None:
+            from raft_tpu.neighbors.sample_filter import passes
+
+            # filtered vectors score +inf so they never rank in the itopk
+            # nor get expanded — the exclusion point the reference's
+            # cagra sample_filter hooks
+            buf_d = jnp.where(passes(filter_bits, init_ids), buf_d, BIG)
         buf_i = init_ids.astype(jnp.int32)
         order = jnp.argsort(buf_d, axis=1)
         buf_d = jnp.take_along_axis(buf_d, order, 1)
@@ -267,6 +274,10 @@ def _search_impl(index: CagraIndex, queries: jax.Array, k: int,
             # 3. distances on the MXU
             nd = dists_to(q, nbrs)
             nd = jnp.where(jnp.repeat(parent_valid, deg, axis=1), nd, BIG)
+            if filter_bits is not None:
+                from raft_tpu.neighbors.sample_filter import passes
+
+                nd = jnp.where(passes(filter_bits, nbrs), nd, BIG)
             # 4. dedupe against the buffer (the visited-hashmap stand-in)
             dup = jnp.any(nbrs[:, :, None] == buf_i[:, None, :], axis=2)
             nd = jnp.where(dup, BIG, nd)
@@ -291,6 +302,10 @@ def _search_impl(index: CagraIndex, queries: jax.Array, k: int,
         buf_d, buf_i, _, _ = lax.while_loop(
             cond, body, (buf_d, buf_i, buf_v, jnp.array(0, jnp.int32)))
         out_d, out_i = buf_d[:, :k], buf_i[:, :k]
+        if filter_bits is not None:
+            # inf-score slots are filtered/unreached: mark their ids -1
+            # (same pad convention as brute-force/IVF)
+            out_i = jnp.where(jnp.isinf(out_d), -1, out_i)
         if ip:
             out_d = -out_d
         elif sqrt_out:
@@ -307,8 +322,12 @@ def _search_impl(index: CagraIndex, queries: jax.Array, k: int,
 
 
 def search(index: CagraIndex, queries: jax.Array, k: int,
-           params: Optional[SearchParams] = None) -> Tuple[jax.Array, jax.Array]:
-    """Search (reference: cagra::search → search_main, cagra_search.cuh:105)."""
+           params: Optional[SearchParams] = None,
+           filter_bitset: Optional[jax.Array] = None) -> Tuple[jax.Array, jax.Array]:
+    """Search (reference: cagra::search → search_main, cagra_search.cuh:105;
+    filtered overload via CagraSampleFilterT).
+    ``filter_bitset``: optional packed bitset over dataset rows (see
+    neighbors.sample_filter) — cleared bits are excluded."""
     if params is None:
         params = SearchParams()
     expects(queries.ndim == 2 and queries.shape[1] == index.dim,
@@ -316,7 +335,7 @@ def search(index: CagraIndex, queries: jax.Array, k: int,
     itopk = max(params.itopk_size, k)
     max_it = params.max_iterations or 2 * (-(-itopk // params.search_width))
     return _search_impl(index, queries, k, itopk, params.search_width,
-                        max_it, params.query_tile)
+                        max_it, params.query_tile, filter_bits=filter_bitset)
 
 
 # ---------------------------------------------------------------------------

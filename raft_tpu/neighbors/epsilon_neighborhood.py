@@ -1,0 +1,29 @@
+"""Dense fixed-radius neighborhood (ε-neighborhood).
+
+TPU-native counterpart of the reference's
+``raft::neighbors::epsilon_neighborhood::eps_neighbors_l2sq``
+(neighbors/epsilon_neighborhood.cuh): boolean adjacency of all pairs
+within squared-L2 radius, plus per-query vertex degrees — one tiled
+pairwise-distance pass with a fused threshold epilogue.
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+
+from ..distance.pairwise import pairwise_distance
+
+
+def eps_neighbors_l2sq(
+    x: jax.Array, y: jax.Array, eps_sq: float
+) -> Tuple[jax.Array, jax.Array]:
+    """adj[i, j] = ||x_i − y_j||² < eps_sq, and vd[i] = deg(x_i).
+
+    Returns (adj [m, n] bool, vd [m] int32) — matching the reference's
+    (adj, vd) output pair."""
+    d = pairwise_distance(jnp.asarray(x), jnp.asarray(y), metric="sqeuclidean")
+    adj = d < eps_sq
+    return adj, jnp.sum(adj, axis=1, dtype=jnp.int32)

@@ -249,7 +249,7 @@ def _coarse_distances(q, centers, mt):
 
 @partial(jax.jit, static_argnames=("k", "n_probes", "query_tile"))
 def _search_impl(index: IvfFlatIndex, queries: jax.Array, k: int,
-                 n_probes: int, query_tile: int):
+                 n_probes: int, query_tile: int, filter_bits=None):
     mt = resolve_metric(index.metric)
     q_all = queries.astype(jnp.float32)
     m = q_all.shape[0]
@@ -285,7 +285,12 @@ def _search_impl(index: IvfFlatIndex, queries: jax.Array, k: int,
             if sqrt_out:
                 dists = jnp.sqrt(dists)
             invalid_val = jnp.inf
-        dists = jnp.where(cand_ids >= 0, dists, invalid_val)
+        valid = cand_ids >= 0
+        if filter_bits is not None:
+            from raft_tpu.neighbors.sample_filter import passes
+
+            valid = passes(filter_bits, cand_ids)
+        dists = jnp.where(valid, dists, invalid_val)
         vals, pos = _select_k(dists, k, select_min=select_min)
         ids = jnp.take_along_axis(cand_ids, pos, axis=1)
         return vals, ids
@@ -305,17 +310,22 @@ def _search_impl(index: IvfFlatIndex, queries: jax.Array, k: int,
 
 
 def search(index: IvfFlatIndex, queries: jax.Array, k: int,
-           params: Optional[SearchParams] = None) -> Tuple[jax.Array, jax.Array]:
-    """Search the index (reference: ivf_flat::search, ivf_flat-inl.cuh:452).
+           params: Optional[SearchParams] = None,
+           filter_bitset: Optional[jax.Array] = None) -> Tuple[jax.Array, jax.Array]:
+    """Search the index (reference: ivf_flat::search, ivf_flat-inl.cuh:452;
+    filtered overload ivf_flat-inl.cuh search_with_filtering).
 
     Returns (distances [m, k], ids [m, k]); ids are dataset row numbers,
-    -1 marks slots beyond the number of valid candidates."""
+    -1 marks slots beyond the number of valid candidates.
+    ``filter_bitset``: optional packed bitset over dataset rows (see
+    neighbors.sample_filter) — cleared bits are excluded."""
     if params is None:
         params = SearchParams()
     expects(queries.ndim == 2 and queries.shape[1] == index.dim,
             "queries must be [m, %d]", index.dim)
     n_probes = min(params.n_probes, index.n_lists)
-    return _search_impl(index, queries, k, n_probes, params.query_tile)
+    return _search_impl(index, queries, k, n_probes, params.query_tile,
+                        filter_bits=filter_bitset)
 
 
 # ---------------------------------------------------------------------------

@@ -368,7 +368,7 @@ def extend(index: IvfPqIndex, new_vectors: jax.Array,
 
 @partial(jax.jit, static_argnames=("k", "n_probes", "query_tile"))
 def _search_impl(index: IvfPqIndex, queries: jax.Array, k: int,
-                 n_probes: int, query_tile: int):
+                 n_probes: int, query_tile: int, filter_bits=None):
     mt = resolve_metric(index.metric)
     q_all = jnp.asarray(queries, jnp.float32)
     if mt == DistanceType.CosineExpanded:
@@ -426,7 +426,12 @@ def _search_impl(index: IvfPqIndex, queries: jax.Array, k: int,
                 dists = jnp.sqrt(dists)
             invalid = jnp.inf
             final_min = True
-        dists = jnp.where(cand_ids >= 0, dists, invalid)
+        valid = cand_ids >= 0
+        if filter_bits is not None:
+            from raft_tpu.neighbors.sample_filter import passes
+
+            valid = passes(filter_bits, cand_ids)
+        dists = jnp.where(valid, dists, invalid)
         vals, pos = _select_k(dists, k, select_min=final_min)
         ids = jnp.take_along_axis(cand_ids, pos, axis=1)
         if ip_like and mt == DistanceType.CosineExpanded:
@@ -451,16 +456,20 @@ def _search_impl(index: IvfPqIndex, queries: jax.Array, k: int,
 
 
 def search(index: IvfPqIndex, queries: jax.Array, k: int,
-           params: Optional[SearchParams] = None) -> Tuple[jax.Array, jax.Array]:
-    """Search (reference: ivf_pq::search, ivf_pq-inl.cuh:478). Distances are
-    PQ-approximate (as the reference's); use neighbors.refine for exact
-    re-ranking."""
+           params: Optional[SearchParams] = None,
+           filter_bitset: Optional[jax.Array] = None) -> Tuple[jax.Array, jax.Array]:
+    """Search (reference: ivf_pq::search, ivf_pq-inl.cuh:478; filtered
+    overload search_with_filtering). Distances are PQ-approximate (as the
+    reference's); use neighbors.refine for exact re-ranking.
+    ``filter_bitset``: optional packed bitset over dataset rows (see
+    neighbors.sample_filter) — cleared bits are excluded."""
     if params is None:
         params = SearchParams()
     expects(queries.ndim == 2 and queries.shape[1] == index.dim,
             "queries must be [m, %d]", index.dim)
     n_probes = min(params.n_probes, index.n_lists)
-    return _search_impl(index, queries, k, n_probes, params.query_tile)
+    return _search_impl(index, queries, k, n_probes, params.query_tile,
+                        filter_bits=filter_bitset)
 
 
 # ---------------------------------------------------------------------------

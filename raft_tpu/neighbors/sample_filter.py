@@ -1,0 +1,50 @@
+"""Sample filtering for ANN search — bitset-based pre-filtering.
+
+TPU-native counterpart of the reference's sample filters
+(neighbors/sample_filter_types.hpp ``bitset_filter`` /
+``none_ivf_sample_filter``, core/bitset.cuh): a packed uint32 bitset
+over dataset row ids where a **set bit means the vector may be
+returned**.  Every search path accepts ``filter_bitset``; filtered
+candidates are scored +inf (or −inf for similarities) before top-k, the
+same exclusion point the reference's filters hook
+(ivf_flat_interleaved_scan / ivf_pq_compute_similarity / cagra).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from ..core import bitset
+
+
+def make_filter(
+    n: int,
+    remove=None,
+    keep=None,
+) -> jax.Array:
+    """Build a filter bitset over ``n`` dataset rows.
+
+    ``remove``: indices to exclude (all others kept) — the common
+    "deleted vectors" case; ``keep``: indices to allow (all others
+    excluded).  Exactly one may be given; neither → allow-all."""
+    if remove is not None and keep is not None:
+        raise ValueError("pass either remove or keep, not both")
+    if keep is not None:
+        bits = bitset.create(n, default_value=False)
+        return bitset.set_bits(bits, jnp.asarray(keep), True)
+    bits = bitset.create(n, default_value=True)
+    if remove is not None:
+        bits = bitset.set_bits(bits, jnp.asarray(remove), False)
+    return bits
+
+
+def passes(filter_bits: Optional[jax.Array], ids: jax.Array) -> jax.Array:
+    """Vectorized filter test for candidate id arrays (negative ids —
+    padding — always fail)."""
+    if filter_bits is None:
+        return jnp.ones(ids.shape, jnp.bool_)
+    ok = bitset.test(filter_bits, jnp.clip(ids, 0))
+    return ok & (ids >= 0)

@@ -1,0 +1,7 @@
+"""Combinatorial solvers — TPU-native counterpart of `raft/solver/`
+(linear assignment; SURVEY.md §2.11)."""
+
+from . import lap
+from .lap import solve as lap_solve
+
+__all__ = ["lap", "lap_solve"]

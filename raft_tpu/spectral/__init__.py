@@ -1,0 +1,18 @@
+"""Spectral partitioning/clustering — TPU-native counterpart of
+`raft/spectral/` (SURVEY.md §2.11)."""
+
+from .partition import (
+    PartitionStats,
+    analyze_partition,
+    modularity,
+    modularity_maximization,
+    partition,
+)
+
+__all__ = [
+    "PartitionStats",
+    "analyze_partition",
+    "modularity",
+    "modularity_maximization",
+    "partition",
+]

@@ -1,0 +1,136 @@
+"""Spectral graph partitioning & modularity clustering.
+
+TPU-native counterpart of the reference's `raft/spectral/`
+(spectral/partition.cuh partition/analyzePartition,
+spectral/modularity_maximization.cuh, eigen_solvers.cuh Lanczos wrapper,
+cluster_solvers.cuh kmeans wrapper): Laplacian (or modularity) eigen-
+embedding via the sparse Lanczos solver, then k-means over embedding rows.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..cluster.kmeans import KMeansParams, fit_predict
+from ..sparse.linalg import laplacian, row_norm
+from ..sparse.solver import lanczos_eigsh
+from ..sparse.types import CSR
+
+
+class PartitionStats(NamedTuple):
+    """Reference: analyzePartition outputs (spectral/partition.cuh:133)."""
+
+    edge_cut: float
+    cost: float  # sum over parts of cut(part)/size(part) ("ratio cut")
+
+
+def partition(
+    adj: CSR,
+    n_parts: int,
+    n_eig_vects: int | None = None,
+    kmeans_params: KMeansParams | None = None,
+    seed: int = 0,
+) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    """Balanced-cut spectral partition of a symmetric weighted graph —
+    counterpart of ``raft::spectral::partition`` (spectral/partition.cuh:71).
+
+    Embeds vertices with the ``n_eig_vects`` smallest eigenvectors of the
+    normalized Laplacian (Lanczos), then clusters rows with k-means.
+    Returns (labels [n], eigenvalues [k], eigenvectors [n, k]).
+    """
+    k = n_eig_vects or n_parts
+    lap = laplacian(adj, normalized=True)
+    evals, evecs = lanczos_eigsh(lap, k, which="smallest", seed=seed)
+    # row-normalize the embedding (standard normalized-spectral trick;
+    # the reference scales by sqrt of degree via its Laplacian transform)
+    emb = evecs / jnp.maximum(
+        jnp.linalg.norm(evecs, axis=1, keepdims=True), 1e-12
+    )
+    params = kmeans_params or KMeansParams(n_clusters=n_parts, seed=seed, n_init=3)
+    _, labels, _, _ = fit_predict(params, emb.astype(jnp.float32))
+    return labels, evals, evecs
+
+
+def modularity_maximization(
+    adj: CSR,
+    n_clusters: int,
+    n_eig_vects: int | None = None,
+    kmeans_params: KMeansParams | None = None,
+    seed: int = 0,
+) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    """Community detection by modularity-matrix spectral embedding —
+    counterpart of ``raft::spectral::modularity_maximization``
+    (spectral/modularity_maximization.cuh:69).
+
+    The modularity matrix is B = A − d·dᵀ/(2m): A deflated along the
+    degree direction.  We take the largest eigenvectors of A (Lanczos)
+    and project the degree direction out of that basis — equivalent to
+    embedding with B's dominant eigenvectors when the spectrum's top
+    block is captured (k+1 vectors are computed so the projection keeps
+    k independent directions).
+    """
+    k = n_eig_vects or n_clusters
+    deg = row_norm(adj, "l1")  # weighted degrees
+    two_m = float(jnp.sum(deg))
+    if two_m <= 0:
+        raise ValueError("graph has no edges")
+
+    # Lanczos needs a CSR; wrap the rank-1 correction by materializing
+    # B's action through a subclassed spmv is non-trivial under jit, so
+    # embed with the largest eigenvectors of A itself re-centered — for
+    # k << n this matches the reference's embedding up to the rank-1
+    # deflation, which we apply by projecting out the degree vector.
+    evals, evecs = lanczos_eigsh(adj, k + 1, which="largest", seed=seed)
+    d_unit = deg / jnp.maximum(jnp.linalg.norm(deg), 1e-30)
+    # project the degree direction (B's deflated direction) out of the basis
+    proj = evecs - d_unit[:, None] * (d_unit @ evecs)[None, :]
+    emb = proj[:, :k]
+    emb = emb / jnp.maximum(jnp.linalg.norm(emb, axis=1, keepdims=True), 1e-12)
+    params = kmeans_params or KMeansParams(n_clusters=n_clusters, seed=seed, n_init=3)
+    _, labels, _, _ = fit_predict(params, emb.astype(jnp.float32))
+    return labels, evals[:k], emb
+
+
+def analyze_partition(adj: CSR, labels) -> PartitionStats:
+    """Edge-cut + ratio-cut cost of a partition — counterpart of
+    ``raft::spectral::analyzePartition`` (spectral/partition.cuh:133)."""
+    from ..sparse.types import csr_to_coo
+
+    coo = csr_to_coo(adj)
+    lab = jnp.asarray(labels, jnp.int32)
+    cross = lab[coo.rows] != lab[coo.cols]
+    # symmetric adjacency stores each undirected edge twice
+    edge_cut = float(jnp.sum(jnp.where(cross, coo.data, 0.0)) / 2.0)
+    n_parts = int(np.asarray(jax.device_get(lab)).max()) + 1
+    sizes = jax.ops.segment_sum(
+        jnp.ones_like(lab, jnp.float32), lab, num_segments=n_parts
+    )
+    cut_per = jax.ops.segment_sum(
+        jnp.where(cross, coo.data, 0.0).astype(jnp.float32),
+        lab[coo.rows],
+        num_segments=n_parts,
+    )
+    cost = float(jnp.sum(cut_per / jnp.maximum(sizes, 1.0)))
+    return PartitionStats(edge_cut=edge_cut, cost=cost)
+
+
+def modularity(adj: CSR, labels) -> float:
+    """Newman modularity Q of a labeling — the quality metric the
+    reference reports via analyzeModularity
+    (spectral/modularity_maximization.cuh:120)."""
+    from ..sparse.types import csr_to_coo
+
+    coo = csr_to_coo(adj)
+    lab = jnp.asarray(labels, jnp.int32)
+    deg = row_norm(adj, "l1")
+    two_m = float(jnp.sum(deg))
+    same = lab[coo.rows] == lab[coo.cols]
+    a_in = float(jnp.sum(jnp.where(same, coo.data, 0.0)))
+    n_parts = int(np.asarray(jax.device_get(lab)).max()) + 1
+    deg_per = jax.ops.segment_sum(deg, lab, num_segments=n_parts)
+    expected = float(jnp.sum(deg_per * deg_per)) / two_m
+    return (a_in - expected) / two_m

@@ -1,0 +1,164 @@
+"""Ball cover, epsilon-neighborhood, and sample filtering
+(reference tests: cpp/test/neighbors/ball_cover.cu,
+epsilon_neighborhood.cu, and the *_filter variants of ann tests).
+"""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from raft_tpu.neighbors import (
+    ball_cover,
+    brute_force,
+    cagra,
+    epsilon_neighborhood,
+    ivf_flat,
+    ivf_pq,
+    sample_filter,
+)
+
+
+def _data(rng, n=500, d=8):
+    return rng.random((n, d), dtype=np.float32)
+
+
+def _truth_l2(x, q, k):
+    d = ((q[:, None, :] - x[None, :, :]) ** 2).sum(-1)
+    ids = np.argsort(d, axis=1, kind="stable")[:, :k]
+    return np.sqrt(np.take_along_axis(d, ids, axis=1)), ids
+
+
+# ---------------------------------------------------------------------------
+# ball cover
+# ---------------------------------------------------------------------------
+
+def test_ball_cover_exact_euclidean(rng):
+    x = _data(rng)
+    q = _data(rng, n=40)
+    index = ball_cover.build(x, metric="euclidean", seed=0)
+    d, i = ball_cover.knn(index, q, k=7)
+    want_d, want_i = _truth_l2(x, q, 7)
+    # exact: distances must match the brute-force truth
+    np.testing.assert_allclose(np.sort(np.asarray(d), 1), np.sort(want_d, 1), rtol=1e-4, atol=1e-5)
+    recall = np.mean([len(set(np.asarray(i)[r]) & set(want_i[r])) / 7 for r in range(40)])
+    assert recall > 0.999
+
+
+def test_ball_cover_haversine(rng):
+    # lat/lon radians
+    pts = np.stack(
+        [rng.uniform(-np.pi / 2, np.pi / 2, 300), rng.uniform(-np.pi, np.pi, 300)], axis=1
+    ).astype(np.float32)
+    q = pts[:20] + 0.01
+    index = ball_cover.build(pts, metric="haversine")
+    d, i = ball_cover.knn(index, q, k=5)
+    # haversine truth
+    lat1, lon1 = q[:, None, 0], q[:, None, 1]
+    lat2, lon2 = pts[None, :, 0], pts[None, :, 1]
+    h = (
+        np.sin((lat2 - lat1) / 2) ** 2
+        + np.cos(lat1) * np.cos(lat2) * np.sin((lon2 - lon1) / 2) ** 2
+    )
+    full = 2 * np.arcsin(np.sqrt(np.clip(h, 0, 1)))
+    want_i = np.argsort(full, axis=1, kind="stable")[:, :5]
+    want_d = np.take_along_axis(full, want_i, axis=1)
+    np.testing.assert_allclose(np.sort(np.asarray(d), 1), np.sort(want_d, 1), rtol=1e-3, atol=1e-5)
+
+
+def test_ball_cover_eps_nn(rng):
+    x = _data(rng, n=200, d=4)
+    q = _data(rng, n=10, d=4)
+    index = ball_cover.build(x, metric="euclidean")
+    eps = 0.5
+    mask, ids = ball_cover.eps_nn(index, q, eps)
+    mask = np.asarray(mask)
+    ids = np.asarray(ids)
+    # reconstruct neighbor sets and compare with truth
+    full = np.sqrt(((q[:, None, :] - x[None, :, :]) ** 2).sum(-1))
+    for r in range(10):
+        got = set(ids[mask[r]].tolist())
+        want = set(np.nonzero(full[r] <= eps)[0].tolist())
+        assert got == want
+
+
+def test_ball_cover_rejects_bad_metric(rng):
+    with pytest.raises(Exception):
+        ball_cover.build(_data(rng, n=50), metric="cosine")
+
+
+# ---------------------------------------------------------------------------
+# epsilon neighborhood
+# ---------------------------------------------------------------------------
+
+def test_eps_neighbors(rng):
+    x = _data(rng, n=60, d=5)
+    y = _data(rng, n=80, d=5)
+    adj, vd = epsilon_neighborhood.eps_neighbors_l2sq(x, y, eps_sq=0.3)
+    full = ((x[:, None, :] - y[None, :, :]) ** 2).sum(-1)
+    np.testing.assert_array_equal(np.asarray(adj), full < 0.3)
+    np.testing.assert_array_equal(np.asarray(vd), (full < 0.3).sum(1))
+
+
+# ---------------------------------------------------------------------------
+# sample filtering
+# ---------------------------------------------------------------------------
+
+def test_filter_brute_force(rng):
+    x = _data(rng, n=300)
+    q = _data(rng, n=25)
+    # remove the true top-1 of each query; it must never be returned
+    _, top1 = _truth_l2(x, q, 1)
+    removed = np.unique(top1.ravel())
+    bits = sample_filter.make_filter(len(x), remove=removed)
+    index = brute_force.build(jnp.asarray(x), metric="euclidean")
+    _, ids = brute_force.knn(index, jnp.asarray(q), 5, filter_bitset=bits)
+    assert not np.isin(np.asarray(ids), removed).any()
+    # and equals brute force over the kept subset
+    keep = np.setdiff1d(np.arange(len(x)), removed)
+    want_d, want_sub = _truth_l2(x[keep], q, 5)
+    np.testing.assert_array_equal(np.asarray(ids), keep[want_sub])
+
+
+def test_filter_keep_semantics(rng):
+    x = _data(rng, n=100)
+    q = _data(rng, n=5)
+    keep = np.arange(0, 100, 7)
+    bits = sample_filter.make_filter(100, keep=keep)
+    index = brute_force.build(jnp.asarray(x), metric="sqeuclidean")
+    _, ids = brute_force.knn(index, jnp.asarray(q), 3, filter_bitset=bits)
+    assert np.isin(np.asarray(ids), keep).all()
+
+
+def test_filter_ivf_flat(rng):
+    x = _data(rng, n=400)
+    q = _data(rng, n=20)
+    _, top1 = _truth_l2(x, q, 1)
+    removed = np.unique(top1.ravel())
+    bits = sample_filter.make_filter(len(x), remove=removed)
+    index = ivf_flat.build(jnp.asarray(x), ivf_flat.IndexParams(n_lists=8))
+    _, ids = ivf_flat.search(index, jnp.asarray(q), 5,
+                             ivf_flat.SearchParams(n_probes=8), filter_bitset=bits)
+    assert not np.isin(np.asarray(ids), removed).any()
+
+
+def test_filter_ivf_pq(rng):
+    x = _data(rng, n=2000, d=16)
+    q = _data(rng, n=10, d=16)
+    removed = np.arange(0, 2000, 3)
+    bits = sample_filter.make_filter(2000, remove=removed)
+    index = ivf_pq.build(jnp.asarray(x), ivf_pq.IndexParams(n_lists=8, pq_dim=4))
+    _, ids = ivf_pq.search(index, jnp.asarray(q), 5,
+                           ivf_pq.SearchParams(n_probes=8), filter_bitset=bits)
+    ids = np.asarray(ids)
+    assert not np.isin(ids[ids >= 0], removed).any()
+
+
+def test_filter_cagra(rng):
+    x = _data(rng, n=2000, d=8)
+    q = _data(rng, n=10, d=8)
+    removed = np.arange(0, 2000, 2)  # remove half the dataset
+    bits = sample_filter.make_filter(2000, remove=removed)
+    index = cagra.build(jnp.asarray(x), cagra.IndexParams(graph_degree=16))
+    _, ids = cagra.search(index, jnp.asarray(q), 5, filter_bitset=bits)
+    ids = np.asarray(ids)
+    assert not np.isin(ids[ids >= 0], removed).any()

@@ -1,0 +1,96 @@
+"""Spectral partition + LAP vs scipy/numpy references
+(reference tests: cpp/test/spectral_matrix.cu, cpp/test/lap/lap.cu)."""
+
+import numpy as np
+import pytest
+import scipy.optimize as sopt
+
+from raft_tpu import sparse, spectral
+from raft_tpu.solver import lap_solve
+from raft_tpu.sparse import ops as sops
+
+
+def _two_cliques(n_per=15, bridge=1):
+    """Two dense cliques joined by a weak bridge — an obvious balanced cut."""
+    n = 2 * n_per
+    rows, cols, w = [], [], []
+    for base in (0, n_per):
+        for i in range(n_per):
+            for j in range(i + 1, n_per):
+                rows.append(base + i)
+                cols.append(base + j)
+                w.append(1.0)
+    for b in range(bridge):
+        rows.append(b)
+        cols.append(n_per + b)
+        w.append(0.05)
+    coo = sparse.make_coo(rows, cols, np.asarray(w, np.float32), (n, n))
+    return sops.symmetrize(coo, mode="max"), n_per
+
+
+def test_partition_two_cliques():
+    adj, n_per = _two_cliques()
+    labels, evals, evecs = spectral.partition(adj, 2, seed=1)
+    lab = np.asarray(labels)
+    assert len(set(lab[:n_per])) == 1
+    assert len(set(lab[n_per:])) == 1
+    assert lab[0] != lab[-1]
+    stats = spectral.analyze_partition(adj, labels)
+    assert stats.edge_cut == pytest.approx(0.05, rel=1e-4)
+
+
+def test_modularity_maximization_two_cliques():
+    adj, n_per = _two_cliques()
+    labels, _, _ = spectral.modularity_maximization(adj, 2, seed=3)
+    lab = np.asarray(labels)
+    assert len(set(lab[:n_per])) == 1 and len(set(lab[n_per:])) == 1
+    q = spectral.modularity(adj, labels)
+    # near-perfect two-community structure → Q close to 0.5
+    assert q > 0.4
+
+
+@pytest.mark.parametrize("n,seed", [(10, 0), (25, 1), (50, 2)])
+def test_lap_matches_scipy(n, seed):
+    rs = np.random.RandomState(seed)
+    cost = rs.randint(0, 100, size=(n, n)).astype(np.float32)
+    assign, total = lap_solve(cost)
+    assign = np.asarray(assign)
+    # valid permutation
+    assert sorted(assign.tolist()) == list(range(n))
+    ri, ci = sopt.linear_sum_assignment(cost)
+    assert float(total) == pytest.approx(cost[ri, ci].sum())
+
+
+def test_lap_maximize():
+    rs = np.random.RandomState(7)
+    cost = rs.randint(0, 50, size=(12, 12)).astype(np.float32)
+    assign, total = lap_solve(cost, maximize=True)
+    ri, ci = sopt.linear_sum_assignment(cost, maximize=True)
+    assert float(total) == pytest.approx(cost[ri, ci].sum())
+
+
+def test_lap_rejects_nonsquare():
+    with pytest.raises(ValueError):
+        lap_solve(np.zeros((3, 4), np.float32))
+
+
+def test_lap_wide_cost_range():
+    """ε-scaling must keep shrinking for wide cost spans (review
+    regression: fixed phase cap left ε too coarse).  f32 price
+    resolution bounds exactness, so assert a tight relative gap."""
+    rs = np.random.RandomState(11)
+    cost = rs.randint(0, 1_000_000, size=(40, 40)).astype(np.float32)
+    assign, total = lap_solve(cost)
+    assert sorted(np.asarray(assign).tolist()) == list(range(40))
+    ri, ci = sopt.linear_sum_assignment(cost)
+    opt = cost[ri, ci].sum()
+    assert float(total) <= opt * 1.001 + 40 * 2.0  # within n·eps of optimal
+
+
+def test_lap_exact_mid_range():
+    """span·(n+1) under 2^20 → exact optimum guaranteed."""
+    rs = np.random.RandomState(13)
+    cost = rs.randint(0, 20_000, size=(30, 30)).astype(np.float32)
+    _, total = lap_solve(cost)
+    ri, ci = sopt.linear_sum_assignment(cost)
+    assert float(total) == pytest.approx(cost[ri, ci].sum())
