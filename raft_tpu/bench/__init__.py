@@ -1,0 +1,4 @@
+"""ANN benchmark harness — TPU-native counterpart of the reference's
+cpp/bench/ann + python/raft-ann-bench (SURVEY.md §2.16)."""
+
+from . import dataset, runner  # noqa: F401

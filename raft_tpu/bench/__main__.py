@@ -1,0 +1,32 @@
+"""CLI: python -m raft_tpu.bench run <config.json> [--out results.csv]
+(reference: the raft-ann-bench CLI, run/__main__.py + data_export)."""
+
+import argparse
+import sys
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser(prog="raft_tpu.bench")
+    sub = p.add_subparsers(dest="cmd", required=True)
+    runp = sub.add_parser("run", help="run a benchmark config")
+    runp.add_argument("config")
+    runp.add_argument("--out", default=None, help="CSV output path")
+    runp.add_argument("--pareto", action="store_true",
+                      help="print the QPS/recall pareto frontier")
+    args = p.parse_args(argv)
+
+    from raft_tpu.bench import runner
+
+    results = runner.run_config_file(args.config)
+    if args.out:
+        runner.export_csv(results, args.out)
+        print(f"[bench] wrote {args.out}")
+    if args.pareto:
+        for r in runner.pareto_frontier(results):
+            print(f"[pareto] {r.index_name} {r.search_param} "
+                  f"qps={r.qps:,.0f} recall={r.recall:.4f}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

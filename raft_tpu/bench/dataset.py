@@ -1,0 +1,110 @@
+"""Benchmark dataset management — .fbin/.ibin files, synthetic sets,
+groundtruth.
+
+TPU-native counterpart of the reference's bench dataset layer
+(cpp/bench/ann/src/common/dataset.hpp: BinFile header/read/subset;
+python/raft-ann-bench get_dataset/split_groundtruth).  Binary IO goes
+through the native C++ reader (raft_tpu.native) with a numpy fallback.
+
+A dataset directory holds::
+
+    <name>/base.fbin           # [n, d] float32 vectors
+    <name>/query.fbin          # [m, d] float32 queries
+    <name>/groundtruth.ibin    # [m, k_gt] int32 exact neighbor ids
+    <name>/groundtruth_dist.fbin  # [m, k_gt] float32 (optional)
+"""
+
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass
+from typing import Optional
+
+import numpy as np
+
+from .. import native
+
+
+@dataclass
+class Dataset:
+    name: str
+    base: np.ndarray        # [n, d] f32
+    queries: np.ndarray     # [m, d] f32
+    groundtruth: Optional[np.ndarray] = None  # [m, k_gt] i32
+    metric: str = "sqeuclidean"
+
+    @property
+    def n(self) -> int:
+        return self.base.shape[0]
+
+    @property
+    def dim(self) -> int:
+        return self.base.shape[1]
+
+
+def write_dataset(root: str, ds: Dataset) -> str:
+    d = os.path.join(root, ds.name)
+    os.makedirs(d, exist_ok=True)
+    native.bin_write(os.path.join(d, "base.fbin"), ds.base.astype(np.float32))
+    native.bin_write(os.path.join(d, "query.fbin"), ds.queries.astype(np.float32))
+    if ds.groundtruth is not None:
+        native.bin_write(os.path.join(d, "groundtruth.ibin"),
+                         ds.groundtruth.astype(np.int32))
+    return d
+
+
+def load_dataset(root: str, name: str, metric: str = "sqeuclidean",
+                 max_rows: int = -1) -> Dataset:
+    """Load a dataset directory; ``max_rows`` subsets the base file (the
+    reference's subset/memmap path for billion-scale files)."""
+    d = os.path.join(root, name)
+    base = native.bin_read(os.path.join(d, "base.fbin"), np.float32,
+                           count=max_rows)
+    queries = native.bin_read(os.path.join(d, "query.fbin"), np.float32)
+    gt_path = os.path.join(d, "groundtruth.ibin")
+    gt = native.bin_read(gt_path, np.int32) if os.path.exists(gt_path) else None
+    return Dataset(name=name, base=base, queries=queries, groundtruth=gt,
+                   metric=metric)
+
+
+def make_synthetic(name: str, n: int, dim: int, n_queries: int,
+                   metric: str = "sqeuclidean", seed: int = 0,
+                   clustered: bool = True) -> Dataset:
+    """Synthetic benchmark set shaped like the reference's standard ones
+    (SIFT-style clustered f32)."""
+    rng = np.random.default_rng(seed)
+    if clustered:
+        n_centers = max(16, int(np.sqrt(n)))
+        centers = rng.random((n_centers, dim), dtype=np.float32) * 10.0
+        assign = rng.integers(0, n_centers, n)
+        base = centers[assign] + 0.5 * rng.standard_normal((n, dim), dtype=np.float32)
+        q_assign = rng.integers(0, n_centers, n_queries)
+        queries = centers[q_assign] + 0.5 * rng.standard_normal(
+            (n_queries, dim), dtype=np.float32)
+    else:
+        base = rng.random((n, dim), dtype=np.float32)
+        queries = rng.random((n_queries, dim), dtype=np.float32)
+    return Dataset(name=name, base=base, queries=queries, metric=metric)
+
+
+def compute_groundtruth(ds: Dataset, k: int = 100) -> Dataset:
+    """Exact top-k groundtruth via the library's own brute force (the
+    reference's split_groundtruth uses its GPU brute force the same way)."""
+    import jax.numpy as jnp
+
+    from ..neighbors import brute_force
+
+    index = brute_force.build(jnp.asarray(ds.base), metric=ds.metric)
+    _, ids = brute_force.knn(index, jnp.asarray(ds.queries), k)
+    ds.groundtruth = np.asarray(ids, np.int32)
+    return ds
+
+
+def recall(found_ids: np.ndarray, groundtruth: np.ndarray) -> float:
+    """recall@k against groundtruth's first k columns (reference:
+    data_export recall column) — delegates to stats.neighborhood_recall."""
+    from ..stats.metrics import neighborhood_recall
+
+    k = found_ids.shape[1]
+    return float(neighborhood_recall(np.asarray(found_ids),
+                                     np.asarray(groundtruth[:, :k])))

@@ -1,0 +1,226 @@
+"""ANN benchmark runner — JSON config → build/search sweeps → CSV export.
+
+TPU-native counterpart of the reference's bench harness
+(cpp/bench/ann/src/common/benchmark.hpp gbench driver + JSON conf.hpp;
+python/raft-ann-bench run/__main__.py orchestration and
+data_export/__main__.py QPS/recall CSV).  One process, no subprocesses:
+XLA jit-caching plays the role of the reference's per-algo executables.
+
+Config schema (mirrors run/conf/*.json)::
+
+    {
+      "dataset": {"name": "...", "n": 10000, "dim": 128, "n_queries": 1000,
+                   "metric": "sqeuclidean"},
+      "k": 10,
+      "batch_size": 10000,
+      "index": [
+        {"name": "ivf_flat.n1024", "algo": "ivf_flat",
+         "build_param": {"n_lists": 1024},
+         "search_params": [{"n_probes": 32}, {"n_probes": 64}]},
+        ...
+      ]
+    }
+"""
+
+from __future__ import annotations
+
+import csv
+import json
+import time
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from . import dataset as ds_mod
+
+
+@dataclass
+class BenchResult:
+    """One (algo, build_param, search_param) measurement row — the
+    reference's gbench JSON record (qps = items_per_second)."""
+
+    algo: str
+    index_name: str
+    dataset: str
+    k: int
+    batch_size: int
+    build_s: float
+    search_s: float
+    qps: float
+    recall: float
+    build_param: Dict[str, Any] = field(default_factory=dict)
+    search_param: Dict[str, Any] = field(default_factory=dict)
+
+
+# ---------------------------------------------------------------------------
+# algorithm wrappers (reference: bench/ann/src/raft/*_wrapper.h)
+# ---------------------------------------------------------------------------
+
+def _algo_brute_force(dsx, build_param, metric):
+    from ..neighbors import brute_force
+
+    index = brute_force.build(dsx, metric=build_param.get("metric", metric))
+
+    def search(q, k, sp):
+        return brute_force.knn(index, q, k)
+
+    return search, index
+
+
+def _algo_ivf_flat(dsx, build_param, metric):
+    from ..neighbors import ivf_flat
+
+    p = ivf_flat.IndexParams(**{"metric": metric, **build_param})
+    index = ivf_flat.build(dsx, p)
+
+    def search(q, k, sp):
+        return ivf_flat.search(index, q, k, ivf_flat.SearchParams(**sp))
+
+    return search, index
+
+
+def _algo_ivf_pq(dsx, build_param, metric):
+    from ..neighbors import ivf_pq, refine
+
+    bp = dict(build_param)
+    refine_ratio = bp.pop("refine_ratio", 1)
+    p = ivf_pq.IndexParams(**{"metric": metric, **bp})
+    index = ivf_pq.build(dsx, p)
+
+    def search(q, k, sp):
+        sp = dict(sp)
+        ratio = sp.pop("refine_ratio", refine_ratio)
+        if ratio > 1:
+            d0, i0 = ivf_pq.search(index, q, k * int(ratio), ivf_pq.SearchParams(**sp))
+            return refine.refine(dsx, q, i0, k, metric=index.metric)
+        return ivf_pq.search(index, q, k, ivf_pq.SearchParams(**sp))
+
+    return search, index
+
+
+def _algo_cagra(dsx, build_param, metric):
+    from ..neighbors import cagra
+
+    p = cagra.IndexParams(**{"metric": metric, **build_param})
+    index = cagra.build(dsx, p)
+
+    def search(q, k, sp):
+        return cagra.search(index, q, k, cagra.SearchParams(**sp))
+
+    return search, index
+
+
+ALGO_REGISTRY: Dict[str, Callable] = {
+    "brute_force": _algo_brute_force,
+    "ivf_flat": _algo_ivf_flat,
+    "ivf_pq": _algo_ivf_pq,
+    "cagra": _algo_cagra,
+}
+
+
+# ---------------------------------------------------------------------------
+# driver
+# ---------------------------------------------------------------------------
+
+def _bench_search(search_fn, queries, k, sp, batch_size, iters=5):
+    m = queries.shape[0]
+    ids_all = []
+    # warmup/compile + correctness capture
+    for start in range(0, m, batch_size):
+        d, i = search_fn(queries[start : start + batch_size], k, sp)
+        ids_all.append(np.asarray(jax.device_get(i)))
+    ids = np.concatenate(ids_all, axis=0)
+    # timed
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        outs = []
+        for start in range(0, m, batch_size):
+            outs.append(search_fn(queries[start : start + batch_size], k, sp))
+        jax.block_until_ready(outs)
+    dt = (time.perf_counter() - t0) / iters
+    return ids, dt, m / dt
+
+
+def run_config(config: Dict[str, Any],
+               data: Optional[ds_mod.Dataset] = None,
+               verbose: bool = True) -> List[BenchResult]:
+    """Run one benchmark config; returns a result row per
+    (index, search_param) combination."""
+    k = int(config.get("k", 10))
+    batch_size = int(config.get("batch_size", 10_000))
+
+    if data is None:
+        dcfg = config["dataset"]
+        data = ds_mod.make_synthetic(
+            dcfg.get("name", "synthetic"),
+            int(dcfg["n"]), int(dcfg["dim"]), int(dcfg["n_queries"]),
+            metric=dcfg.get("metric", "sqeuclidean"),
+            seed=int(dcfg.get("seed", 0)),
+        )
+    if data.groundtruth is None:
+        ds_mod.compute_groundtruth(data, k=max(k, 10))
+
+    dsx = jnp.asarray(data.base)
+    queries = jnp.asarray(data.queries)
+    results: List[BenchResult] = []
+    for index_cfg in config["index"]:
+        algo = index_cfg["algo"]
+        if algo not in ALGO_REGISTRY:
+            raise ValueError(f"unknown algo {algo!r} (have {sorted(ALGO_REGISTRY)})")
+        bp = dict(index_cfg.get("build_param", {}))
+        t0 = time.perf_counter()
+        search_fn, index_obj = ALGO_REGISTRY[algo](dsx, dict(bp), data.metric)
+        # block on the *index* arrays, not the input: async dispatch would
+        # otherwise let the build overlap the first search timing
+        jax.block_until_ready(
+            [leaf for leaf in jax.tree_util.tree_leaves(index_obj)
+             if hasattr(leaf, "block_until_ready")])
+        build_s = time.perf_counter() - t0
+        for sp in index_cfg.get("search_params", [{}]):
+            ids, dt, qps = _bench_search(search_fn, queries, k, sp, batch_size)
+            rec = ds_mod.recall(ids, data.groundtruth)
+            row = BenchResult(
+                algo=algo, index_name=index_cfg.get("name", algo),
+                dataset=data.name, k=k, batch_size=batch_size,
+                build_s=build_s, search_s=dt, qps=qps, recall=rec,
+                build_param=bp, search_param=dict(sp),
+            )
+            results.append(row)
+            if verbose:
+                print(f"[bench] {row.index_name} {sp}: "
+                      f"qps={qps:,.0f} recall={rec:.4f} build={build_s:.1f}s")
+    return results
+
+
+def run_config_file(path: str, **kw) -> List[BenchResult]:
+    with open(path) as f:
+        return run_config(json.load(f), **kw)
+
+
+def export_csv(results: List[BenchResult], path: str) -> None:
+    """QPS/recall CSV (reference: data_export/__main__.py:54-55)."""
+    cols = ["algo", "index_name", "dataset", "k", "batch_size", "build_s",
+            "search_s", "qps", "recall", "build_param", "search_param"]
+    with open(path, "w", newline="") as f:
+        w = csv.writer(f)
+        w.writerow(cols)
+        for r in results:
+            w.writerow([r.algo, r.index_name, r.dataset, r.k, r.batch_size,
+                        f"{r.build_s:.4f}", f"{r.search_s:.6f}", f"{r.qps:.1f}",
+                        f"{r.recall:.4f}", json.dumps(r.build_param),
+                        json.dumps(r.search_param)])
+
+
+def pareto_frontier(results: List[BenchResult]) -> List[BenchResult]:
+    """QPS/recall pareto points (the reference's plot module draws
+    exactly this frontier)."""
+    rows = sorted(results, key=lambda r: (-r.recall, -r.qps))
+    front, best_qps = [], -1.0
+    for r in rows:
+        if r.qps > best_qps:
+            front.append(r)
+            best_qps = r.qps
+    return list(reversed(front))

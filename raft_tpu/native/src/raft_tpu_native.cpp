@@ -1,0 +1,144 @@
+// raft_tpu native runtime components.
+//
+// TPU-native counterpart of the host-side C++ the reference ships:
+//  - refine_host: exact candidate re-ranking on the host CPU with OpenMP
+//    (reference: neighbors/detail/refine_host-inl.hpp — explicitly a
+//    host/OpenMP code path there too; it complements the device refine).
+//  - dataset IO: .fbin/.ibin big-ann-benchmarks binary format reader
+//    with pread-based subset loading (reference:
+//    cpp/bench/ann/src/common/dataset.hpp BinFile/load/subset).
+//
+// Exposed as a plain C ABI consumed via ctypes (no pybind11 in the
+// image); all buffers are caller-allocated numpy arrays.
+
+#include <algorithm>
+#include <cmath>
+#include <cstdint>
+#include <cstdio>
+#include <cstring>
+#include <vector>
+
+#if defined(_OPENMP)
+#include <omp.h>
+#endif
+
+extern "C" {
+
+// ---------------------------------------------------------------------------
+// refine_host (reference: refine_host-inl.hpp)
+// ---------------------------------------------------------------------------
+// metric: 0 = squared L2, 1 = inner product (higher better), 2 = sqrt L2,
+//         3 = cosine distance
+// dataset  [n_rows, dim] float32
+// queries  [n_q, dim]    float32
+// cand_ids [n_q, n_cand] int32 (candidate dataset rows; -1 = invalid)
+// out_ids  [n_q, k] int32, out_dists [n_q, k] float32
+int refine_host_f32(const float* dataset, int64_t n_rows, int64_t dim,
+                    const float* queries, int64_t n_q,
+                    const int32_t* cand_ids, int64_t n_cand,
+                    int32_t k, int32_t metric,
+                    int32_t* out_ids, float* out_dists) {
+  if (k > n_cand) return -1;
+#pragma omp parallel for schedule(dynamic, 16)
+  for (int64_t qi = 0; qi < n_q; ++qi) {
+    const float* q = queries + qi * dim;
+    float qnorm = 0.f;
+    if (metric == 3) {
+      for (int64_t d = 0; d < dim; ++d) qnorm += q[d] * q[d];
+      qnorm = std::sqrt(std::max(qnorm, 1e-30f));
+    }
+    std::vector<std::pair<float, int32_t>> scored;
+    scored.reserve(n_cand);
+    for (int64_t ci = 0; ci < n_cand; ++ci) {
+      int32_t id = cand_ids[qi * n_cand + ci];
+      if (id < 0 || id >= n_rows) continue;
+      const float* v = dataset + (int64_t)id * dim;
+      float acc = 0.f, vnorm = 0.f;
+      if (metric == 1) {
+        for (int64_t d = 0; d < dim; ++d) acc += q[d] * v[d];
+        acc = -acc;  // store negated so ascending sort works uniformly
+      } else if (metric == 3) {
+        for (int64_t d = 0; d < dim; ++d) { acc += q[d] * v[d]; vnorm += v[d] * v[d]; }
+        vnorm = std::sqrt(std::max(vnorm, 1e-30f));
+        acc = 1.0f - acc / (qnorm * vnorm);
+      } else {
+        for (int64_t d = 0; d < dim; ++d) {
+          float diff = q[d] - v[d];
+          acc += diff * diff;
+        }
+      }
+      scored.emplace_back(acc, id);
+    }
+    int64_t kk = std::min<int64_t>(k, (int64_t)scored.size());
+    std::partial_sort(scored.begin(), scored.begin() + kk, scored.end());
+    for (int64_t j = 0; j < k; ++j) {
+      if (j < kk) {
+        float dval = scored[j].first;
+        if (metric == 1) dval = -dval;          // undo negation
+        else if (metric == 2) dval = std::sqrt(std::max(dval, 0.f));
+        out_dists[qi * k + j] = dval;
+        out_ids[qi * k + j] = scored[j].second;
+      } else {
+        out_dists[qi * k + j] = metric == 1 ? -INFINITY : INFINITY;
+        out_ids[qi * k + j] = -1;
+      }
+    }
+  }
+  return 0;
+}
+
+// ---------------------------------------------------------------------------
+// .fbin/.ibin dataset IO (reference: bench/ann/src/common/dataset.hpp)
+// header: int32 n_rows, int32 dim; payload row-major
+// ---------------------------------------------------------------------------
+
+int bin_header(const char* path, int32_t* n_rows, int32_t* dim) {
+  FILE* f = std::fopen(path, "rb");
+  if (!f) return -1;
+  int32_t hdr[2];
+  if (std::fread(hdr, sizeof(int32_t), 2, f) != 2) { std::fclose(f); return -2; }
+  *n_rows = hdr[0];
+  *dim = hdr[1];
+  std::fclose(f);
+  return 0;
+}
+
+// Read `count` rows starting at `offset` into out (caller-allocated,
+// count*dim elements of elem_size bytes).
+int bin_read(const char* path, int64_t offset, int64_t count,
+             void* out, int32_t elem_size) {
+  FILE* f = std::fopen(path, "rb");
+  if (!f) return -1;
+  int32_t hdr[2];
+  if (std::fread(hdr, sizeof(int32_t), 2, f) != 2) { std::fclose(f); return -2; }
+  const int64_t dim = hdr[1];
+  if (offset + count > (int64_t)hdr[0]) { std::fclose(f); return -3; }
+  const int64_t row_bytes = dim * (int64_t)elem_size;
+  if (std::fseek(f, 8 + offset * row_bytes, SEEK_SET) != 0) { std::fclose(f); return -4; }
+  const size_t want = (size_t)(count * dim);
+  size_t got = std::fread(out, elem_size, want, f);
+  std::fclose(f);
+  return got == want ? 0 : -5;
+}
+
+int bin_write(const char* path, const void* data, int32_t n_rows,
+              int32_t dim, int32_t elem_size) {
+  FILE* f = std::fopen(path, "wb");
+  if (!f) return -1;
+  int32_t hdr[2] = {n_rows, dim};
+  if (std::fwrite(hdr, sizeof(int32_t), 2, f) != 2) { std::fclose(f); return -2; }
+  size_t want = (size_t)n_rows * dim;
+  size_t got = std::fwrite(data, elem_size, want, f);
+  std::fclose(f);
+  return got == want ? 0 : -3;
+}
+
+int native_num_threads() {
+#if defined(_OPENMP)
+  return omp_get_max_threads();
+#else
+  return 1;
+#endif
+}
+
+}  // extern "C"

@@ -1,0 +1,108 @@
+"""Native components + bench harness
+(reference: cpp/bench/ann dataset/driver; refine_host-inl.hpp).
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+from raft_tpu import native
+from raft_tpu.bench import dataset as ds_mod
+from raft_tpu.bench import runner
+
+
+def test_bin_roundtrip(tmp_path, rng):
+    a = rng.random((50, 9), dtype=np.float32)
+    p = str(tmp_path / "x.fbin")
+    native.bin_write(p, a)
+    assert native.bin_header(p) == (50, 9)
+    np.testing.assert_array_equal(native.bin_read(p, np.float32), a)
+    np.testing.assert_array_equal(native.bin_read(p, np.float32, offset=7, count=11), a[7:18])
+
+
+def test_bin_read_out_of_range(tmp_path, rng):
+    p = str(tmp_path / "y.fbin")
+    native.bin_write(p, rng.random((10, 4), dtype=np.float32))
+    with pytest.raises(IOError):
+        native.bin_read(p, np.float32, offset=5, count=20)
+
+
+@pytest.mark.skipif(not native.available(), reason="native lib unavailable")
+def test_refine_host_matches_numpy(rng):
+    x = rng.random((300, 12), dtype=np.float32)
+    q = rng.random((15, 12), dtype=np.float32)
+    cand = rng.integers(0, 300, (15, 40)).astype(np.int32)
+    cand[0, :5] = -1  # invalid slots
+    d, i = native.refine_host(x, q, cand, k=6, metric="sqeuclidean")
+    full = ((q[:, None, :] - x[np.maximum(cand, 0)]) ** 2).sum(-1)
+    full[cand < 0] = np.inf
+    pos = np.argsort(full, axis=1)[:, :6]
+    want_i = np.take_along_axis(cand, pos, 1)
+    want_d = np.take_along_axis(full, pos, 1)
+    np.testing.assert_allclose(np.sort(d, 1), np.sort(want_d, 1), rtol=1e-5)
+    assert np.array_equal(np.sort(i, 1), np.sort(want_i, 1))
+
+
+@pytest.mark.skipif(not native.available(), reason="native lib unavailable")
+def test_refine_host_inner_product(rng):
+    x = rng.random((100, 8), dtype=np.float32)
+    q = rng.random((5, 8), dtype=np.float32)
+    cand = np.tile(np.arange(100, dtype=np.int32), (5, 1))
+    d, i = native.refine_host(x, q, cand, k=3, metric="inner_product")
+    full = q @ x.T
+    want_i = np.argsort(-full, axis=1)[:, :3]
+    assert np.array_equal(np.sort(i, 1), np.sort(want_i, 1))
+    assert (np.diff(d, axis=1) <= 1e-6).all()  # descending similarity
+
+
+def test_dataset_write_load(tmp_path, rng):
+    ds = ds_mod.make_synthetic("t", 200, 8, 20, seed=1)
+    ds_mod.compute_groundtruth(ds, k=10)
+    ds_mod.write_dataset(str(tmp_path), ds)
+    back = ds_mod.load_dataset(str(tmp_path), "t")
+    np.testing.assert_array_equal(back.base, ds.base)
+    np.testing.assert_array_equal(back.groundtruth, ds.groundtruth)
+    sub = ds_mod.load_dataset(str(tmp_path), "t", max_rows=50)
+    assert sub.base.shape == (50, 8)
+
+
+def test_runner_end_to_end():
+    config = {
+        "dataset": {"name": "tiny", "n": 2000, "dim": 16, "n_queries": 100},
+        "k": 5,
+        "batch_size": 100,
+        "index": [
+            {"name": "bf", "algo": "brute_force", "build_param": {},
+             "search_params": [{}]},
+            {"name": "ivf", "algo": "ivf_flat",
+             "build_param": {"n_lists": 8},
+             "search_params": [{"n_probes": 4}, {"n_probes": 8}]},
+        ],
+    }
+    results = runner.run_config(config, verbose=False)
+    assert len(results) == 3
+    bf = results[0]
+    assert bf.recall == pytest.approx(1.0)
+    assert bf.qps > 0 and bf.build_s >= 0
+    # full-probe ivf over clustered data must be near-exact
+    assert results[2].recall > 0.95
+    front = runner.pareto_frontier(results)
+    assert front and all(front[i].qps <= front[i + 1].qps for i in range(len(front) - 1))
+
+
+def test_runner_rejects_unknown_algo():
+    with pytest.raises(ValueError):
+        runner.run_config(
+            {"dataset": {"name": "x", "n": 100, "dim": 4, "n_queries": 5},
+             "index": [{"algo": "hnsw"}]},
+            verbose=False,
+        )
+
+
+def test_export_csv(tmp_path):
+    rows = [runner.BenchResult("bf", "bf", "d", 10, 100, 1.0, 0.1, 1000.0, 0.99)]
+    p = str(tmp_path / "out.csv")
+    runner.export_csv(rows, p)
+    text = open(p).read()
+    assert "qps" in text and "1000.0" in text
