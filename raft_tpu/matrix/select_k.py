@@ -29,12 +29,26 @@ def _top_k_signed(scores: jax.Array, k: int, select_min: bool):
     return lax.top_k(scores, k)
 
 
+def _on_tpu() -> bool:
+    try:
+        return jax.devices()[0].platform == "tpu"
+    except Exception:  # pragma: no cover
+        return False
+
+
+# Pallas route thresholds: wide rows where the running-buffer kernel
+# beats lax.top_k's full sort; small k keeps its extraction loop short.
+_PALLAS_MIN_LEN = 8192
+_PALLAS_MAX_K = 64
+
+
 def select_k(
     scores: jax.Array,
     k: int,
     select_min: bool = True,
     input_indices: Optional[jax.Array] = None,
     len_tile: Optional[int] = None,
+    impl: Optional[str] = None,
 ) -> Tuple[jax.Array, jax.Array]:
     """Select the k smallest/largest entries per row.
 
@@ -57,6 +71,23 @@ def select_k(
     batch, n = scores.shape
     if k > n:
         raise ValueError(f"k={k} > len={n}")
+
+    # algorithm choice (the reference's choose_select_k_algorithm,
+    # matrix/detail/select_k-inl.cuh:293): Pallas running-buffer kernel
+    # for wide rows / small k on TPU, lax.top_k otherwise
+    if impl is None:
+        impl = (
+            "pallas"
+            if _on_tpu() and n >= _PALLAS_MIN_LEN and k <= _PALLAS_MAX_K
+            else "xla"
+        )
+    if impl == "pallas":
+        from raft_tpu.ops import select_k_pallas
+
+        vals, idx = select_k_pallas(scores, k, select_min=select_min)
+        if input_indices is not None:
+            idx = jnp.take_along_axis(input_indices, idx, axis=1)
+        return vals, idx
 
     if len_tile is not None and n > len_tile and n > k:
         return _select_k_tiled(scores, k, select_min, input_indices, len_tile)

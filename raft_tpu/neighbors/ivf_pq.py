@@ -407,12 +407,24 @@ def _search_impl(index: IvfPqIndex, queries: jax.Array, k: int,
         codes = index.packed_codes[probe]                 # [t, Pr, L, S]
         cand_ids = index.packed_ids[probe].reshape(t, n_probes * L)
         cand_norms = index.packed_norms[probe].reshape(t, n_probes * L)
-        # ⟨q, d⟩ via gather+sum over subspaces (the reference's fused scan;
-        # Pallas target): qd[t,c] = Σ_s qlut[t, s, codes[t,c,s]]
+        # ⟨q, d⟩: qd[t,c] = Σ_s qlut[t, s, codes[t,c,s]].  On TPU this is
+        # formulated as a one-hot contraction: per-lane dynamic gathers
+        # are the slowest op on a TPU, while the iota-compare one-hot
+        # fuses into the MXU matmul's operand feed (never hits HBM) —
+        # the TPU counterpart of the reference's fused LUT scan
+        # (ivf_pq_compute_similarity-inl.cuh).  CPU keeps the gather
+        # (its XLA doesn't fuse the one-hot and would materialize it).
         idx = codes.reshape(t, n_probes * L, S).astype(jnp.int32)
-        idx_t = jnp.transpose(idx, (0, 2, 1))             # [t, S, C]
-        gath = jnp.take_along_axis(qlut, idx_t, axis=2)   # [t, S, C]
-        qd = jnp.sum(gath, axis=1)                        # [t, C]
+        if jax.devices()[0].platform == "tpu":
+            onehot = jax.nn.one_hot(idx, K, dtype=jnp.float32)  # [t, C, S, K]
+            qd = jnp.einsum(
+                "tcsk,tsk->tc", onehot, qlut,
+                precision=get_precision(), preferred_element_type=jnp.float32,
+            )
+        else:
+            idx_t = jnp.transpose(idx, (0, 2, 1))             # [t, S, C]
+            gath = jnp.take_along_axis(qlut, idx_t, axis=2)   # [t, S, C]
+            qd = jnp.sum(gath, axis=1)                        # [t, C]
         qcand = jnp.broadcast_to(qc_probed[:, :, None],
                                  (t, n_probes, L)).reshape(t, n_probes * L)
         if ip_like:

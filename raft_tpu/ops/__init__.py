@@ -1,0 +1,3 @@
+"""Pallas TPU kernels for hot primitives (SURVEY.md §7)."""
+
+from .pallas_kernels import fused_l2_argmin, select_k_pallas  # noqa: F401

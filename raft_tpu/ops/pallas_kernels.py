@@ -1,0 +1,235 @@
+"""Pallas TPU kernels for the hot ANN primitives.
+
+TPU-native re-implementation of the reference's load-bearing CUDA
+kernels (SURVEY.md §7 "hard parts"):
+
+- :func:`fused_l2_argmin` — fused distance + argmin over column tiles,
+  the counterpart of ``fused_l2_nn`` (distance/detail/fused_l2_nn.cuh):
+  one VMEM-resident pass per y-tile, MXU Gram + VPU epilogue + running
+  (min, argmin) accumulated in the output block across the sequential
+  grid axis — the [m, n] matrix never touches HBM.
+- :func:`select_k_pallas` — batched top-k, counterpart of
+  ``matrix::select_k``'s warp-sort path
+  (matrix/detail/select_warpsort.cuh): a running k-buffer in VMEM is
+  merged with each score tile by iterative extraction (k min+mask
+  rounds per tile, all VPU work on VMEM-resident data — the TPU-shaped
+  replacement for warp bitonic queues).
+
+Both kernels run compiled on TPU and in interpreter mode elsewhere
+(tests force ``interpret=True`` on CPU; dispatchers in matrix/distance
+pick the XLA path off-TPU).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+# Lane width constraint: last dim multiples of 128, sublanes of 8 (f32).
+_LANES = 128
+_SUBLANES = 8
+
+
+def _pad_to(x, mult, axis, value):
+    size = x.shape[axis]
+    pad = (-size) % mult
+    if pad == 0:
+        return x
+    widths = [(0, 0)] * x.ndim
+    widths[axis] = (0, pad)
+    return jnp.pad(x, widths, constant_values=value)
+
+
+def _on_tpu() -> bool:
+    try:
+        return jax.devices()[0].platform == "tpu"
+    except Exception:  # pragma: no cover - no backend at all
+        return False
+
+
+# ---------------------------------------------------------------------------
+# fused L2 argmin
+# ---------------------------------------------------------------------------
+
+def _fused_l2_argmin_kernel(x_ref, y_ref, nvalid_ref, dist_ref, idx_ref):
+    """Grid = (m_tiles, n_tiles); n is the minor (sequential) axis, so the
+    output block for a given m-tile is revisited across n-tiles and acts
+    as the running (min, argmin) accumulator.
+
+    Per-row scalars live as lane-broadcast [bm, 128] blocks — Mosaic's
+    layout for 1-D f32 operands doesn't match XLA's, so 2-D it is; the
+    host-side wrapper slices lane 0.  Row norms are computed in-kernel
+    (cheap VPU work) to avoid extra 1-D operands."""
+    nt = pl.program_id(1)
+    bn = y_ref.shape[0]
+
+    @pl.when(nt == 0)
+    def _init():
+        dist_ref[:] = jnp.full_like(dist_ref, jnp.inf)
+        idx_ref[:] = jnp.zeros_like(idx_ref)
+
+    x = x_ref[:]                       # [bm, d]
+    y = y_ref[:]                       # [bn, d]
+    xsq = jnp.sum(x * x, axis=1)       # [bm]
+    ysq = jnp.sum(y * y, axis=1)       # [bn]
+    d2 = (
+        xsq[:, None]
+        + ysq[None, :]
+        - 2.0 * jax.lax.dot_general(
+            x, y, (((1,), (1,)), ((), ())),
+            # f32-exact MXU passes: bf16 default loses ~1e-3 relative,
+            # enough to flip argmins (the reference kernel is fp32)
+            precision=jax.lax.Precision.HIGHEST,
+            preferred_element_type=jnp.float32,
+        )
+    )
+    d2 = jnp.maximum(d2, 0.0)
+    # mask padded columns of the final tile
+    col = jax.lax.broadcasted_iota(jnp.int32, d2.shape, 1) + nt * bn
+    d2 = jnp.where(col < nvalid_ref[0], d2, jnp.inf)
+
+    blk_min = jnp.min(d2, axis=1)                                  # [bm]
+    blk_arg = jnp.argmin(d2, axis=1).astype(jnp.int32) + nt * bn   # [bm]
+    lanes = dist_ref.shape[1]
+    take = blk_min < dist_ref[:, 0]
+    dist_ref[:] = jnp.where(
+        take[:, None], jnp.broadcast_to(blk_min[:, None], (blk_min.shape[0], lanes)),
+        dist_ref[:])
+    idx_ref[:] = jnp.where(
+        take[:, None], jnp.broadcast_to(blk_arg[:, None], (blk_arg.shape[0], lanes)),
+        idx_ref[:])
+
+
+@functools.partial(jax.jit, static_argnames=("bm", "bn", "interpret"))
+def fused_l2_argmin(x: jax.Array, y: jax.Array, bm: int = 256, bn: int = 512,
+                    interpret: bool = False) -> Tuple[jax.Array, jax.Array]:
+    """(min squared-L2 distance, argmin) of each x row against all y rows.
+
+    Pallas counterpart of ``fused_l2_nn`` (distance/fused_l2_nn.cuh).
+    """
+    m, d = x.shape
+    n = y.shape[0]
+    xf = _pad_to(x.astype(jnp.float32), bm, 0, 0.0)
+    yf = _pad_to(y.astype(jnp.float32), bn, 0, 0.0)
+    dpad = (-d) % _LANES
+    if dpad:
+        xf = jnp.pad(xf, ((0, 0), (0, dpad)))
+        yf = jnp.pad(yf, ((0, 0), (0, dpad)))
+    mp, np_ = xf.shape[0], yf.shape[0]
+    nvalid = jnp.full((1,), n, jnp.int32)
+
+    grid = (mp // bm, np_ // bn)
+    dist, idx = pl.pallas_call(
+        _fused_l2_argmin_kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((bm, xf.shape[1]), lambda i, j: (i, 0)),
+            pl.BlockSpec((bn, yf.shape[1]), lambda i, j: (j, 0)),
+            pl.BlockSpec(memory_space=pltpu.SMEM),
+        ],
+        out_specs=[
+            pl.BlockSpec((bm, _LANES), lambda i, j: (i, 0)),
+            pl.BlockSpec((bm, _LANES), lambda i, j: (i, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((mp, _LANES), jnp.float32),
+            jax.ShapeDtypeStruct((mp, _LANES), jnp.int32),
+        ],
+        interpret=interpret,
+    )(xf, yf, nvalid)
+    return dist[:m, 0], idx[:m, 0]
+
+
+# ---------------------------------------------------------------------------
+# select_k (running top-k buffer, iterative extraction per tile)
+# ---------------------------------------------------------------------------
+
+def _select_k_kernel(scores_ref, nvalid_ref, vals_ref, idx_ref, *, k: int,
+                     select_min: bool):
+    """Grid = (m_tiles, len_tiles); len is the sequential minor axis.  The
+    output [bm, kpad] block doubles as the running top-k buffer."""
+    lt = pl.program_id(1)
+    bm, bl = scores_ref.shape
+    kpad = vals_ref.shape[1]
+    big = jnp.inf if select_min else -jnp.inf
+
+    @pl.when(lt == 0)
+    def _init():
+        vals_ref[:] = jnp.full_like(vals_ref, big)
+        idx_ref[:] = jnp.full_like(idx_ref, -1)
+
+    s = scores_ref[:]
+    if not select_min:
+        s = -s  # uniform ascending selection
+    col = jax.lax.broadcasted_iota(jnp.int32, s.shape, 1) + lt * bl
+    s = jnp.where(col < nvalid_ref[0], s, jnp.inf)
+
+    buf_v = vals_ref[:] if select_min else jnp.where(
+        jnp.isinf(vals_ref[:]), jnp.inf, -vals_ref[:])
+    # combined candidate set: running buffer ++ this tile
+    comb_v = jnp.concatenate([buf_v, s], axis=1)          # [bm, kpad+bl]
+    comb_i = jnp.concatenate([idx_ref[:], col], axis=1)
+
+    out_v = jnp.full((bm, kpad), jnp.inf, jnp.float32)
+    out_i = jnp.full((bm, kpad), -1, jnp.int32)
+    out_cols = jax.lax.broadcasted_iota(jnp.int32, (bm, kpad), 1)
+    # k is static → unrolled extraction (scatter at a traced column is
+    # unsupported in Mosaic; a where against the static column is)
+    for j in range(k):
+        mn = jnp.min(comb_v, axis=1)
+        am = jnp.argmin(comb_v, axis=1)
+        onehot = jax.lax.broadcasted_iota(jnp.int32, comb_v.shape, 1) == am[:, None]
+        # gather-free pick: masked min over the argmin one-hot (Mosaic
+        # has no general gather)
+        picked_i = jnp.min(
+            jnp.where(onehot, comb_i, jnp.iinfo(jnp.int32).max), axis=1)
+        out_v = jnp.where(out_cols == j, mn[:, None], out_v)
+        out_i = jnp.where(out_cols == j, picked_i[:, None], out_i)
+        # knock out the extracted entry
+        comb_v = jnp.where(onehot, jnp.inf, comb_v)
+    vals_ref[:] = out_v if select_min else jnp.where(
+        jnp.isinf(out_v), -jnp.inf, -out_v)
+    idx_ref[:] = out_i
+
+
+@functools.partial(jax.jit,
+                   static_argnames=("k", "select_min", "bm", "bl", "interpret"))
+def select_k_pallas(scores: jax.Array, k: int, select_min: bool = True,
+                    bm: int = 64, bl: int = 2048,
+                    interpret: bool = False) -> Tuple[jax.Array, jax.Array]:
+    """Batched top-k over rows of ``scores`` [m, len] — Pallas counterpart
+    of ``matrix::select_k`` (matrix/select_k.cuh:81).  Returns sorted
+    (values [m, k], indices [m, k])."""
+    m, n = scores.shape
+    if k > n:
+        raise ValueError(f"k={k} > len={n}")
+    kpad = max(_LANES, ((k + _LANES - 1) // _LANES) * _LANES)
+    s = _pad_to(scores.astype(jnp.float32), bm, 0, 0.0)
+    s = _pad_to(s, bl, 1, jnp.inf if select_min else -jnp.inf)
+    mp, npad = s.shape
+    nvalid = jnp.full((1,), n, jnp.int32)
+
+    grid = (mp // bm, npad // bl)
+    vals, idx = pl.pallas_call(
+        functools.partial(_select_k_kernel, k=k, select_min=select_min),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((bm, bl), lambda i, j: (i, j)),
+            pl.BlockSpec(memory_space=pltpu.SMEM),
+        ],
+        out_specs=[
+            pl.BlockSpec((bm, kpad), lambda i, j: (i, 0)),
+            pl.BlockSpec((bm, kpad), lambda i, j: (i, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((mp, kpad), jnp.float32),
+            jax.ShapeDtypeStruct((mp, kpad), jnp.int32),
+        ],
+        interpret=interpret,
+    )(s, nvalid)
+    return vals[:m, :k], idx[:m, :k]

@@ -1,0 +1,63 @@
+"""Pallas kernels in interpreter mode vs references (the CPU-side
+equivalent of the reference's kernel unit tests; on real TPU the same
+kernels run compiled — see bench.py)."""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from raft_tpu.ops import fused_l2_argmin, select_k_pallas
+
+
+def test_fused_l2_argmin_interpret(rng):
+    x = rng.random((100, 40), dtype=np.float32)
+    y = rng.random((1000, 40), dtype=np.float32)
+    d, i = fused_l2_argmin(jnp.asarray(x), jnp.asarray(y), interpret=True)
+    full = ((x[:, None, :] - y[None]) ** 2).sum(-1)
+    np.testing.assert_array_equal(np.asarray(i), np.argmin(full, 1))
+    np.testing.assert_allclose(np.asarray(d), full.min(1), rtol=1e-4, atol=1e-5)
+
+
+def test_fused_l2_argmin_ragged_shapes(rng):
+    # shapes not multiples of the block sizes exercise the padding masks
+    x = rng.random((33, 7), dtype=np.float32)
+    y = rng.random((517, 7), dtype=np.float32)
+    d, i = fused_l2_argmin(jnp.asarray(x), jnp.asarray(y), bm=32, bn=256, interpret=True)
+    full = ((x[:, None, :] - y[None]) ** 2).sum(-1)
+    np.testing.assert_array_equal(np.asarray(i), np.argmin(full, 1))
+
+
+@pytest.mark.parametrize("select_min", [True, False])
+def test_select_k_pallas_interpret(rng, select_min):
+    s = rng.random((37, 5000), dtype=np.float32)
+    v, ix = select_k_pallas(jnp.asarray(s), 10, select_min=select_min, interpret=True)
+    order = np.argsort(s if select_min else -s, 1)[:, :10]
+    want_v = np.take_along_axis(s, order, 1)
+    np.testing.assert_allclose(np.asarray(v), want_v, rtol=1e-6)
+    np.testing.assert_array_equal(np.sort(np.asarray(ix), 1), np.sort(order, 1))
+
+
+def test_select_k_pallas_duplicates(rng):
+    # ties: every extracted index must be distinct
+    s = np.zeros((4, 300), np.float32)
+    v, ix = select_k_pallas(jnp.asarray(s), 8, interpret=True)
+    ix = np.asarray(ix)
+    for r in range(4):
+        assert len(set(ix[r].tolist())) == 8
+    np.testing.assert_allclose(np.asarray(v), 0.0)
+
+
+def test_select_k_pallas_k_too_big(rng):
+    with pytest.raises(ValueError):
+        select_k_pallas(jnp.zeros((2, 5)), 6, interpret=True)
+
+
+def test_fused_dispatch_cpu_falls_back(rng):
+    # on the CPU test backend the auto dispatch must take the XLA path
+    from raft_tpu.distance.fused_l2_nn import fused_l2_nn_argmin
+
+    x = rng.random((20, 8), dtype=np.float32)
+    y = rng.random((50, 8), dtype=np.float32)
+    d, i = fused_l2_nn_argmin(jnp.asarray(x), jnp.asarray(y))
+    full = ((x[:, None, :] - y[None]) ** 2).sum(-1)
+    np.testing.assert_array_equal(np.asarray(i), np.argmin(full, 1))
