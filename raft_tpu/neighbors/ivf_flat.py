@@ -62,10 +62,19 @@ class IndexParams:
 
 @dataclasses.dataclass
 class SearchParams:
-    """reference: ``ivf_flat::search_params`` (ivf_flat_types.hpp:157)."""
+    """reference: ``ivf_flat::search_params`` (ivf_flat_types.hpp:157).
+
+    ``scan_mode`` selects the TPU scan structure: "grouped" is the
+    list-centric batch scan (see neighbors/ivf_common.py — each list block
+    streams through the MXU once per query batch), "per_query" gathers
+    each query's probed lists (lower latency for small batches), "auto"
+    picks by batch size."""
 
     n_probes: int = 20
-    query_tile: int = 256  # bounds the candidate intermediate per map step
+    query_tile: int = 256  # per_query path: bounds the per-step intermediate
+    scan_mode: str = "auto"  # "auto" | "grouped" | "per_query"
+    qmax_factor: float = 4.0  # grouped path: per-list queue headroom
+    list_chunk: int = 16     # grouped path: lists scanned per step
 
 
 class IvfFlatIndex(flax.struct.PyTreeNode):
@@ -104,29 +113,38 @@ def _normalize_rows(x):
 def _pack_lists(dataset: np.ndarray, labels: np.ndarray, n_lists: int,
                 max_list_size: int, dtype) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
     """Host-side list packing (reference: detail/ivf_flat_build.cuh pack;
-    build is host-orchestrated, like the reference's build pipeline)."""
+    build is host-orchestrated, like the reference's build pipeline).
+    Fully vectorized: one argsort + fancy-indexed fill, no per-list loop."""
     n, d = dataset.shape
     order = np.argsort(labels, kind="stable")
     sorted_labels = labels[order]
+    starts = np.searchsorted(sorted_labels, np.arange(n_lists))
+    rank = np.arange(n) - starts[sorted_labels]   # slot within each list
+    keep = rank < max_list_size
+    dropped = int(n - keep.sum())
     packed = np.zeros((n_lists, max_list_size, d), dtype=dtype)
     ids = np.full((n_lists, max_list_size), -1, np.int32)
-    sizes = np.zeros((n_lists,), np.int32)
-    starts = np.searchsorted(sorted_labels, np.arange(n_lists))
-    ends = np.searchsorted(sorted_labels, np.arange(n_lists), side="right")
-    dropped = 0
-    for l in range(n_lists):
-        rows = order[starts[l]:ends[l]]
-        if len(rows) > max_list_size:  # cap overflow (balanced fit makes this rare)
-            dropped += len(rows) - max_list_size
-            rows = rows[:max_list_size]
-        packed[l, :len(rows)] = dataset[rows]
-        ids[l, :len(rows)] = rows
-        sizes[l] = len(rows)
+    rows = order[keep]
+    packed[sorted_labels[keep], rank[keep]] = dataset[rows]
+    ids[sorted_labels[keep], rank[keep]] = rows
+    sizes = np.minimum(np.bincount(labels, minlength=n_lists),
+                       max_list_size).astype(np.int32)
     if dropped:
         from raft_tpu.core import logging as _log
         _log.warn("ivf_flat: dropped %d overflow vectors (raise "
                   "list_size_cap_factor)", dropped)
     return packed, ids, sizes
+
+
+def _fit_list_size(counts: np.ndarray, avg: int, cap_factor: float) -> int:
+    """Padded list capacity: the actual max list size (rounded up to a
+    multiple of 128 for MXU-shaped scans), clamped by the cap factor.
+    Sizing to the real histogram instead of the worst-case cap is a large
+    scan-FLOP saver — padding is wasted work on every probe."""
+    cap = max(8, int(avg * cap_factor))
+    actual = int(counts.max()) if counts.size else 8
+    size = min(cap, actual)
+    return max(8, -(-size // 128) * 128) if size > 8 else 8
 
 
 def build(dataset: jax.Array, params: Optional[IndexParams] = None) -> IvfFlatIndex:
@@ -159,9 +177,9 @@ def build(dataset: jax.Array, params: Optional[IndexParams] = None) -> IvfFlatIn
                                   params.n_lists, km_params)
 
     avg = max(1, n // params.n_lists)
-    max_list_size = max(8, int(avg * params.list_size_cap_factor))
 
     if not params.add_data_on_build:
+        max_list_size = max(8, int(avg * params.list_size_cap_factor))
         packed = jnp.zeros((params.n_lists, max_list_size, d), x.dtype)
         ids = jnp.full((params.n_lists, max_list_size), -1, jnp.int32)
         sizes = jnp.zeros((params.n_lists,), jnp.int32)
@@ -172,6 +190,8 @@ def build(dataset: jax.Array, params: Optional[IndexParams] = None) -> IvfFlatIn
 
     labels = np.asarray(kmeans_balanced.predict(centers, x.astype(jnp.float32),
                                                 km_params))
+    counts = np.bincount(labels, minlength=params.n_lists)
+    max_list_size = _fit_list_size(counts, avg, params.list_size_cap_factor)
     packed, ids, sizes = _pack_lists(np.asarray(x), labels, params.n_lists,
                                      max_list_size, np.asarray(x).dtype)
     packed_j = jnp.asarray(packed)
@@ -211,14 +231,16 @@ def extend(index: IvfFlatIndex, new_vectors: jax.Array,
     ids[:, :L] = np.asarray(index.packed_ids)
     nv = np.asarray(new_vectors)
     ni = np.asarray(new_ids)
-    fill = old_sizes.copy()
-    for row, lbl in enumerate(labels):
-        p = fill[lbl]
-        if p >= new_L:
-            continue
-        packed[lbl, p] = nv[row]
-        ids[lbl, p] = ni[row]
-        fill[lbl] += 1
+    # vectorized append: slot = old_size[list] + rank within the new rows
+    order = np.argsort(labels, kind="stable")
+    sorted_l = labels[order]
+    starts = np.searchsorted(sorted_l, np.arange(n_lists))
+    rank = np.arange(len(labels)) - starts[sorted_l]
+    slot = old_sizes[sorted_l] + rank
+    keep = slot < new_L
+    packed[sorted_l[keep], slot[keep]] = nv[order[keep]]
+    ids[sorted_l[keep], slot[keep]] = ni[order[keep]]
+    fill = np.minimum(need, new_L)
     packed_j = jnp.asarray(packed)
     return IvfFlatIndex(
         centers=index.centers, packed_data=packed_j, packed_ids=jnp.asarray(ids),
@@ -309,6 +331,90 @@ def _search_impl(index: IvfFlatIndex, queries: jax.Array, k: int,
             ids.reshape(n_tiles * query_tile, k)[:m])
 
 
+@partial(jax.jit, static_argnames=("k", "n_probes", "qmax", "list_chunk"))
+def _search_grouped(index: IvfFlatIndex, queries: jax.Array, k: int,
+                    n_probes: int, qmax: int, list_chunk: int,
+                    filter_bits=None):
+    """List-centric batch scan (see ivf_common module docstring): stream
+    each list block through the MXU once per batch, queries grouped by
+    probed list. TPU counterpart of the reference's interleaved scan
+    (ivf_flat_interleaved_scan-inl.cuh) with the loop order inverted."""
+    from raft_tpu.neighbors import ivf_common as ic
+
+    mt = resolve_metric(index.metric)
+    q_all = queries.astype(jnp.float32)
+    B = q_all.shape[0]
+    n_lists, L, d = index.packed_data.shape
+    sqrt_out = mt == DistanceType.L2SqrtExpanded
+    ip = mt == DistanceType.InnerProduct
+    cos = mt == DistanceType.CosineExpanded
+    select_min = not ip
+    invalid = -jnp.inf if ip else jnp.inf
+
+    coarse, coarse_min = _coarse_distances(q_all, index.centers, mt)
+    _, probes = _select_k(coarse, n_probes, select_min=coarse_min)  # [B, P]
+    qtable, rank = ic.invert_probes(probes, n_lists, qmax)
+
+    q_sq = jnp.sum(q_all * q_all, axis=1)                 # [B]
+    qn = jnp.sqrt(jnp.maximum(q_sq, 1e-30))
+    valid_full = index.packed_ids >= 0                    # [n_lists, L]
+    if filter_bits is not None:
+        from raft_tpu.neighbors.sample_filter import passes
+
+        valid_full &= passes(filter_bits, index.packed_ids)
+
+    G = list_chunk
+    n_chunks = n_lists // G
+    data_r = index.packed_data.reshape(n_chunks, G, L, d)
+    norms_r = index.packed_norms.reshape(n_chunks, G, L)
+    lids_r = index.packed_ids.reshape(n_chunks, G, L)
+    valid_r = valid_full.reshape(n_chunks, G, L)
+    qt_r = qtable.reshape(n_chunks, G, qmax)
+
+    def scan_chunk(args):
+        data, norms, lids, valid, qt = args
+        qi = jnp.clip(qt, 0, B - 1)                       # [G, qmax]
+        qv = q_all[qi]                                    # [G, qmax, d]
+        scores = jnp.einsum("gqd,gld->gql", qv, data.astype(jnp.float32),
+                            precision=get_precision(),
+                            preferred_element_type=jnp.float32)
+        if ip:
+            dists = scores
+        elif cos:
+            cn = jnp.sqrt(jnp.maximum(norms, 1e-30))
+            dists = 1.0 - scores / (qn[qi][:, :, None] * cn[:, None, :])
+        else:
+            dists = jnp.maximum(
+                q_sq[qi][:, :, None] + norms[:, None, :] - 2.0 * scores, 0.0)
+        dists = jnp.where(valid[:, None, :], dists, invalid)
+        vals, pos = _select_k(dists.reshape(G * qmax, L), kk,
+                              select_min=select_min)
+        vals = vals.reshape(G, qmax, kk)
+        pos = pos.reshape(G, qmax, kk)
+        cids = jax.vmap(lambda l, p: l[p])(lids, pos)     # [G, qmax, kk]
+        cids = jnp.where(vals == invalid, -1, cids)       # filtered/padded slots
+        return vals, cids
+
+    kk = min(k, L)  # a single list holds at most L candidates
+    vals, cids = lax.map(scan_chunk, (data_r, norms_r, lids_r, valid_r, qt_r))
+    vals = vals.reshape(n_lists, qmax, kk)
+    cids = cids.reshape(n_lists, qmax, kk)
+
+    pv, pi = ic.gather_pair_results(vals, cids, probes, rank, invalid)
+    out_vals, out_ids = _select_k(pv.reshape(B, n_probes * kk),
+                                  min(k, n_probes * kk),
+                                  select_min=select_min,
+                                  input_indices=pi.reshape(B, n_probes * kk))
+    if k > n_probes * kk:  # fewer candidates than asked: pad with invalid
+        pad = k - n_probes * kk
+        out_vals = jnp.pad(out_vals, ((0, 0), (0, pad)),
+                           constant_values=invalid)
+        out_ids = jnp.pad(out_ids, ((0, 0), (0, pad)), constant_values=-1)
+    if sqrt_out:
+        out_vals = jnp.sqrt(out_vals)
+    return out_vals, out_ids
+
+
 def search(index: IvfFlatIndex, queries: jax.Array, k: int,
            params: Optional[SearchParams] = None,
            filter_bitset: Optional[jax.Array] = None) -> Tuple[jax.Array, jax.Array]:
@@ -324,6 +430,20 @@ def search(index: IvfFlatIndex, queries: jax.Array, k: int,
     expects(queries.ndim == 2 and queries.shape[1] == index.dim,
             "queries must be [m, %d]", index.dim)
     n_probes = min(params.n_probes, index.n_lists)
+    B = queries.shape[0]
+    mode = params.scan_mode
+    if mode == "auto":
+        # grouped wins once the batch populates the per-list queues
+        mode = ("grouped" if B * n_probes >= 2 * index.n_lists
+                else "per_query")
+    if mode == "grouped":
+        from raft_tpu.neighbors import ivf_common as ic
+
+        qmax = ic.default_qmax(B, n_probes, index.n_lists,
+                               params.qmax_factor)
+        chunk = ic.choose_list_chunk(index.n_lists, params.list_chunk)
+        return _search_grouped(index, queries, k, n_probes, qmax, chunk,
+                               filter_bits=filter_bitset)
     return _search_impl(index, queries, k, n_probes, params.query_tile,
                         filter_bits=filter_bitset)
 

@@ -67,14 +67,27 @@ class IndexParams:
     add_data_on_build: bool = True
     list_size_cap_factor: float = 4.0
     seed: int = 0
+    # TPU-specific: keep a bf16 reconstruction (c + decoded residual) of
+    # every list alongside the codes. Trades HBM (2 bytes/dim) for scan
+    # speed — the grouped scan then skips the per-chunk one-hot decode
+    # (expensive at pq_bits=8: the MXU decode runs at K× the lookup
+    # FLOPs). "auto" enables it when the cache stays under ~1 GB.
+    cache_reconstruction: str = "auto"  # "auto" | "always" | "never"
 
 
 @dataclasses.dataclass
 class SearchParams:
-    """reference: ``ivf_pq::search_params``."""
+    """reference: ``ivf_pq::search_params``.
+
+    ``scan_mode``: "grouped" is the list-centric batch scan (see
+    neighbors/ivf_common.py), "per_query" the gather path for small
+    batches, "auto" picks by batch size."""
 
     n_probes: int = 20
     query_tile: int = 64
+    scan_mode: str = "auto"  # "auto" | "grouped" | "per_query"
+    qmax_factor: float = 4.0
+    list_chunk: int = 8
 
 
 class IvfPqIndex(flax.struct.PyTreeNode):
@@ -88,6 +101,7 @@ class IvfPqIndex(flax.struct.PyTreeNode):
     packed_ids: jax.Array     # [n_lists, L] i32, -1 pad
     packed_norms: jax.Array   # [n_lists, L] f32: ‖c + decoded‖²
     list_sizes: jax.Array     # [n_lists] i32
+    packed_recon: Optional[jax.Array] = None  # [n_lists, L, rot_dim] bf16 cache
     metric: str = flax.struct.field(pytree_node=False, default="sqeuclidean")
 
     @property
@@ -200,33 +214,43 @@ def _encode_rows(rot_rows: jax.Array, centers_rot: jax.Array,
 
 
 def _decode_codes(codes: jax.Array, codebooks: jax.Array) -> jax.Array:
-    """codes [..., S] u8 → decoded residuals [..., S*P] f32."""
+    """codes [..., S] u8 → decoded residuals [..., S*P] f32.
+
+    On TPU the lookup is a one-hot MXU contraction: arbitrary-axis
+    gathers do not lower on the TPU backend (and would be VPU-serial
+    anyway), while the iota-compare one-hot feeds the MXU directly.
+    CPU keeps the natural gather."""
     S, K, P = codebooks.shape
-    gathered = codebooks[jnp.arange(S), codes.astype(jnp.int32)]  # [..., S, P]
-    return gathered.reshape(*codes.shape[:-1], S * P)
+    if jax.default_backend() == "cpu":
+        gathered = codebooks[jnp.arange(S), codes.astype(jnp.int32)]
+        return gathered.reshape(*codes.shape[:-1], S * P)
+    oh = jax.nn.one_hot(codes.astype(jnp.int32), K, dtype=jnp.bfloat16)
+    dec = jnp.einsum("...sk,skp->...sp", oh, codebooks.astype(jnp.bfloat16),
+                     preferred_element_type=jnp.float32)
+    return dec.reshape(*codes.shape[:-1], S * P)
 
 
 def _pack_codes(codes: np.ndarray, labels: np.ndarray, norms: np.ndarray,
                 n_lists: int, max_list_size: int, row_ids: np.ndarray):
+    """Vectorized list packing: one argsort + fancy-indexed fill
+    (reference: encode+pack, ivf_pq_build.cuh:1411-1432)."""
     n, S = codes.shape
     order = np.argsort(labels, kind="stable")
     sorted_labels = labels[order]
+    starts = np.searchsorted(sorted_labels, np.arange(n_lists))
+    rank = np.arange(n) - starts[sorted_labels]
+    keep = rank < max_list_size
+    dropped = int(n - keep.sum())
     packed = np.zeros((n_lists, max_list_size, S), np.uint8)
     ids = np.full((n_lists, max_list_size), -1, np.int32)
     pnorm = np.zeros((n_lists, max_list_size), np.float32)
-    sizes = np.zeros((n_lists,), np.int32)
-    starts = np.searchsorted(sorted_labels, np.arange(n_lists))
-    ends = np.searchsorted(sorted_labels, np.arange(n_lists), side="right")
-    dropped = 0
-    for l in range(n_lists):
-        rows = order[starts[l]:ends[l]]
-        if len(rows) > max_list_size:
-            dropped += len(rows) - max_list_size
-            rows = rows[:max_list_size]
-        packed[l, :len(rows)] = codes[rows]
-        ids[l, :len(rows)] = row_ids[rows]
-        pnorm[l, :len(rows)] = norms[rows]
-        sizes[l] = len(rows)
+    rows = order[keep]
+    ls, rk = sorted_labels[keep], rank[keep]
+    packed[ls, rk] = codes[rows]
+    ids[ls, rk] = row_ids[rows]
+    pnorm[ls, rk] = norms[rows]
+    sizes = np.minimum(np.bincount(labels, minlength=n_lists),
+                       max_list_size).astype(np.int32)
     if dropped:
         from raft_tpu.core import logging as _log
         _log.warn("ivf_pq: dropped %d overflow vectors", dropped)
@@ -281,9 +305,9 @@ def build(dataset: jax.Array, params: Optional[IndexParams] = None) -> IvfPqInde
                                jax.random.fold_in(key, 2))
 
     avg = max(1, n // params.n_lists)
-    max_list_size = max(8, int(avg * params.list_size_cap_factor))
 
     if not params.add_data_on_build:
+        max_list_size = max(8, int(avg * params.list_size_cap_factor))
         return IvfPqIndex(
             centers=centers, centers_rot=centers_rot, rotation=rotation,
             codebooks=codebooks,
@@ -294,6 +318,8 @@ def build(dataset: jax.Array, params: Optional[IndexParams] = None) -> IvfPqInde
             metric=mt.value)
 
     # 4. encode + pack all rows
+    from raft_tpu.neighbors.ivf_flat import _fit_list_size
+
     labels = kmeans_balanced.predict(centers, x, km)
     x_rot = x @ rotation.T
     codes = _encode_rows(x_rot, centers_rot, labels, codebooks)
@@ -301,14 +327,39 @@ def build(dataset: jax.Array, params: Optional[IndexParams] = None) -> IvfPqInde
     recon = centers_rot[labels] + decoded
     norms = jnp.sum(recon * recon, axis=1)
 
+    labels_h = np.asarray(labels)
+    counts = np.bincount(labels_h, minlength=params.n_lists)
+    max_list_size = _fit_list_size(counts, avg, params.list_size_cap_factor)
     packed, ids, pnorm, sizes = _pack_codes(
-        np.asarray(codes), np.asarray(labels), np.asarray(norms),
+        np.asarray(codes), labels_h, np.asarray(norms),
         params.n_lists, max_list_size, np.arange(n))
-    return IvfPqIndex(
+    index = IvfPqIndex(
         centers=centers, centers_rot=centers_rot, rotation=rotation,
         codebooks=codebooks, packed_codes=jnp.asarray(packed),
         packed_ids=jnp.asarray(ids), packed_norms=jnp.asarray(pnorm),
         list_sizes=jnp.asarray(sizes), metric=mt.value)
+    if _want_recon_cache(params, params.n_lists, max_list_size, rot_dim):
+        index = index.replace(packed_recon=_build_recon_cache(index))
+    return index
+
+
+def _want_recon_cache(params: IndexParams, n_lists: int, L: int,
+                      rot_dim: int) -> bool:
+    if params.cache_reconstruction == "never":
+        return False
+    if params.cache_reconstruction == "always":
+        return True
+    return n_lists * L * rot_dim * 2 <= (1 << 30)  # "auto": ≤ 1 GB
+
+
+@jax.jit
+def _build_recon_cache(index: IvfPqIndex) -> jax.Array:
+    """bf16 reconstruction (c + decoded residual) of every packed slot."""
+    n_lists, L, S = index.packed_codes.shape
+    decoded = _decode_codes(index.packed_codes.reshape(n_lists * L, S),
+                            index.codebooks)
+    recon = decoded.reshape(n_lists, L, -1) + index.centers_rot[:, None, :]
+    return recon.astype(jnp.bfloat16)
 
 
 def extend(index: IvfPqIndex, new_vectors: jax.Array,
@@ -345,21 +396,28 @@ def extend(index: IvfPqIndex, new_vectors: jax.Array,
     ids[:, :L] = np.asarray(index.packed_ids)
     pnorm[:, :L] = np.asarray(index.packed_norms)
     codes_h, norms_h, nid_h = np.asarray(codes), np.asarray(norms), np.asarray(new_ids)
-    fill = old_sizes.copy()
-    for row, lbl in enumerate(labels_h):
-        p = fill[lbl]
-        if p >= new_L:
-            continue
-        packed[lbl, p] = codes_h[row]
-        ids[lbl, p] = nid_h[row]
-        pnorm[lbl, p] = norms_h[row]
-        fill[lbl] += 1
-    return IvfPqIndex(
+    # vectorized append: slot = old_size[list] + rank within the new rows
+    order = np.argsort(labels_h, kind="stable")
+    sorted_l = labels_h[order]
+    starts = np.searchsorted(sorted_l, np.arange(n_lists))
+    rk = np.arange(len(labels_h)) - starts[sorted_l]
+    slot = old_sizes[sorted_l] + rk
+    keep = slot < new_L
+    rows = order[keep]
+    ls, sl = sorted_l[keep], slot[keep]
+    packed[ls, sl] = codes_h[rows]
+    ids[ls, sl] = nid_h[rows]
+    pnorm[ls, sl] = norms_h[rows]
+    fill = np.minimum(need, new_L)
+    out = IvfPqIndex(
         centers=index.centers, centers_rot=index.centers_rot,
         rotation=index.rotation, codebooks=index.codebooks,
         packed_codes=jnp.asarray(packed), packed_ids=jnp.asarray(ids),
         packed_norms=jnp.asarray(pnorm),
         list_sizes=jnp.asarray(fill.astype(np.int32)), metric=index.metric)
+    if index.packed_recon is not None:
+        out = out.replace(packed_recon=_build_recon_cache(out))
+    return out
 
 
 # ---------------------------------------------------------------------------
@@ -415,7 +473,7 @@ def _search_impl(index: IvfPqIndex, queries: jax.Array, k: int,
         # (ivf_pq_compute_similarity-inl.cuh).  CPU keeps the gather
         # (its XLA doesn't fuse the one-hot and would materialize it).
         idx = codes.reshape(t, n_probes * L, S).astype(jnp.int32)
-        if jax.devices()[0].platform == "tpu":
+        if jax.default_backend() != "cpu":
             onehot = jax.nn.one_hot(idx, K, dtype=jnp.float32)  # [t, C, S, K]
             qd = jnp.einsum(
                 "tcsk,tsk->tc", onehot, qlut,
@@ -467,6 +525,116 @@ def _search_impl(index: IvfPqIndex, queries: jax.Array, k: int,
     return (vals.reshape(-1, k)[:m], ids.reshape(-1, k)[:m])
 
 
+@partial(jax.jit, static_argnames=("k", "n_probes", "qmax", "list_chunk"))
+def _search_grouped(index: IvfPqIndex, queries: jax.Array, k: int,
+                    n_probes: int, qmax: int, list_chunk: int,
+                    filter_bits=None):
+    """List-centric batch scan (see ivf_common): each list's codes are
+    decoded ONCE per query batch (one-hot MXU contraction — or skipped
+    entirely when the bf16 reconstruction cache is present) and scanned
+    against its queued queries with one batched MXU contraction.
+    Counterpart of the reference's compute_similarity kernel
+    (ivf_pq_compute_similarity-inl.cuh) with the loop order inverted:
+    the reference re-reads packed codes per query, this reads them per
+    batch."""
+    from raft_tpu.neighbors import ivf_common as ic
+
+    mt = resolve_metric(index.metric)
+    q_all = jnp.asarray(queries, jnp.float32)
+    if mt == DistanceType.CosineExpanded:
+        q_all = q_all / jnp.sqrt(jnp.maximum(
+            jnp.sum(q_all * q_all, -1, keepdims=True), 1e-12))
+    B = q_all.shape[0]
+    n_lists, L, S = index.packed_codes.shape
+    ip_like = mt in (DistanceType.InnerProduct, DistanceType.CosineExpanded)
+    sqrt_out = mt == DistanceType.L2SqrtExpanded
+    select_min = not ip_like
+    invalid = -jnp.inf if ip_like else jnp.inf
+
+    # probe selection (select_clusters, ivf_pq_search.cuh:70-156)
+    qc = lax.dot_general(q_all, index.centers, (((1,), (1,)), ((), ())),
+                         precision=get_precision(),
+                         preferred_element_type=jnp.float32)
+    if ip_like:
+        _, probes = _select_k(qc, n_probes, select_min=False)
+    else:
+        c_sq = jnp.sum(index.centers**2, axis=1)
+        _, probes = _select_k(c_sq[None, :] - 2.0 * qc, n_probes,
+                              select_min=True)
+    qtable, rank = ic.invert_probes(probes, n_lists, qmax)
+
+    q_rot = q_all @ index.rotation.T                      # [B, rot_dim]
+    q_sq = jnp.sum(q_rot * q_rot, axis=1)
+    valid_full = index.packed_ids >= 0
+    if filter_bits is not None:
+        from raft_tpu.neighbors.sample_filter import passes
+
+        valid_full &= passes(filter_bits, index.packed_ids)
+
+    G = list_chunk
+    n_chunks = n_lists // G
+    codes_r = index.packed_codes.reshape(n_chunks, G, L, S)
+    norms_r = index.packed_norms.reshape(n_chunks, G, L)
+    lids_r = index.packed_ids.reshape(n_chunks, G, L)
+    valid_r = valid_full.reshape(n_chunks, G, L)
+    qt_r = qtable.reshape(n_chunks, G, qmax)
+    crot_r = index.centers_rot.reshape(n_chunks, G, -1)
+    recon_r = (None if index.packed_recon is None
+               else index.packed_recon.reshape(n_chunks, G, L, -1))
+
+    def scan_chunk(args):
+        if recon_r is None:
+            codes, norms, lids, valid, qt, crot = args
+            decoded = _decode_codes(codes, index.codebooks)  # [G, L, rot]
+            recon = decoded + crot[:, None, :]
+        else:
+            recon, norms, lids, valid, qt = args
+        qi = jnp.clip(qt, 0, B - 1)
+        qv = q_rot[qi]                                    # [G, qmax, rot]
+        scores = jnp.einsum("gqd,gld->gql", qv,
+                            recon.astype(jnp.float32),
+                            precision=get_precision(),
+                            preferred_element_type=jnp.float32)
+        if ip_like:
+            dists = scores
+        else:
+            dists = jnp.maximum(
+                q_sq[qi][:, :, None] + norms[:, None, :] - 2.0 * scores, 0.0)
+        dists = jnp.where(valid[:, None, :], dists, invalid)
+        vals, pos = _select_k(dists.reshape(G * qmax, L), kk,
+                              select_min=select_min)
+        vals = vals.reshape(G, qmax, kk)
+        pos = pos.reshape(G, qmax, kk)
+        cids = jax.vmap(lambda l, p: l[p])(lids, pos)
+        cids = jnp.where(vals == invalid, -1, cids)
+        return vals, cids
+
+    kk = min(k, L)  # a single list holds at most L candidates
+    if recon_r is None:
+        ins = (codes_r, norms_r, lids_r, valid_r, qt_r, crot_r)
+    else:
+        ins = (recon_r, norms_r, lids_r, valid_r, qt_r)
+    vals, cids = lax.map(scan_chunk, ins)
+    vals = vals.reshape(n_lists, qmax, kk)
+    cids = cids.reshape(n_lists, qmax, kk)
+
+    pv, pi = ic.gather_pair_results(vals, cids, probes, rank, invalid)
+    out_vals, out_ids = _select_k(pv.reshape(B, n_probes * kk),
+                                  min(k, n_probes * kk),
+                                  select_min=select_min,
+                                  input_indices=pi.reshape(B, n_probes * kk))
+    if k > n_probes * kk:
+        pad = k - n_probes * kk
+        out_vals = jnp.pad(out_vals, ((0, 0), (0, pad)),
+                           constant_values=invalid)
+        out_ids = jnp.pad(out_ids, ((0, 0), (0, pad)), constant_values=-1)
+    if sqrt_out:
+        out_vals = jnp.sqrt(out_vals)
+    if mt == DistanceType.CosineExpanded:
+        out_vals = 1.0 - out_vals
+    return out_vals, out_ids
+
+
 def search(index: IvfPqIndex, queries: jax.Array, k: int,
            params: Optional[SearchParams] = None,
            filter_bitset: Optional[jax.Array] = None) -> Tuple[jax.Array, jax.Array]:
@@ -480,6 +648,19 @@ def search(index: IvfPqIndex, queries: jax.Array, k: int,
     expects(queries.ndim == 2 and queries.shape[1] == index.dim,
             "queries must be [m, %d]", index.dim)
     n_probes = min(params.n_probes, index.n_lists)
+    B = queries.shape[0]
+    mode = params.scan_mode
+    if mode == "auto":
+        mode = ("grouped" if B * n_probes >= 2 * index.n_lists
+                else "per_query")
+    if mode == "grouped":
+        from raft_tpu.neighbors import ivf_common as ic
+
+        qmax = ic.default_qmax(B, n_probes, index.n_lists,
+                               params.qmax_factor)
+        chunk = ic.choose_list_chunk(index.n_lists, params.list_chunk)
+        return _search_grouped(index, queries, k, n_probes, qmax, chunk,
+                               filter_bits=filter_bitset)
     return _search_impl(index, queries, k, n_probes, params.query_tile,
                         filter_bits=filter_bitset)
 
@@ -489,21 +670,24 @@ def search(index: IvfPqIndex, queries: jax.Array, k: int,
 # ---------------------------------------------------------------------------
 
 def save(index: IvfPqIndex, path: str) -> None:
-    ser.save_arrays(path, "ivf_pq", _SERIAL_VERSION, {"metric": index.metric},
-                    {"centers": index.centers,
-                     "centers_rot": index.centers_rot,
-                     "rotation": index.rotation,
-                     "codebooks": index.codebooks,
-                     "packed_codes": index.packed_codes,
-                     "packed_ids": index.packed_ids,
-                     "packed_norms": index.packed_norms,
-                     "list_sizes": index.list_sizes})
+    arrays = {"centers": index.centers,
+              "centers_rot": index.centers_rot,
+              "rotation": index.rotation,
+              "codebooks": index.codebooks,
+              "packed_codes": index.packed_codes,
+              "packed_ids": index.packed_ids,
+              "packed_norms": index.packed_norms,
+              "list_sizes": index.list_sizes}
+    # the bf16 cache is derived data — rebuilt on load, never serialized
+    ser.save_arrays(path, "ivf_pq", _SERIAL_VERSION,
+                    {"metric": index.metric,
+                     "has_recon": index.packed_recon is not None}, arrays)
 
 
 def load(path: str) -> IvfPqIndex:
     version, meta, a = ser.load_arrays(path, "ivf_pq")
     expects(version == _SERIAL_VERSION, "unsupported ivf_pq version %d", version)
-    return IvfPqIndex(
+    index = IvfPqIndex(
         centers=jnp.asarray(a["centers"]),
         centers_rot=jnp.asarray(a["centers_rot"]),
         rotation=jnp.asarray(a["rotation"]),
@@ -513,3 +697,6 @@ def load(path: str) -> IvfPqIndex:
         packed_norms=jnp.asarray(a["packed_norms"]),
         list_sizes=jnp.asarray(a["list_sizes"]),
         metric=meta["metric"])
+    if meta.get("has_recon"):
+        index = index.replace(packed_recon=_build_recon_cache(index))
+    return index

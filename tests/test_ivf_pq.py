@@ -139,3 +139,59 @@ class TestIvfPq:
         r = make_rotation_matrix(jax.random.PRNGKey(0), 40, 32)
         np.testing.assert_allclose(np.asarray(r.T @ r), np.eye(32),
                                    atol=1e-5)
+
+class TestGroupedScanPq:
+    """List-centric batch scan must agree with the per-query path."""
+
+    def _corpus(self):
+        from raft_tpu.random import make_blobs
+        from raft_tpu.random.rng import RngState
+        x, _ = make_blobs(5000, 32, n_clusters=50, cluster_std=1.0,
+                          state=RngState(3))
+        q, _ = make_blobs(100, 32, n_clusters=50, cluster_std=1.0,
+                          state=RngState(4))
+        return np.asarray(x), np.asarray(q)
+
+    @pytest.mark.parametrize("metric", ["sqeuclidean", "inner_product"])
+    def test_grouped_matches_per_query(self, metric):
+        x, q = self._corpus()
+        idx = ivf_pq.build(jnp.asarray(x),
+                           IndexParams(n_lists=32, pq_dim=8, metric=metric,
+                                       seed=0, cache_reconstruction="never"))
+        dg, ig = ivf_pq.search(idx, jnp.asarray(q), 10,
+                               SearchParams(n_probes=16, scan_mode="grouped"))
+        dp, ip_ = ivf_pq.search(idx, jnp.asarray(q), 10,
+                                SearchParams(n_probes=16, scan_mode="per_query"))
+        np.testing.assert_allclose(np.sort(np.asarray(dg), 1),
+                                   np.sort(np.asarray(dp), 1),
+                                   rtol=1e-3, atol=1e-3)
+
+    def test_recon_cache_matches_decode(self):
+        x, q = self._corpus()
+        idx_n = ivf_pq.build(jnp.asarray(x),
+                             IndexParams(n_lists=32, pq_dim=8, seed=0,
+                                         cache_reconstruction="never"))
+        idx_c = idx_n.replace(packed_recon=ivf_pq._build_recon_cache(idx_n))
+        dn, _ = ivf_pq.search(idx_n, jnp.asarray(q), 10,
+                              SearchParams(n_probes=16, scan_mode="grouped"))
+        dc, _ = ivf_pq.search(idx_c, jnp.asarray(q), 10,
+                              SearchParams(n_probes=16, scan_mode="grouped"))
+        # bf16 cache vs f32 decode: small numeric drift allowed
+        np.testing.assert_allclose(np.sort(np.asarray(dn), 1),
+                                   np.sort(np.asarray(dc), 1),
+                                   rtol=2e-2, atol=2e-2)
+
+    def test_grouped_recall_with_refine(self):
+        from raft_tpu.neighbors import refine as rf
+        from scipy.spatial.distance import cdist
+        x, q = self._corpus()
+        idx = ivf_pq.build(jnp.asarray(x),
+                           IndexParams(n_lists=32, pq_dim=16, seed=0))
+        _, i0 = ivf_pq.search(idx, jnp.asarray(q), 40,
+                              SearchParams(n_probes=16, scan_mode="grouped"))
+        _, ids = rf.refine(jnp.asarray(x), jnp.asarray(q), i0, 10,
+                           metric="sqeuclidean")
+        full = cdist(q, x, "sqeuclidean")
+        ref = np.argsort(full, 1)[:, :10]
+        hits = sum(len(set(g) & set(r)) for g, r in zip(np.asarray(ids), ref))
+        assert hits / ref.size >= 0.9
