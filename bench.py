@@ -1,65 +1,107 @@
 #!/usr/bin/env python
 """Headline benchmark — prints ONE JSON line for the driver.
 
-Protocol (BASELINE.md): search QPS at fixed recall on the reference's ANN
-benchmark shapes. Current flagship config: brute-force kNN (L2) on
-SIFT-10K-shaped synthetic data (10K × 128, k=10, batch=10000) — BASELINE
-config 1. As the IVF/CAGRA stack lands, this graduates to IVF-PQ / CAGRA
-QPS@recall on SIFT-1M shapes.
+Protocol (BASELINE.md / docs/source/raft_ann_benchmarks.md): search QPS
+at recall@10 on SIFT-1M shapes (1M × 128 clustered synthetic, 10k
+queries, k=10, batch=10000), for the flagship ANN indexes — IVF-Flat,
+IVF-PQ (+refine) and CAGRA — via the bench harness
+(raft_tpu.bench.runner, the data_export qps/recall protocol,
+data_export/__main__.py:54-55). Groundtruth is exact brute force on
+device.
 
-``vs_baseline`` is reported as 1.0: the reference publishes plots, not
-numeric tables (BASELINE.json ``published`` is empty), so there is no
-hardware-comparable number to divide by.
+Headline ``value``: best QPS among configs reaching recall@10 ≥ 0.95
+(the BASELINE quality bar). Per-config {algo, qps, recall} rows ride in
+``detail``. ``vs_baseline`` is 1.0: the reference publishes plots, not
+numeric tables (BASELINE.json ``published`` empty), so there is no
+hardware-comparable denominator.
+
+Env: RAFT_TPU_BENCH_N / RAFT_TPU_BENCH_Q override dataset/query count
+(smoke runs); RAFT_TPU_BENCH_ALGOS comma-list restricts algos.
 """
 
 import json
+import os
 import time
 
-import jax
-import jax.numpy as jnp
-import numpy as np
+
+RECALL_BAR = 0.95
+
+
+def build_config(n: int, n_queries: int, algos):
+    index = []
+    if "ivf_flat" in algos:
+        index.append({
+            "name": "ivf_flat.n1024", "algo": "ivf_flat",
+            "build_param": {"n_lists": 1024},
+            "search_params": [{"n_probes": 32}, {"n_probes": 64}],
+        })
+    if "ivf_pq" in algos:
+        index.append({
+            "name": "ivf_pq.n1024.d64", "algo": "ivf_pq",
+            "build_param": {"n_lists": 1024, "pq_dim": 64},
+            "search_params": [{"n_probes": 64, "refine_ratio": 2}],
+        })
+    if "cagra" in algos:
+        index.append({
+            "name": "cagra.d64", "algo": "cagra",
+            "build_param": {"graph_degree": 64},
+            "search_params": [{"itopk_size": 64}],
+        })
+    if "brute_force" in algos:
+        index.append({"name": "brute_force", "algo": "brute_force",
+                      "build_param": {}, "search_params": [{}]})
+    return {
+        "dataset": {"name": f"sift-{n // 1000}k-synth", "n": n, "dim": 128,
+                    "n_queries": n_queries, "metric": "sqeuclidean"},
+        "k": 10,
+        "batch_size": 10_000,
+        "index": index,
+    }
 
 
 def main():
-    from raft_tpu.neighbors import brute_force
+    from raft_tpu.bench import runner
 
-    n, d, m, k = 10_000, 128, 10_000, 10
-    rng = np.random.default_rng(0)
-    dataset = jnp.asarray(rng.random((n, d), dtype=np.float32))
-    queries = jnp.asarray(rng.random((m, d), dtype=np.float32))
+    n = int(os.environ.get("RAFT_TPU_BENCH_N", 1_000_000))
+    n_queries = int(os.environ.get("RAFT_TPU_BENCH_Q", 10_000))
+    known = {"ivf_flat", "ivf_pq", "cagra", "brute_force"}
+    algos = [a.strip() for a in os.environ.get(
+        "RAFT_TPU_BENCH_ALGOS", "ivf_flat,ivf_pq,cagra,brute_force"
+    ).split(",") if a.strip()]
+    bad = [a for a in algos if a not in known]
+    if bad or not algos:
+        raise SystemExit(
+            f"RAFT_TPU_BENCH_ALGOS: unknown algos {bad} (known: {sorted(known)})")
 
-    index = brute_force.build(dataset, metric="sqeuclidean")
+    t0 = time.time()
+    results = runner.run_config(build_config(n, n_queries, algos),
+                                verbose=True)
+    total_s = time.time() - t0
 
-    @jax.jit
-    def search(q):
-        return brute_force.knn(index, q, k)
+    detail = [{
+        "algo": r.algo, "index": r.index_name, "qps": round(r.qps, 1),
+        "recall": round(r.recall, 4), "build_s": round(r.build_s, 2),
+        "search_param": r.search_param,
+    } for r in results]
 
-    # warmup & compile
-    dists, ids = search(queries)
-    jax.block_until_ready((dists, ids))
-
-    iters = 10
-    t0 = time.perf_counter()
-    for _ in range(iters):
-        dists, ids = search(queries)
-    jax.block_until_ready((dists, ids))
-    dt = (time.perf_counter() - t0) / iters
-    qps = m / dt
-
-    # recall sanity vs naive on a subsample (protocol: recall@10)
-    sub = 256
-    ref_d = np.asarray(
-        jnp.sum((queries[:sub, None, :] - dataset[None, :1000, :]) ** 2, axis=-1))
-    # exact check against the same first-1000 subset requires full scan; use
-    # distance agreement instead: returned dists must be sorted ascending
-    dd = np.asarray(dists[:sub])
-    assert (np.diff(np.sort(dd, 1)) >= -1e-3).all()
+    ann = [r for r in results if r.algo != "brute_force"]
+    good = [r for r in ann if r.recall >= RECALL_BAR]
+    if good:
+        best = max(good, key=lambda r: r.qps)
+        metric = f"ann_qps_at_recall{int(RECALL_BAR * 100)}_sift1m_b10000_k10"
+    else:  # quality bar missed: report best-recall ANN config, flagged
+        best = max(ann, key=lambda r: r.recall) if ann else results[0]
+        metric = "ann_qps_below_recall_bar_sift1m_b10000_k10"
 
     print(json.dumps({
-        "metric": "bruteforce_knn_qps_sift10k_b10000_k10",
-        "value": round(qps, 1),
+        "metric": metric,
+        "value": round(best.qps, 1),
         "unit": "queries/s",
         "vs_baseline": 1.0,
+        "best_algo": best.index_name,
+        "best_recall": round(best.recall, 4),
+        "total_bench_s": round(total_s, 1),
+        "detail": detail,
     }))
 
 

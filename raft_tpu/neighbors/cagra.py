@@ -68,6 +68,7 @@ class SearchParams:
     search_width: int = 4
     max_iterations: int = 0   # 0 → auto: ceil(itopk/search_width) * 2
     query_tile: int = 256
+    seed: int = 0             # entry-point sampling (rand_xor_mask analog)
 
 
 class CagraIndex(flax.struct.PyTreeNode):
@@ -95,10 +96,14 @@ class CagraIndex(flax.struct.PyTreeNode):
 # ---------------------------------------------------------------------------
 
 def build_knn_graph(dataset: jax.Array, k: int, metric: str = "sqeuclidean",
-                    seed: int = 0) -> jax.Array:
+                    seed: int = 0, search_batch: int = 16384) -> jax.Array:
     """k-NN graph via IVF-PQ self-search + exact refine
     (reference: cagra_build.cuh:89 build_knn_graph — ivf_pq::build, batched
-    search with gpu_top_k = k·refine_rate :102, refine :173)."""
+    search with gpu_top_k = k·refine_rate :102, refine :173).
+
+    The self-search runs in ``search_batch`` query chunks, as the
+    reference does: one all-rows batch would give the grouped scan an
+    O(n·n_probes/n_lists) per-list queue and blow HBM at 100k+ rows."""
     x = jnp.asarray(dataset, jnp.float32)
     n, d = x.shape
     n_lists = max(8, min(1024, int(np.sqrt(n) / 2) or 8))
@@ -109,9 +114,17 @@ def build_knn_graph(dataset: jax.Array, k: int, metric: str = "sqeuclidean",
         seed=seed))
     gpu_top_k = min(n, 2 * (k + 1))  # refine_rate 2
     n_probes = max(2, n_lists // 8)
-    _, cand = _ivf_pq.search(idx, x, gpu_top_k,
-                             _ivf_pq.SearchParams(n_probes=n_probes))
-    _, knn_ids = _refine(x, x, cand, k + 1, metric=metric)
+    sp = _ivf_pq.SearchParams(n_probes=n_probes)
+    b = min(search_batch, n)
+    knn_parts = []
+    for start in range(0, n, b):
+        q = x[start:start + b]
+        if q.shape[0] < b:  # pad the tail chunk: one compiled shape
+            q = jnp.pad(q, ((0, b - q.shape[0]), (0, 0)))
+        _, cand = _ivf_pq.search(idx, q, gpu_top_k, sp)
+        _, ids = _refine(x, q, cand, k + 1, metric=metric)
+        knn_parts.append(ids)
+    knn_ids = jnp.concatenate(knn_parts, axis=0)[:n]
     # drop self-edges: if a row's first hit is itself, skip it, else drop last
     self_col = knn_ids == jnp.arange(n, dtype=knn_ids.dtype)[:, None]
     # stable partition: non-self entries first, keep k of them
@@ -203,10 +216,10 @@ def build(dataset: jax.Array, params: Optional[IndexParams] = None) -> CagraInde
 # ---------------------------------------------------------------------------
 
 @partial(jax.jit, static_argnames=("k", "itopk_size", "search_width",
-                                   "max_iterations", "query_tile"))
+                                   "max_iterations", "query_tile", "seed"))
 def _search_impl(index: CagraIndex, queries: jax.Array, k: int,
                  itopk_size: int, search_width: int, max_iterations: int,
-                 query_tile: int, filter_bits=None):
+                 query_tile: int, seed: int = 0, filter_bits=None):
     mt = resolve_metric(index.metric)
     ip = mt == DistanceType.InnerProduct
     sqrt_out = mt == DistanceType.L2SqrtExpanded
@@ -228,13 +241,33 @@ def _search_impl(index: CagraIndex, queries: jax.Array, k: int,
             return -s
         return jnp.maximum(jnp.sum(q * q, 1)[:, None] + x_sq[ids] - 2.0 * s, 0.0)
 
-    def search_tile(q):
+    base_key = jax.random.PRNGKey(seed)
+
+    def search_tile(q, qstart):
         t = q.shape[0]
-        key = jax.random.PRNGKey(0)
-        # random entry points (reference: random_sampling of initial itopk)
-        init_ids = jax.random.choice(key, n, (itopk_size,), replace=False)
-        init_ids = jnp.broadcast_to(init_ids[None, :], (t, itopk_size))
-        buf_d = dists_to(q, init_ids)
+        # entry points are a per-QUERY pseudo-random function of (seed,
+        # global query index) — the reference hashes query id through
+        # rand_xor_mask the same way — so results are independent of query
+        # tiling and entry sets are decorrelated across queries
+        qidx = qstart + jnp.arange(t, dtype=jnp.uint32)
+        keys = jax.vmap(lambda i: jax.random.fold_in(base_key, i))(qidx)
+        # oversample 2× candidates and keep the best itopk — the
+        # reference's random_sampling makes multiple hashed draws per
+        # itopk slot the same way (compute_random_samples)
+        n_seed = 2 * itopk_size
+        init_ids = jax.vmap(
+            lambda kk: jax.random.randint(kk, (n_seed,), 0, n))(keys)
+        # sampled with replacement: demote duplicate entry slots so an id
+        # can never surface twice in the buffer
+        dup0 = jnp.any(
+            (init_ids[:, :, None] == init_ids[:, None, :])
+            & jnp.tril(jnp.ones((n_seed, n_seed), jnp.bool_), -1)[None],
+            axis=2)
+        seed_d = dists_to(q, init_ids)
+        seed_d = jnp.where(dup0, BIG, seed_d)
+        _, best = lax.top_k(-seed_d, itopk_size)
+        init_ids = jnp.take_along_axis(init_ids, best, axis=1)
+        buf_d = jnp.take_along_axis(seed_d, best, axis=1)
         if filter_bits is not None:
             from raft_tpu.neighbors.sample_filter import passes
 
@@ -313,11 +346,13 @@ def _search_impl(index: CagraIndex, queries: jax.Array, k: int,
         return out_d, out_i
 
     if m <= query_tile:
-        return search_tile(q_all)
+        return search_tile(q_all, jnp.uint32(0))
     n_tiles = -(-m // query_tile)
     pad = n_tiles * query_tile - m
     qp = jnp.pad(q_all, ((0, pad), (0, 0)))
-    vals, ids = lax.map(search_tile, qp.reshape(n_tiles, query_tile, d))
+    starts = (jnp.arange(n_tiles, dtype=jnp.uint32) * query_tile)
+    vals, ids = lax.map(lambda args: search_tile(*args),
+                        (qp.reshape(n_tiles, query_tile, d), starts))
     return vals.reshape(-1, k)[:m], ids.reshape(-1, k)[:m]
 
 
@@ -335,7 +370,8 @@ def search(index: CagraIndex, queries: jax.Array, k: int,
     itopk = max(params.itopk_size, k)
     max_it = params.max_iterations or 2 * (-(-itopk // params.search_width))
     return _search_impl(index, queries, k, itopk, params.search_width,
-                        max_it, params.query_tile, filter_bits=filter_bitset)
+                        max_it, params.query_tile, seed=params.seed,
+                        filter_bits=filter_bitset)
 
 
 # ---------------------------------------------------------------------------

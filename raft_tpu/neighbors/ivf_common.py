@@ -24,13 +24,15 @@ per batch:
 
 HBM traffic: each list block is read once per *batch* instead of once
 per *probing query* — the amortization that makes IVF beat brute force
-on TPU at large batch sizes. Queries overflowing a list's ``qmax`` queue
-slots are dropped from that one probe (bounded recall loss; sized by
-``qmax_factor`` with generous default headroom).
+on TPU at large batch sizes. ``qmax`` is sized from the actual probe
+histogram (``max_probe_load`` + ``exact_qmax``), so the scan is
+drop-free; the machinery still tolerates ``rank >= qmax`` defensively
+(those pairs come back masked invalid).
 """
 
 from __future__ import annotations
 
+from functools import partial
 from typing import Tuple
 
 import jax
@@ -91,10 +93,69 @@ def gather_pair_results(list_vals: jax.Array, list_ids: jax.Array,
 def default_qmax(batch: int, n_probes: int, n_lists: int,
                  factor: float = 4.0) -> int:
     """Queue capacity: ``factor ×`` the average queue load, padded to a
-    multiple of 8, at least 8. The default 4× headroom makes drops rare
-    even on clustered query sets (probe loads are data-dependent)."""
+    multiple of 8, at least 8. Used as the *memory budget* for the exact
+    queue size (see exact_qmax); the scan itself never drops pairs."""
     avg = batch * n_probes / max(n_lists, 1)
     return max(8, int(-(-factor * avg // 8)) * 8)
+
+
+@partial(jax.jit, static_argnames=("n_lists",))
+def max_probe_load(probes: jax.Array, n_lists: int) -> jax.Array:
+    """Largest per-list queue load of a probe table [B, P] — the exact
+    qmax needed for a drop-free grouped scan."""
+    counts = jnp.zeros((n_lists,), jnp.int32).at[
+        probes.reshape(-1)].add(1, mode="drop")
+    return jnp.max(counts)
+
+
+def exact_qmax(max_load: int) -> int:
+    """Static queue capacity covering the observed max load, rounded up
+    to a power of two (≥8) so repeated searches with similar batches hit
+    the jit cache instead of recompiling per batch."""
+    m = max(8, int(max_load))
+    return 1 << (m - 1).bit_length()
+
+
+def pack_lists(row_arrays, labels: jax.Array, row_ids: jax.Array,
+               n_lists: int, L: int, fill_values):
+    """Device-side list packing (jit-safe) — the device twin of the host
+    numpy packers in ivf_flat/ivf_pq (reference: encode+pack,
+    ivf_pq_build.cuh:1411-1432), used by the distributed SPMD build where
+    a host round-trip is impossible.
+
+    One stable sort of ``labels`` gives each row its (list, slot) address;
+    rows with ``labels >= n_lists`` (pad markers) or slot ``>= L``
+    (overflow) are dropped by the scatter's ``mode="drop"``.
+
+    Parameters
+    ----------
+    row_arrays : sequence of [n, ...] arrays to pack per-list.
+    labels : [n] int — destination list per row.
+    row_ids : [n] int32 — ids stored alongside (global ids for shards).
+    n_lists, L : static list count / padded capacity.
+    fill_values : pad value per row_array.
+
+    Returns (packed_arrays [n_lists, L, ...], ids [n_lists, L] (-1 pad),
+    sizes [n_lists] int32, n_dropped () int32 — rows lost to list
+    overflow; callers should surface it, the host packers warn).
+    """
+    n = labels.shape[0]
+    labels = labels.astype(jnp.int32)
+    order = jnp.argsort(labels, stable=True)
+    sorted_l = labels[order]
+    starts = jnp.searchsorted(sorted_l, jnp.arange(n_lists, dtype=jnp.int32))
+    rank = (jnp.arange(n, dtype=jnp.int32)
+            - starts[jnp.clip(sorted_l, 0, n_lists - 1)].astype(jnp.int32))
+    packed = []
+    for arr, fill in zip(row_arrays, fill_values):
+        out = jnp.full((n_lists, L) + arr.shape[1:], fill, arr.dtype)
+        packed.append(out.at[sorted_l, rank].set(arr[order], mode="drop"))
+    ids = jnp.full((n_lists, L), -1, jnp.int32).at[sorted_l, rank].set(
+        row_ids[order].astype(jnp.int32), mode="drop")
+    counts = jnp.zeros((n_lists,), jnp.int32).at[labels].add(1, mode="drop")
+    sizes = jnp.minimum(counts, L)
+    n_dropped = jnp.sum(counts - sizes)
+    return packed, ids, sizes, n_dropped
 
 
 def choose_list_chunk(n_lists: int, target: int) -> int:

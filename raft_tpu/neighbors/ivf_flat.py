@@ -331,19 +331,35 @@ def _search_impl(index: IvfFlatIndex, queries: jax.Array, k: int,
             ids.reshape(n_tiles * query_tile, k)[:m])
 
 
-@partial(jax.jit, static_argnames=("k", "n_probes", "qmax", "list_chunk"))
-def _search_grouped(index: IvfFlatIndex, queries: jax.Array, k: int,
-                    n_probes: int, qmax: int, list_chunk: int,
+@partial(jax.jit, static_argnames=("n_probes",))
+def _select_probes(index: IvfFlatIndex, queries: jax.Array,
+                   n_probes: int) -> jax.Array:
+    """Coarse probe selection → [B, n_probes] list ids (reference:
+    select_clusters). Split out so search() can size the grouped scan's
+    queues from the actual probe histogram before staging the scan."""
+    q_all = queries.astype(jnp.float32)
+    coarse, coarse_min = _coarse_distances(q_all, index.centers,
+                                           resolve_metric(index.metric))
+    _, probes = _select_k(coarse, n_probes, select_min=coarse_min)
+    return probes
+
+
+@partial(jax.jit, static_argnames=("k", "qmax", "list_chunk"))
+def _search_grouped(index: IvfFlatIndex, queries: jax.Array,
+                    probes: jax.Array, k: int, qmax: int, list_chunk: int,
                     filter_bits=None):
     """List-centric batch scan (see ivf_common module docstring): stream
     each list block through the MXU once per batch, queries grouped by
     probed list. TPU counterpart of the reference's interleaved scan
-    (ivf_flat_interleaved_scan-inl.cuh) with the loop order inverted."""
+    (ivf_flat_interleaved_scan-inl.cuh) with the loop order inverted.
+    ``qmax`` must cover the probe table's max per-list load (search()
+    sizes it exactly) — the scan is then drop-free."""
     from raft_tpu.neighbors import ivf_common as ic
 
     mt = resolve_metric(index.metric)
     q_all = queries.astype(jnp.float32)
     B = q_all.shape[0]
+    n_probes = probes.shape[1]
     n_lists, L, d = index.packed_data.shape
     sqrt_out = mt == DistanceType.L2SqrtExpanded
     ip = mt == DistanceType.InnerProduct
@@ -351,8 +367,6 @@ def _search_grouped(index: IvfFlatIndex, queries: jax.Array, k: int,
     select_min = not ip
     invalid = -jnp.inf if ip else jnp.inf
 
-    coarse, coarse_min = _coarse_distances(q_all, index.centers, mt)
-    _, probes = _select_k(coarse, n_probes, select_min=coarse_min)  # [B, P]
     qtable, rank = ic.invert_probes(probes, n_lists, qmax)
 
     q_sq = jnp.sum(q_all * q_all, axis=1)                 # [B]
@@ -439,11 +453,18 @@ def search(index: IvfFlatIndex, queries: jax.Array, k: int,
     if mode == "grouped":
         from raft_tpu.neighbors import ivf_common as ic
 
-        qmax = ic.default_qmax(B, n_probes, index.n_lists,
-                               params.qmax_factor)
-        chunk = ic.choose_list_chunk(index.n_lists, params.list_chunk)
-        return _search_grouped(index, queries, k, n_probes, qmax, chunk,
-                               filter_bits=filter_bitset)
+        # size the per-list queues from the ACTUAL probe histogram, so the
+        # grouped scan never drops (query, probe) pairs; a pathologically
+        # hot list (queue beyond the memory budget) falls back to the
+        # exact per_query path instead of losing recall silently
+        probes = _select_probes(index, queries, n_probes)
+        qmax = ic.exact_qmax(int(ic.max_probe_load(probes, index.n_lists)))
+        budget = ic.default_qmax(B, n_probes, index.n_lists,
+                                 max(8.0, 2.0 * params.qmax_factor))
+        if params.scan_mode == "grouped" or qmax <= max(64, budget):
+            chunk = ic.choose_list_chunk(index.n_lists, params.list_chunk)
+            return _search_grouped(index, queries, probes, k, qmax, chunk,
+                                   filter_bits=filter_bitset)
     return _search_impl(index, queries, k, n_probes, params.query_tile,
                         filter_bits=filter_bitset)
 

@@ -525,9 +525,32 @@ def _search_impl(index: IvfPqIndex, queries: jax.Array, k: int,
     return (vals.reshape(-1, k)[:m], ids.reshape(-1, k)[:m])
 
 
-@partial(jax.jit, static_argnames=("k", "n_probes", "qmax", "list_chunk"))
-def _search_grouped(index: IvfPqIndex, queries: jax.Array, k: int,
-                    n_probes: int, qmax: int, list_chunk: int,
+@partial(jax.jit, static_argnames=("n_probes",))
+def _select_probes(index: IvfPqIndex, queries: jax.Array,
+                   n_probes: int) -> jax.Array:
+    """Coarse probe selection → [B, n_probes] list ids (reference:
+    select_clusters, ivf_pq_search.cuh:70-156). Split out so search()
+    can size the grouped scan's queues from the probe histogram."""
+    mt = resolve_metric(index.metric)
+    q_all = jnp.asarray(queries, jnp.float32)
+    if mt == DistanceType.CosineExpanded:
+        q_all = q_all / jnp.sqrt(jnp.maximum(
+            jnp.sum(q_all * q_all, -1, keepdims=True), 1e-12))
+    qc = lax.dot_general(q_all, index.centers, (((1,), (1,)), ((), ())),
+                         precision=get_precision(),
+                         preferred_element_type=jnp.float32)
+    if mt in (DistanceType.InnerProduct, DistanceType.CosineExpanded):
+        _, probes = _select_k(qc, n_probes, select_min=False)
+    else:
+        c_sq = jnp.sum(index.centers**2, axis=1)
+        _, probes = _select_k(c_sq[None, :] - 2.0 * qc, n_probes,
+                              select_min=True)
+    return probes
+
+
+@partial(jax.jit, static_argnames=("k", "qmax", "list_chunk"))
+def _search_grouped(index: IvfPqIndex, queries: jax.Array,
+                    probes: jax.Array, k: int, qmax: int, list_chunk: int,
                     filter_bits=None):
     """List-centric batch scan (see ivf_common): each list's codes are
     decoded ONCE per query batch (one-hot MXU contraction — or skipped
@@ -536,7 +559,8 @@ def _search_grouped(index: IvfPqIndex, queries: jax.Array, k: int,
     Counterpart of the reference's compute_similarity kernel
     (ivf_pq_compute_similarity-inl.cuh) with the loop order inverted:
     the reference re-reads packed codes per query, this reads them per
-    batch."""
+    batch. ``qmax`` must cover the probe table's max per-list load
+    (search() sizes it exactly) — the scan is then drop-free."""
     from raft_tpu.neighbors import ivf_common as ic
 
     mt = resolve_metric(index.metric)
@@ -545,22 +569,13 @@ def _search_grouped(index: IvfPqIndex, queries: jax.Array, k: int,
         q_all = q_all / jnp.sqrt(jnp.maximum(
             jnp.sum(q_all * q_all, -1, keepdims=True), 1e-12))
     B = q_all.shape[0]
+    n_probes = probes.shape[1]
     n_lists, L, S = index.packed_codes.shape
     ip_like = mt in (DistanceType.InnerProduct, DistanceType.CosineExpanded)
     sqrt_out = mt == DistanceType.L2SqrtExpanded
     select_min = not ip_like
     invalid = -jnp.inf if ip_like else jnp.inf
 
-    # probe selection (select_clusters, ivf_pq_search.cuh:70-156)
-    qc = lax.dot_general(q_all, index.centers, (((1,), (1,)), ((), ())),
-                         precision=get_precision(),
-                         preferred_element_type=jnp.float32)
-    if ip_like:
-        _, probes = _select_k(qc, n_probes, select_min=False)
-    else:
-        c_sq = jnp.sum(index.centers**2, axis=1)
-        _, probes = _select_k(c_sq[None, :] - 2.0 * qc, n_probes,
-                              select_min=True)
     qtable, rank = ic.invert_probes(probes, n_lists, qmax)
 
     q_rot = q_all @ index.rotation.T                      # [B, rot_dim]
@@ -656,11 +671,18 @@ def search(index: IvfPqIndex, queries: jax.Array, k: int,
     if mode == "grouped":
         from raft_tpu.neighbors import ivf_common as ic
 
-        qmax = ic.default_qmax(B, n_probes, index.n_lists,
-                               params.qmax_factor)
-        chunk = ic.choose_list_chunk(index.n_lists, params.list_chunk)
-        return _search_grouped(index, queries, k, n_probes, qmax, chunk,
-                               filter_bits=filter_bitset)
+        # size the per-list queues from the ACTUAL probe histogram, so the
+        # grouped scan never drops (query, probe) pairs; a pathologically
+        # hot list (queue beyond the memory budget) falls back to the
+        # exact per_query path instead of losing recall silently
+        probes = _select_probes(index, queries, n_probes)
+        qmax = ic.exact_qmax(int(ic.max_probe_load(probes, index.n_lists)))
+        budget = ic.default_qmax(B, n_probes, index.n_lists,
+                                 max(8.0, 2.0 * params.qmax_factor))
+        if params.scan_mode == "grouped" or qmax <= max(64, budget):
+            chunk = ic.choose_list_chunk(index.n_lists, params.list_chunk)
+            return _search_grouped(index, queries, probes, k, qmax, chunk,
+                                   filter_bits=filter_bitset)
     return _search_impl(index, queries, k, n_probes, params.query_tile,
                         filter_bits=filter_bitset)
 

@@ -13,3 +13,11 @@ from raft_tpu.parallel.mesh import (  # noqa: F401
     shard_rows,
 )
 from raft_tpu.parallel.knn import replicated_knn, sharded_knn  # noqa: F401
+from raft_tpu.parallel.ivf import (  # noqa: F401
+    ShardedIvfFlat,
+    ShardedIvfPq,
+    build_ivf_flat,
+    build_ivf_pq,
+    search_ivf_flat,
+    search_ivf_pq,
+)

@@ -1,0 +1,369 @@
+"""Distributed IVF indexes — SPMD sharded build + search over a mesh.
+
+The reference's raison-d'être for its comms stack: raft-dask sharded-index
+patterns (SURVEY.md §2.15; raft_dask/common/comms.py:39) where each worker
+builds an IVF index over its shard of the dataset and search merges
+per-shard top-k candidates (``knn_merge_parts``,
+neighbors/detail/knn_merge_parts.cuh). BASELINE config 5 (sharded IVF-PQ,
+SIFT-1B on v5e-64) is this module's target shape.
+
+TPU-native structure — everything is ``shard_map`` over one mesh axis:
+
+- **coarse centers**: ONE distributed Lloyd program (local fused-L2
+  assign + ``psum``-merged centroid sums — the reference's MNMG kmeans
+  allreduce, SURVEY.md §3.5) over the row-sharded dataset, so every
+  shard trains against the *global* data distribution, not its slice;
+- **codebooks / rotation** (PQ): replicated. Codebooks train on an
+  ``all_gather``-ed cross-shard subsample (the reference also trains on
+  a trainset fraction, ivf_pq_build.cuh:1511);
+- **encode + pack**: per shard, fully on device — ``ivf_common.pack_lists``
+  (one stable sort + scatter) replaces the host packers, because inside
+  an SPMD program there is no host round-trip. Stored ids are *global*
+  row ids (shard offset baked in at build), so search needs no
+  translation step;
+- **search**: queries replicated; each shard scans its local lists with
+  the single-device search kernel, then ``all_gather`` + final select_k
+  merges candidates over ICI — the sharded brute-force pattern
+  (parallel/knn.py) applied to IVF.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Optional, Tuple
+
+import flax.struct
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax, shard_map
+from jax.sharding import Mesh, PartitionSpec as P
+
+from raft_tpu.core.errors import expects
+from raft_tpu.cluster import KMeansParams
+from raft_tpu.cluster import distributed as dkm
+from raft_tpu.distance import SELECT_MIN
+from raft_tpu.distance.fused_l2_nn import fused_l2_nn_argmin
+from raft_tpu.distance.types import DistanceType, resolve_metric
+from raft_tpu.matrix import select_k as _select_k
+from raft_tpu.neighbors import ivf_flat as _flat
+from raft_tpu.neighbors import ivf_pq as _pq
+from raft_tpu.neighbors import ivf_common as ic
+
+
+class ShardedIvfPq(flax.struct.PyTreeNode):
+    """IVF-PQ index sharded over a mesh axis: quantizers replicated,
+    packed lists carrying a leading device axis (sharded)."""
+
+    centers: jax.Array        # [n_lists, dim] replicated
+    centers_rot: jax.Array    # [n_lists, rot_dim] replicated
+    rotation: jax.Array       # [rot_dim, dim] replicated
+    codebooks: jax.Array      # [pq_dim, K, pq_len] replicated
+    packed_codes: jax.Array   # [n_dev, n_lists, L, pq_dim] u8, sharded
+    packed_ids: jax.Array     # [n_dev, n_lists, L] i32 global ids, -1 pad
+    packed_norms: jax.Array   # [n_dev, n_lists, L] f32
+    list_sizes: jax.Array     # [n_dev, n_lists] i32
+    metric: str = flax.struct.field(pytree_node=False, default="sqeuclidean")
+
+    @property
+    def n_shards(self) -> int:
+        return self.packed_codes.shape[0]
+
+    @property
+    def n_lists(self) -> int:
+        return self.centers.shape[0]
+
+    @property
+    def dim(self) -> int:
+        return self.centers.shape[1]
+
+    @property
+    def size(self) -> int:
+        return int(jnp.sum(self.list_sizes))
+
+
+class ShardedIvfFlat(flax.struct.PyTreeNode):
+    """IVF-Flat index sharded over a mesh axis (raw-vector lists)."""
+
+    centers: jax.Array       # [n_lists, dim] replicated
+    packed_data: jax.Array   # [n_dev, n_lists, L, dim] sharded
+    packed_ids: jax.Array    # [n_dev, n_lists, L] i32 global ids
+    packed_norms: jax.Array  # [n_dev, n_lists, L] f32
+    list_sizes: jax.Array    # [n_dev, n_lists] i32
+    metric: str = flax.struct.field(pytree_node=False, default="sqeuclidean")
+
+    @property
+    def n_lists(self) -> int:
+        return self.centers.shape[0]
+
+    @property
+    def dim(self) -> int:
+        return self.centers.shape[1]
+
+
+def _warn_dropped(what: str, dropped: jax.Array) -> None:
+    """Surface device-side pack overflow on the host (the host packers'
+    warn path, ivf_flat._pack_lists:134)."""
+    total = int(jnp.sum(dropped))
+    if total:
+        from raft_tpu.core import logging as _log
+        _log.warn("sharded %s build: dropped %d overflow vectors (raise "
+                  "list_size_cap_factor)", what, total)
+
+
+def _pad_shard(x: jax.Array, n_dev: int) -> Tuple[jax.Array, int]:
+    n = x.shape[0]
+    padded = -(-n // n_dev) * n_dev
+    if padded != n:
+        x = jnp.pad(x, ((0, padded - n), (0, 0)))
+    return x, n
+
+
+def _coarse_centers(n_lists: int, n_iters: int, seed: int,
+                    x: jax.Array, mesh: Mesh, axis: str,
+                    spherical: bool) -> jax.Array:
+    """Distributed Lloyd coarse fit (the reference trains kmeans_balanced
+    per ivf_pq_build.cuh:1618; distributed it becomes the MNMG psum
+    pattern). ``x`` must be UNPADDED — dkm.fit pads with zero weights
+    itself. Spherical metrics re-normalize the centers."""
+    km = KMeansParams(n_clusters=n_lists, max_iter=n_iters, seed=seed)
+    centers, _, _ = dkm.fit(km, x, mesh, axis=axis)
+    if spherical:
+        centers = centers / jnp.sqrt(
+            jnp.maximum(jnp.sum(centers**2, -1, keepdims=True), 1e-12))
+    return centers
+
+
+def _gather_trainset(x: jax.Array, mesh: Mesh, axis: str, t: int,
+                     seed: int, n_real: int) -> jax.Array:
+    """All-gather a per-shard random subsample → replicated trainset
+    [n_dev·t, d] (the PQ codebooks' trainset fraction, SURVEY §3.1).
+    Samples with replacement from each shard's *real* rows only, so the
+    zero rows `_pad_shard` appends never reach codebook training."""
+
+    def local(x_shard):
+        rank = lax.axis_index(axis)
+        shard_n = x_shard.shape[0]
+        key = jax.random.fold_in(jax.random.PRNGKey(seed), rank)
+        n_local = jnp.clip(n_real - rank * shard_n, 1, shard_n)
+        idx = jax.random.randint(key, (t,), 0, n_local)
+        sub = x_shard[idx]
+        return lax.all_gather(sub, axis).reshape(-1, x_shard.shape[1])
+
+    fn = shard_map(local, mesh=mesh, in_specs=(P(axis, None),),
+                   out_specs=P(), check_vma=False)
+    return fn(x)
+
+
+def _merge_topk(vals: jax.Array, ids: jax.Array, axis: str, m: int, k: int,
+                n_dev: int, select_min: bool) -> Tuple[jax.Array, jax.Array]:
+    """Cross-shard candidate merge: all-gather per-shard top-k over ICI,
+    final select_k (reference: knn_merge_parts.cuh). Runs inside
+    shard_map; also the epilogue of parallel/knn.py's sharded search."""
+    all_v = lax.all_gather(vals, axis)          # [n_dev, m, k]
+    all_i = lax.all_gather(ids, axis)
+    flat_v = jnp.transpose(all_v, (1, 0, 2)).reshape(m, n_dev * k)
+    flat_i = jnp.transpose(all_i, (1, 0, 2)).reshape(m, n_dev * k)
+    return _select_k(flat_v, k, select_min=select_min, input_indices=flat_i)
+
+
+def build_ivf_pq(params: _pq.IndexParams, dataset: jax.Array, mesh: Mesh,
+                 axis: str = "shard") -> ShardedIvfPq:
+    """Distributed IVF-PQ build over a row-sharded dataset.
+
+    reference: the raft-dask sharded-index pattern (each worker an
+    ivf_pq::build over its shard) with the coarse quantizer trained
+    globally (MNMG kmeans) instead of per-shard — sharper lists than the
+    reference's per-worker quantizers at zero extra comms beyond psum.
+    """
+    mt = resolve_metric(params.metric)
+    x = jnp.asarray(dataset, jnp.float32)
+    n, dim = x.shape
+    n_dev = mesh.shape[axis]
+    spherical = mt in (DistanceType.InnerProduct, DistanceType.CosineExpanded)
+    if mt == DistanceType.CosineExpanded:
+        x = x / jnp.sqrt(jnp.maximum(jnp.sum(x * x, -1, keepdims=True), 1e-12))
+
+    pq_dim = params.pq_dim or _pq._default_pq_dim(dim)
+    pq_len = -(-dim // pq_dim)
+    rot_dim = pq_dim * pq_len
+    K = 1 << params.pq_bits
+
+    # 1. global coarse centers (ONE psum Lloyd over the sharded rows;
+    #    dkm.fit zero-weights its own padding)
+    centers = _coarse_centers(params.n_lists, params.kmeans_n_iters,
+                              params.seed, x, mesh, axis, spherical)
+
+    x, n_real = _pad_shard(x, n_dev)
+    shard_n = x.shape[0] // n_dev
+
+    # 2. rotation + codebooks on a replicated cross-shard subsample sized
+    #    by kmeans_trainset_fraction (parity with the single-device build)
+    key = jax.random.PRNGKey(params.seed)
+    rotation = _pq.make_rotation_matrix(jax.random.fold_in(key, 1),
+                                        rot_dim, dim)
+    centers_rot = centers @ rotation.T
+    t = min(shard_n,
+            max(int(shard_n * params.kmeans_trainset_fraction),
+                -(-4 * K // n_dev), 256))
+    expects(t * n_dev >= K,
+            "trainset too small for pq_bits=%d: %d < %d codebook entries",
+            params.pq_bits, t * n_dev, K)
+    trainset = _gather_trainset(x, mesh, axis, t, params.seed, n_real)
+    _, tr_labels = fused_l2_nn_argmin(trainset, centers)
+    tr_res = trainset @ rotation.T - centers_rot[tr_labels]
+    sub = jnp.transpose(tr_res.reshape(-1, pq_dim, pq_len), (1, 0, 2))
+    codebooks = _pq._vmapped_lloyd(sub, K, params.kmeans_n_iters,
+                                   jax.random.fold_in(key, 2))
+
+    # 3. per-shard encode + device-side pack (global ids baked in)
+    avg = max(1, shard_n // params.n_lists)
+    L = max(8, -(-int(avg * params.list_size_cap_factor) // 8) * 8)
+    n_lists = params.n_lists
+
+    def encode_pack(x_blk, centers, centers_rot, rotation, codebooks):
+        xs = x_blk
+        rank = lax.axis_index(axis)
+        gid = rank * shard_n + jnp.arange(shard_n, dtype=jnp.int32)
+        _, labels = fused_l2_nn_argmin(xs, centers)
+        labels = jnp.where(gid < n_real, labels, n_lists)  # drop pad rows
+        safe = jnp.clip(labels, 0, n_lists - 1)
+        x_rot = xs @ rotation.T
+        codes = _pq._encode_rows(x_rot, centers_rot, safe, codebooks)
+        decoded = _pq._decode_codes(codes, codebooks)
+        recon = centers_rot[safe] + decoded
+        norms = jnp.sum(recon * recon, axis=1)
+        (pcodes, pnorms), ids, sizes, dropped = ic.pack_lists(
+            (codes, norms), labels, gid, n_lists, L,
+            (jnp.uint8(0), jnp.float32(0)))
+        return pcodes[None], ids[None], pnorms[None], sizes[None], dropped[None]
+
+    fn = shard_map(
+        encode_pack, mesh=mesh,
+        in_specs=(P(axis, None), P(), P(), P(), P()),
+        out_specs=(P(axis, None, None, None), P(axis, None, None),
+                   P(axis, None, None), P(axis, None), P(axis)),
+        check_vma=False)
+    pcodes, pids, pnorms, sizes, dropped = fn(x, centers, centers_rot,
+                                              rotation, codebooks)
+    _warn_dropped("ivf_pq", dropped)
+    return ShardedIvfPq(
+        centers=centers, centers_rot=centers_rot, rotation=rotation,
+        codebooks=codebooks, packed_codes=pcodes, packed_ids=pids,
+        packed_norms=pnorms, list_sizes=sizes, metric=mt.value)
+
+
+def search_ivf_pq(params: _pq.SearchParams, index: ShardedIvfPq,
+                  queries: jax.Array, k: int, mesh: Mesh,
+                  axis: str = "shard") -> Tuple[jax.Array, jax.Array]:
+    """Sharded IVF-PQ search: per-shard list scan + all-gather top-k merge
+    (reference: per-worker search + knn_merge_parts.cuh). Queries are
+    replicated; returns replicated (distances [m, k], global ids [m, k])."""
+    mt = resolve_metric(index.metric)
+    select_min = SELECT_MIN[mt]
+    n_probes = min(params.n_probes, index.n_lists)
+    q = jnp.asarray(queries, jnp.float32)
+    m = q.shape[0]
+    n_dev = index.n_shards
+    expects(n_dev == mesh.shape[axis],
+            "index sharded over %d devices, mesh axis has %d",
+            n_dev, mesh.shape[axis])
+
+    def local_search(codes, ids, norms, sizes, q,
+                     centers, centers_rot, rotation, codebooks):
+        local = _pq.IvfPqIndex(
+            centers=centers, centers_rot=centers_rot, rotation=rotation,
+            codebooks=codebooks, packed_codes=codes[0], packed_ids=ids[0],
+            packed_norms=norms[0], list_sizes=sizes[0], metric=index.metric)
+        vals, gids = _pq._search_impl(local, q, k, n_probes,
+                                      params.query_tile)
+        return _merge_topk(vals, gids, axis, m, k, n_dev, select_min)
+
+    fn = shard_map(
+        local_search, mesh=mesh,
+        in_specs=(P(axis, None, None, None), P(axis, None, None),
+                  P(axis, None, None), P(axis, None), P(),
+                  P(), P(), P(), P()),
+        out_specs=(P(), P()),
+        check_vma=False)
+    return fn(index.packed_codes, index.packed_ids, index.packed_norms,
+              index.list_sizes, q, index.centers, index.centers_rot,
+              index.rotation, index.codebooks)
+
+
+def build_ivf_flat(params: _flat.IndexParams, dataset: jax.Array, mesh: Mesh,
+                   axis: str = "shard") -> ShardedIvfFlat:
+    """Distributed IVF-Flat build: global coarse centers (psum Lloyd) +
+    per-shard device-side raw-vector packing."""
+    mt = resolve_metric(params.metric)
+    x = jnp.asarray(dataset, jnp.float32)
+    n, dim = x.shape
+    n_dev = mesh.shape[axis]
+    spherical = mt in (DistanceType.InnerProduct, DistanceType.CosineExpanded)
+    if mt == DistanceType.CosineExpanded:
+        x = x / jnp.sqrt(jnp.maximum(jnp.sum(x * x, -1, keepdims=True), 1e-12))
+    n_lists = params.n_lists
+
+    centers = _coarse_centers(n_lists, params.kmeans_n_iters,
+                              params.seed, x, mesh, axis, spherical)
+
+    x, n_real = _pad_shard(x, n_dev)
+    shard_n = x.shape[0] // n_dev
+
+    avg = max(1, shard_n // n_lists)
+    L = max(8, -(-int(avg * params.list_size_cap_factor) // 8) * 8)
+
+    def assign_pack(x_blk, centers):
+        rank = lax.axis_index(axis)
+        gid = rank * shard_n + jnp.arange(shard_n, dtype=jnp.int32)
+        _, labels = fused_l2_nn_argmin(x_blk, centers)
+        labels = jnp.where(gid < n_real, labels, n_lists)
+        norms = jnp.sum(x_blk * x_blk, axis=1)
+        (pdata, pnorms), ids, sizes, dropped = ic.pack_lists(
+            (x_blk, norms), labels, gid, n_lists, L,
+            (jnp.float32(0), jnp.float32(0)))
+        return pdata[None], ids[None], pnorms[None], sizes[None], dropped[None]
+
+    fn = shard_map(
+        assign_pack, mesh=mesh,
+        in_specs=(P(axis, None), P()),
+        out_specs=(P(axis, None, None, None), P(axis, None, None),
+                   P(axis, None, None), P(axis, None), P(axis)),
+        check_vma=False)
+    pdata, pids, pnorms, sizes, dropped = fn(x, centers)
+    _warn_dropped("ivf_flat", dropped)
+    return ShardedIvfFlat(centers=centers, packed_data=pdata,
+                          packed_ids=pids, packed_norms=pnorms,
+                          list_sizes=sizes, metric=mt.value)
+
+
+def search_ivf_flat(params: _flat.SearchParams, index: ShardedIvfFlat,
+                    queries: jax.Array, k: int, mesh: Mesh,
+                    axis: str = "shard") -> Tuple[jax.Array, jax.Array]:
+    """Sharded IVF-Flat search (per-shard scan + all-gather merge)."""
+    mt = resolve_metric(index.metric)
+    select_min = SELECT_MIN[mt]
+    n_probes = min(params.n_probes, index.n_lists)
+    q = jnp.asarray(queries, jnp.float32)
+    m = q.shape[0]
+    n_dev = index.packed_data.shape[0]
+    expects(n_dev == mesh.shape[axis],
+            "index sharded over %d devices, mesh axis has %d",
+            n_dev, mesh.shape[axis])
+
+    def local_search(data, ids, norms, sizes, q, centers):
+        local = _flat.IvfFlatIndex(
+            centers=centers, packed_data=data[0], packed_ids=ids[0],
+            packed_norms=norms[0], list_sizes=sizes[0], metric=index.metric)
+        vals, gids = _flat._search_impl(local, q, k, n_probes,
+                                        params.query_tile)
+        return _merge_topk(vals, gids, axis, m, k, n_dev, select_min)
+
+    fn = shard_map(
+        local_search, mesh=mesh,
+        in_specs=(P(axis, None, None, None), P(axis, None, None),
+                  P(axis, None, None), P(axis, None), P(), P()),
+        out_specs=(P(), P()),
+        check_vma=False)
+    return fn(index.packed_data, index.packed_ids, index.packed_norms,
+              index.list_sizes, q, index.centers)
