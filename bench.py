@@ -89,9 +89,12 @@ def main():
     if good:
         best = max(good, key=lambda r: r.qps)
         metric = f"ann_qps_at_recall{int(RECALL_BAR * 100)}_sift1m_b10000_k10"
-    else:  # quality bar missed: report best-recall ANN config, flagged
-        best = max(ann, key=lambda r: r.recall) if ann else results[0]
+    elif ann:  # quality bar missed: report best-recall ANN config, flagged
+        best = max(ann, key=lambda r: r.recall)
         metric = "ann_qps_below_recall_bar_sift1m_b10000_k10"
+    else:  # brute-force-only run: exact search, label it as such
+        best = results[0]
+        metric = "brute_force_qps_sift1m_b10000_k10"
 
     print(json.dumps({
         "metric": metric,

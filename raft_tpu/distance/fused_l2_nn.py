@@ -101,20 +101,55 @@ def masked_l2_nn_argmin(
     adj: jax.Array,
     group_idx: Optional[jax.Array] = None,
     sqrt: bool = False,
+    tile: int = _DEFAULT_TILE,
 ) -> Tuple[jax.Array, jax.Array]:
     """Masked L2 argmin (reference: distance/masked_nn.cuh).
 
     ``adj`` is a [m, n_groups] boolean adjacency: row i may only match
     columns whose group is admitted. ``group_idx`` maps each y row to its
     group (default: one group per y row, i.e. adj is [m, n]).
+
+    Tiled like :func:`fused_l2_nn_argmin` — a ``lax.scan`` over column
+    tiles of ``y`` with a running (min, argmin) carry, so HBM cost is
+    O(m·tile), never the full [m, n] matrix (the point of the
+    reference's masked fusion, detail/masked_distance_base.cuh).
     """
-    dists = _dist_block(
-        x.astype(jnp.float32), y.astype(jnp.float32),
-        jnp.sum(x.astype(jnp.float32) ** 2, 1), jnp.sum(y.astype(jnp.float32) ** 2, 1),
-        sqrt)
-    if group_idx is not None:
-        col_mask = jnp.take(adj, group_idx, axis=1)  # [m, n]
-    else:
-        col_mask = adj
-    dists = jnp.where(col_mask, dists, jnp.inf)
-    return jnp.min(dists, axis=1), jnp.argmin(dists, axis=1).astype(jnp.int32)
+    xf = x.astype(jnp.float32)
+    x_sq = jnp.sum(xf * xf, axis=1)
+    m = x.shape[0]
+    n, d = y.shape
+    if group_idx is None:
+        group_idx = jnp.arange(n, dtype=jnp.int32)
+
+    if n <= tile:
+        dists = _dist_block(xf, y.astype(jnp.float32), x_sq,
+                            jnp.sum(y.astype(jnp.float32) ** 2, axis=1), sqrt)
+        dists = jnp.where(jnp.take(adj, group_idx, axis=1), dists, jnp.inf)
+        return jnp.min(dists, axis=1), jnp.argmin(dists, axis=1).astype(jnp.int32)
+
+    n_tiles = -(-n // tile)
+    pad = n_tiles * tile - n
+    yp = jnp.pad(y.astype(jnp.float32), ((0, pad), (0, 0)))
+    y_blocks = yp.reshape(n_tiles, tile, d)
+    y_sq = jnp.sum(y_blocks * y_blocks, axis=2)
+    g_blocks = jnp.pad(group_idx.astype(jnp.int32), (0, pad)).reshape(
+        n_tiles, tile)
+    valid = (jnp.arange(n_tiles * tile).reshape(n_tiles, tile) < n)
+
+    def step(carry, inp):
+        best_d, best_i = carry
+        yb, yb_sq, gb, vmask, base = inp
+        dblk = _dist_block(xf, yb, x_sq, yb_sq, sqrt)
+        mask = jnp.take(adj, gb, axis=1) & vmask[None, :]  # [m, tile]
+        dblk = jnp.where(mask, dblk, jnp.inf)
+        blk_min = jnp.min(dblk, axis=1)
+        blk_arg = jnp.argmin(dblk, axis=1).astype(jnp.int32) + base
+        take = blk_min < best_d
+        return (jnp.where(take, blk_min, best_d),
+                jnp.where(take, blk_arg, best_i)), None
+
+    init = (jnp.full((m,), jnp.inf, jnp.float32), jnp.zeros((m,), jnp.int32))
+    bases = (jnp.arange(n_tiles) * tile).astype(jnp.int32)
+    (best_d, best_i), _ = lax.scan(
+        step, init, (y_blocks, y_sq, g_blocks, valid, bases))
+    return best_d, best_i

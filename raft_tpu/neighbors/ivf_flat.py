@@ -137,14 +137,18 @@ def _pack_lists(dataset: np.ndarray, labels: np.ndarray, n_lists: int,
 
 
 def _fit_list_size(counts: np.ndarray, avg: int, cap_factor: float) -> int:
-    """Padded list capacity: the actual max list size (rounded up to a
-    multiple of 128 for MXU-shaped scans), clamped by the cap factor.
+    """Padded list capacity: the actual max list size, clamped by the cap
+    factor, rounded up to a lane-friendly multiple — 128 for MXU-shaped
+    scans once lists are that big, but only a multiple of 8 below that so
+    tiny-list indexes (actual max 15 → 16, not 128) aren't padded 8×.
     Sizing to the real histogram instead of the worst-case cap is a large
     scan-FLOP saver — padding is wasted work on every probe."""
     cap = max(8, int(avg * cap_factor))
     actual = int(counts.max()) if counts.size else 8
-    size = min(cap, actual)
-    return max(8, -(-size // 128) * 128) if size > 8 else 8
+    size = max(8, min(cap, actual))
+    if size >= 128:
+        return -(-size // 128) * 128
+    return -(-size // 8) * 8
 
 
 def build(dataset: jax.Array, params: Optional[IndexParams] = None) -> IvfFlatIndex:
@@ -271,7 +275,8 @@ def _coarse_distances(q, centers, mt):
 
 @partial(jax.jit, static_argnames=("k", "n_probes", "query_tile"))
 def _search_impl(index: IvfFlatIndex, queries: jax.Array, k: int,
-                 n_probes: int, query_tile: int, filter_bits=None):
+                 n_probes: int, query_tile: int, filter_bits=None,
+                 probes=None):
     mt = resolve_metric(index.metric)
     q_all = queries.astype(jnp.float32)
     m = q_all.shape[0]
@@ -279,8 +284,9 @@ def _search_impl(index: IvfFlatIndex, queries: jax.Array, k: int,
     sqrt_out = mt == DistanceType.L2SqrtExpanded
     select_min = mt != DistanceType.InnerProduct
 
-    coarse, coarse_min = _coarse_distances(q_all, index.centers, mt)
-    _, probes = _select_k(coarse, n_probes, select_min=coarse_min)  # [m, P]
+    if probes is None:  # callers with precomputed probes pass them in
+        coarse, coarse_min = _coarse_distances(q_all, index.centers, mt)
+        _, probes = _select_k(coarse, n_probes, select_min=coarse_min)
 
     def search_tile(args):
         q, probe = args  # [t, dim], [t, P]
@@ -465,6 +471,9 @@ def search(index: IvfFlatIndex, queries: jax.Array, k: int,
             chunk = ic.choose_list_chunk(index.n_lists, params.list_chunk)
             return _search_grouped(index, queries, probes, k, qmax, chunk,
                                    filter_bits=filter_bitset)
+        # hot-list fallback: reuse the probes, don't redo coarse selection
+        return _search_impl(index, queries, k, n_probes, params.query_tile,
+                            filter_bits=filter_bitset, probes=probes)
     return _search_impl(index, queries, k, n_probes, params.query_tile,
                         filter_bits=filter_bitset)
 

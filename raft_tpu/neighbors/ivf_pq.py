@@ -354,12 +354,28 @@ def _want_recon_cache(params: IndexParams, n_lists: int, L: int,
 
 @jax.jit
 def _build_recon_cache(index: IvfPqIndex) -> jax.Array:
-    """bf16 reconstruction (c + decoded residual) of every packed slot."""
+    """bf16 reconstruction (c + decoded residual) of every packed slot.
+
+    The decode is blocked over list chunks with ``lax.map`` (mirroring
+    _encode_rows' 4096-row blocking): a single unblocked decode would
+    materialize a one-hot K× the code volume if XLA fails to fuse it —
+    near the 1 GB "auto" cache cap that is a multi-GB peak."""
+    from raft_tpu.neighbors import ivf_common as ic
+
     n_lists, L, S = index.packed_codes.shape
-    decoded = _decode_codes(index.packed_codes.reshape(n_lists * L, S),
-                            index.codebooks)
-    recon = decoded.reshape(n_lists, L, -1) + index.centers_rot[:, None, :]
-    return recon.astype(jnp.bfloat16)
+    chunk = ic.choose_list_chunk(n_lists, max(1, -(-4096 // max(L, 1))))
+    n_chunks = n_lists // chunk
+
+    def decode_chunk(args):
+        codes, crot = args
+        dec = _decode_codes(codes.reshape(chunk * L, S), index.codebooks)
+        return (dec.reshape(chunk, L, -1)
+                + crot[:, None, :]).astype(jnp.bfloat16)
+
+    out = lax.map(decode_chunk,
+                  (index.packed_codes.reshape(n_chunks, chunk, L, S),
+                   index.centers_rot.reshape(n_chunks, chunk, -1)))
+    return out.reshape(n_lists, L, -1)
 
 
 def extend(index: IvfPqIndex, new_vectors: jax.Array,
@@ -426,7 +442,8 @@ def extend(index: IvfPqIndex, new_vectors: jax.Array,
 
 @partial(jax.jit, static_argnames=("k", "n_probes", "query_tile"))
 def _search_impl(index: IvfPqIndex, queries: jax.Array, k: int,
-                 n_probes: int, query_tile: int, filter_bits=None):
+                 n_probes: int, query_tile: int, filter_bits=None,
+                 probes=None):
     mt = resolve_metric(index.metric)
     q_all = jnp.asarray(queries, jnp.float32)
     if mt == DistanceType.CosineExpanded:
@@ -439,17 +456,18 @@ def _search_impl(index: IvfPqIndex, queries: jax.Array, k: int,
     sqrt_out = mt == DistanceType.L2SqrtExpanded
     select_min = not ip_like
 
-    # probe selection on q·c (select_clusters, ivf_pq_search.cuh:70-156)
+    # probe selection on q·c (select_clusters, ivf_pq_search.cuh:70-156);
+    # qc itself is needed regardless — the ⟨q,c⟩ term of the decomposition
     qc = lax.dot_general(q_all, index.centers, (((1,), (1,)), ((), ())),
                          precision=get_precision(),
                          preferred_element_type=jnp.float32)  # [m, n_lists]
-    if ip_like:
-        coarse = qc
-        _, probes = _select_k(coarse, n_probes, select_min=False)
-    else:
-        c_sq = jnp.sum(index.centers**2, axis=1)
-        coarse = c_sq[None, :] - 2.0 * qc
-        _, probes = _select_k(coarse, n_probes, select_min=True)
+    if probes is None:
+        if ip_like:
+            _, probes = _select_k(qc, n_probes, select_min=False)
+        else:
+            c_sq = jnp.sum(index.centers**2, axis=1)
+            _, probes = _select_k(c_sq[None, :] - 2.0 * qc, n_probes,
+                                  select_min=True)
 
     q_rot_all = q_all @ index.rotation.T
     q_sq_all = jnp.sum(q_rot_all * q_rot_all, axis=1)
@@ -683,6 +701,9 @@ def search(index: IvfPqIndex, queries: jax.Array, k: int,
             chunk = ic.choose_list_chunk(index.n_lists, params.list_chunk)
             return _search_grouped(index, queries, probes, k, qmax, chunk,
                                    filter_bits=filter_bitset)
+        # hot-list fallback: reuse the probes, don't redo coarse selection
+        return _search_impl(index, queries, k, n_probes, params.query_tile,
+                            filter_bits=filter_bitset, probes=probes)
     return _search_impl(index, queries, k, n_probes, params.query_tile,
                         filter_bits=filter_bitset)
 

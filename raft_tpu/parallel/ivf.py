@@ -136,19 +136,28 @@ def _coarse_centers(n_lists: int, n_iters: int, seed: int,
 
 def _gather_trainset(x: jax.Array, mesh: Mesh, axis: str, t: int,
                      seed: int, n_real: int) -> jax.Array:
-    """All-gather a per-shard random subsample → replicated trainset
-    [n_dev·t, d] (the PQ codebooks' trainset fraction, SURVEY §3.1).
-    Samples with replacement from each shard's *real* rows only, so the
-    zero rows `_pad_shard` appends never reach codebook training."""
+    """Replicated trainset [n_dev·t, d] sampled uniformly (with
+    replacement) from the *global real* rows of the sharded dataset (the
+    PQ codebooks' trainset fraction, SURVEY §3.1).
+
+    Every shard draws the SAME global row ids (same key), keeps the ones
+    it owns, and a ``psum`` assembles the replicated result — so the zero
+    rows `_pad_shard` appends never reach codebook training (even when a
+    whole shard is padding), and the sample is uniform over the dataset
+    rather than per-shard (which would overweight short shards)."""
+    n_dev = mesh.shape[axis]
+    total = n_dev * t
 
     def local(x_shard):
         rank = lax.axis_index(axis)
         shard_n = x_shard.shape[0]
-        key = jax.random.fold_in(jax.random.PRNGKey(seed), rank)
-        n_local = jnp.clip(n_real - rank * shard_n, 1, shard_n)
-        idx = jax.random.randint(key, (t,), 0, n_local)
-        sub = x_shard[idx]
-        return lax.all_gather(sub, axis).reshape(-1, x_shard.shape[1])
+        key = jax.random.PRNGKey(seed)  # identical on every shard
+        gidx = jax.random.randint(key, (total,), 0, n_real)
+        local_idx = gidx - rank * shard_n
+        owned = (local_idx >= 0) & (local_idx < shard_n)
+        rows = x_shard[jnp.clip(local_idx, 0, shard_n - 1)]
+        contrib = jnp.where(owned[:, None], rows, 0.0)
+        return lax.psum(contrib, axis)
 
     fn = shard_map(local, mesh=mesh, in_specs=(P(axis, None),),
                    out_specs=P(), check_vma=False)

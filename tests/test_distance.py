@@ -160,6 +160,39 @@ def test_masked_l2_nn(rng):
     np.testing.assert_array_equal(np.asarray(i), full.argmin(1))
 
 
+def test_masked_l2_nn_tiled(rng):
+    """The scanned (tile < n) path must match the single-block path and
+    never pick a masked or padded column."""
+    x = rng.random((37, 16), dtype=np.float32)
+    y = rng.random((301, 16), dtype=np.float32)
+    adj = rng.random((37, 301)) < 0.2
+    adj[:, 5] = True
+    d, i = masked_l2_nn_argmin(jnp.asarray(x), jnp.asarray(y),
+                               jnp.asarray(adj), tile=64)
+    full = cdist(x, y, "sqeuclidean")
+    full[~adj] = np.inf
+    np.testing.assert_array_equal(np.asarray(i), full.argmin(1))
+    np.testing.assert_allclose(np.asarray(d), full.min(1), rtol=1e-4,
+                               atol=1e-5)
+
+
+def test_masked_l2_nn_tiled_groups(rng):
+    """Group-indexed adjacency on the tiled path (reference: masked_nn's
+    group semantics, detail/masked_distance_base.cuh)."""
+    x = rng.random((20, 8), dtype=np.float32)
+    y = rng.random((150, 8), dtype=np.float32)
+    n_groups = 6
+    gidx = rng.integers(0, n_groups, 150).astype(np.int32)
+    adj = rng.random((20, n_groups)) < 0.5
+    adj[:, 0] = True
+    col_mask = adj[:, gidx]
+    d, i = masked_l2_nn_argmin(jnp.asarray(x), jnp.asarray(y),
+                               jnp.asarray(adj), jnp.asarray(gidx), tile=64)
+    full = cdist(x, y, "sqeuclidean")
+    full[~col_mask] = np.inf
+    np.testing.assert_array_equal(np.asarray(i), full.argmin(1))
+
+
 class TestGram:
     def test_linear(self, rng):
         x, y = _data(rng)

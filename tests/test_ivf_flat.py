@@ -124,6 +124,17 @@ class TestIvfFlat:
         ref = np.argsort(full, 1)[:, :10]
         assert recall_at_k(np.asarray(ids), ref) >= 0.9
 
+    def test_fit_list_size_rounding(self):
+        """Tiny lists round to a multiple of 8, not 128 (padding is scan
+        FLOPs); big lists keep the MXU-shaped 128 rounding."""
+        fit = ivf_flat._fit_list_size
+        assert fit(np.array([15, 3, 9]), avg=9, cap_factor=4.0) == 16
+        assert fit(np.array([5, 2]), avg=3, cap_factor=4.0) == 8
+        assert fit(np.array([130, 40]), avg=85, cap_factor=4.0) == 256
+        assert fit(np.array([1000, 400]), avg=700, cap_factor=4.0) == 1024
+        # cap clamps a skew-hot list
+        assert fit(np.array([10_000, 10]), avg=100, cap_factor=4.0) == 512
+
 class TestGroupedScan:
     """The list-centric batch scan (ivf_common) must agree with the
     per-query gather path on every metric."""

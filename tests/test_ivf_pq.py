@@ -152,7 +152,8 @@ class TestGroupedScanPq:
                           state=RngState(4))
         return np.asarray(x), np.asarray(q)
 
-    @pytest.mark.parametrize("metric", ["sqeuclidean", "inner_product"])
+    @pytest.mark.parametrize(
+        "metric", ["sqeuclidean", "euclidean", "inner_product", "cosine"])
     def test_grouped_matches_per_query(self, metric):
         x, q = self._corpus()
         idx = ivf_pq.build(jnp.asarray(x),
