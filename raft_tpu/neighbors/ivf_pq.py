@@ -43,6 +43,7 @@ import numpy as np
 from jax import lax
 
 from raft_tpu.core.errors import expects
+from raft_tpu.core.tracing import traced
 from raft_tpu.core import serialize as ser
 from raft_tpu.cluster import kmeans_balanced
 from raft_tpu.cluster.kmeans_balanced import KMeansBalancedParams
@@ -50,7 +51,7 @@ from raft_tpu.distance.types import DistanceType, resolve_metric
 from raft_tpu.matrix import select_k as _select_k
 from raft_tpu.utils.precision import get_precision
 
-_SERIAL_VERSION = 1
+_SERIAL_VERSION = 2
 
 
 @dataclasses.dataclass
@@ -81,28 +82,61 @@ class SearchParams:
 
     ``scan_mode``: "grouped" is the list-centric batch scan (see
     neighbors/ivf_common.py), "per_query" the gather path for small
-    batches, "auto" picks by batch size."""
+    batches, "auto" picks by batch size.
+
+    ``lut_dtype``: dtype the query LUT is quantized to before the scan
+    contraction — the reference's ``search_params::lut_dtype`` fp8 option
+    (detail/ivf_pq_fp_8bit.cuh) trading LUT precision for on-chip
+    footprint. One of "float32" | "bfloat16" | "float8_e4m3"."""
 
     n_probes: int = 20
     query_tile: int = 64
     scan_mode: str = "auto"  # "auto" | "grouped" | "per_query"
     qmax_factor: float = 4.0
     list_chunk: int = 8
+    lut_dtype: str = "float32"
+
+
+_LUT_DTYPES = {"float32": jnp.float32, "bfloat16": jnp.bfloat16,
+               "float8_e4m3": jnp.float8_e4m3fn}
+
+
+def _quantize_lut(lut: jax.Array, lut_dtype: str) -> jax.Array:
+    """Round the query LUT to the requested storage dtype, returning it in
+    a compute-friendly dtype (fp8 simulates the reference's fp8 LUT: the
+    values are quantized, the contraction runs in bf16)."""
+    expects(lut_dtype in _LUT_DTYPES, "unknown lut_dtype %s", lut_dtype)
+    dt = _LUT_DTYPES[lut_dtype]
+    if dt == jnp.float32:
+        return lut
+    q = lut.astype(dt)
+    return q.astype(jnp.bfloat16) if dt == jnp.float8_e4m3fn else q
 
 
 class IvfPqIndex(flax.struct.PyTreeNode):
-    """IVF-PQ index (reference: ``ivf_pq::index``, ivf_pq_types.hpp)."""
+    """IVF-PQ index (reference: ``ivf_pq::index``, ivf_pq_types.hpp).
+
+    ``codebooks`` is [pq_dim, K, pq_len] for per_subspace codebooks and
+    [n_lists, K, pq_len] for per_cluster (ivf_pq_types.hpp:43,83).
+    ``packed_codes`` stores n-bit codes bit-packed into bytes — pq_bits=4
+    costs half the bytes of pq_bits=8, matching the reference's packed
+    list layout (ivf_pq_types.hpp:68)."""
 
     centers: jax.Array        # [n_lists, dim] f32 (original space)
     centers_rot: jax.Array    # [n_lists, rot_dim] f32
     rotation: jax.Array       # [rot_dim, dim] f32, orthonormal rows' columns
-    codebooks: jax.Array      # [pq_dim, 2^bits, pq_len] f32 (per-subspace)
-    packed_codes: jax.Array   # [n_lists, L, pq_dim] u8
+    codebooks: jax.Array      # [S|n_lists, 2^bits, pq_len] f32
+    packed_codes: jax.Array   # [n_lists, L, ceil(pq_dim·pq_bits/8)] u8
     packed_ids: jax.Array     # [n_lists, L] i32, -1 pad
     packed_norms: jax.Array   # [n_lists, L] f32: ‖c + decoded‖²
     list_sizes: jax.Array     # [n_lists] i32
     packed_recon: Optional[jax.Array] = None  # [n_lists, L, rot_dim] bf16 cache
     metric: str = flax.struct.field(pytree_node=False, default="sqeuclidean")
+    codebook_kind: str = flax.struct.field(pytree_node=False,
+                                           default="per_subspace")
+    pq_bits: int = flax.struct.field(pytree_node=False, default=8)
+    # 0 → derive from packed_codes (legacy byte-per-subspace layout)
+    pq_dim_static: int = flax.struct.field(pytree_node=False, default=0)
 
     @property
     def n_lists(self) -> int:
@@ -118,7 +152,7 @@ class IvfPqIndex(flax.struct.PyTreeNode):
 
     @property
     def pq_dim(self) -> int:
-        return self.codebooks.shape[0]
+        return self.pq_dim_static or self.packed_codes.shape[2]
 
     @property
     def pq_len(self) -> int:
@@ -135,6 +169,70 @@ class IvfPqIndex(flax.struct.PyTreeNode):
     @property
     def size(self) -> int:
         return int(jnp.sum(self.list_sizes))
+
+    def unpack_codes(self, packed: jax.Array) -> jax.Array:
+        """[..., nbytes] u8 → [..., pq_dim] u8 code values."""
+        return unpack_bits(packed, self.pq_dim, self.pq_bits)
+
+
+# ---------------------------------------------------------------------------
+# n-bit code packing (reference: packed n-bit lists, ivf_pq_types.hpp:68)
+# ---------------------------------------------------------------------------
+
+def packed_nbytes(pq_dim: int, pq_bits: int) -> int:
+    return (pq_dim * pq_bits + 7) // 8
+
+
+def pack_bits_np(codes: np.ndarray, pq_bits: int) -> np.ndarray:
+    """Host bit-pack [n, S] u8 code values (< 2^pq_bits) → [n, nbytes] u8."""
+    if pq_bits == 8:
+        return np.ascontiguousarray(codes, dtype=np.uint8)
+    n, S = codes.shape
+    nbytes = packed_nbytes(S, pq_bits)
+    out = np.zeros((n, nbytes), np.uint8)
+    for s in range(S):
+        byte_idx, off = divmod(s * pq_bits, 8)
+        v = codes[:, s].astype(np.uint16) << off
+        out[:, byte_idx] |= (v & 0xFF).astype(np.uint8)
+        if byte_idx + 1 < nbytes:
+            out[:, byte_idx + 1] |= (v >> 8).astype(np.uint8)
+    return out
+
+
+def pack_bits(codes: jax.Array, pq_bits: int) -> jax.Array:
+    """Device bit-pack [..., S] u8 → [..., nbytes] u8 (jit-safe; the SPMD
+    build packs on device where a host round-trip is impossible)."""
+    if pq_bits == 8:
+        return codes.astype(jnp.uint8)
+    S = codes.shape[-1]
+    nbytes = packed_nbytes(S, pq_bits)
+    acc = jnp.zeros(codes.shape[:-1] + (nbytes,), jnp.uint16)
+    for s in range(S):  # static unroll: S is a trace-time constant
+        byte_idx, off = divmod(s * pq_bits, 8)
+        v = codes[..., s].astype(jnp.uint16) << off
+        acc = acc.at[..., byte_idx].set(acc[..., byte_idx] | (v & 0xFF))
+        if byte_idx + 1 < nbytes:
+            acc = acc.at[..., byte_idx + 1].set(
+                acc[..., byte_idx + 1] | (v >> 8))
+    return acc.astype(jnp.uint8)
+
+
+def unpack_bits(packed: jax.Array, pq_dim: int, pq_bits: int) -> jax.Array:
+    """Device unpack [..., nbytes] u8 → [..., pq_dim] u8 code values.
+    Pure shift/mask VPU ops — fuses into whatever consumes the codes."""
+    if pq_bits == 8:
+        return packed
+    nbytes = packed.shape[-1]
+    s = np.arange(pq_dim)
+    byte_idx = (s * pq_bits) // 8
+    bit_off = jnp.asarray((s * pq_bits) % 8, jnp.uint16)
+    p16 = packed.astype(jnp.uint16)
+    lo = jnp.take(p16, jnp.asarray(byte_idx), axis=-1)
+    hi_idx = np.minimum(byte_idx + 1, nbytes - 1)
+    hi = jnp.take(p16, jnp.asarray(hi_idx), axis=-1)
+    hi = jnp.where(jnp.asarray(byte_idx + 1 < nbytes), hi, 0)
+    val = ((lo | (hi << 8)) >> bit_off) & ((1 << pq_bits) - 1)
+    return val.astype(jnp.uint8)
 
 
 # ---------------------------------------------------------------------------
@@ -182,6 +280,64 @@ def _vmapped_lloyd(data, k: int, n_iters: int, key):
     return jax.vmap(one)(data, keys)
 
 
+@partial(jax.jit, static_argnames=("k", "n_iters"))
+def _vmapped_lloyd_masked(data, mask, k: int, n_iters: int, key):
+    """Independent kmeans per cluster over PADDED row blocks — the
+    per_cluster codebook trainer (reference: train_per_cluster,
+    ivf_pq_build.cuh:448-492). ``mask`` zero-weights pad rows; clusters
+    with fewer than k valid rows keep their init centroids for the
+    surplus entries."""
+    C, cap, d = data.shape
+
+    def one(sub, m, subkey):
+        w = m.astype(jnp.float32)
+        p = w / jnp.maximum(jnp.sum(w), 1.0)
+        idx = jax.random.choice(subkey, cap, (k,), replace=False, p=p)
+        c0 = sub[idx]
+
+        def body(i, c):
+            d2 = (jnp.sum(sub**2, 1)[:, None] + jnp.sum(c**2, 1)[None, :]
+                  - 2.0 * sub @ c.T)
+            labels = jnp.argmin(d2, axis=1)
+            sums = jax.ops.segment_sum(sub * w[:, None], labels,
+                                       num_segments=k)
+            counts = jax.ops.segment_sum(w, labels, num_segments=k)
+            return jnp.where(counts[:, None] > 0,
+                             sums / jnp.maximum(counts[:, None], 1e-12), c)
+
+        return lax.fori_loop(0, n_iters, body, c0)
+
+    keys = jax.random.split(key, C)
+    return jax.vmap(one)(data, mask, keys)
+
+
+def _train_per_cluster(tr_res: jax.Array, tr_labels: jax.Array,
+                       n_lists: int, pq_dim: int, pq_len: int, K: int,
+                       n_iters: int, key) -> jax.Array:
+    """Per-cluster codebooks [n_lists, K, pq_len]: each cluster trains one
+    codebook over its residual sub-vectors pooled across ALL subspaces
+    (ivf_pq_types.hpp:83 PER_CLUSTER). Rows are grouped per cluster with
+    the same sort+scatter the list packers use; clusters hotter than the
+    per-cluster cap are subsampled by truncation (the trainset is already
+    a random subsample, so truncation is unbiased)."""
+    from raft_tpu.neighbors import ivf_common as ic
+
+    n_train = tr_res.shape[0]
+    flat_sub = tr_res.reshape(n_train * pq_dim, pq_len)
+    flat_lbl = jnp.repeat(tr_labels.astype(jnp.int32), pq_dim)
+    avg = max(1, (n_train * pq_dim) // max(n_lists, 1))
+    # clamp: the padded block is [n_lists, cap, pq_len] whose tiny minor
+    # dim lane-pads to 128 — an unbounded cap at large n_lists would
+    # blow HBM for no statistical gain
+    cap = min(max(2 * K, -(-4 * avg // 8) * 8), max(2 * K, 8192))
+    (packed,), _, sizes, _ = ic.pack_lists(
+        (flat_sub,), flat_lbl,
+        jnp.arange(n_train * pq_dim, dtype=jnp.int32),
+        n_lists, cap, (jnp.float32(0),))
+    mask = jnp.arange(cap)[None, :] < sizes[:, None]
+    return _vmapped_lloyd_masked(packed, mask, K, n_iters, key)
+
+
 def _encode_rows(rot_rows: jax.Array, centers_rot: jax.Array,
                  labels: jax.Array, codebooks: jax.Array,
                  block: int = 4096) -> jax.Array:
@@ -213,6 +369,68 @@ def _encode_rows(rot_rows: jax.Array, centers_rot: jax.Array,
     return out.reshape(n_blocks * block, S)[:n]
 
 
+def _encode_rows_cluster(rot_rows: jax.Array, centers_rot: jax.Array,
+                         labels: jax.Array, codebooks: jax.Array,
+                         block: int = 4096) -> jax.Array:
+    """Per-cluster encode: row i's subspaces all quantize against its
+    cluster's codebook ``codebooks[labels[i]]`` (reference: PER_CLUSTER
+    encode, ivf_pq_build.cuh). Returns codes [n, pq_dim] uint8."""
+    C, K, P = codebooks.shape
+    n = rot_rows.shape[0]
+    S = rot_rows.shape[1] // P
+
+    def encode_block(args):
+        rows, lbls = args
+        res = rows - centers_rot[lbls]
+        sub = res.reshape(res.shape[0], S, P)
+        cb = codebooks[lbls]                              # [b, K, P]
+        d2 = (jnp.sum(sub**2, -1)[..., None]
+              + jnp.sum(cb**2, -1)[:, None, :]
+              - 2.0 * jnp.einsum("bsp,bkp->bsk", sub, cb,
+                                 precision=get_precision()))
+        return jnp.argmin(d2, axis=-1).astype(jnp.uint8)
+
+    if n <= block:
+        return encode_block((rot_rows, labels))
+    n_blocks = -(-n // block)
+    pad = n_blocks * block - n
+    rows_p = jnp.pad(rot_rows, ((0, pad), (0, 0)))
+    lbls_p = jnp.pad(labels, (0, pad))
+    out = lax.map(encode_block, (rows_p.reshape(n_blocks, block, -1),
+                                 lbls_p.reshape(n_blocks, block)))
+    return out.reshape(n_blocks * block, S)[:n]
+
+
+def _decode_dtype():
+    """One-hot decode compute dtype: bf16 feeds the MXU on TPU; CPU XLA
+    doesn't fuse the one-hot, so keep exact f32 there."""
+    return jnp.float32 if jax.default_backend() == "cpu" else jnp.bfloat16
+
+
+def _decode_codes_cluster(codes: jax.Array, cb_rows: jax.Array) -> jax.Array:
+    """Per-cluster decode: codes [..., S] u8 with a MATCHING per-row
+    codebook ``cb_rows [..., K, P]`` → decoded residuals [..., S·P] f32."""
+    K, P = cb_rows.shape[-2:]
+    S = codes.shape[-1]
+    dt = _decode_dtype()
+    oh = jax.nn.one_hot(codes.astype(jnp.int32), K, dtype=dt)
+    dec = jnp.einsum("...sk,...kp->...sp", oh, cb_rows.astype(dt),
+                     preferred_element_type=jnp.float32)
+    return dec.reshape(*codes.shape[:-1], S * P)
+
+
+def _decode_lists_cluster(codes: jax.Array, cb: jax.Array) -> jax.Array:
+    """Per-cluster decode of a chunk of packed LISTS: codes [C, L, S] u8
+    with one codebook per list ``cb [C, K, P]`` → [C, L, S·P] f32 (the
+    recon cache and the grouped scan both decode in this shape)."""
+    C, L, S = codes.shape
+    dt = _decode_dtype()
+    oh = jax.nn.one_hot(codes.astype(jnp.int32), cb.shape[1], dtype=dt)
+    dec = jnp.einsum("clsk,ckp->clsp", oh, cb.astype(dt),
+                     preferred_element_type=jnp.float32)
+    return dec.reshape(C, L, -1)
+
+
 def _decode_codes(codes: jax.Array, codebooks: jax.Array) -> jax.Array:
     """codes [..., S] u8 → decoded residuals [..., S*P] f32.
 
@@ -230,15 +448,28 @@ def _decode_codes(codes: jax.Array, codebooks: jax.Array) -> jax.Array:
     return dec.reshape(*codes.shape[:-1], S * P)
 
 
+def _stable_slots(labels: np.ndarray, n_lists: int,
+                  base: Optional[np.ndarray] = None):
+    """Each row's (list, slot) address from ONE stable sort — the shared
+    core of every host packer (reference: encode+pack,
+    ivf_pq_build.cuh:1411-1432). ``base`` offsets slots by current list
+    fill (extend / chunked append). Returns (order, sorted_l, slot):
+    row ``order[i]`` goes to ``(sorted_l[i], slot[i])``."""
+    n = len(labels)
+    order = np.argsort(labels, kind="stable")
+    sorted_l = labels[order]
+    starts = np.searchsorted(sorted_l, np.arange(n_lists))
+    rank = np.arange(n) - starts[sorted_l]
+    slot = rank if base is None else base[sorted_l] + rank
+    return order, sorted_l, slot
+
+
 def _pack_codes(codes: np.ndarray, labels: np.ndarray, norms: np.ndarray,
                 n_lists: int, max_list_size: int, row_ids: np.ndarray):
     """Vectorized list packing: one argsort + fancy-indexed fill
     (reference: encode+pack, ivf_pq_build.cuh:1411-1432)."""
     n, S = codes.shape
-    order = np.argsort(labels, kind="stable")
-    sorted_labels = labels[order]
-    starts = np.searchsorted(sorted_labels, np.arange(n_lists))
-    rank = np.arange(n) - starts[sorted_labels]
+    order, sorted_labels, rank = _stable_slots(labels, n_lists)
     keep = rank < max_list_size
     dropped = int(n - keep.sum())
     packed = np.zeros((n_lists, max_list_size, S), np.uint8)
@@ -257,13 +488,65 @@ def _pack_codes(codes: np.ndarray, labels: np.ndarray, norms: np.ndarray,
     return packed, ids, pnorm, sizes
 
 
+def _train_quantizers(trainset: jax.Array, params: IndexParams, dim: int,
+                      pq_dim: int, pq_len: int, K: int, key,
+                      km: KMeansBalancedParams,
+                      max_codebook_rows: int = 1 << 16):
+    """Coarse centers + rotation + codebooks from a (sub)trainset — the
+    quantizer-training block shared by build() and build_chunked()
+    (reference: detail/ivf_pq_build.cuh:1511-1621 + :385-492).
+
+    Codebook training sees at most ``max_codebook_rows`` rows (a strided
+    subset of the already-random trainset; the coarse kmeans keeps the
+    full trainset). Beyond the statistics (≥256 samples/centroid at
+    K=256), this bounds a TPU-specific blowup: the per-subspace sample
+    [pq_dim, n, pq_len] lane-pads its tiny minor dim to 128, so an
+    uncapped 2M-row trainset at pq_len=2 would demand 64× its logical
+    size in HBM (measured: a 51 GB allocation on a 16 GB chip)."""
+    n_train = trainset.shape[0]
+    rot_dim = pq_dim * pq_len
+    centers = kmeans_balanced.fit(trainset, params.n_lists, km)
+    rotation = make_rotation_matrix(jax.random.fold_in(key, 1), rot_dim, dim)
+    centers_rot = centers @ rotation.T
+    stride = max(1, -(-n_train // max_codebook_rows))
+    tr_cb = trainset[::stride]
+    n_cb = tr_cb.shape[0]
+    cb_labels = kmeans_balanced.predict(centers, tr_cb, km)
+    tr_res = tr_cb @ rotation.T - centers_rot[cb_labels]
+    if params.codebook_kind == "per_subspace":
+        sub = jnp.transpose(tr_res.reshape(n_cb, pq_dim, pq_len), (1, 0, 2))
+        codebooks = _vmapped_lloyd(sub, K, params.kmeans_n_iters,
+                                   jax.random.fold_in(key, 2))
+    else:
+        codebooks = _train_per_cluster(
+            tr_res, cb_labels, params.n_lists, pq_dim, pq_len, K,
+            params.kmeans_n_iters, jax.random.fold_in(key, 2))
+    return centers, rotation, centers_rot, codebooks
+
+
+def _encode_with_norms(x_rot: jax.Array, centers_rot: jax.Array,
+                       labels: jax.Array, codebooks: jax.Array,
+                       codebook_kind: str):
+    """(codes [n, S] u8, ‖c + decoded‖² [n]) for either codebook kind —
+    the encode block shared by build/build_chunked/extend."""
+    if codebook_kind == "per_subspace":
+        codes = _encode_rows(x_rot, centers_rot, labels, codebooks)
+        decoded = _decode_codes(codes, codebooks)
+    else:
+        codes = _encode_rows_cluster(x_rot, centers_rot, labels, codebooks)
+        decoded = _decode_codes_cluster(codes, codebooks[labels])
+    recon = centers_rot[labels] + decoded
+    return codes, jnp.sum(recon * recon, axis=1)
+
+
+@traced("raft_tpu.ivf_pq.build")
 def build(dataset: jax.Array, params: Optional[IndexParams] = None) -> IvfPqIndex:
     """Build the index (reference: ivf_pq::build, detail/ivf_pq_build.cuh:1511)."""
     if params is None:
         params = IndexParams()
     mt = resolve_metric(params.metric)
-    expects(params.codebook_kind == "per_subspace",
-            "only per_subspace codebooks are implemented (per_cluster: TODO)")
+    expects(params.codebook_kind in ("per_subspace", "per_cluster"),
+            "codebook_kind must be per_subspace or per_cluster")
     expects(4 <= params.pq_bits <= 8, "pq_bits must be in [4, 8]")
 
     x = jnp.asarray(dataset, jnp.float32)
@@ -290,55 +573,154 @@ def build(dataset: jax.Array, params: Optional[IndexParams] = None) -> IvfPqInde
     km = KMeansBalancedParams(n_iters=params.kmeans_n_iters,
                               metric="cosine" if spherical else "l2",
                               seed=params.seed)
-    centers = kmeans_balanced.fit(trainset, params.n_lists, km)
-
-    # 2. rotation
-    rotation = make_rotation_matrix(jax.random.fold_in(key, 1), rot_dim, dim)
-    centers_rot = centers @ rotation.T
-
-    # 3. per-subspace codebooks on trainset residuals
-    tr_labels = kmeans_balanced.predict(centers, trainset, km)
-    tr_rot = trainset @ rotation.T
-    tr_res = tr_rot - centers_rot[tr_labels]
-    sub = jnp.transpose(tr_res.reshape(n_train, pq_dim, pq_len), (1, 0, 2))
-    codebooks = _vmapped_lloyd(sub, K, params.kmeans_n_iters,
-                               jax.random.fold_in(key, 2))
+    # 2.-3. coarse centers + rotation + codebooks (shared trainer)
+    centers, rotation, centers_rot, codebooks = _train_quantizers(
+        trainset, params, dim, pq_dim, pq_len, K, key, km)
 
     avg = max(1, n // params.n_lists)
+    nbytes = packed_nbytes(pq_dim, params.pq_bits)
 
     if not params.add_data_on_build:
         max_list_size = max(8, int(avg * params.list_size_cap_factor))
         return IvfPqIndex(
             centers=centers, centers_rot=centers_rot, rotation=rotation,
             codebooks=codebooks,
-            packed_codes=jnp.zeros((params.n_lists, max_list_size, pq_dim), jnp.uint8),
+            packed_codes=jnp.zeros((params.n_lists, max_list_size, nbytes), jnp.uint8),
             packed_ids=jnp.full((params.n_lists, max_list_size), -1, jnp.int32),
             packed_norms=jnp.zeros((params.n_lists, max_list_size), jnp.float32),
             list_sizes=jnp.zeros((params.n_lists,), jnp.int32),
-            metric=mt.value)
+            metric=mt.value, codebook_kind=params.codebook_kind,
+            pq_bits=params.pq_bits, pq_dim_static=pq_dim)
 
-    # 4. encode + pack all rows
+    # 4. encode + bit-pack + pack all rows into lists
     from raft_tpu.neighbors.ivf_flat import _fit_list_size
 
     labels = kmeans_balanced.predict(centers, x, km)
-    x_rot = x @ rotation.T
-    codes = _encode_rows(x_rot, centers_rot, labels, codebooks)
-    decoded = _decode_codes(codes, codebooks)
-    recon = centers_rot[labels] + decoded
-    norms = jnp.sum(recon * recon, axis=1)
+    codes, norms = _encode_with_norms(x @ rotation.T, centers_rot, labels,
+                                      codebooks, params.codebook_kind)
 
     labels_h = np.asarray(labels)
     counts = np.bincount(labels_h, minlength=params.n_lists)
     max_list_size = _fit_list_size(counts, avg, params.list_size_cap_factor)
     packed, ids, pnorm, sizes = _pack_codes(
-        np.asarray(codes), labels_h, np.asarray(norms),
-        params.n_lists, max_list_size, np.arange(n))
+        pack_bits_np(np.asarray(codes), params.pq_bits), labels_h,
+        np.asarray(norms), params.n_lists, max_list_size, np.arange(n))
     index = IvfPqIndex(
         centers=centers, centers_rot=centers_rot, rotation=rotation,
         codebooks=codebooks, packed_codes=jnp.asarray(packed),
         packed_ids=jnp.asarray(ids), packed_norms=jnp.asarray(pnorm),
-        list_sizes=jnp.asarray(sizes), metric=mt.value)
+        list_sizes=jnp.asarray(sizes), metric=mt.value,
+        codebook_kind=params.codebook_kind, pq_bits=params.pq_bits,
+        pq_dim_static=pq_dim)
     if _want_recon_cache(params, params.n_lists, max_list_size, rot_dim):
+        index = index.replace(packed_recon=_build_recon_cache(index))
+    return index
+
+
+@traced("raft_tpu.ivf_pq.build_chunked")
+def build_chunked(dataset, params: Optional[IndexParams] = None,
+                  chunk_rows: int = 1 << 18,
+                  max_train_rows: int = 1 << 21) -> IvfPqIndex:
+    """Build from a host array/memmap in O(chunk) device + host working
+    memory — the billion-scale path (reference: the bench harness's
+    memmapped BinFile + subset datasets, cpp/bench/ann/src/common/
+    dataset.hpp, and ivf_pq::build's trainset subsampling).
+
+    ``dataset`` may be a ``np.memmap`` (see bench.dataset.bin_memmap):
+    rows are touched once per pass (train-sample, label, encode), so host
+    RSS stays bounded by ``chunk_rows`` plus the packed index itself.
+    """
+    if params is None:
+        params = IndexParams()
+    mt = resolve_metric(params.metric)
+    expects(params.codebook_kind in ("per_subspace", "per_cluster"),
+            "codebook_kind must be per_subspace or per_cluster")
+    expects(4 <= params.pq_bits <= 8, "pq_bits must be in [4, 8]")
+    n, dim = dataset.shape
+    spherical = mt in (DistanceType.InnerProduct, DistanceType.CosineExpanded)
+    normalize = mt == DistanceType.CosineExpanded
+
+    def to_device(rows):
+        x = jnp.asarray(np.asarray(rows, np.float32))
+        if normalize:
+            x = x / jnp.sqrt(jnp.maximum(
+                jnp.sum(x * x, -1, keepdims=True), 1e-12))
+        return x
+
+    pq_dim = params.pq_dim or _default_pq_dim(dim)
+    pq_len = -(-dim // pq_dim)
+    rot_dim = pq_dim * pq_len
+    K = 1 << params.pq_bits
+    key = jax.random.PRNGKey(params.seed)
+
+    # 1. quantizers on a bounded random subsample (sorted: memmap locality)
+    n_train = min(n, max_train_rows,
+                  max(params.n_lists * 4, 4 * K,
+                      int(n * params.kmeans_trainset_fraction)))
+    rng = np.random.default_rng(params.seed)
+    tr_idx = np.sort(rng.choice(n, n_train, replace=False))
+    trainset = to_device(dataset[tr_idx])
+    km = KMeansBalancedParams(n_iters=params.kmeans_n_iters,
+                              metric="cosine" if spherical else "l2",
+                              seed=params.seed)
+    centers, rotation, centers_rot, codebooks = _train_quantizers(
+        trainset, params, dim, pq_dim, pq_len, K, key, km)
+    del trainset
+
+    # 2. streaming label pass → histogram → list capacity
+    from raft_tpu.neighbors.ivf_flat import _fit_list_size
+
+    from raft_tpu.core.interruptible import cancellation_point
+
+    labels = np.empty(n, np.int32)
+    for a in range(0, n, chunk_rows):
+        cancellation_point()  # chunk seams are the cancellation points
+        b = min(n, a + chunk_rows)
+        labels[a:b] = np.asarray(
+            kmeans_balanced.predict(centers, to_device(dataset[a:b]), km))
+    counts = np.bincount(labels, minlength=params.n_lists)
+    avg = max(1, n // params.n_lists)
+    L = _fit_list_size(counts, avg, params.list_size_cap_factor)
+    nbytes = packed_nbytes(pq_dim, params.pq_bits)
+
+    # 3. streaming encode + pack into the preallocated index
+    packed = np.zeros((params.n_lists, L, nbytes), np.uint8)
+    ids = np.full((params.n_lists, L), -1, np.int32)
+    pnorm = np.zeros((params.n_lists, L), np.float32)
+    cursor = np.zeros(params.n_lists, np.int64)  # next free slot per list
+    dropped = 0
+    for a in range(0, n, chunk_rows):
+        cancellation_point()
+        b = min(n, a + chunk_rows)
+        xb = to_device(dataset[a:b])
+        lb = jnp.asarray(labels[a:b])
+        codes, norms = _encode_with_norms(xb @ rotation.T, centers_rot, lb,
+                                          codebooks, params.codebook_kind)
+        codes_h = pack_bits_np(np.asarray(codes), params.pq_bits)
+        norms_h = np.asarray(norms)
+        lb_h = labels[a:b]
+        order, sorted_l, slot = _stable_slots(lb_h, params.n_lists, cursor)
+        keep = slot < L
+        dropped += int((~keep).sum())
+        rows = order[keep]
+        ls, sl = sorted_l[keep], slot[keep].astype(np.int64)
+        packed[ls, sl] = codes_h[rows]
+        ids[ls, sl] = (a + rows).astype(np.int32)
+        pnorm[ls, sl] = norms_h[rows]
+        cursor = np.minimum(
+            cursor + np.bincount(lb_h, minlength=params.n_lists), L)
+    if dropped:
+        from raft_tpu.core import logging as _log
+        _log.warn("ivf_pq chunked build: dropped %d overflow vectors", dropped)
+
+    index = IvfPqIndex(
+        centers=centers, centers_rot=centers_rot, rotation=rotation,
+        codebooks=codebooks, packed_codes=jnp.asarray(packed),
+        packed_ids=jnp.asarray(ids), packed_norms=jnp.asarray(pnorm),
+        list_sizes=jnp.asarray(np.minimum(counts, L).astype(np.int32)),
+        metric=mt.value, codebook_kind=params.codebook_kind,
+        pq_bits=params.pq_bits, pq_dim_static=pq_dim)
+    if _want_recon_cache(params, params.n_lists, L, rot_dim):
         index = index.replace(packed_recon=_build_recon_cache(index))
     return index
 
@@ -362,22 +744,33 @@ def _build_recon_cache(index: IvfPqIndex) -> jax.Array:
     near the 1 GB "auto" cache cap that is a multi-GB peak."""
     from raft_tpu.neighbors import ivf_common as ic
 
-    n_lists, L, S = index.packed_codes.shape
+    n_lists, L, nb = index.packed_codes.shape
+    S = index.pq_dim
     chunk = ic.choose_list_chunk(n_lists, max(1, -(-4096 // max(L, 1))))
     n_chunks = n_lists // chunk
+    per_cluster = index.codebook_kind == "per_cluster"
 
     def decode_chunk(args):
-        codes, crot = args
-        dec = _decode_codes(codes.reshape(chunk * L, S), index.codebooks)
-        return (dec.reshape(chunk, L, -1)
-                + crot[:, None, :]).astype(jnp.bfloat16)
+        if per_cluster:
+            codes_p, crot, cb = args
+            dec = _decode_lists_cluster(index.unpack_codes(codes_p), cb)
+        else:
+            codes_p, crot = args
+            codes = index.unpack_codes(codes_p)
+            dec = _decode_codes(codes.reshape(chunk * L, S),
+                                index.codebooks).reshape(chunk, L, -1)
+        return (dec + crot[:, None, :]).astype(jnp.bfloat16)
 
-    out = lax.map(decode_chunk,
-                  (index.packed_codes.reshape(n_chunks, chunk, L, S),
-                   index.centers_rot.reshape(n_chunks, chunk, -1)))
+    ins = (index.packed_codes.reshape(n_chunks, chunk, L, nb),
+           index.centers_rot.reshape(n_chunks, chunk, -1))
+    if per_cluster:
+        K, P = index.codebooks.shape[1:]
+        ins = ins + (index.codebooks.reshape(n_chunks, chunk, K, P),)
+    out = lax.map(decode_chunk, ins)
     return out.reshape(n_lists, L, -1)
 
 
+@traced("raft_tpu.ivf_pq.extend")
 def extend(index: IvfPqIndex, new_vectors: jax.Array,
            new_ids: Optional[jax.Array] = None) -> IvfPqIndex:
     """Append vectors (reference: ivf_pq::extend): encode against existing
@@ -393,13 +786,11 @@ def extend(index: IvfPqIndex, new_vectors: jax.Array,
         new_ids = jnp.arange(old_n, old_n + x.shape[0], dtype=jnp.int32)
 
     labels = kmeans_balanced.predict(index.centers, x, km)
-    x_rot = x @ index.rotation.T
-    codes = _encode_rows(x_rot, index.centers_rot, labels, index.codebooks)
-    decoded = _decode_codes(codes, index.codebooks)
-    recon = index.centers_rot[labels] + decoded
-    norms = jnp.sum(recon * recon, axis=1)
+    codes, norms = _encode_with_norms(x @ index.rotation.T, index.centers_rot,
+                                      labels, index.codebooks,
+                                      index.codebook_kind)
 
-    n_lists, L, S = index.packed_codes.shape
+    n_lists, L, S = index.packed_codes.shape  # S = packed bytes per row
     old_sizes = np.asarray(index.list_sizes)
     labels_h = np.asarray(labels)
     need = old_sizes + np.bincount(labels_h, minlength=n_lists)
@@ -411,13 +802,10 @@ def extend(index: IvfPqIndex, new_vectors: jax.Array,
     packed[:, :L] = np.asarray(index.packed_codes)
     ids[:, :L] = np.asarray(index.packed_ids)
     pnorm[:, :L] = np.asarray(index.packed_norms)
-    codes_h, norms_h, nid_h = np.asarray(codes), np.asarray(norms), np.asarray(new_ids)
+    codes_h = pack_bits_np(np.asarray(codes), index.pq_bits)
+    norms_h, nid_h = np.asarray(norms), np.asarray(new_ids)
     # vectorized append: slot = old_size[list] + rank within the new rows
-    order = np.argsort(labels_h, kind="stable")
-    sorted_l = labels_h[order]
-    starts = np.searchsorted(sorted_l, np.arange(n_lists))
-    rk = np.arange(len(labels_h)) - starts[sorted_l]
-    slot = old_sizes[sorted_l] + rk
+    order, sorted_l, slot = _stable_slots(labels_h, n_lists, old_sizes)
     keep = slot < new_L
     rows = order[keep]
     ls, sl = sorted_l[keep], slot[keep]
@@ -430,7 +818,9 @@ def extend(index: IvfPqIndex, new_vectors: jax.Array,
         rotation=index.rotation, codebooks=index.codebooks,
         packed_codes=jnp.asarray(packed), packed_ids=jnp.asarray(ids),
         packed_norms=jnp.asarray(pnorm),
-        list_sizes=jnp.asarray(fill.astype(np.int32)), metric=index.metric)
+        list_sizes=jnp.asarray(fill.astype(np.int32)), metric=index.metric,
+        codebook_kind=index.codebook_kind, pq_bits=index.pq_bits,
+        pq_dim_static=index.pq_dim)
     if index.packed_recon is not None:
         out = out.replace(packed_recon=_build_recon_cache(out))
     return out
@@ -440,17 +830,19 @@ def extend(index: IvfPqIndex, new_vectors: jax.Array,
 # search
 # ---------------------------------------------------------------------------
 
-@partial(jax.jit, static_argnames=("k", "n_probes", "query_tile"))
+@partial(jax.jit, static_argnames=("k", "n_probes", "query_tile",
+                                   "lut_dtype"))
 def _search_impl(index: IvfPqIndex, queries: jax.Array, k: int,
                  n_probes: int, query_tile: int, filter_bits=None,
-                 probes=None):
+                 probes=None, lut_dtype: str = "float32"):
     mt = resolve_metric(index.metric)
     q_all = jnp.asarray(queries, jnp.float32)
     if mt == DistanceType.CosineExpanded:
         q_all = q_all / jnp.sqrt(jnp.maximum(
             jnp.sum(q_all * q_all, -1, keepdims=True), 1e-12))
     m = q_all.shape[0]
-    S, K, P = index.codebooks.shape
+    S, K, P = index.pq_dim, index.pq_book_size, index.pq_len
+    per_cluster = index.codebook_kind == "per_cluster"
     L = index.max_list_size
     ip_like = mt in (DistanceType.InnerProduct, DistanceType.CosineExpanded)
     sqrt_out = mt == DistanceType.L2SqrtExpanded
@@ -476,11 +868,9 @@ def _search_impl(index: IvfPqIndex, queries: jax.Array, k: int,
     def search_tile(args):
         q_rot, probe, qc_probed, q_sq = args
         t = q_rot.shape[0]
-        # query-only LUT: ⟨q_s, cb[s,k]⟩ — one batched MXU contraction
         q_sub = q_rot.reshape(t, S, P)
-        qlut = jnp.einsum("tsp,skp->tsk", q_sub, index.codebooks,
-                          precision=get_precision())      # [t, S, K]
-        codes = index.packed_codes[probe]                 # [t, Pr, L, S]
+        codes_p = index.packed_codes[probe]               # [t, Pr, L, nb]
+        codes = index.unpack_codes(codes_p)               # [t, Pr, L, S]
         cand_ids = index.packed_ids[probe].reshape(t, n_probes * L)
         cand_norms = index.packed_norms[probe].reshape(t, n_probes * L)
         # ⟨q, d⟩: qd[t,c] = Σ_s qlut[t, s, codes[t,c,s]].  On TPU this is
@@ -490,17 +880,44 @@ def _search_impl(index: IvfPqIndex, queries: jax.Array, k: int,
         # the TPU counterpart of the reference's fused LUT scan
         # (ivf_pq_compute_similarity-inl.cuh).  CPU keeps the gather
         # (its XLA doesn't fuse the one-hot and would materialize it).
-        idx = codes.reshape(t, n_probes * L, S).astype(jnp.int32)
-        if jax.default_backend() != "cpu":
-            onehot = jax.nn.one_hot(idx, K, dtype=jnp.float32)  # [t, C, S, K]
-            qd = jnp.einsum(
-                "tcsk,tsk->tc", onehot, qlut,
-                precision=get_precision(), preferred_element_type=jnp.float32,
-            )
+        if per_cluster:
+            # LUT is per (query, probed cluster): ⟨q_s, cb[probe][k]⟩
+            cb_probed = index.codebooks[probe]            # [t, Pr, K, P]
+            lut = jnp.einsum("tsp,tjkp->tjsk", q_sub, cb_probed,
+                             precision=get_precision())   # [t, Pr, S, K]
+            lut = _quantize_lut(lut, lut_dtype)
+            if jax.default_backend() == "cpu":
+                # CPU XLA won't fuse the 5-D one-hot — gather instead
+                codes_t = jnp.transpose(codes, (0, 1, 3, 2))  # [t, Pr, S, L]
+                gath = jnp.take_along_axis(
+                    lut.astype(jnp.float32), codes_t.astype(jnp.int32),
+                    axis=3)                               # [t, Pr, S, L]
+                qd = jnp.sum(gath, axis=2).reshape(t, n_probes * L)
+            else:
+                oh = jax.nn.one_hot(codes.astype(jnp.int32), K,
+                                    dtype=jnp.float32)    # [t, Pr, L, S, K]
+                qd = jnp.einsum("tjlsk,tjsk->tjl", oh, lut,
+                                precision=get_precision(),
+                                preferred_element_type=jnp.float32
+                                ).reshape(t, n_probes * L)
         else:
-            idx_t = jnp.transpose(idx, (0, 2, 1))             # [t, S, C]
-            gath = jnp.take_along_axis(qlut, idx_t, axis=2)   # [t, S, C]
-            qd = jnp.sum(gath, axis=1)                        # [t, C]
+            # query-only LUT: ⟨q_s, cb[s,k]⟩ — one batched MXU contraction
+            qlut = jnp.einsum("tsp,skp->tsk", q_sub, index.codebooks,
+                              precision=get_precision())  # [t, S, K]
+            qlut = _quantize_lut(qlut, lut_dtype)
+            idx = codes.reshape(t, n_probes * L, S).astype(jnp.int32)
+            if jax.default_backend() != "cpu":
+                onehot = jax.nn.one_hot(idx, K, dtype=jnp.float32)
+                qd = jnp.einsum(
+                    "tcsk,tsk->tc", onehot, qlut,
+                    precision=get_precision(),
+                    preferred_element_type=jnp.float32,
+                )
+            else:
+                idx_t = jnp.transpose(idx, (0, 2, 1))           # [t, S, C]
+                gath = jnp.take_along_axis(
+                    qlut.astype(jnp.float32), idx_t, axis=2)    # [t, S, C]
+                qd = jnp.sum(gath, axis=1)                      # [t, C]
         qcand = jnp.broadcast_to(qc_probed[:, :, None],
                                  (t, n_probes, L)).reshape(t, n_probes * L)
         if ip_like:
@@ -566,10 +983,11 @@ def _select_probes(index: IvfPqIndex, queries: jax.Array,
     return probes
 
 
-@partial(jax.jit, static_argnames=("k", "qmax", "list_chunk"))
+@partial(jax.jit, static_argnames=("k", "qmax", "list_chunk", "use_pallas"))
 def _search_grouped(index: IvfPqIndex, queries: jax.Array,
-                    probes: jax.Array, k: int, qmax: int, list_chunk: int,
-                    filter_bits=None):
+                    probes: jax.Array, qtable: jax.Array, rank: jax.Array,
+                    k: int, qmax: int, list_chunk: int,
+                    use_pallas: bool = False, filter_bits=None):
     """List-centric batch scan (see ivf_common): each list's codes are
     decoded ONCE per query batch (one-hot MXU contraction — or skipped
     entirely when the bf16 reconstruction cache is present) and scanned
@@ -588,13 +1006,16 @@ def _search_grouped(index: IvfPqIndex, queries: jax.Array,
             jnp.sum(q_all * q_all, -1, keepdims=True), 1e-12))
     B = q_all.shape[0]
     n_probes = probes.shape[1]
-    n_lists, L, S = index.packed_codes.shape
+    n_lists, L, nb = index.packed_codes.shape
+    per_cluster = index.codebook_kind == "per_cluster"
     ip_like = mt in (DistanceType.InnerProduct, DistanceType.CosineExpanded)
     sqrt_out = mt == DistanceType.L2SqrtExpanded
     select_min = not ip_like
     invalid = -jnp.inf if ip_like else jnp.inf
 
-    qtable, rank = ic.invert_probes(probes, n_lists, qmax)
+    from raft_tpu.ops import pallas_kernels as _pk
+
+    use_pallas = use_pallas and index.packed_recon is not None
 
     q_rot = q_all @ index.rotation.T                      # [B, rot_dim]
     q_sq = jnp.sum(q_rot * q_rot, axis=1)
@@ -606,7 +1027,7 @@ def _search_grouped(index: IvfPqIndex, queries: jax.Array,
 
     G = list_chunk
     n_chunks = n_lists // G
-    codes_r = index.packed_codes.reshape(n_chunks, G, L, S)
+    codes_r = index.packed_codes.reshape(n_chunks, G, L, nb)
     norms_r = index.packed_norms.reshape(n_chunks, G, L)
     lids_r = index.packed_ids.reshape(n_chunks, G, L)
     valid_r = valid_full.reshape(n_chunks, G, L)
@@ -616,14 +1037,34 @@ def _search_grouped(index: IvfPqIndex, queries: jax.Array,
                else index.packed_recon.reshape(n_chunks, G, L, -1))
 
     def scan_chunk(args):
-        if recon_r is None:
-            codes, norms, lids, valid, qt, crot = args
+        if recon_r is None and per_cluster:
+            codes_p, norms, lids, valid, qt, crot, cb = args
+            decoded = _decode_lists_cluster(index.unpack_codes(codes_p), cb)
+            recon = decoded + crot[:, None, :]
+        elif recon_r is None:
+            codes_p, norms, lids, valid, qt, crot = args
+            codes = index.unpack_codes(codes_p)
             decoded = _decode_codes(codes, index.codebooks)  # [G, L, rot]
             recon = decoded + crot[:, None, :]
         else:
             recon, norms, lids, valid, qt = args
         qi = jnp.clip(qt, 0, B - 1)
         qv = q_rot[qi]                                    # [G, qmax, rot]
+        if use_pallas:
+            # fused contraction + epilogue + local top-k in VMEM over the
+            # bf16 reconstructions (reference: compute_similarity's fused
+            # block-sort top-k, ivf_pq_compute_similarity-inl.cuh:439);
+            # the l2 epilogue recomputes ‖c+d‖² from the bf16 recon —
+            # ~1e-3 relative drift vs the stored f32 norms
+            met = "ip" if ip_like else "l2"
+            mask_add = jnp.where(valid, 0.0, jnp.inf)
+            keys, pos = _pk.grouped_scan_topk(
+                qv, recon, mask_add, kk, met, interpret=not _pk._on_tpu())
+            vals = -keys if ip_like else keys
+            vals = jnp.where(pos < 0, invalid, vals)
+            cids = jax.vmap(lambda l, p: l[jnp.clip(p, 0, L - 1)])(lids, pos)
+            cids = jnp.where(pos < 0, -1, cids)
+            return vals, cids
         scores = jnp.einsum("gqd,gld->gql", qv,
                             recon.astype(jnp.float32),
                             precision=get_precision(),
@@ -643,7 +1084,11 @@ def _search_grouped(index: IvfPqIndex, queries: jax.Array,
         return vals, cids
 
     kk = min(k, L)  # a single list holds at most L candidates
-    if recon_r is None:
+    if recon_r is None and per_cluster:
+        K, P = index.codebooks.shape[1:]
+        ins = (codes_r, norms_r, lids_r, valid_r, qt_r, crot_r,
+               index.codebooks.reshape(n_chunks, G, K, P))
+    elif recon_r is None:
         ins = (codes_r, norms_r, lids_r, valid_r, qt_r, crot_r)
     else:
         ins = (recon_r, norms_r, lids_r, valid_r, qt_r)
@@ -668,6 +1113,7 @@ def _search_grouped(index: IvfPqIndex, queries: jax.Array,
     return out_vals, out_ids
 
 
+@traced("raft_tpu.ivf_pq.search")
 def search(index: IvfPqIndex, queries: jax.Array, k: int,
            params: Optional[SearchParams] = None,
            filter_bitset: Optional[jax.Array] = None) -> Tuple[jax.Array, jax.Array]:
@@ -692,20 +1138,32 @@ def search(index: IvfPqIndex, queries: jax.Array, k: int,
         # size the per-list queues from the ACTUAL probe histogram, so the
         # grouped scan never drops (query, probe) pairs; a pathologically
         # hot list (queue beyond the memory budget) falls back to the
-        # exact per_query path instead of losing recall silently
+        # exact per_query path instead of losing recall silently. One
+        # stable sort feeds the histogram, the ranks, and the queue table.
         probes = _select_probes(index, queries, n_probes)
-        qmax = ic.exact_qmax(int(ic.max_probe_load(probes, index.n_lists)))
+        max_load, sorted_l, rank_sorted, q_of, rank = ic.probe_sort(
+            probes, index.n_lists)
+        qmax = ic.exact_qmax(int(max_load))
         budget = ic.default_qmax(B, n_probes, index.n_lists,
                                  max(8.0, 2.0 * params.qmax_factor))
         if params.scan_mode == "grouped" or qmax <= max(64, budget):
+            qtable = ic.qtable_from_sort(sorted_l, rank_sorted, q_of,
+                                         index.n_lists, qmax)
             chunk = ic.choose_list_chunk(index.n_lists, params.list_chunk)
-            return _search_grouped(index, queries, probes, k, qmax, chunk,
+            from raft_tpu.ops import pallas_kernels as _pk
+
+            kk = min(k, index.max_list_size)
+            wants = _pk.pallas_grouped_wanted(
+                kk, index.max_list_size, index.rot_dim)
+            return _search_grouped(index, queries, probes, qtable, rank,
+                                   k, qmax, chunk, use_pallas=wants,
                                    filter_bits=filter_bitset)
         # hot-list fallback: reuse the probes, don't redo coarse selection
         return _search_impl(index, queries, k, n_probes, params.query_tile,
-                            filter_bits=filter_bitset, probes=probes)
+                            filter_bits=filter_bitset, probes=probes,
+                            lut_dtype=params.lut_dtype)
     return _search_impl(index, queries, k, n_probes, params.query_tile,
-                        filter_bits=filter_bitset)
+                        filter_bits=filter_bitset, lut_dtype=params.lut_dtype)
 
 
 # ---------------------------------------------------------------------------
@@ -724,22 +1182,32 @@ def save(index: IvfPqIndex, path: str) -> None:
     # the bf16 cache is derived data — rebuilt on load, never serialized
     ser.save_arrays(path, "ivf_pq", _SERIAL_VERSION,
                     {"metric": index.metric,
-                     "has_recon": index.packed_recon is not None}, arrays)
+                     "has_recon": index.packed_recon is not None,
+                     "codebook_kind": index.codebook_kind,
+                     "pq_bits": index.pq_bits,
+                     "pq_dim": index.pq_dim}, arrays)
 
 
 def load(path: str) -> IvfPqIndex:
     version, meta, a = ser.load_arrays(path, "ivf_pq")
-    expects(version == _SERIAL_VERSION, "unsupported ivf_pq version %d", version)
+    expects(version in (1, _SERIAL_VERSION),
+            "unsupported ivf_pq version %d", version)
+    # v1 files predate codebook_kind/pq_bits/packed codes: byte-per-
+    # subspace per_subspace layout, recoverable from the defaults
+    packed_codes = jnp.asarray(a["packed_codes"])
     index = IvfPqIndex(
         centers=jnp.asarray(a["centers"]),
         centers_rot=jnp.asarray(a["centers_rot"]),
         rotation=jnp.asarray(a["rotation"]),
         codebooks=jnp.asarray(a["codebooks"]),
-        packed_codes=jnp.asarray(a["packed_codes"]),
+        packed_codes=packed_codes,
         packed_ids=jnp.asarray(a["packed_ids"]),
         packed_norms=jnp.asarray(a["packed_norms"]),
         list_sizes=jnp.asarray(a["list_sizes"]),
-        metric=meta["metric"])
+        metric=meta["metric"],
+        codebook_kind=meta.get("codebook_kind", "per_subspace"),
+        pq_bits=int(meta.get("pq_bits", 8)),
+        pq_dim_static=int(meta.get("pq_dim", packed_codes.shape[2])))
     if meta.get("has_recon"):
         index = index.replace(packed_recon=_build_recon_cache(index))
     return index

@@ -59,11 +59,13 @@ class ShardedIvfPq(flax.struct.PyTreeNode):
     centers_rot: jax.Array    # [n_lists, rot_dim] replicated
     rotation: jax.Array       # [rot_dim, dim] replicated
     codebooks: jax.Array      # [pq_dim, K, pq_len] replicated
-    packed_codes: jax.Array   # [n_dev, n_lists, L, pq_dim] u8, sharded
+    packed_codes: jax.Array   # [n_dev, n_lists, L, nbytes] u8, sharded
     packed_ids: jax.Array     # [n_dev, n_lists, L] i32 global ids, -1 pad
     packed_norms: jax.Array   # [n_dev, n_lists, L] f32
     list_sizes: jax.Array     # [n_dev, n_lists] i32
     metric: str = flax.struct.field(pytree_node=False, default="sqeuclidean")
+    pq_bits: int = flax.struct.field(pytree_node=False, default=8)
+    pq_dim: int = flax.struct.field(pytree_node=False, default=0)
 
     @property
     def n_shards(self) -> int:
@@ -186,6 +188,8 @@ def build_ivf_pq(params: _pq.IndexParams, dataset: jax.Array, mesh: Mesh,
     reference's per-worker quantizers at zero extra comms beyond psum.
     """
     mt = resolve_metric(params.metric)
+    expects(params.codebook_kind == "per_subspace",
+            "distributed build supports per_subspace codebooks")
     x = jnp.asarray(dataset, jnp.float32)
     n, dim = x.shape
     n_dev = mesh.shape[axis]
@@ -242,8 +246,9 @@ def build_ivf_pq(params: _pq.IndexParams, dataset: jax.Array, mesh: Mesh,
         decoded = _pq._decode_codes(codes, codebooks)
         recon = centers_rot[safe] + decoded
         norms = jnp.sum(recon * recon, axis=1)
+        codes_p = _pq.pack_bits(codes, params.pq_bits)  # n-bit device pack
         (pcodes, pnorms), ids, sizes, dropped = ic.pack_lists(
-            (codes, norms), labels, gid, n_lists, L,
+            (codes_p, norms), labels, gid, n_lists, L,
             (jnp.uint8(0), jnp.float32(0)))
         return pcodes[None], ids[None], pnorms[None], sizes[None], dropped[None]
 
@@ -259,7 +264,8 @@ def build_ivf_pq(params: _pq.IndexParams, dataset: jax.Array, mesh: Mesh,
     return ShardedIvfPq(
         centers=centers, centers_rot=centers_rot, rotation=rotation,
         codebooks=codebooks, packed_codes=pcodes, packed_ids=pids,
-        packed_norms=pnorms, list_sizes=sizes, metric=mt.value)
+        packed_norms=pnorms, list_sizes=sizes, metric=mt.value,
+        pq_bits=params.pq_bits, pq_dim=pq_dim)
 
 
 def search_ivf_pq(params: _pq.SearchParams, index: ShardedIvfPq,
@@ -283,9 +289,11 @@ def search_ivf_pq(params: _pq.SearchParams, index: ShardedIvfPq,
         local = _pq.IvfPqIndex(
             centers=centers, centers_rot=centers_rot, rotation=rotation,
             codebooks=codebooks, packed_codes=codes[0], packed_ids=ids[0],
-            packed_norms=norms[0], list_sizes=sizes[0], metric=index.metric)
+            packed_norms=norms[0], list_sizes=sizes[0], metric=index.metric,
+            pq_bits=index.pq_bits, pq_dim_static=index.pq_dim)
         vals, gids = _pq._search_impl(local, q, k, n_probes,
-                                      params.query_tile)
+                                      params.query_tile,
+                                      lut_dtype=params.lut_dtype)
         return _merge_topk(vals, gids, axis, m, k, n_dev, select_min)
 
     fn = shard_map(
