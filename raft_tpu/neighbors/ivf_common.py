@@ -39,6 +39,43 @@ import jax
 import jax.numpy as jnp
 
 
+@partial(jax.jit, static_argnames=("n_lists",))
+def probe_sort(probes: jax.Array, n_lists: int):
+    """One stable sort of the flattened probe table, shared by everything
+    downstream: the per-list load histogram (max_load → qmax), the
+    pair-order ranks, and the qtable scatter. Splitting this qmax-
+    independent work out means the host sync that picks the static qmax
+    costs one cheap ``max`` instead of a separate scatter-add histogram
+    (TPU scatters are serial — the bincount approach measured ~100 ms at
+    B=10k on a v5e chip, the sort pipeline amortizes it to ~0).
+
+    Returns (max_load [], sorted_l [B·P], rank_sorted [B·P], q_of [B·P],
+    rank [B, P]).
+    """
+    B, P = probes.shape
+    l_flat = probes.reshape(-1).astype(jnp.int32)
+    order = jnp.argsort(l_flat, stable=True)
+    sorted_l = l_flat[order]
+    starts = jnp.searchsorted(sorted_l, jnp.arange(n_lists, dtype=jnp.int32))
+    rank_sorted = (jnp.arange(B * P, dtype=jnp.int32)
+                   - starts[sorted_l].astype(jnp.int32))
+    counts = jnp.diff(jnp.append(starts, B * P))
+    max_load = jnp.max(counts)
+    # back to pair order (small scatter: B·P elements)
+    rank = jnp.zeros((B * P,), jnp.int32).at[order].set(rank_sorted)
+    q_of = (order // P).astype(jnp.int32)
+    return max_load, sorted_l, rank_sorted, q_of, rank.reshape(B, P)
+
+
+@partial(jax.jit, static_argnames=("n_lists", "qmax"))
+def qtable_from_sort(sorted_l: jax.Array, rank_sorted: jax.Array,
+                     q_of: jax.Array, n_lists: int, qmax: int) -> jax.Array:
+    """Scatter the sorted probe pairs into the [n_lists, qmax] queue table
+    (the only qmax-dependent step; see probe_sort)."""
+    qtable = jnp.full((n_lists, qmax), -1, jnp.int32)
+    return qtable.at[sorted_l, rank_sorted].set(q_of, mode="drop")
+
+
 def invert_probes(probes: jax.Array, n_lists: int, qmax: int
                   ) -> Tuple[jax.Array, jax.Array]:
     """Invert queries→lists probes into per-list query queues.
@@ -55,19 +92,8 @@ def invert_probes(probes: jax.Array, n_lists: int, qmax: int
     rank : [B, P] int32 — each (query, probe) pair's slot in its list's
         queue; ``rank >= qmax`` marks a dropped pair.
     """
-    B, P = probes.shape
-    l_flat = probes.reshape(-1).astype(jnp.int32)
-    order = jnp.argsort(l_flat, stable=True)
-    sorted_l = l_flat[order]
-    starts = jnp.searchsorted(sorted_l, jnp.arange(n_lists, dtype=jnp.int32))
-    rank_sorted = (jnp.arange(B * P, dtype=jnp.int32)
-                   - starts[sorted_l].astype(jnp.int32))
-    # back to pair order (small scatter: B·P elements)
-    rank = jnp.zeros((B * P,), jnp.int32).at[order].set(rank_sorted)
-    q_of = (order // P).astype(jnp.int32)
-    qtable = jnp.full((n_lists, qmax), -1, jnp.int32)
-    qtable = qtable.at[sorted_l, rank_sorted].set(q_of, mode="drop")
-    return qtable, rank.reshape(B, P)
+    _, sorted_l, rank_sorted, q_of, rank = probe_sort(probes, n_lists)
+    return qtable_from_sort(sorted_l, rank_sorted, q_of, n_lists, qmax), rank
 
 
 def gather_pair_results(list_vals: jax.Array, list_ids: jax.Array,
@@ -99,13 +125,10 @@ def default_qmax(batch: int, n_probes: int, n_lists: int,
     return max(8, int(-(-factor * avg // 8)) * 8)
 
 
-@partial(jax.jit, static_argnames=("n_lists",))
 def max_probe_load(probes: jax.Array, n_lists: int) -> jax.Array:
     """Largest per-list queue load of a probe table [B, P] — the exact
-    qmax needed for a drop-free grouped scan."""
-    counts = jnp.zeros((n_lists,), jnp.int32).at[
-        probes.reshape(-1)].add(1, mode="drop")
-    return jnp.max(counts)
+    qmax needed for a drop-free grouped scan (sort-based; see probe_sort)."""
+    return probe_sort(probes, n_lists)[0]
 
 
 def exact_qmax(max_load: int) -> int:

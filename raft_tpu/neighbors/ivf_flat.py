@@ -37,6 +37,7 @@ import numpy as np
 from jax import lax
 
 from raft_tpu.core.errors import expects
+from raft_tpu.core.tracing import traced
 from raft_tpu.core import serialize as ser
 from raft_tpu.cluster import kmeans_balanced
 from raft_tpu.cluster.kmeans_balanced import KMeansBalancedParams
@@ -151,6 +152,7 @@ def _fit_list_size(counts: np.ndarray, avg: int, cap_factor: float) -> int:
     return -(-size // 8) * 8
 
 
+@traced("raft_tpu.ivf_flat.build")
 def build(dataset: jax.Array, params: Optional[IndexParams] = None) -> IvfFlatIndex:
     """Build the index (reference: ivf_flat::build, ivf_flat-inl.cuh:65):
     balanced-kmeans coarse fit on a trainset subsample, assign all rows,
@@ -206,6 +208,7 @@ def build(dataset: jax.Array, params: Optional[IndexParams] = None) -> IvfFlatIn
                         list_sizes=jnp.asarray(sizes), metric=mt.value)
 
 
+@traced("raft_tpu.ivf_flat.extend")
 def extend(index: IvfFlatIndex, new_vectors: jax.Array,
            new_ids: Optional[jax.Array] = None) -> IvfFlatIndex:
     """Append vectors (reference: ivf_flat::extend). Host-side re-pack with
@@ -350,16 +353,20 @@ def _select_probes(index: IvfFlatIndex, queries: jax.Array,
     return probes
 
 
-@partial(jax.jit, static_argnames=("k", "qmax", "list_chunk"))
+@partial(jax.jit, static_argnames=("k", "qmax", "list_chunk", "use_pallas"))
 def _search_grouped(index: IvfFlatIndex, queries: jax.Array,
-                    probes: jax.Array, k: int, qmax: int, list_chunk: int,
-                    filter_bits=None):
+                    probes: jax.Array, qtable: jax.Array, rank: jax.Array,
+                    k: int, qmax: int, list_chunk: int,
+                    use_pallas: bool = False, filter_bits=None):
     """List-centric batch scan (see ivf_common module docstring): stream
     each list block through the MXU once per batch, queries grouped by
     probed list. TPU counterpart of the reference's interleaved scan
     (ivf_flat_interleaved_scan-inl.cuh) with the loop order inverted.
-    ``qmax`` must cover the probe table's max per-list load (search()
-    sizes it exactly) — the scan is then drop-free."""
+    ``qtable``/``rank`` come from the probe inversion (ivf_common) —
+    computed by search() so their sort is shared with the qmax sizing;
+    ``qmax`` covers the max per-list load, making the scan drop-free.
+    ``use_pallas`` (static) routes the per-chunk scan to the fused
+    Pallas kernel."""
     from raft_tpu.neighbors import ivf_common as ic
 
     mt = resolve_metric(index.metric)
@@ -372,8 +379,6 @@ def _search_grouped(index: IvfFlatIndex, queries: jax.Array,
     cos = mt == DistanceType.CosineExpanded
     select_min = not ip
     invalid = -jnp.inf if ip else jnp.inf
-
-    qtable, rank = ic.invert_probes(probes, n_lists, qmax)
 
     q_sq = jnp.sum(q_all * q_all, axis=1)                 # [B]
     qn = jnp.sqrt(jnp.maximum(q_sq, 1e-30))
@@ -391,10 +396,26 @@ def _search_grouped(index: IvfFlatIndex, queries: jax.Array,
     valid_r = valid_full.reshape(n_chunks, G, L)
     qt_r = qtable.reshape(n_chunks, G, qmax)
 
+    from raft_tpu.ops import pallas_kernels as _pk
+
     def scan_chunk(args):
         data, norms, lids, valid, qt = args
         qi = jnp.clip(qt, 0, B - 1)                       # [G, qmax]
         qv = q_all[qi]                                    # [G, qmax, d]
+        if use_pallas:
+            # fused contraction + epilogue + local top-k in VMEM — the
+            # [G·qmax, L] distance block never reaches HBM (reference:
+            # the fused scan kernels, ivf_flat_interleaved_scan-inl.cuh)
+            met = "ip" if ip else ("cos" if cos else "l2")
+            mask_add = jnp.where(valid, 0.0, jnp.inf)
+            keys, pos = _pk.grouped_scan_topk(
+                qv, data.astype(jnp.float32), mask_add, kk, met,
+                interpret=not _pk._on_tpu())
+            vals = -keys if ip else keys
+            vals = jnp.where(pos < 0, invalid, vals)
+            cids = jax.vmap(lambda l, p: l[jnp.clip(p, 0, L - 1)])(lids, pos)
+            cids = jnp.where(pos < 0, -1, cids)
+            return vals, cids
         scores = jnp.einsum("gqd,gld->gql", qv, data.astype(jnp.float32),
                             precision=get_precision(),
                             preferred_element_type=jnp.float32)
@@ -435,6 +456,7 @@ def _search_grouped(index: IvfFlatIndex, queries: jax.Array,
     return out_vals, out_ids
 
 
+@traced("raft_tpu.ivf_flat.search")
 def search(index: IvfFlatIndex, queries: jax.Array, k: int,
            params: Optional[SearchParams] = None,
            filter_bitset: Optional[jax.Array] = None) -> Tuple[jax.Array, jax.Array]:
@@ -462,14 +484,25 @@ def search(index: IvfFlatIndex, queries: jax.Array, k: int,
         # size the per-list queues from the ACTUAL probe histogram, so the
         # grouped scan never drops (query, probe) pairs; a pathologically
         # hot list (queue beyond the memory budget) falls back to the
-        # exact per_query path instead of losing recall silently
+        # exact per_query path instead of losing recall silently. One
+        # stable sort feeds the histogram, the ranks, and the queue table.
         probes = _select_probes(index, queries, n_probes)
-        qmax = ic.exact_qmax(int(ic.max_probe_load(probes, index.n_lists)))
+        max_load, sorted_l, rank_sorted, q_of, rank = ic.probe_sort(
+            probes, index.n_lists)
+        qmax = ic.exact_qmax(int(max_load))
         budget = ic.default_qmax(B, n_probes, index.n_lists,
                                  max(8.0, 2.0 * params.qmax_factor))
         if params.scan_mode == "grouped" or qmax <= max(64, budget):
+            qtable = ic.qtable_from_sort(sorted_l, rank_sorted, q_of,
+                                         index.n_lists, qmax)
             chunk = ic.choose_list_chunk(index.n_lists, params.list_chunk)
-            return _search_grouped(index, queries, probes, k, qmax, chunk,
+            from raft_tpu.ops import pallas_kernels as _pk
+
+            kk = min(k, index.packed_data.shape[1])
+            wants = _pk.pallas_grouped_wanted(
+                kk, index.packed_data.shape[1], index.dim)
+            return _search_grouped(index, queries, probes, qtable, rank,
+                                   k, qmax, chunk, use_pallas=wants,
                                    filter_bits=filter_bitset)
         # hot-list fallback: reuse the probes, don't redo coarse selection
         return _search_impl(index, queries, k, n_probes, params.query_tile,

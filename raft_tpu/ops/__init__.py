@@ -1,3 +1,7 @@
 """Pallas TPU kernels for hot primitives (SURVEY.md §7)."""
 
-from .pallas_kernels import fused_l2_argmin, select_k_pallas  # noqa: F401
+from .pallas_kernels import (  # noqa: F401
+    fused_l2_argmin,
+    grouped_scan_topk,
+    select_k_pallas,
+)

@@ -197,6 +197,138 @@ def _select_k_kernel(scores_ref, nvalid_ref, vals_ref, idx_ref, *, k: int,
     idx_ref[:] = out_i
 
 
+# ---------------------------------------------------------------------------
+# grouped IVF list scan: contraction + metric epilogue + local top-k, fused
+# ---------------------------------------------------------------------------
+
+def _grouped_scan_kernel(qv_ref, data_ref, mask_ref, vals_ref, pos_ref, *,
+                         kk: int, metric: str):
+    """One (list, query-tile) program: [bq, d] × [d, Lp] on the MXU, the
+    metric epilogue on the VPU, and a kk-round running extraction — the
+    [bq, Lp] distance block lives and dies in VMEM.  Counterpart of the
+    reference's fused scan+top-k kernels
+    (ivf_flat_interleaved_scan-inl.cuh; ivf_pq_compute_similarity-inl.cuh
+    manage_local_topk :439).  All metrics are minimized: ip keys are
+    negated scores (caller restores sign)."""
+    qv = qv_ref[0]                                  # [bq, dpad] f32
+    data = data_ref[0].astype(jnp.float32)          # [Lp, dpad]
+    mask = mask_ref[0]                              # [1, Lp]
+    s = jax.lax.dot_general(
+        qv, data, (((1,), (1,)), ((), ())),
+        precision=jax.lax.Precision.HIGHEST,
+        preferred_element_type=jnp.float32)         # [bq, Lp]
+    if metric == "ip":
+        dist = -s
+    else:
+        qsq = jnp.sum(qv * qv, axis=1)              # [bq]
+        nsq = jnp.sum(data * data, axis=1)          # [Lp]
+        if metric == "cos":
+            qn = jax.lax.rsqrt(jnp.maximum(qsq, 1e-30))
+            cn = jax.lax.rsqrt(jnp.maximum(nsq, 1e-30))
+            dist = 1.0 - s * qn[:, None] * cn[None, :]
+        else:  # l2
+            dist = jnp.maximum(qsq[:, None] + nsq[None, :] - 2.0 * s, 0.0)
+    dist = dist + mask                              # [1, Lp] broadcast: +inf invalid
+
+    bq = dist.shape[0]
+    kpad = vals_ref.shape[2]
+    out_v = jnp.full((bq, kpad), jnp.inf, jnp.float32)
+    out_i = jnp.full((bq, kpad), -1, jnp.int32)
+    out_cols = jax.lax.broadcasted_iota(jnp.int32, (bq, kpad), 1)
+    col = jax.lax.broadcasted_iota(jnp.int32, dist.shape, 1)
+    for j in range(kk):  # static unroll (see _select_k_kernel)
+        mn = jnp.min(dist, axis=1)
+        am = jnp.argmin(dist, axis=1)
+        out_v = jnp.where(out_cols == j, mn[:, None], out_v)
+        out_i = jnp.where(out_cols == j, am[:, None], out_i)
+        # knock out the extracted entry for the next round
+        dist = jnp.where(col == am[:, None], jnp.inf, dist)
+    vals_ref[0] = out_v
+    pos_ref[0] = out_i
+
+
+# VMEM working-set budget for one grouped-scan program (of ~16 MB/core):
+# list block [Lp, dpad] f32 + distance block [bq, Lp] f32 + small operands.
+_GROUPED_VMEM_BUDGET = 12 * 1024 * 1024
+
+
+def pallas_grouped_wanted(kk: int, L: int = 0, d: int = 0,
+                          bq: int = 128) -> bool:
+    """Dispatch: use the fused grouped-scan kernel on TPU for small kk
+    (the extraction loop is kk VPU rounds) when one program's VMEM
+    working set — padded list block + distance block — fits the budget;
+    otherwise the XLA grouped path (which tiles freely) handles it.
+    ``RAFT_TPU_PALLAS_GROUPED`` = always | never | auto — "always" runs
+    interpreted off-TPU (tests)."""
+    import os
+
+    force = os.environ.get("RAFT_TPU_PALLAS_GROUPED", "auto")
+    if force == "never" or kk > 64:
+        return False
+    if L and d:
+        Lp = -(-L // _LANES) * _LANES
+        dpad = -(-d // _LANES) * _LANES
+        vmem = 4 * (Lp * dpad + bq * Lp + bq * dpad)
+        if vmem > _GROUPED_VMEM_BUDGET:
+            return False
+    return True if force == "always" else _on_tpu()
+
+
+@functools.partial(jax.jit,
+                   static_argnames=("kk", "metric", "bq", "interpret"))
+def grouped_scan_topk(q_gathered: jax.Array, list_data: jax.Array,
+                      mask_add: jax.Array, kk: int, metric: str = "l2",
+                      bq: int = 128, interpret: bool = False
+                      ) -> Tuple[jax.Array, jax.Array]:
+    """Fused grouped IVF scan over one list chunk.
+
+    q_gathered [G, qmax, d] — each list's queued queries (gathered by the
+    caller from the probe inversion, see neighbors/ivf_common.py);
+    list_data [G, L, d] — raw vectors (ivf_flat) or bf16 reconstructions
+    (ivf_pq recon cache); mask_add [G, L] — 0 for valid slots, +inf for
+    padding/filtered.  Returns (keys [G, qmax, kk], pos [G, qmax, kk]):
+    minimized sort keys (ip keys are negated scores) and in-list column
+    positions (-1 when the slot saw fewer than kk valid candidates).
+    """
+    G, qmax, d = q_gathered.shape
+    L = list_data.shape[1]
+    assert metric in ("l2", "ip", "cos")
+    bq = min(bq, max(_SUBLANES, qmax))
+    q = _pad_to(q_gathered.astype(jnp.float32), bq, 1, 0.0)
+    q = _pad_to(q, _LANES, 2, 0.0)
+    data = _pad_to(list_data, _LANES, 2, 0.0)
+    data = _pad_to(data, 16, 1, 0.0)  # 16 sublanes covers bf16 list data
+    mask = _pad_to(mask_add.astype(jnp.float32), data.shape[1], 1, jnp.inf)
+    mask = mask[:, None, :]  # [G, 1, Lp]: trailing dims match the array
+    qp, Lp, dpad = q.shape[1], data.shape[1], data.shape[2]
+    kpad = max(_LANES, -(-kk // _LANES) * _LANES)
+
+    grid = (G, qp // bq)
+    vals, pos = pl.pallas_call(
+        functools.partial(_grouped_scan_kernel, kk=kk, metric=metric),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, bq, dpad), lambda g, j: (g, j, 0)),
+            pl.BlockSpec((1, Lp, dpad), lambda g, j: (g, 0, 0)),
+            pl.BlockSpec((1, 1, Lp), lambda g, j: (g, 0, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, bq, kpad), lambda g, j: (g, j, 0)),
+            pl.BlockSpec((1, bq, kpad), lambda g, j: (g, j, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((G, qp, kpad), jnp.float32),
+            jax.ShapeDtypeStruct((G, qp, kpad), jnp.int32),
+        ],
+        interpret=interpret,
+    )(q, data, mask)
+    keys = vals[:, :qmax, :kk]
+    posk = pos[:, :qmax, :kk]
+    # positions beyond the valid candidates come back as inf keys
+    posk = jnp.where(jnp.isinf(keys), -1, posk)
+    return keys, posk
+
+
 @functools.partial(jax.jit,
                    static_argnames=("k", "select_min", "bm", "bl", "interpret"))
 def select_k_pallas(scores: jax.Array, k: int, select_min: bool = True,
