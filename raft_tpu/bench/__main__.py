@@ -13,7 +13,19 @@ def main(argv=None):
     runp.add_argument("--out", default=None, help="CSV output path")
     runp.add_argument("--pareto", action="store_true",
                       help="print the QPS/recall pareto frontier")
+    primsp = sub.add_parser("prims",
+                            help="primitive micro-benchmarks "
+                                 "(reference: cpp/bench/prims)")
+    primsp.add_argument("benches", nargs="*", default=["all"])
+    primsp.add_argument("--csv", default=None)
     args = p.parse_args(argv)
+
+    if args.cmd == "prims":
+        from raft_tpu.bench import prims
+
+        prims.main((args.benches or ["all"]) +
+                   (["--csv", args.csv] if args.csv else []))
+        return 0
 
     from raft_tpu.bench import runner
 

@@ -53,16 +53,38 @@ def write_dataset(root: str, ds: Dataset) -> str:
     return d
 
 
+def bin_memmap(path: str, dtype) -> np.ndarray:
+    """Memory-map a .fbin/.ibin file's payload as [n, d] without reading
+    it (the reference's mmap path for billion-scale files,
+    cpp/bench/ann/src/common/dataset.hpp BinFile::map). Row chunks are
+    paged in on access and reclaimable — host RSS stays O(touched)."""
+    n, d = native.bin_header(path)
+    return np.memmap(path, dtype=np.dtype(dtype), mode="r", offset=8,
+                     shape=(n, d))
+
+
 def load_dataset(root: str, name: str, metric: str = "sqeuclidean",
-                 max_rows: int = -1) -> Dataset:
-    """Load a dataset directory; ``max_rows`` subsets the base file (the
+                 max_rows: int = -1, mmap: bool = False) -> Dataset:
+    """Load a dataset directory; ``max_rows`` subsets the base file and
+    ``mmap=True`` memory-maps it instead of reading it whole (the
     reference's subset/memmap path for billion-scale files)."""
     d = os.path.join(root, name)
-    base = native.bin_read(os.path.join(d, "base.fbin"), np.float32,
-                           count=max_rows)
+    if mmap:
+        base = bin_memmap(os.path.join(d, "base.fbin"), np.float32)
+        if max_rows >= 0:
+            base = base[:max_rows]
+    else:
+        base = native.bin_read(os.path.join(d, "base.fbin"), np.float32,
+                               count=max_rows)
     queries = native.bin_read(os.path.join(d, "query.fbin"), np.float32)
     gt_path = os.path.join(d, "groundtruth.ibin")
     gt = native.bin_read(gt_path, np.int32) if os.path.exists(gt_path) else None
+    if gt is not None and 0 <= max_rows < native.bin_header(
+            os.path.join(d, "base.fbin"))[0]:
+        # the on-disk groundtruth covers the FULL base; against a subset
+        # it contains unreachable ids and would deflate recall silently —
+        # drop it so callers recompute on the subset
+        gt = None
     return Dataset(name=name, base=base, queries=queries, groundtruth=gt,
                    metric=metric)
 

@@ -1,0 +1,228 @@
+"""Primitive micro-benchmarks — the ``cpp/bench/prims`` analog.
+
+The reference ships gbench micro-benchmarks per primitive
+(cpp/bench/prims: matrix/select_k.cu, distance, fused_l2_nn, kmeans...)
+to ground kernel-choice heuristics in measurements. This module plays
+that role for the TPU build: it times the competing implementations of
+each hot primitive (XLA vs Pallas select_k; XLA-scan vs Pallas
+fused_l2_nn; grouped vs per-query IVF scans) on the *current* backend,
+so dispatch thresholds (`matrix/select_k.py` `_PALLAS_MIN_LEN`/
+`_PALLAS_MAX_K`, `ivf_pq.search` scan_mode="auto") can be set
+empirically rather than guessed.
+
+CLI::
+
+    python -m raft_tpu.bench.prims [select_k|fused_l2_nn|pairwise|
+                                    kmeans|ivf_scan|all] [--csv out.csv]
+
+Each row: {bench, params, impl, ms, throughput}.
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+@dataclass
+class PrimResult:
+    bench: str
+    impl: str
+    ms: float
+    throughput: float      # bench-specific unit/s (rows, pairs, queries)
+    unit: str
+    params: Dict[str, Any] = field(default_factory=dict)
+
+
+def _time(fn: Callable[[], Any], iters: int = 10, warmup: int = 2) -> float:
+    """Median wall ms of ``fn`` (jax-aware: blocks on the result)."""
+    for _ in range(warmup):
+        jax.block_until_ready(fn())
+    times = []
+    for _ in range(iters):
+        t0 = time.perf_counter()
+        jax.block_until_ready(fn())
+        times.append((time.perf_counter() - t0) * 1e3)
+    return float(np.median(times))
+
+
+# ---------------------------------------------------------------------------
+# select_k (reference: bench/prims/matrix/select_k.cu)
+# ---------------------------------------------------------------------------
+
+def bench_select_k(grid=None, iters: int = 10) -> List[PrimResult]:
+    from raft_tpu.matrix import select_k as select_k_auto
+    from raft_tpu.ops import select_k_pallas
+    from raft_tpu.ops.pallas_kernels import _on_tpu
+
+    if grid is None:
+        grid = [(256, 2048, 10), (256, 16384, 10), (64, 65536, 10),
+                (256, 16384, 64), (64, 65536, 64)]
+    rows: List[PrimResult] = []
+    rng = np.random.default_rng(0)
+    for batch, length, k in grid:
+        s = jnp.asarray(rng.random((batch, length), dtype=np.float32))
+        p = {"batch": batch, "len": length, "k": k}
+        impls = {
+            "lax.top_k": lambda: jax.lax.top_k(-s, k),
+            "select_k.auto": lambda: select_k_auto(s, k),
+        }
+        if _on_tpu() and k <= 64:
+            impls["pallas"] = lambda: select_k_pallas(s, k)
+        for name, fn in impls.items():
+            ms = _time(fn, iters)
+            rows.append(PrimResult("select_k", name, ms,
+                                   batch * 1e3 / ms, "rows/s", p))
+    return rows
+
+
+# ---------------------------------------------------------------------------
+# fused_l2_nn (reference: bench/prims/distance/fused_l2_nn.cu)
+# ---------------------------------------------------------------------------
+
+def bench_fused_l2_nn(grid=None, iters: int = 10) -> List[PrimResult]:
+    from raft_tpu.distance.fused_l2_nn import fused_l2_nn_argmin
+    from raft_tpu.ops.pallas_kernels import _on_tpu
+
+    if grid is None:
+        grid = [(10000, 1024, 64), (10000, 16384, 128), (100000, 1024, 128)]
+    rows: List[PrimResult] = []
+    rng = np.random.default_rng(0)
+    for m, n, d in grid:
+        x = jnp.asarray(rng.random((m, d), dtype=np.float32))
+        y = jnp.asarray(rng.random((n, d), dtype=np.float32))
+        p = {"m": m, "n": n, "d": d}
+        impls = {"xla": lambda: fused_l2_nn_argmin(x, y, impl="xla")}
+        if _on_tpu():
+            impls["pallas"] = lambda: fused_l2_nn_argmin(x, y, impl="pallas")
+        for name, fn in impls.items():
+            ms = _time(fn, iters)
+            rows.append(PrimResult("fused_l2_nn", name, ms,
+                                   m * 1e3 / ms, "rows/s", p))
+    return rows
+
+
+# ---------------------------------------------------------------------------
+# pairwise distance (reference: bench/prims/distance/distance_*.cu)
+# ---------------------------------------------------------------------------
+
+def bench_pairwise(grid=None, iters: int = 10) -> List[PrimResult]:
+    from raft_tpu.distance import pairwise_distance
+
+    if grid is None:
+        grid = [("sqeuclidean", 4096, 4096, 128), ("cosine", 4096, 4096, 128),
+                ("l1", 2048, 2048, 128)]
+    rows: List[PrimResult] = []
+    rng = np.random.default_rng(0)
+    for metric, m, n, d in grid:
+        x = jnp.asarray(rng.random((m, d), dtype=np.float32))
+        y = jnp.asarray(rng.random((n, d), dtype=np.float32))
+        ms = _time(lambda: pairwise_distance(x, y, metric=metric), iters)
+        rows.append(PrimResult(
+            "pairwise", metric, ms, m * n * 1e3 / ms, "pairs/s",
+            {"m": m, "n": n, "d": d, "metric": metric}))
+    return rows
+
+
+# ---------------------------------------------------------------------------
+# kmeans Lloyd step (reference: bench/prims/cluster/kmeans.cu)
+# ---------------------------------------------------------------------------
+
+def bench_kmeans(grid=None, iters: int = 5) -> List[PrimResult]:
+    from raft_tpu.cluster import KMeansParams, kmeans
+
+    if grid is None:
+        grid = [(100000, 64, 256), (100000, 128, 1024)]
+    rows: List[PrimResult] = []
+    rng = np.random.default_rng(0)
+    for n, d, clusters in grid:
+        x = jnp.asarray(rng.random((n, d), dtype=np.float32))
+        p = KMeansParams(n_clusters=clusters, max_iter=5, seed=0)
+        ms = _time(lambda: kmeans.fit(p, x), iters=iters, warmup=1)
+        rows.append(PrimResult(
+            "kmeans.fit5", "lloyd", ms, n * 5 * 1e3 / ms, "row-iters/s",
+            {"n": n, "d": d, "clusters": clusters}))
+    return rows
+
+
+# ---------------------------------------------------------------------------
+# IVF scan-mode crossover (grouped vs per-query; sets scan_mode="auto")
+# ---------------------------------------------------------------------------
+
+def bench_ivf_scan(batches=(16, 64, 256, 1024, 4096), n: int = 200_000,
+                   d: int = 96, n_lists: int = 1024, n_probes: int = 20,
+                   iters: int = 5) -> List[PrimResult]:
+    from raft_tpu.neighbors import ivf_pq
+
+    rng = np.random.default_rng(0)
+    x = jnp.asarray(rng.random((n, d), dtype=np.float32))
+    index = ivf_pq.build(x, ivf_pq.IndexParams(
+        n_lists=n_lists, pq_dim=max(8, d // 2 // 8 * 8), seed=0))
+    q_all = jnp.asarray(rng.random((max(batches), d), dtype=np.float32))
+    rows: List[PrimResult] = []
+    for b in batches:
+        q = q_all[:b]
+        for mode in ("grouped", "per_query"):
+            sp = ivf_pq.SearchParams(n_probes=n_probes, scan_mode=mode)
+            ms = _time(lambda: ivf_pq.search(index, q, 10, sp),
+                       iters=iters, warmup=1)
+            rows.append(PrimResult(
+                "ivf_pq.scan", mode, ms, b * 1e3 / ms, "queries/s",
+                {"batch": b, "n": n, "n_lists": n_lists,
+                 "n_probes": n_probes}))
+    return rows
+
+
+BENCHES: Dict[str, Callable[[], List[PrimResult]]] = {
+    "select_k": bench_select_k,
+    "fused_l2_nn": bench_fused_l2_nn,
+    "pairwise": bench_pairwise,
+    "kmeans": bench_kmeans,
+    "ivf_scan": bench_ivf_scan,
+}
+
+
+def run(names=("all",)) -> List[PrimResult]:
+    picked = list(BENCHES) if "all" in names else list(names)
+    rows: List[PrimResult] = []
+    for name in picked:
+        if name not in BENCHES:
+            raise ValueError(f"unknown bench {name!r} (have {sorted(BENCHES)})")
+        rows.extend(BENCHES[name]())
+    return rows
+
+
+def export_csv(rows: List[PrimResult], path: str) -> None:
+    import csv
+    import json as _json
+
+    with open(path, "w", newline="") as f:
+        w = csv.writer(f)
+        w.writerow(["bench", "impl", "ms", "throughput", "unit", "params"])
+        for r in rows:
+            w.writerow([r.bench, r.impl, f"{r.ms:.4f}",
+                        f"{r.throughput:.1f}", r.unit, _json.dumps(r.params)])
+
+
+def main(argv: Optional[List[str]] = None) -> None:
+    import argparse
+
+    ap = argparse.ArgumentParser(description="raft_tpu prim micro-benchmarks")
+    ap.add_argument("benches", nargs="*", default=["all"])
+    ap.add_argument("--csv", default=None)
+    args = ap.parse_args(argv)
+    rows = run(args.benches or ["all"])
+    for r in rows:
+        print(f"{r.bench:14s} {r.impl:14s} {r.ms:10.3f} ms "
+              f"{r.throughput:14,.0f} {r.unit:12s} {r.params}")
+    if args.csv:
+        export_csv(rows, args.csv)
+
+
+if __name__ == "__main__":
+    main()

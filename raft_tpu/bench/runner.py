@@ -87,14 +87,27 @@ def _algo_ivf_pq(dsx, build_param, metric):
 
     bp = dict(build_param)
     refine_ratio = bp.pop("refine_ratio", 1)
+    chunked = bp.pop("chunked_build", False)
+    chunk_rows = bp.pop("chunk_rows", 1 << 18)
     p = ivf_pq.IndexParams(**{"metric": metric, **bp})
-    index = ivf_pq.build(dsx, p)
+    if chunked:  # streaming build: O(chunk) working set (memmap-friendly)
+        base = dsx if isinstance(dsx, np.ndarray) else np.asarray(dsx)
+        index = ivf_pq.build_chunked(base, p, chunk_rows=chunk_rows)
+    else:
+        index = ivf_pq.build(dsx, p)
+
+    host_base = dsx if isinstance(dsx, np.ndarray) else None
 
     def search(q, k, sp):
         sp = dict(sp)
         ratio = sp.pop("refine_ratio", refine_ratio)
         if ratio > 1:
             d0, i0 = ivf_pq.search(index, q, k * int(ratio), ivf_pq.SearchParams(**sp))
+            if host_base is not None:
+                # memmapped base: gather only candidate rows on the host —
+                # jitted refine would materialize the whole base in HBM
+                return refine.refine_gathered(host_base, q, i0, k,
+                                              metric=index.metric)
             return refine.refine(dsx, q, i0, k, metric=index.metric)
         return ivf_pq.search(index, q, k, ivf_pq.SearchParams(**sp))
 
@@ -152,18 +165,28 @@ def run_config(config: Dict[str, Any],
     k = int(config.get("k", 10))
     batch_size = int(config.get("batch_size", 10_000))
 
+    mmap_mode = False
     if data is None:
         dcfg = config["dataset"]
-        data = ds_mod.make_synthetic(
-            dcfg.get("name", "synthetic"),
-            int(dcfg["n"]), int(dcfg["dim"]), int(dcfg["n_queries"]),
-            metric=dcfg.get("metric", "sqeuclidean"),
-            seed=int(dcfg.get("seed", 0)),
-        )
+        if "dir" in dcfg:  # on-disk .fbin/.ibin dataset directory
+            mmap_mode = bool(dcfg.get("mmap", False))
+            data = ds_mod.load_dataset(
+                dcfg["dir"], dcfg["name"],
+                metric=dcfg.get("metric", "sqeuclidean"),
+                max_rows=int(dcfg.get("max_rows", -1)), mmap=mmap_mode)
+        else:
+            data = ds_mod.make_synthetic(
+                dcfg.get("name", "synthetic"),
+                int(dcfg["n"]), int(dcfg["dim"]), int(dcfg["n_queries"]),
+                metric=dcfg.get("metric", "sqeuclidean"),
+                seed=int(dcfg.get("seed", 0)),
+            )
     if data.groundtruth is None:
         ds_mod.compute_groundtruth(data, k=max(k, 10))
 
-    dsx = jnp.asarray(data.base)
+    # memmapped bases stay host-side: chunked builds page them in; only
+    # algos that genuinely need the full matrix pull it to device
+    dsx = data.base if mmap_mode else jnp.asarray(data.base)
     queries = jnp.asarray(data.queries)
     results: List[BenchResult] = []
     for index_cfg in config["index"]:

@@ -19,6 +19,7 @@ import jax.numpy as jnp
 from jax import lax
 
 from raft_tpu.core.errors import expects
+from raft_tpu.core.tracing import traced
 from raft_tpu.distance.types import DistanceType, resolve_metric
 from raft_tpu.matrix import select_k as _select_k
 from raft_tpu.utils.precision import get_precision
@@ -26,11 +27,15 @@ from raft_tpu.utils.precision import get_precision
 
 @partial(jax.jit, static_argnames=("k", "metric"))
 def _refine_impl(dataset, queries, candidates, k: int, metric: str):
-    mt = resolve_metric(metric)
-    q = jnp.asarray(queries, jnp.float32)
-    m, n_cand = candidates.shape
     safe_cand = jnp.maximum(candidates, 0)
     cand_rows = dataset[safe_cand].astype(jnp.float32)    # [m, C, d]
+    return _refine_rows(cand_rows, queries, candidates, k, metric)
+
+
+@partial(jax.jit, static_argnames=("k", "metric"))
+def _refine_rows(cand_rows, queries, candidates, k: int, metric: str):
+    mt = resolve_metric(metric)
+    q = jnp.asarray(queries, jnp.float32)
     scores = jnp.einsum("md,mcd->mc", q, cand_rows,
                         precision=get_precision(),
                         preferred_element_type=jnp.float32)
@@ -58,6 +63,7 @@ def _refine_impl(dataset, queries, candidates, k: int, metric: str):
     return vals, ids
 
 
+@traced("raft_tpu.refine")
 def refine(
     dataset: jax.Array,
     queries: jax.Array,
@@ -77,3 +83,29 @@ def refine(
             k, candidates.shape[1])
     mt = resolve_metric(metric)
     return _refine_impl(dataset, queries, candidates, k, mt.value)
+
+
+def refine_gathered(
+    host_base,
+    queries: jax.Array,
+    candidates: jax.Array,
+    k: int,
+    metric="sqeuclidean",
+) -> Tuple[jax.Array, jax.Array]:
+    """Re-rank against a HOST-resident (possibly memmapped) dataset:
+    gather only each query's candidate rows on the host — O(m·C·d) pages
+    touched, never the whole base — then re-rank on device (reference:
+    the host refine path, detail/refine_host-inl.hpp, used by CAGRA
+    builds and billion-scale benches where the base doesn't fit)."""
+    import numpy as np
+
+    expects(candidates.ndim == 2, "candidates must be [m, n_candidates]")
+    expects(k <= candidates.shape[1], "k=%d > n_candidates=%d",
+            k, candidates.shape[1])
+    mt = resolve_metric(metric)
+    cand = np.asarray(candidates)
+    safe = np.clip(cand, 0, host_base.shape[0] - 1)
+    rows = np.asarray(host_base[safe.reshape(-1)], np.float32).reshape(
+        cand.shape[0], cand.shape[1], host_base.shape[1])
+    return _refine_rows(jnp.asarray(rows), queries, jnp.asarray(cand),
+                        k, mt.value)

@@ -91,6 +91,69 @@ def test_runner_end_to_end():
     assert front and all(front[i].qps <= front[i + 1].qps for i in range(len(front) - 1))
 
 
+def test_runner_memmap_dir_chunked_build(tmp_path):
+    """The DEEP-100M-shaped path at subset scale: on-disk dataset dir,
+    memmapped base, chunked IVF-PQ build (reference: run/conf/deep-1B.json
+    + dataset.hpp subsets)."""
+    # no groundtruth on disk: the runner recomputes it on the subset
+    ds = ds_mod.make_synthetic("deep-shaped", 4000, 32, 100, seed=3)
+    ds_mod.write_dataset(str(tmp_path), ds)
+    config = {
+        "dataset": {"dir": str(tmp_path), "name": "deep-shaped",
+                    "metric": "sqeuclidean", "mmap": True, "max_rows": 3000},
+        "k": 10,
+        "batch_size": 100,
+        "index": [
+            {"name": "ivf_pq.chunked", "algo": "ivf_pq",
+             "build_param": {"n_lists": 16, "pq_dim": 16,
+                             "chunked_build": True, "chunk_rows": 512},
+             "search_params": [{"n_probes": 16}]},
+        ],
+    }
+    results = runner.run_config(config, verbose=False)
+    assert len(results) == 1
+    assert results[0].qps > 0
+    assert results[0].recall >= 0.5
+
+
+def test_subset_load_drops_full_groundtruth(tmp_path, rng):
+    """GT computed over the full base is unreachable on a subset — it must
+    be dropped so callers recompute, not silently deflate recall."""
+    ds = ds_mod.make_synthetic("g", 300, 8, 10, seed=2)
+    ds_mod.compute_groundtruth(ds, k=5)
+    ds_mod.write_dataset(str(tmp_path), ds)
+    full = ds_mod.load_dataset(str(tmp_path), "g")
+    assert full.groundtruth is not None
+    sub = ds_mod.load_dataset(str(tmp_path), "g", max_rows=100)
+    assert sub.groundtruth is None
+
+
+def test_refine_gathered_matches_device(rng):
+    """Host-gather refine (memmap path) must equal the device refine."""
+    import jax.numpy as jnp
+    from raft_tpu.neighbors import refine
+
+    x = rng.random((500, 16), dtype=np.float32)
+    q = rng.random((20, 16), dtype=np.float32)
+    cand = rng.integers(0, 500, (20, 30)).astype(np.int32)
+    cand[0, 5] = -1  # invalid slot
+    d1, i1 = refine.refine(jnp.asarray(x), jnp.asarray(q),
+                           jnp.asarray(cand), 10)
+    d2, i2 = refine.refine_gathered(x, jnp.asarray(q), cand, 10)
+    np.testing.assert_array_equal(np.asarray(i1), np.asarray(i2))
+    np.testing.assert_allclose(np.asarray(d1), np.asarray(d2), rtol=1e-6)
+
+
+def test_deep100m_conf_parses():
+    import json, os
+    conf = os.path.join(os.path.dirname(runner.__file__), "conf",
+                        "deep-100m.json")
+    with open(conf) as f:
+        cfg = json.load(f)
+    assert cfg["dataset"]["mmap"] is True
+    assert cfg["index"][0]["build_param"]["chunked_build"] is True
+
+
 def test_runner_rejects_unknown_algo():
     with pytest.raises(ValueError):
         runner.run_config(

@@ -1,0 +1,29 @@
+"""Micro-benchmark harness smoke tests (reference: cpp/bench/prims)."""
+
+import numpy as np
+
+from raft_tpu.bench import prims
+
+
+def test_select_k_bench_rows(tmp_path):
+    rows = prims.bench_select_k(grid=[(32, 512, 5)], iters=2)
+    assert {r.impl for r in rows} >= {"lax.top_k", "select_k.auto"}
+    assert all(r.ms > 0 and np.isfinite(r.throughput) for r in rows)
+    out = str(tmp_path / "prims.csv")
+    prims.export_csv(rows, out)
+    with open(out) as f:
+        assert len(f.readlines()) == len(rows) + 1
+
+
+def test_run_rejects_unknown():
+    import pytest
+
+    with pytest.raises(ValueError):
+        prims.run(["nope"])
+
+
+def test_ivf_scan_crossover_smoke():
+    rows = prims.bench_ivf_scan(batches=(16, 128), n=4000, d=32,
+                                n_lists=32, n_probes=8, iters=1)
+    modes = {(r.params["batch"], r.impl) for r in rows}
+    assert (16, "grouped") in modes and (128, "per_query") in modes
