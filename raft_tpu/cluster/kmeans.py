@@ -30,6 +30,7 @@ import jax.numpy as jnp
 from jax import lax
 
 from raft_tpu.core.errors import expects
+from raft_tpu.core.tracing import traced
 from raft_tpu.distance.fused_l2_nn import fused_l2_nn_argmin
 from raft_tpu.distance.pairwise import l2_expanded
 from raft_tpu.random.rng import RngState, _as_key
@@ -127,6 +128,7 @@ def _lloyd(x, w, init_centroids, n_clusters: int, max_iter: int, tol: float):
     return centroids, inertia, n_iter
 
 
+@traced("raft_tpu.kmeans.fit")
 def fit(
     params: KMeansParams,
     x: jax.Array,

@@ -34,6 +34,7 @@ import numpy as np
 from jax import lax
 
 from raft_tpu.core.errors import expects
+from raft_tpu.core.tracing import traced
 from raft_tpu.distance.fused_l2_nn import fused_l2_nn_argmin
 from raft_tpu.cluster.kmeans import _update_centroids, init_random
 from raft_tpu.random.rng import RngState
@@ -111,6 +112,7 @@ def build_clusters(
     return centers, labels, sizes.astype(jnp.int32)
 
 
+@traced("raft_tpu.kmeans_balanced.fit")
 def fit(
     x: jax.Array,
     n_clusters: int,

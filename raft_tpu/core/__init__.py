@@ -8,9 +8,18 @@ async dispatch, and the comms *interface* into raft_tpu.parallel.
 
 from raft_tpu.core.resources import (  # noqa: F401
     DeviceResources,
+    DeviceResourcesManager,
     Resources,
     RngKeySource,
     get_device_resources,
+    manager,
 )
 from raft_tpu.core.errors import RaftError, LogicError, expects, fail  # noqa: F401
+from raft_tpu.core.tracing import traced  # noqa: F401
+from raft_tpu.core.interruptible import (  # noqa: F401
+    cancel,
+    cancellation_point,
+    interrupted_exception,
+    synchronize,
+)
 from raft_tpu.core import logging, serialize, bitset  # noqa: F401

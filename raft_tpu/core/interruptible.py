@@ -1,0 +1,91 @@
+"""Cooperative cancellation — the ``raft::interruptible`` analog.
+
+The reference (core/interruptible.hpp:71) keeps a thread-local token;
+``synchronize(stream)`` spin-yields on the GPU event and throws
+``interrupted_exception`` when another thread calls ``cancel()`` — so
+Ctrl-C aborts GPU work at the next sync point (pylibraft wires this into
+Python via interruptible.pyx).
+
+Under XLA there are no streams to spin on; the natural cancellation
+points are the host-orchestration seams — between chunks of a streaming
+build, between Lloyd iterations driven from the host, between bench
+batches. :func:`cancellation_point` is called at those seams (e.g.
+``ivf_pq.build_chunked``), and :func:`synchronize` is the
+block-until-ready that doubles as a cancellation point, mirroring the
+reference's sync-as-cancellation-point design.
+"""
+
+from __future__ import annotations
+
+import threading
+import weakref
+from typing import Optional, Union
+
+import jax
+
+
+class interrupted_exception(RuntimeError):
+    """Raised at a cancellation point after :func:`cancel`
+    (reference: raft::interruptible::interrupted_exception)."""
+
+
+# Tokens are keyed by the Thread OBJECT in a weak dict, mirroring the
+# reference's thread-local token store (interruptible.hpp:71 keeps a
+# weak_ptr registry): entries die with their thread, so a cancel aimed
+# at a thread that exits unconsumed can never leak onto a future thread
+# whose OS ident happens to be recycled.
+_tokens: "weakref.WeakKeyDictionary[threading.Thread, threading.Event]" = (
+    weakref.WeakKeyDictionary())
+_lock = threading.Lock()
+
+
+def _resolve(thread: Optional[Union[int, threading.Thread]]
+             ) -> Optional[threading.Thread]:
+    if thread is None:
+        return threading.current_thread()
+    if isinstance(thread, threading.Thread):
+        return thread
+    for t in threading.enumerate():
+        if t.ident == thread:
+            return t
+    return None  # already exited: nothing to cancel
+
+
+def _token(thread: threading.Thread) -> threading.Event:
+    with _lock:
+        ev = _tokens.get(thread)
+        if ev is None:
+            ev = threading.Event()
+            _tokens[thread] = ev
+        return ev
+
+
+def cancel(thread: Optional[Union[int, threading.Thread]] = None) -> None:
+    """Request cancellation of a thread's raft_tpu work (default: the
+    calling thread — useful from signal handlers). Accepts a Thread or an
+    ident; an ident of an already-exited thread is a no-op. The target
+    raises :class:`interrupted_exception` at its next cancellation point
+    (reference: interruptible::cancel)."""
+    t = _resolve(thread)
+    if t is not None:
+        _token(t).set()
+
+
+def cancellation_point() -> None:
+    """Raise if this thread was cancelled (reference: yield_no_throw /
+    the check inside interruptible::synchronize). Clears the token so
+    subsequent work can proceed, matching the reference's
+    ``throw-and-reset`` semantics."""
+    ev = _token(threading.current_thread())
+    if ev.is_set():
+        ev.clear()
+        raise interrupted_exception("raft_tpu work cancelled")
+
+
+def synchronize(*arrays) -> None:
+    """Block on async results, then honor cancellation (reference:
+    interruptible::synchronize — the sync that is also a cancellation
+    point)."""
+    for a in arrays:
+        jax.block_until_ready(a)
+    cancellation_point()

@@ -154,18 +154,87 @@ class DeviceResources(Resources):
         return _log.get_logger()
 
 
-_default_handles: Dict[int, DeviceResources] = {}
-_default_lock = threading.Lock()
+class DeviceResourcesManager:
+    """Process-wide pool of per-device handles for multi-threaded servers
+    (reference: ``device_resources_manager``,
+    core/device_resources_manager.hpp:79).
+
+    The reference pools N handles per device, each with its own stream
+    pool, and freezes configuration at first ``get_device_resources``.
+    The TPU analog: N handles per device, each with an independent PRNG
+    stream (the handle-local state that matters under XLA), options
+    (pool size, seed, precision, mesh) settable only before first use —
+    later setters log a warning and are ignored, matching the
+    reference's behavior."""
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._pools: Dict[int, list] = {}
+        self._rr: Dict[int, int] = {}
+        self._pool_size = 1
+        self._seed = 0
+        self._precision = "highest"
+        self._mesh = None
+        self._initialized = False
+
+    def _warn_if_initialized(self, what: str) -> bool:
+        if self._initialized:
+            _log.warn("DeviceResourcesManager.%s ignored: pool already "
+                      "initialized (set options before the first "
+                      "get_device_resources, as the reference requires)", what)
+            return True
+        return False
+
+    def set_pool_size(self, n: int) -> None:
+        """Handles pooled per device (reference: set_streams_per_device)."""
+        with self._lock:
+            if not self._warn_if_initialized("set_pool_size"):
+                expects(n >= 1, "pool size must be >= 1")
+                self._pool_size = int(n)
+
+    def set_seed(self, seed: int) -> None:
+        with self._lock:
+            if not self._warn_if_initialized("set_seed"):
+                self._seed = int(seed)
+
+    def set_precision(self, precision: str) -> None:
+        with self._lock:
+            if not self._warn_if_initialized("set_precision"):
+                self._precision = precision
+
+    def set_mesh(self, mesh) -> None:
+        with self._lock:
+            if not self._warn_if_initialized("set_mesh"):
+                self._mesh = mesh
+
+    def get_resources(self, device: Optional[jax.Device] = None
+                      ) -> DeviceResources:
+        """Round-robin a pooled handle for ``device`` (first call freezes
+        the options, builds the pool lazily per device)."""
+        if device is None:
+            device = jax.devices()[0]
+        with self._lock:
+            self._initialized = True
+            pool = self._pools.get(device.id)
+            if pool is None:
+                pool = [
+                    DeviceResources(
+                        device=device, mesh=self._mesh,
+                        seed=int(np.uint32(self._seed + device.id * 7919 + i)),
+                        precision=self._precision)
+                    for i in range(self._pool_size)
+                ]
+                self._pools[device.id] = pool
+                self._rr[device.id] = 0
+            i = self._rr[device.id]
+            self._rr[device.id] = (i + 1) % len(pool)
+            return pool[i]
+
+
+manager = DeviceResourcesManager()
 
 
 def get_device_resources(device: Optional[jax.Device] = None) -> DeviceResources:
     """Process-wide per-device handle pool
     (reference: core/device_resources_manager.hpp:79)."""
-    if device is None:
-        device = jax.devices()[0]
-    with _default_lock:
-        h = _default_handles.get(device.id)
-        if h is None:
-            h = DeviceResources(device=device, seed=int(np.uint32(device.id)))
-            _default_handles[device.id] = h
-        return h
+    return manager.get_resources(device)

@@ -1,0 +1,44 @@
+"""Tracing annotations — the nvtx analog.
+
+The reference wraps every major API in a scoped NVTX range
+(``raft::common::nvtx::range``, core/nvtx.hpp:96-144 — e.g.
+select_k-inl.cuh:289, ivf_pq_build.cuh:130), zero-cost unless profiling.
+The TPU equivalents are:
+
+- :func:`jax.named_scope` — labels the XLA ops traced inside the scope,
+  so kernels show up under the API name in XProf/Perfetto op profiles;
+- :class:`jax.profiler.TraceAnnotation` — a host-side span on the
+  profiler timeline covering dispatch + host orchestration.
+
+:func:`traced` applies both. Like NVTX, the cost when no profiler is
+attached is negligible (a context-manager enter/exit per call), and the
+XLA metadata is baked in at trace time only.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Callable, Optional
+
+import jax
+
+
+def traced(name: Optional[str] = None) -> Callable:
+    """Decorator: run the function under a named profiler scope
+    (reference: RAFT_USING_NVTX / nvtx::range at API entry).
+
+    >>> @traced("raft_tpu.select_k")
+    ... def select_k(...): ...
+    """
+
+    def deco(fn):
+        label = name or f"raft_tpu.{fn.__qualname__}"
+
+        @functools.wraps(fn)
+        def wrapper(*args, **kwargs):
+            with jax.profiler.TraceAnnotation(label), jax.named_scope(label):
+                return fn(*args, **kwargs)
+
+        return wrapper
+
+    return deco

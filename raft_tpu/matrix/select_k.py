@@ -18,6 +18,8 @@ from __future__ import annotations
 from typing import Optional, Tuple
 
 import jax
+
+from raft_tpu.core.tracing import traced
 import jax.numpy as jnp
 from jax import lax
 
@@ -42,6 +44,7 @@ _PALLAS_MIN_LEN = 8192
 _PALLAS_MAX_K = 64
 
 
+@traced("raft_tpu.select_k")
 def select_k(
     scores: jax.Array,
     k: int,

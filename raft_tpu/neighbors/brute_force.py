@@ -24,6 +24,7 @@ import jax.numpy as jnp
 from jax import lax
 
 from raft_tpu.core.errors import expects
+from raft_tpu.core.tracing import traced
 from raft_tpu.distance import pairwise_distance, resolve_metric, DistanceType, SELECT_MIN
 from raft_tpu.matrix import select_k as _select_k
 from raft_tpu.matrix.select_k import merge_parts
@@ -52,6 +53,7 @@ class BruteForceIndex:
         return self.dataset.shape[1]
 
 
+@traced("raft_tpu.brute_force.build")
 def build(dataset: jax.Array, metric="euclidean", metric_arg: float = 2.0) -> BruteForceIndex:
     """Build a brute-force index (reference: brute_force::build).
 
@@ -94,6 +96,7 @@ def _expanded_block(q, db, q_sq, db_sq, metric):
     return d2
 
 
+@traced("raft_tpu.brute_force.knn")
 def knn(
     index: BruteForceIndex,
     queries: jax.Array,

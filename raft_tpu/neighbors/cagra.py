@@ -38,6 +38,7 @@ import numpy as np
 from jax import lax
 
 from raft_tpu.core.errors import expects
+from raft_tpu.core.tracing import traced
 from raft_tpu.core import serialize as ser
 from raft_tpu.distance.types import DistanceType, resolve_metric
 from raft_tpu.matrix import select_k as _select_k
@@ -189,6 +190,7 @@ def optimize_graph(knn_graph: jax.Array, out_degree: int) -> jax.Array:
     return jnp.concatenate([fwd, merged], axis=1)
 
 
+@traced("raft_tpu.cagra.build")
 def build(dataset: jax.Array, params: Optional[IndexParams] = None) -> CagraIndex:
     """Build (reference: cagra::build, cagra.cuh — knn-graph + optimize)."""
     if params is None:
@@ -356,6 +358,7 @@ def _search_impl(index: CagraIndex, queries: jax.Array, k: int,
     return vals.reshape(-1, k)[:m], ids.reshape(-1, k)[:m]
 
 
+@traced("raft_tpu.cagra.search")
 def search(index: CagraIndex, queries: jax.Array, k: int,
            params: Optional[SearchParams] = None,
            filter_bitset: Optional[jax.Array] = None) -> Tuple[jax.Array, jax.Array]:
@@ -392,3 +395,74 @@ def load(path: str, dataset: Optional[jax.Array] = None) -> CagraIndex:
     ds = jnp.asarray(a["dataset"]) if "dataset" in a else jnp.asarray(dataset)
     return CagraIndex(dataset=ds, graph=jnp.asarray(a["graph"]),
                       metric=meta["metric"])
+
+
+def serialize_to_hnswlib(index: CagraIndex, path: str,
+                         ef_construction: int = 200) -> None:
+    """Export the CAGRA graph as an hnswlib-loadable index file
+    (reference capability: cagra_serialize serialize_to_hnswlib — a
+    flat level-0-only HNSW whose neighbor lists are the CAGRA graph).
+
+    Binary layout follows hnswlib's ``HierarchicalNSW::saveIndex``
+    (hnswalg.h): header of size_t/int fields, then per-element level-0
+    blocks ``[link_count u16 + pad u16][maxM0 x u32 links][f32 data]
+    [u64 label]``, then a zero u32 per element (no upper levels).
+    Loadable with ``hnswlib.Index(space, dim).load_index(path)`` where
+    space is "l2" for (sq)euclidean and "ip" for inner_product.
+    """
+    import struct
+
+    expects(resolve_metric(index.metric) in
+            (DistanceType.L2Expanded, DistanceType.L2SqrtExpanded,
+             DistanceType.InnerProduct),
+            "hnswlib export supports l2/inner_product metrics, not %s",
+            index.metric)
+    data = np.ascontiguousarray(np.asarray(index.dataset), np.float32)
+    graph = np.asarray(index.graph, np.int64)
+    n, dim = data.shape
+    degree = graph.shape[1]
+
+    max_m0 = degree               # level-0 out-degree = graph degree
+    m = max(1, degree // 2)
+    data_size = dim * 4
+    size_links0 = max_m0 * 4 + 4  # u32 count-word + maxM0 u32 links
+    size_per_elem = size_links0 + data_size + 8  # + u64 label
+    offset_data = size_links0
+    label_offset = size_links0 + data_size
+    mult = 1.0 / np.log(max(m, 2))
+
+    # hnswlib reads the first `count` links, so valid ids must be
+    # compacted to the front (graph rows can carry interior -1 entries
+    # when the knn stage returned fewer than degree candidates)
+    valid = graph >= 0
+    counts = np.sum(valid, axis=1).astype(np.uint16)
+    front = np.argsort(~valid, axis=1, kind="stable")  # valid-first, ordered
+    links = np.take_along_axis(np.where(valid, graph, 0), front,
+                               axis=1).astype(np.uint32)
+
+    with open(path, "wb") as f:
+        f.write(struct.pack("<QQQQQQiIQQQdQ",
+                            0,              # offsetLevel0_
+                            n,              # max_elements_
+                            n,              # cur_element_count
+                            size_per_elem,  # size_data_per_element_
+                            label_offset,   # label_offset_
+                            offset_data,    # offsetData_
+                            0,              # maxlevel_
+                            0,              # enterpoint_node_
+                            m,              # maxM_
+                            max_m0,         # maxM0_
+                            m,              # M_
+                            float(mult),    # mult_
+                            ef_construction))
+        # level-0 blocks, assembled vectorized then written once
+        block = np.zeros((n, size_per_elem), np.uint8)
+        block[:, 0:2] = counts[:, None].view(np.uint8).reshape(n, 2)
+        block[:, 4:4 + max_m0 * 4] = links.view(np.uint8).reshape(n, -1)
+        block[:, offset_data:offset_data + data_size] = data.view(
+            np.uint8).reshape(n, -1)
+        block[:, label_offset:] = np.arange(n, dtype=np.uint64).view(
+            np.uint8).reshape(n, 8)
+        f.write(block.tobytes())
+        # one u32 per element: no higher-level link lists
+        f.write(np.zeros(n, np.uint32).tobytes())

@@ -109,6 +109,51 @@ class TestCagraSearch:
         d2, i2 = cagra.search(idx2, jnp.asarray(q), 5)
         np.testing.assert_array_equal(np.asarray(i1), np.asarray(i2))
 
+    def test_serialize_to_hnswlib_layout(self, built_index, corpus, tmp_path):
+        """Structural parse of the exported file following hnswlib's
+        saveIndex layout (hnswlib itself isn't in the image): header
+        fields, per-element link/data/label blocks, level-list zeros."""
+        import struct
+
+        x, _ = corpus
+        path = os.path.join(tmp_path, "cagra.hnsw")
+        cagra.serialize_to_hnswlib(built_index, path, ef_construction=150)
+        n, dim = x.shape
+        degree = built_index.graph.shape[1]
+        with open(path, "rb") as f:
+            raw = f.read()
+        hdr_fmt = "<QQQQQQiIQQQdQ"
+        hdr = struct.unpack_from(hdr_fmt, raw, 0)
+        (off0, max_el, cur, size_pe, label_off, off_data,
+         maxlevel, entry, maxm, maxm0, m, mult, efc) = hdr
+        assert (off0, max_el, cur, maxlevel) == (0, n, n, 0)
+        assert maxm0 == degree and efc == 150
+        assert size_pe == (degree * 4 + 4) + dim * 4 + 8
+        base = struct.calcsize(hdr_fmt)
+        blocks = np.frombuffer(
+            raw, np.uint8, n * size_pe, base).reshape(n, size_pe)
+        # vectors roundtrip exactly
+        vecs = blocks[:, off_data:off_data + dim * 4].copy().view(
+            np.float32).reshape(n, dim)
+        np.testing.assert_array_equal(vecs, np.asarray(built_index.dataset))
+        # labels are 0..n-1
+        labels = blocks[:, label_off:label_off + 8].copy().view(np.uint64)
+        np.testing.assert_array_equal(labels.reshape(-1), np.arange(n))
+        # link lists: count, then that many valid neighbor ids compacted
+        # to the front in graph order
+        counts = blocks[:, 0:2].copy().view(np.uint16).reshape(-1)
+        links = blocks[:, 4:4 + degree * 4].copy().view(np.uint32).reshape(
+            n, degree)
+        g = np.asarray(built_index.graph)
+        np.testing.assert_array_equal(counts, (g >= 0).sum(1))
+        for row in (0, n // 2, n - 1):
+            np.testing.assert_array_equal(
+                links[row, :counts[row]], g[row][g[row] >= 0])
+        # trailing: one zero u32 per element (no upper levels)
+        tail = np.frombuffer(raw, np.uint32, n, base + n * size_pe)
+        assert (tail == 0).all()
+        assert len(raw) == base + n * size_pe + n * 4
+
     def test_serialize_without_dataset(self, built_index, corpus, tmp_path):
         x, q = corpus
         path = os.path.join(tmp_path, "cagra_nods.idx")
