@@ -1,0 +1,32 @@
+#!/bin/bash
+# CI test runner (reference: ci/test_python.sh — pytest for pylibraft :43
+# and raft-dask :55). Runs the whole suite on a virtual 8-device CPU mesh
+# so every sharded/shard_map code path executes for real without TPU
+# hardware (tests/conftest.py pins the platform; these env vars make the
+# intent explicit and cover non-pytest entry points).
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+export JAX_PLATFORMS=cpu
+export XLA_FLAGS="${XLA_FLAGS:-} --xla_force_host_platform_device_count=8"
+
+echo "== raft_tpu unit+integration tests (8-device CPU mesh) =="
+python -m pytest tests/ -q "$@"
+
+echo "== driver contract: entry() compiles, dryrun_multichip(8) executes =="
+python - <<'EOF'
+import jax
+import __graft_entry__ as g
+
+fn, args = g.entry()
+jax.jit(fn).lower(*args)  # compile-check single chip
+print("entry() lowers OK")
+g.dryrun_multichip(8)
+print("dryrun_multichip(8) OK")
+EOF
+
+echo "== bench smoke (tiny synthetic) =="
+RAFT_TPU_BENCH_N=20000 RAFT_TPU_BENCH_Q=500 \
+RAFT_TPU_BENCH_ALGOS=ivf_flat python bench.py
+
+echo "CI: all green"
