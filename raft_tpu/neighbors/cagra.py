@@ -63,13 +63,23 @@ class IndexParams:
 
 @dataclasses.dataclass
 class SearchParams:
-    """reference: ``cagra::search_params`` (cagra_types.hpp:54-112)."""
+    """reference: ``cagra::search_params`` (cagra_types.hpp:54-112).
+
+    ``num_seeds``: random entry points sampled per query (the
+    ``num_random_samplings``/rand_xor_mask analog). 0 → auto, scaled
+    with index size: a graph over strongly clustered data is near-
+    disconnected across clusters, so greedy traversal only finds a
+    query's cluster if some entry lands in it — entry count is the
+    recall floor, and it must grow with n (measured: recall 0.35 at
+    n=100k with 128 seeds on 316-cluster data; the miss probability
+    (1 - c/n_clusters)^seeds matches exactly)."""
 
     itopk_size: int = 64
     search_width: int = 4
     max_iterations: int = 0   # 0 → auto: ceil(itopk/search_width) * 2
     query_tile: int = 256
     seed: int = 0             # entry-point sampling (rand_xor_mask analog)
+    num_seeds: int = 0        # 0 → auto: max(2·itopk, min(2048, n/64))
 
 
 class CagraIndex(flax.struct.PyTreeNode):
@@ -218,10 +228,12 @@ def build(dataset: jax.Array, params: Optional[IndexParams] = None) -> CagraInde
 # ---------------------------------------------------------------------------
 
 @partial(jax.jit, static_argnames=("k", "itopk_size", "search_width",
-                                   "max_iterations", "query_tile", "seed"))
+                                   "max_iterations", "query_tile", "seed",
+                                   "num_seeds"))
 def _search_impl(index: CagraIndex, queries: jax.Array, k: int,
                  itopk_size: int, search_width: int, max_iterations: int,
-                 query_tile: int, seed: int = 0, filter_bits=None):
+                 query_tile: int, seed: int = 0, num_seeds: int = 0,
+                 filter_bits=None):
     mt = resolve_metric(index.metric)
     ip = mt == DistanceType.InnerProduct
     sqrt_out = mt == DistanceType.L2SqrtExpanded
@@ -253,18 +265,27 @@ def _search_impl(index: CagraIndex, queries: jax.Array, k: int,
         # tiling and entry sets are decorrelated across queries
         qidx = qstart + jnp.arange(t, dtype=jnp.uint32)
         keys = jax.vmap(lambda i: jax.random.fold_in(base_key, i))(qidx)
-        # oversample 2× candidates and keep the best itopk — the
-        # reference's random_sampling makes multiple hashed draws per
-        # itopk slot the same way (compute_random_samples)
-        n_seed = 2 * itopk_size
+        # oversample candidates and keep the best itopk — the reference's
+        # random_sampling makes multiple hashed draws per itopk slot the
+        # same way (compute_random_samples / num_random_samplings). The
+        # count scales with n (see SearchParams.num_seeds): entry
+        # coverage is the recall floor on clustered data
+        # clamp: the buffer init takes top itopk of the seeds, so fewer
+        # seeds than itopk slots would break lax.top_k
+        n_seed = max(num_seeds or max(2 * itopk_size, min(2048, n // 64)),
+                     itopk_size)
         init_ids = jax.vmap(
             lambda kk: jax.random.randint(kk, (n_seed,), 0, n))(keys)
         # sampled with replacement: demote duplicate entry slots so an id
-        # can never surface twice in the buffer
-        dup0 = jnp.any(
-            (init_ids[:, :, None] == init_ids[:, None, :])
-            & jnp.tril(jnp.ones((n_seed, n_seed), jnp.bool_), -1)[None],
-            axis=2)
+        # can never surface twice in the buffer. Sort-based dedup — the
+        # quadratic pairwise mask would be O(n_seed²) per query
+        order = jnp.argsort(init_ids, axis=1)
+        sorted_ids = jnp.take_along_axis(init_ids, order, axis=1)
+        dup_sorted = jnp.concatenate(
+            [jnp.zeros((t, 1), jnp.bool_),
+             sorted_ids[:, 1:] == sorted_ids[:, :-1]], axis=1)
+        inv = jnp.argsort(order, axis=1)
+        dup0 = jnp.take_along_axis(dup_sorted, inv, axis=1)
         seed_d = dists_to(q, init_ids)
         seed_d = jnp.where(dup0, BIG, seed_d)
         _, best = lax.top_k(-seed_d, itopk_size)
@@ -374,6 +395,7 @@ def search(index: CagraIndex, queries: jax.Array, k: int,
     max_it = params.max_iterations or 2 * (-(-itopk // params.search_width))
     return _search_impl(index, queries, k, itopk, params.search_width,
                         max_it, params.query_tile, seed=params.seed,
+                        num_seeds=params.num_seeds,
                         filter_bits=filter_bitset)
 
 

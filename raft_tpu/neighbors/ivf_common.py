@@ -116,13 +116,18 @@ def gather_pair_results(list_vals: jax.Array, list_ids: jax.Array,
     return vals, ids
 
 
-def default_qmax(batch: int, n_probes: int, n_lists: int,
-                 factor: float = 4.0) -> int:
-    """Queue capacity: ``factor ×`` the average queue load, padded to a
-    multiple of 8, at least 8. Used as the *memory budget* for the exact
-    queue size (see exact_qmax); the scan itself never drops pairs."""
-    avg = batch * n_probes / max(n_lists, 1)
-    return max(8, int(-(-factor * avg // 8)) * 8)
+# Auto-dispatch guard: fall back from grouped to per_query only when the
+# grouped scan's qmax-shaped allocations would be memory-hostile.
+# Measured on-chip, grouped beats the gather-bound per_query path even at
+# full skew (qmax = B), so this is a memory bound, not a cost model.
+GROUPED_BYTES_CAP = 1 << 30
+
+
+def grouped_mem_ok(n_lists: int, qmax: int, kk: int) -> bool:
+    """True when the grouped scan's qmax-shaped buffers fit the budget:
+    the [n_lists, qmax] int32 queue table plus the [n_lists, qmax, kk]
+    f32+i32 per-slot top-k accumulators (the dominant allocations)."""
+    return n_lists * qmax * (4 + 8 * kk) <= GROUPED_BYTES_CAP
 
 
 def max_probe_load(probes: jax.Array, n_lists: int) -> jax.Array:

@@ -74,7 +74,6 @@ class SearchParams:
     n_probes: int = 20
     query_tile: int = 256  # per_query path: bounds the per-step intermediate
     scan_mode: str = "auto"  # "auto" | "grouped" | "per_query"
-    qmax_factor: float = 4.0  # grouped path: per-list queue headroom
     list_chunk: int = 16     # grouped path: lists scanned per step
 
 
@@ -482,17 +481,20 @@ def search(index: IvfFlatIndex, queries: jax.Array, k: int,
         from raft_tpu.neighbors import ivf_common as ic
 
         # size the per-list queues from the ACTUAL probe histogram, so the
-        # grouped scan never drops (query, probe) pairs; a pathologically
-        # hot list (queue beyond the memory budget) falls back to the
-        # exact per_query path instead of losing recall silently. One
-        # stable sort feeds the histogram, the ranks, and the queue table.
+        # grouped scan never drops (query, probe) pairs. Skew-hot lists
+        # inflate qmax toward B — that wastes scan FLOPs on cold lists'
+        # padding, but measured on-chip the per_query gather path is an
+        # order of magnitude slower still (TPUs hate gathers, love the
+        # MXU), so grouped stays preferred until the queue TABLE itself
+        # is memory-hostile. One stable sort feeds the histogram, the
+        # ranks, and the queue table.
         probes = _select_probes(index, queries, n_probes)
         max_load, sorted_l, rank_sorted, q_of, rank = ic.probe_sort(
             probes, index.n_lists)
         qmax = ic.exact_qmax(int(max_load))
-        budget = ic.default_qmax(B, n_probes, index.n_lists,
-                                 max(8.0, 2.0 * params.qmax_factor))
-        if params.scan_mode == "grouped" or qmax <= max(64, budget):
+        kk_cap = min(k, index.max_list_size)
+        if params.scan_mode == "grouped" or ic.grouped_mem_ok(
+                index.n_lists, qmax, kk_cap):
             qtable = ic.qtable_from_sort(sorted_l, rank_sorted, q_of,
                                          index.n_lists, qmax)
             chunk = ic.choose_list_chunk(index.n_lists, params.list_chunk)
