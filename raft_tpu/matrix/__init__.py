@@ -11,12 +11,24 @@ from raft_tpu.matrix.ops import (  # noqa: F401
     argmax,
     argmin,
     col_wise_sort,
+    copy,
+    eye,
+    fill,
     gather,
+    get_diagonal,
+    invert_diagonal,
     linewise_op,
     norm,
+    power,
+    print_matrix,
+    ratio,
+    reciprocal,
     reverse,
     scatter,
+    set_diagonal,
     sign_flip,
     slice_matrix,
+    sqrt,
     triangular_upper,
+    zero_small_values,
 )

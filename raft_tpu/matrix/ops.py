@@ -1,12 +1,17 @@
 """Matrix utilities (reference: cpp/include/raft/matrix/*.cuh).
 
 Thin named XLA surfaces over the reference's per-file matrix ops: argmax/
-argmin (matrix/argmax.cuh), gather/scatter (matrix/gather.cuh), col_wise_sort
-(matrix/col_wise_sort.cuh), linewise_op (matrix/linewise_op.cuh), slice
-(matrix/slice.cuh), norm (matrix/norm.cuh), reverse, sign_flip, triangular.
+argmin, gather/scatter, col_wise_sort, linewise_op, slice, norm, reverse,
+sign_flip, triangular, diagonal, init/copy/eye, math (power/sqrt/
+reciprocal/ratio/threshold). One name per reference header so ported
+algorithms read the same; the implementations are the jnp one-liners the
+TPU compiler wants (SURVEY.md §2.3 note: expose the API surface, don't
+re-implement kernels).
 """
 
 from __future__ import annotations
+
+from typing import Optional
 
 import jax
 import jax.numpy as jnp
@@ -83,3 +88,81 @@ def sign_flip(m: jax.Array) -> jax.Array:
 def triangular_upper(m: jax.Array) -> jax.Array:
     """Upper-triangular copy (reference: matrix/triangular.cuh)."""
     return jnp.triu(m)
+
+
+def get_diagonal(m: jax.Array) -> jax.Array:
+    """Main diagonal (reference: matrix/diagonal.cuh)."""
+    return jnp.diagonal(m)
+
+
+def set_diagonal(m: jax.Array, d) -> jax.Array:
+    """Copy of ``m`` with the diagonal set (reference: matrix/diagonal.cuh
+    set_diagonal — value-semantic here)."""
+    k = min(m.shape[0], m.shape[1])
+    return m.at[jnp.arange(k), jnp.arange(k)].set(d)
+
+
+def invert_diagonal(m: jax.Array) -> jax.Array:
+    """Reciprocal of the diagonal in place of it (reference:
+    matrix/diagonal.cuh invert_diagonal)."""
+    return set_diagonal(m, 1.0 / get_diagonal(m))
+
+
+def fill(shape, value, dtype=jnp.float32) -> jax.Array:
+    """Constant matrix (reference: matrix/init.cuh)."""
+    return jnp.full(shape, value, dtype)
+
+
+def eye(n: int, dtype=jnp.float32) -> jax.Array:
+    """Identity (reference: matrix/init.cuh / matrix.cuh)."""
+    return jnp.eye(n, dtype=dtype)
+
+
+def copy(m: jax.Array) -> jax.Array:
+    """Copy (reference: matrix/copy.cuh — value semantics make this an
+    alias; it exists so ported call sites keep their name)."""
+    return jnp.asarray(m)
+
+
+def power(m: jax.Array, exponent: float) -> jax.Array:
+    """Element-wise power (reference: matrix/power.cuh)."""
+    return jnp.power(m, exponent)
+
+
+def sqrt(m: jax.Array) -> jax.Array:
+    """Element-wise sqrt (reference: matrix/sqrt.cuh)."""
+    return jnp.sqrt(m)
+
+
+def reciprocal(m: jax.Array, scalar: float = 1.0,
+               thres: Optional[float] = None) -> jax.Array:
+    """``scalar / m`` with optional small-value thresholding to zero
+    (reference: matrix/reciprocal.cuh)."""
+    r = scalar / m
+    if thres is not None:
+        r = jnp.where(jnp.abs(m) <= thres, 0.0, r)
+    return r
+
+
+def ratio(m: jax.Array) -> jax.Array:
+    """Each element divided by the matrix sum (reference: matrix/ratio.cuh)."""
+    return m / jnp.sum(m)
+
+
+def zero_small_values(m: jax.Array, thres: float) -> jax.Array:
+    """Zero entries below ``thres`` (reference: matrix/threshold.cuh)."""
+    return jnp.where(jnp.abs(m) < thres, 0.0, m)
+
+
+def print_matrix(m: jax.Array, name: str = "matrix") -> str:
+    """Formatted dump (reference: matrix/print.cuh). Returns the string
+    and prints it."""
+    s = f"{name} {tuple(m.shape)}:\n{np_str(m)}"
+    print(s)
+    return s
+
+
+def np_str(m: jax.Array) -> str:
+    import numpy as np
+
+    return np.array2string(np.asarray(m), precision=4, suppress_small=True)

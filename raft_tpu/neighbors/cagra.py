@@ -271,9 +271,11 @@ def _search_impl(index: CagraIndex, queries: jax.Array, k: int,
         # count scales with n (see SearchParams.num_seeds): entry
         # coverage is the recall floor on clustered data
         # clamp: the buffer init takes top itopk of the seeds, so fewer
-        # seeds than itopk slots would break lax.top_k
+        # seeds than itopk slots would break lax.top_k; round to a
+        # multiple of 128 so the seed phase can chunk evenly
         n_seed = max(num_seeds or max(2 * itopk_size, min(2048, n // 64)),
                      itopk_size)
+        n_seed = -(-n_seed // 128) * 128
         init_ids = jax.vmap(
             lambda kk: jax.random.randint(kk, (n_seed,), 0, n))(keys)
         # sampled with replacement: demote duplicate entry slots so an id
@@ -286,7 +288,20 @@ def _search_impl(index: CagraIndex, queries: jax.Array, k: int,
              sorted_ids[:, 1:] == sorted_ids[:, :-1]], axis=1)
         inv = jnp.argsort(order, axis=1)
         dup0 = jnp.take_along_axis(dup_sorted, inv, axis=1)
-        seed_d = dists_to(q, init_ids)
+        # chunk the seed-distance gather: at n_seed=2048 an unchunked
+        # x[init_ids] would materialize [t, n_seed, d] (GBs at large d);
+        # lax.map bounds the intermediate to one chunk
+        if n_seed > 512:
+            c = 512
+            while n_seed % c:
+                c -= 128  # n_seed is a multiple of 128
+            ids_r = jnp.transpose(
+                init_ids.reshape(t, n_seed // c, c), (1, 0, 2))
+            seed_d = jnp.transpose(
+                lax.map(lambda ii: dists_to(q, ii), ids_r),
+                (1, 0, 2)).reshape(t, n_seed)
+        else:
+            seed_d = dists_to(q, init_ids)
         seed_d = jnp.where(dup0, BIG, seed_d)
         _, best = lax.top_k(-seed_d, itopk_size)
         init_ids = jnp.take_along_axis(init_ids, best, axis=1)

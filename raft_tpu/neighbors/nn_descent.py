@@ -77,11 +77,27 @@ def _nn_descent_impl(x: jax.Array, k: int, n_iters: int, n_samples: int,
         sampled = jnp.take_along_axis(graph_ids, sample_pos, axis=1)  # [n, S]
         # neighbor-of-neighbor candidates
         non = graph_ids[sampled].reshape(n, n_samples * k)
-        # reverse-neighbor candidates: nodes that sampled-point to u —
-        # approximate with a random permutation splice of forward edges
+        # TRUE reverse-neighbor candidates: nodes v whose sampled forward
+        # edges point at u (the reference builds reverse lists from the
+        # forward lists the same way, detail/nn_descent.cuh). One stable
+        # sort inverts the [n·S] edge list; each node keeps up to S
+        # reverse sources, overflow dropped, empty slots masked via self.
+        # The edge list is shuffled first so a hub's kept sources are a
+        # RANDOM subsample — a stable sort of the raw list would keep the
+        # lowest source ids every iteration (systematic bias; the
+        # reference subsamples reverse lists randomly too)
         kr = jax.random.fold_in(ki, 1)
-        rev_perm = jax.random.permutation(kr, n)
-        rev = sampled[rev_perm]                           # [n, S] pseudo-reverse
+        shuf = jax.random.permutation(kr, n * n_samples)
+        targets = sampled.reshape(-1)[shuf]
+        srcs = jnp.repeat(jnp.arange(n, dtype=jnp.int32), n_samples)[shuf]
+        order = jnp.argsort(targets, stable=True)
+        st = targets[order]
+        starts = jnp.searchsorted(st, jnp.arange(n, dtype=jnp.int32))
+        rank = (jnp.arange(n * n_samples, dtype=jnp.int32)
+                - starts[st].astype(jnp.int32))
+        rev = jnp.full((n, n_samples), -1, jnp.int32).at[st, rank].set(
+            srcs[order], mode="drop")
+        rev = jnp.where(rev < 0, jnp.arange(n, dtype=jnp.int32)[:, None], rev)
         cand = jnp.concatenate([non, rev], axis=1)
         cd = dists_to(cand)
         return merge(graph_ids, graph_d, cand, cd)

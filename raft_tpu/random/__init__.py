@@ -23,6 +23,7 @@ from raft_tpu.random.rng import (  # noqa: F401
 from raft_tpu.random.generators import (  # noqa: F401
     make_blobs,
     make_regression,
+    multi_variable_gaussian,
     permute,
     rmat_rectangular,
     sample_without_replacement,

@@ -123,6 +123,36 @@ def sample_without_replacement(
     return jnp.take(items, idx, axis=0)
 
 
+def multi_variable_gaussian(
+    state: RngState | jax.Array,
+    mean: jax.Array,
+    cov: jax.Array,
+    n_samples: int,
+    method: str = "cholesky",
+) -> jax.Array:
+    """Samples from N(mean, cov) (reference:
+    random/multi_variable_gaussian.cuh — Cholesky or eigen/"Jacobi"
+    factorization of the covariance).
+
+    ``method``: "cholesky" (cov must be positive definite) or "eig"
+    (tolerates positive semi-definite, matching the reference's Jacobi
+    path). Returns [n_samples, dim].
+    """
+    key = _as_key(state)
+    mean = jnp.asarray(mean, jnp.float32)
+    cov = jnp.asarray(cov, jnp.float32)
+    dim = mean.shape[0]
+    z = jax.random.normal(key, (n_samples, dim), jnp.float32)
+    if method == "cholesky":
+        chol = jnp.linalg.cholesky(cov)
+        return mean[None, :] + z @ chol.T
+    if method == "eig":
+        w, v = jnp.linalg.eigh(cov)
+        scale = v * jnp.sqrt(jnp.maximum(w, 0.0))[None, :]
+        return mean[None, :] + z @ scale.T
+    raise ValueError(f"unknown method {method!r} (cholesky | eig)")
+
+
 def subsample(
     state: RngState | jax.Array,
     n_rows: int,

@@ -99,3 +99,48 @@ class TestSerialize:
         serialize.save_arrays(path, "a", 1, {}, {})
         with pytest.raises(ValueError, match="expected"):
             serialize.load_arrays(path, "b")
+
+
+class TestMatrixMiscOps:
+    """Reference matrix/*.cuh long-tail surfaces."""
+
+    def test_diagonal_ops(self):
+        import jax.numpy as jnp
+        from raft_tpu import matrix as M
+
+        m = jnp.asarray([[2.0, 1.0], [3.0, 4.0]])
+        np.testing.assert_array_equal(np.asarray(M.get_diagonal(m)), [2.0, 4.0])
+        m2 = M.set_diagonal(m, jnp.asarray([9.0, 8.0]))
+        np.testing.assert_array_equal(np.asarray(M.get_diagonal(m2)), [9.0, 8.0])
+        m3 = M.invert_diagonal(m)
+        np.testing.assert_allclose(np.asarray(M.get_diagonal(m3)), [0.5, 0.25])
+
+    def test_math_ops(self):
+        import jax.numpy as jnp
+        from raft_tpu import matrix as M
+
+        m = jnp.asarray([[4.0, 0.01], [1.0, 9.0]])
+        np.testing.assert_allclose(np.asarray(M.sqrt(m))[0, 0], 2.0)
+        np.testing.assert_allclose(np.asarray(M.power(m, 2))[1, 1], 81.0)
+        r = M.reciprocal(m, thres=0.1)
+        assert np.asarray(r)[0, 1] == 0.0 and np.asarray(r)[0, 0] == 0.25
+        np.testing.assert_allclose(float(np.asarray(M.ratio(m)).sum()), 1.0,
+                                   rtol=1e-6)
+        z = M.zero_small_values(m, 0.5)
+        assert np.asarray(z)[0, 1] == 0.0
+        assert np.asarray(M.eye(3)).trace() == 3.0
+        assert np.asarray(M.fill((2, 2), 7.0)).sum() == 28.0
+
+
+def test_multi_variable_gaussian():
+    import jax.numpy as jnp
+    from raft_tpu.random import multi_variable_gaussian
+    from raft_tpu.random.rng import RngState
+
+    mean = jnp.asarray([1.0, -2.0, 0.5])
+    cov = jnp.asarray([[2.0, 0.6, 0.0], [0.6, 1.0, 0.3], [0.0, 0.3, 0.5]])
+    for method in ("cholesky", "eig"):
+        s = np.asarray(multi_variable_gaussian(RngState(0), mean, cov,
+                                               20000, method=method))
+        np.testing.assert_allclose(s.mean(0), np.asarray(mean), atol=0.05)
+        np.testing.assert_allclose(np.cov(s.T), np.asarray(cov), atol=0.08)
