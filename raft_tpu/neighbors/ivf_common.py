@@ -119,15 +119,33 @@ def gather_pair_results(list_vals: jax.Array, list_ids: jax.Array,
 # Auto-dispatch guard: fall back from grouped to per_query only when the
 # grouped scan's qmax-shaped allocations would be memory-hostile.
 # Measured on-chip, grouped beats the gather-bound per_query path even at
-# full skew (qmax = B), so this is a memory bound, not a cost model.
-GROUPED_BYTES_CAP = 1 << 30
+# full skew (qmax = B), so this is a memory bound, not a cost model. The
+# accumulators are transient (freed after the pair gather), so the cap
+# is sized against total HBM, not a per-op budget.
+GROUPED_BYTES_CAP = 4 << 30
+# Per-chunk budget for the [chunk·qmax, L] distance block — the scan's
+# transient; search() shrinks the list chunk (down to 1) to honor it.
+CHUNK_BYTES_TARGET = 256 << 20
 
 
-def grouped_mem_ok(n_lists: int, qmax: int, kk: int) -> bool:
+def grouped_mem_ok(n_lists: int, qmax: int, kk: int, pairs: int) -> bool:
     """True when the grouped scan's qmax-shaped buffers fit the budget:
-    the [n_lists, qmax] int32 queue table plus the [n_lists, qmax, kk]
-    f32+i32 per-slot top-k accumulators (the dominant allocations)."""
-    return n_lists * qmax * (4 + 8 * kk) <= GROUPED_BYTES_CAP
+    the [n_lists, qmax] int32 queue table, the [n_lists, qmax, kk]
+    f32+i32 per-slot top-k accumulators, and the [pairs, kk] gathered
+    results live at the same time during gather_pair_results
+    (``pairs`` = B·n_probes; the per-chunk distance block is bounded
+    separately via fit_list_chunk)."""
+    return (n_lists * qmax * (4 + 8 * kk)
+            + pairs * kk * 8) <= GROUPED_BYTES_CAP
+
+
+def fit_list_chunk(n_lists: int, qmax: int, L: int, want: int) -> int:
+    """Largest list chunk ≤ ``want`` (and dividing n_lists) whose
+    [chunk·qmax, L] f32 distance block stays under CHUNK_BYTES_TARGET —
+    skew-hot batches (large qmax) scan fewer lists per step instead of
+    blowing HBM."""
+    cap = max(1, CHUNK_BYTES_TARGET // max(1, qmax * L * 4))
+    return choose_list_chunk(n_lists, min(want, cap))
 
 
 def max_probe_load(probes: jax.Array, n_lists: int) -> jax.Array:

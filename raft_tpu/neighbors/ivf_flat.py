@@ -492,25 +492,36 @@ def search(index: IvfFlatIndex, queries: jax.Array, k: int,
         max_load, sorted_l, rank_sorted, q_of, rank = ic.probe_sort(
             probes, index.n_lists)
         qmax = ic.exact_qmax(int(max_load))
-        kk_cap = min(k, index.max_list_size)
+        L = index.max_list_size
+        kk = min(k, L)
         if params.scan_mode == "grouped" or ic.grouped_mem_ok(
-                index.n_lists, qmax, kk_cap):
+                index.n_lists, qmax, kk, B * n_probes):
             qtable = ic.qtable_from_sort(sorted_l, rank_sorted, q_of,
                                          index.n_lists, qmax)
-            chunk = ic.choose_list_chunk(index.n_lists, params.list_chunk)
+            chunk = ic.fit_list_chunk(index.n_lists, qmax, L,
+                                      params.list_chunk)
             from raft_tpu.ops import pallas_kernels as _pk
 
-            kk = min(k, index.packed_data.shape[1])
-            wants = _pk.pallas_grouped_wanted(
-                kk, index.packed_data.shape[1], index.dim)
+            wants = _pk.pallas_grouped_wanted(kk, L, index.dim)
             return _search_grouped(index, queries, probes, qtable, rank,
                                    k, qmax, chunk, use_pallas=wants,
                                    filter_bits=filter_bitset)
         # hot-list fallback: reuse the probes, don't redo coarse selection
-        return _search_impl(index, queries, k, n_probes, params.query_tile,
+        return _search_impl(index, queries, k, n_probes,
+                            _fit_query_tile(params.query_tile, n_probes,
+                                            index),
                             filter_bits=filter_bitset, probes=probes)
-    return _search_impl(index, queries, k, n_probes, params.query_tile,
+    return _search_impl(index, queries, k, n_probes,
+                        _fit_query_tile(params.query_tile, n_probes, index),
                         filter_bits=filter_bitset)
+
+
+def _fit_query_tile(want: int, n_probes: int, index: IvfFlatIndex) -> int:
+    """Largest per_query tile ≤ ``want`` whose [t, n_probes, L, d] f32
+    candidate gather stays under ~1 GB — at 1M rows (L≈4k) the default
+    256-query tile would gather 17 GB and OOM the chip."""
+    L, d = index.max_list_size, index.dim
+    return max(1, min(want, (1 << 30) // max(1, n_probes * L * d * 4)))
 
 
 # ---------------------------------------------------------------------------

@@ -525,17 +525,38 @@ def _train_quantizers(trainset: jax.Array, params: IndexParams, dim: int,
 
 def _encode_with_norms(x_rot: jax.Array, centers_rot: jax.Array,
                        labels: jax.Array, codebooks: jax.Array,
-                       codebook_kind: str):
+                       codebook_kind: str, block: int = 4096):
     """(codes [n, S] u8, ‖c + decoded‖² [n]) for either codebook kind —
-    the encode block shared by build/build_chunked/extend."""
-    if codebook_kind == "per_subspace":
+    the encode block shared by build/build_chunked/extend. Both the
+    encode and the norms decode are blocked with ``lax.map``: an
+    unblocked decode's one-hot is K× the code volume (measured OOM at
+    n=1M, pq_dim=64, K=256 on a 16 GB chip)."""
+    per_subspace = codebook_kind == "per_subspace"
+    if per_subspace:
         codes = _encode_rows(x_rot, centers_rot, labels, codebooks)
-        decoded = _decode_codes(codes, codebooks)
     else:
         codes = _encode_rows_cluster(x_rot, centers_rot, labels, codebooks)
-        decoded = _decode_codes_cluster(codes, codebooks[labels])
-    recon = centers_rot[labels] + decoded
-    return codes, jnp.sum(recon * recon, axis=1)
+
+    def norms_block(args):
+        cds, lbls = args
+        if per_subspace:
+            dec = _decode_codes(cds, codebooks)
+        else:
+            dec = _decode_codes_cluster(cds, codebooks[lbls])
+        rec = centers_rot[lbls] + dec
+        return jnp.sum(rec * rec, axis=1)
+
+    n = codes.shape[0]
+    if n <= block:
+        return codes, norms_block((codes, labels))
+    n_blocks = -(-n // block)
+    pad = n_blocks * block - n
+    codes_p = jnp.pad(codes, ((0, pad), (0, 0)))
+    lbls_p = jnp.pad(labels, (0, pad))
+    norms = lax.map(norms_block,
+                    (codes_p.reshape(n_blocks, block, -1),
+                     lbls_p.reshape(n_blocks, block)))
+    return codes, norms.reshape(-1)[:n]
 
 
 @traced("raft_tpu.ivf_pq.build")
@@ -864,14 +885,33 @@ def _search_impl(index: IvfPqIndex, queries: jax.Array, k: int,
     q_sq_all = jnp.sum(q_rot_all * q_rot_all, axis=1)
     qc_probed_all = jnp.take_along_axis(qc, probes, axis=1)  # [m, P] ⟨q,c⟩
 
+    # recon-dot preempts the LUT scan only when (a) the cache exists,
+    # (b) the user didn't ask for LUT quantization, and (c) the one-hot
+    # operand feed [C, S, K] is large enough to be dangerous — observed
+    # device fault at C≈254k, S=64, K=256 (n=1M, L≈4k); small indexes
+    # keep the exact f32-LUT ADC so per_query results are unchanged
+    use_recon_dot = (index.packed_recon is not None
+                     and lut_dtype == "float32"
+                     and n_probes * L * S * K >= (1 << 28))
+
     def search_tile(args):
         q_rot, probe, qc_probed, q_sq = args
         t = q_rot.shape[0]
         q_sub = q_rot.reshape(t, S, P)
-        codes_p = index.packed_codes[probe]               # [t, Pr, L, nb]
-        codes = index.unpack_codes(codes_p)               # [t, Pr, L, S]
         cand_ids = index.packed_ids[probe].reshape(t, n_probes * L)
         cand_norms = index.packed_norms[probe].reshape(t, n_probes * L)
+        if use_recon_dot:
+            # one contraction against the gathered bf16 reconstructions:
+            # ⟨q_rot, c+d⟩ = ⟨q,c⟩ + ⟨q,d⟩, so the LUT decomposition
+            # collapses and no one-hot is formed
+            rows = index.packed_recon[probe].reshape(t, n_probes * L, -1)
+            dots = jnp.einsum("td,tcd->tc", q_rot,
+                              rows.astype(jnp.float32),
+                              precision=get_precision(),
+                              preferred_element_type=jnp.float32)
+            return finish_tile(dots, cand_ids, cand_norms, q_sq)
+        codes_p = index.packed_codes[probe]               # [t, Pr, L, nb]
+        codes = index.unpack_codes(codes_p)               # [t, Pr, L, S]
         # ⟨q, d⟩: qd[t,c] = Σ_s qlut[t, s, codes[t,c,s]].  On TPU this is
         # formulated as a one-hot contraction: per-lane dynamic gathers
         # are the slowest op on a TPU, while the iota-compare one-hot
@@ -919,13 +959,19 @@ def _search_impl(index: IvfPqIndex, queries: jax.Array, k: int,
                 qd = jnp.sum(gath, axis=1)                      # [t, C]
         qcand = jnp.broadcast_to(qc_probed[:, :, None],
                                  (t, n_probes, L)).reshape(t, n_probes * L)
+        return finish_tile(qcand + qd, cand_ids, cand_norms, q_sq)
+
+    def finish_tile(dots, cand_ids, cand_norms, q_sq):
+        """Shared epilogue: ``dots`` = ⟨q, c+d⟩ per candidate (from the
+        LUT decomposition or the recon gather) → metric distances, mask,
+        select, id gather, cosine flip."""
         if ip_like:
-            dists = qcand + qd
+            dists = dots
             invalid = -jnp.inf
             final_min = False
         else:
             dists = jnp.maximum(
-                q_sq[:, None] - 2.0 * (qcand + qd) + cand_norms, 0.0)
+                q_sq[:, None] - 2.0 * dots + cand_norms, 0.0)
             if sqrt_out:
                 dists = jnp.sqrt(dists)
             invalid = jnp.inf
@@ -1146,26 +1192,40 @@ def search(index: IvfPqIndex, queries: jax.Array, k: int,
         max_load, sorted_l, rank_sorted, q_of, rank = ic.probe_sort(
             probes, index.n_lists)
         qmax = ic.exact_qmax(int(max_load))
-        kk_cap = min(k, index.max_list_size)
+        L = index.max_list_size
+        kk = min(k, L)
         if params.scan_mode == "grouped" or ic.grouped_mem_ok(
-                index.n_lists, qmax, kk_cap):
+                index.n_lists, qmax, kk, B * n_probes):
             qtable = ic.qtable_from_sort(sorted_l, rank_sorted, q_of,
                                          index.n_lists, qmax)
-            chunk = ic.choose_list_chunk(index.n_lists, params.list_chunk)
+            chunk = ic.fit_list_chunk(index.n_lists, qmax, L,
+                                      params.list_chunk)
             from raft_tpu.ops import pallas_kernels as _pk
 
-            kk = min(k, index.max_list_size)
-            wants = _pk.pallas_grouped_wanted(
-                kk, index.max_list_size, index.rot_dim)
+            wants = _pk.pallas_grouped_wanted(kk, L, index.rot_dim)
             return _search_grouped(index, queries, probes, qtable, rank,
                                    k, qmax, chunk, use_pallas=wants,
                                    filter_bits=filter_bitset)
         # hot-list fallback: reuse the probes, don't redo coarse selection
-        return _search_impl(index, queries, k, n_probes, params.query_tile,
+        return _search_impl(index, queries, k, n_probes,
+                            _fit_query_tile(params.query_tile, n_probes,
+                                            index),
                             filter_bits=filter_bitset, probes=probes,
                             lut_dtype=params.lut_dtype)
-    return _search_impl(index, queries, k, n_probes, params.query_tile,
+    return _search_impl(index, queries, k, n_probes,
+                        _fit_query_tile(params.query_tile, n_probes, index),
                         filter_bits=filter_bitset, lut_dtype=params.lut_dtype)
+
+
+def _fit_query_tile(want: int, n_probes: int, index: IvfPqIndex) -> int:
+    """Largest per_query tile ≤ ``want`` whose per-tile candidate tensors
+    stay bounded: the f32 [t, n_probes, L, rot_dim] recon gather on the
+    recon-dot path, or the unpacked codes + one-hot operand feed on the
+    LUT path — sized on the wider of the two at 4 bytes."""
+    L = index.max_list_size
+    width = max(index.pq_dim,
+                index.rot_dim if index.packed_recon is not None else 0)
+    return max(1, min(want, (1 << 30) // max(1, n_probes * L * width * 4)))
 
 
 # ---------------------------------------------------------------------------
