@@ -140,19 +140,24 @@ ALGO_REGISTRY: Dict[str, Callable] = {
 
 def _bench_search(search_fn, queries, k, sp, batch_size, iters=5):
     m = queries.shape[0]
+    # pre-split batches ONCE: eager slicing inside the timed loop costs a
+    # per-op dispatch round-trip on remote-device (tunnelled) backends
+    batches = [queries[start : start + batch_size]
+               for start in range(0, m, batch_size)]
+    jax.block_until_ready(batches)
     ids_all = []
     # warmup/compile + correctness capture
-    for start in range(0, m, batch_size):
-        d, i = search_fn(queries[start : start + batch_size], k, sp)
+    for qb in batches:
+        d, i = search_fn(qb, k, sp)
         ids_all.append(np.asarray(jax.device_get(i)))
     ids = np.concatenate(ids_all, axis=0)
-    # timed
+    # timed, end-to-end: device_get the results — block_until_ready alone
+    # does not reliably synchronize on remote-device backends, and the
+    # reference's harness also measures through to host-visible results
     t0 = time.perf_counter()
     for _ in range(iters):
-        outs = []
-        for start in range(0, m, batch_size):
-            outs.append(search_fn(queries[start : start + batch_size], k, sp))
-        jax.block_until_ready(outs)
+        outs = [search_fn(qb, k, sp) for qb in batches]
+        jax.device_get(outs)
     dt = (time.perf_counter() - t0) / iters
     return ids, dt, m / dt
 

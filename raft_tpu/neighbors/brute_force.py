@@ -16,9 +16,10 @@ cached norms brute_force_types.hpp). Design mapping:
 
 from __future__ import annotations
 
-import dataclasses
+from functools import partial
 from typing import Optional, Tuple
 
+import flax.struct
 import jax
 import jax.numpy as jnp
 from jax import lax
@@ -34,15 +35,19 @@ from raft_tpu.utils.precision import get_precision
 _TILE_BUDGET_ELEMS = 1 << 26
 
 
-@dataclasses.dataclass
-class BruteForceIndex:
+class BruteForceIndex(flax.struct.PyTreeNode):
     """Brute-force index: the dataset plus cached norms
-    (reference: brute_force_types.hpp ``brute_force::index``)."""
+    (reference: brute_force_types.hpp ``brute_force::index``).
+
+    A pytree (arrays are leaves, metric config is static) so whole
+    searches jit over it — the search path must be ONE compiled program:
+    op-by-op dispatch costs ~50 ms/op through a remote-device tunnel."""
 
     dataset: jax.Array          # [n, d]
     norms: Optional[jax.Array]  # [n] cached squared L2 norms (L2/cosine only)
-    metric: DistanceType
-    metric_arg: float = 2.0
+    metric: DistanceType = flax.struct.field(pytree_node=False,
+                                             default=DistanceType.L2Expanded)
+    metric_arg: float = flax.struct.field(pytree_node=False, default=2.0)
 
     @property
     def size(self) -> int:
@@ -96,6 +101,7 @@ def _expanded_block(q, db, q_sq, db_sq, metric):
     return d2
 
 
+@partial(jax.jit, static_argnames=("k",))
 @traced("raft_tpu.brute_force.knn")
 def knn(
     index: BruteForceIndex,
@@ -105,6 +111,7 @@ def knn(
 ) -> Tuple[jax.Array, jax.Array]:
     """Exact k nearest neighbors (reference: brute_force::knn,
     brute_force-inl.cuh:156). Returns (distances [m,k], indices [m,k]).
+    The whole search is one jitted program (index is a pytree).
 
     ``filter_bitset``: optional packed bitset over index rows (see
     neighbors.sample_filter) — cleared bits are excluded from results."""

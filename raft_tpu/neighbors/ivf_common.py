@@ -8,158 +8,145 @@ wrong twice over: per-query work is too small for the MXU, and each
 query re-reads its lists from HBM.
 
 The TPU-native structure inverts the loop — **group the query batch by
-probed list**, then stream each list block through the MXU exactly once
-per batch:
+probed list**, then stream each probed list through the MXU in
+fixed-size *segments* of its query queue:
 
 1. probe selection gives ``probes [B, n_probes]`` (queries → lists);
-2. :func:`invert_probes` builds the transposed table
-   ``qtable [n_lists, qmax]`` (lists → queries) via one sort — the same
-   trick the index build uses to pack rows into lists;
-3. the scan loops over *list chunks*: for chunk lists, gather their
-   (few, small) queries, run one batched ``[qmax, d] × [d, L]``
-   contraction per list on the MXU, and take a per-(query,list) top-k;
+2. :func:`segment_probes` buckets the (query, probe) pairs into
+   segments of ``seg`` pairs, each segment owned by ONE list, via one
+   stable sort — the same trick the index build uses to pack rows;
+3. the scan loops over *segment chunks*: gather each segment's list
+   block and its ``seg`` queries, run one batched ``[seg, d] × [d, L]``
+   contraction per segment on the MXU, take a per-(slot, list) top-k;
 4. results are gathered back to ``[B, n_probes, k]`` pair order (a
    gather, not a scatter — TPUs gather much faster than they scatter)
    and a final select_k merges each query's n_probes·k candidates.
 
-HBM traffic: each list block is read once per *batch* instead of once
-per *probing query* — the amortization that makes IVF beat brute force
-on TPU at large batch sizes. ``qmax`` is sized from the actual probe
-histogram (``max_probe_load`` + ``exact_qmax``), so the scan is
-drop-free; the machinery still tolerates ``rank >= qmax`` defensively
-(those pairs come back masked invalid).
+Segments are the load-balancing device: a skew-hot list simply owns
+more segments, a cold list at most one — total padded work is bounded
+by ``pairs + n_lists·seg`` slots regardless of skew. (The earlier
+design padded every list's queue to the batch's max per-list load,
+which both wasted up to ~70× FLOPs under skew and needed a host sync
+to read that load; the segmented table is **statically shaped** from
+``(B, n_probes, n_lists)`` alone, so a whole search — probe selection,
+segmenting, scan, merge — compiles into ONE jitted program with no
+host round-trip.)
+
+HBM traffic: each list block is read once per *owned segment* per
+batch instead of once per *probing query* — the amortization that
+makes IVF beat brute force on TPU at large batch sizes.
 """
 
 from __future__ import annotations
 
-from functools import partial
 from typing import Tuple
 
 import jax
 import jax.numpy as jnp
 
 
-@partial(jax.jit, static_argnames=("n_lists",))
-def probe_sort(probes: jax.Array, n_lists: int):
-    """One stable sort of the flattened probe table, shared by everything
-    downstream: the per-list load histogram (max_load → qmax), the
-    pair-order ranks, and the qtable scatter. Splitting this qmax-
-    independent work out means the host sync that picks the static qmax
-    costs one cheap ``max`` instead of a separate scatter-add histogram
-    (TPU scatters are serial — the bincount approach measured ~100 ms at
-    B=10k on a v5e chip, the sort pipeline amortizes it to ~0).
-
-    Returns (max_load [], sorted_l [B·P], rank_sorted [B·P], q_of [B·P],
-    rank [B, P]).
-    """
-    B, P = probes.shape
-    l_flat = probes.reshape(-1).astype(jnp.int32)
-    order = jnp.argsort(l_flat, stable=True)
-    sorted_l = l_flat[order]
-    starts = jnp.searchsorted(sorted_l, jnp.arange(n_lists, dtype=jnp.int32))
-    rank_sorted = (jnp.arange(B * P, dtype=jnp.int32)
-                   - starts[sorted_l].astype(jnp.int32))
-    counts = jnp.diff(jnp.append(starts, B * P))
-    max_load = jnp.max(counts)
-    # back to pair order (small scatter: B·P elements)
-    rank = jnp.zeros((B * P,), jnp.int32).at[order].set(rank_sorted)
-    q_of = (order // P).astype(jnp.int32)
-    return max_load, sorted_l, rank_sorted, q_of, rank.reshape(B, P)
+# Default segment size: one MXU-friendly block of queries per segment
+# (matches the Pallas grouped kernel's bq block).
+SEGMENT_SIZE = 128
 
 
-@partial(jax.jit, static_argnames=("n_lists", "qmax"))
-def qtable_from_sort(sorted_l: jax.Array, rank_sorted: jax.Array,
-                     q_of: jax.Array, n_lists: int, qmax: int) -> jax.Array:
-    """Scatter the sorted probe pairs into the [n_lists, qmax] queue table
-    (the only qmax-dependent step; see probe_sort)."""
-    qtable = jnp.full((n_lists, qmax), -1, jnp.int32)
-    return qtable.at[sorted_l, rank_sorted].set(q_of, mode="drop")
+def n_segments(pairs: int, n_lists: int, seg: int) -> int:
+    """Static upper bound on the segment count: every list owns
+    ``ceil(load/seg)`` segments, and ``sum ceil(load/seg) <=
+    floor(pairs/seg) + n_lists`` for any load histogram — so the table
+    shape depends only on (B, n_probes, n_lists, seg), never on the
+    data. That is what keeps the whole search one jitted program."""
+    return pairs // seg + n_lists
 
 
-def invert_probes(probes: jax.Array, n_lists: int, qmax: int
-                  ) -> Tuple[jax.Array, jax.Array]:
-    """Invert queries→lists probes into per-list query queues.
+def segment_probes(probes: jax.Array, n_lists: int, seg: int, n_seg: int):
+    """Bucket (query, probe) pairs into per-list segments (trace-time;
+    called inside the search jit).
+
+    One stable sort of the flattened probe table gives each pair its
+    within-list rank; segment ids follow from a cumsum of per-list
+    segment counts. TPU note: this is one sort + one scatter of B·P
+    elements — the scatter-free alternatives (bincount histograms)
+    measured slower on a v5e chip because TPU scatters serialize.
 
     Parameters
     ----------
     probes : [B, P] int32 list ids per query.
     n_lists : number of inverted lists.
-    qmax : queue capacity per list (static).
+    seg : segment capacity (pairs per segment, static).
+    n_seg : static segment-table height (:func:`n_segments`).
 
     Returns
     -------
-    qtable : [n_lists, qmax] int32 — query ids probing each list, -1 pad.
-    rank : [B, P] int32 — each (query, probe) pair's slot in its list's
-        queue; ``rank >= qmax`` marks a dropped pair.
+    seg_list : [n_seg] int32 — which list each segment scans (unused
+        segments point at an arbitrary list; their slots are all -1).
+    seg_q : [n_seg, seg] int32 — query ids, -1 pad.
+    pair_seg, pair_slot : [B, P] int32 — each pair's (segment, slot)
+        address, for gathering results back to pair order.
     """
-    _, sorted_l, rank_sorted, q_of, rank = probe_sort(probes, n_lists)
-    return qtable_from_sort(sorted_l, rank_sorted, q_of, n_lists, qmax), rank
+    B, P = probes.shape
+    BP = B * P
+    l_flat = probes.reshape(-1).astype(jnp.int32)
+    order = jnp.argsort(l_flat, stable=True)
+    sorted_l = l_flat[order]
+    starts = jnp.searchsorted(sorted_l, jnp.arange(n_lists, dtype=jnp.int32))
+    rank_sorted = (jnp.arange(BP, dtype=jnp.int32)
+                   - starts[sorted_l].astype(jnp.int32))
+    counts = jnp.diff(jnp.append(starts, BP)).astype(jnp.int32)
+    segs_per_list = (counts + seg - 1) // seg
+    seg_base = jnp.cumsum(segs_per_list) - segs_per_list  # exclusive
+    seg_sorted = seg_base[sorted_l] + rank_sorted // seg
+    slot_sorted = rank_sorted % seg
+    q_of = (order // P).astype(jnp.int32)
+    seg_q = jnp.full((n_seg, seg), -1, jnp.int32).at[
+        seg_sorted, slot_sorted].set(q_of, mode="drop")
+    # segment → owning list: rightmost list whose base is <= s (right-
+    # side search steps over zero-segment lists, whose base repeats)
+    seg_list = jnp.clip(
+        jnp.searchsorted(seg_base, jnp.arange(n_seg, dtype=jnp.int32),
+                         side="right") - 1, 0, n_lists - 1).astype(jnp.int32)
+    # pair-order addresses: one combined scatter, then split
+    comb = jnp.zeros((BP,), jnp.int32).at[order].set(
+        seg_sorted * seg + slot_sorted)
+    return (seg_list, seg_q,
+            (comb // seg).reshape(B, P), (comb % seg).reshape(B, P))
 
 
-def gather_pair_results(list_vals: jax.Array, list_ids: jax.Array,
-                        probes: jax.Array, rank: jax.Array,
-                        invalid_val) -> Tuple[jax.Array, jax.Array]:
-    """Collect per-(list, queue-slot) top-k back into (query, probe) order.
-
-    ``list_vals/list_ids [n_lists, qmax, k]`` hold each queue slot's local
-    top-k; pair (q, p) owns slot ``(probes[q,p], rank[q,p])``. Dropped
-    pairs (rank >= qmax) come back masked to ``invalid_val`` / -1.
-    Returns ``[B, P, k]`` values and ids.
-    """
-    qmax = list_vals.shape[1]
-    ok = rank < qmax
-    r = jnp.minimum(rank, qmax - 1)
-    vals = list_vals[probes, r]
-    ids = list_ids[probes, r]
-    vals = jnp.where(ok[..., None], vals, invalid_val)
-    ids = jnp.where(ok[..., None], ids, -1)
-    return vals, ids
+def gather_segment_results(seg_vals: jax.Array, seg_ids: jax.Array,
+                           pair_seg: jax.Array, pair_slot: jax.Array
+                           ) -> Tuple[jax.Array, jax.Array]:
+    """Collect per-(segment, slot) top-k back into (query, probe) order:
+    ``[n_seg, seg, kk] → [B, P, kk]``. Pure gather — every pair owns
+    exactly one slot (the segmented table is drop-free by construction)."""
+    return seg_vals[pair_seg, pair_slot], seg_ids[pair_seg, pair_slot]
 
 
 # Auto-dispatch guard: fall back from grouped to per_query only when the
-# grouped scan's qmax-shaped allocations would be memory-hostile.
-# Measured on-chip, grouped beats the gather-bound per_query path even at
-# full skew (qmax = B), so this is a memory bound, not a cost model. The
-# accumulators are transient (freed after the pair gather), so the cap
-# is sized against total HBM, not a per-op budget.
+# segmented scan's allocations would be memory-hostile. Measured
+# on-chip, grouped beats the gather-bound per_query path, so this is a
+# memory bound, not a cost model. The accumulators are transient (freed
+# after the pair gather), so the cap is sized against total HBM.
 GROUPED_BYTES_CAP = 4 << 30
-# Per-chunk budget for the [chunk·qmax, L] distance block — the scan's
-# transient; search() shrinks the list chunk (down to 1) to honor it.
+# Per-chunk budget for the scan's transients (the [chunk·seg, L]
+# distance block and the gathered [chunk, L, d] list blocks); search()
+# shrinks the segment chunk (down to 1) to honor it.
 CHUNK_BYTES_TARGET = 256 << 20
 
 
-def grouped_mem_ok(n_lists: int, qmax: int, kk: int, pairs: int) -> bool:
-    """True when the grouped scan's qmax-shaped buffers fit the budget:
-    the [n_lists, qmax] int32 queue table, the [n_lists, qmax, kk]
-    f32+i32 per-slot top-k accumulators, and the [pairs, kk] gathered
-    results live at the same time during gather_pair_results
-    (``pairs`` = B·n_probes; the per-chunk distance block is bounded
-    separately via fit_list_chunk)."""
-    return (n_lists * qmax * (4 + 8 * kk)
-            + pairs * kk * 8) <= GROUPED_BYTES_CAP
+def grouped_mem_ok(n_seg: int, seg: int, kk: int, pairs: int) -> bool:
+    """True when the segmented scan's buffers fit the budget: the
+    [n_seg, seg] int32 query table, the [n_seg, seg, kk] f32+i32
+    per-slot top-k accumulators, and the [pairs, kk] gathered results
+    live at the same time during gather_segment_results."""
+    return (n_seg * seg * (4 + 8 * kk) + pairs * kk * 8) <= GROUPED_BYTES_CAP
 
 
-def fit_list_chunk(n_lists: int, qmax: int, L: int, want: int) -> int:
-    """Largest list chunk ≤ ``want`` (and dividing n_lists) whose
-    [chunk·qmax, L] f32 distance block stays under CHUNK_BYTES_TARGET —
-    skew-hot batches (large qmax) scan fewer lists per step instead of
-    blowing HBM."""
-    cap = max(1, CHUNK_BYTES_TARGET // max(1, qmax * L * 4))
-    return choose_list_chunk(n_lists, min(want, cap))
-
-
-def max_probe_load(probes: jax.Array, n_lists: int) -> jax.Array:
-    """Largest per-list queue load of a probe table [B, P] — the exact
-    qmax needed for a drop-free grouped scan (sort-based; see probe_sort)."""
-    return probe_sort(probes, n_lists)[0]
-
-
-def exact_qmax(max_load: int) -> int:
-    """Static queue capacity covering the observed max load, rounded up
-    to a power of two (≥8) so repeated searches with similar batches hit
-    the jit cache instead of recompiling per batch."""
-    m = max(8, int(max_load))
-    return 1 << (m - 1).bit_length()
+def fit_seg_chunk(seg: int, L: int, d: int, want: int) -> int:
+    """Largest segment chunk ≤ ``want`` whose per-step transients — the
+    [chunk·seg, L] f32 distance block and the gathered [chunk, L, d]
+    f32 list blocks — stay under CHUNK_BYTES_TARGET."""
+    per_seg = L * 4 * (seg + d)
+    return max(1, min(want, CHUNK_BYTES_TARGET // max(1, per_seg)))
 
 
 def pack_lists(row_arrays, labels: jax.Array, row_ids: jax.Array,

@@ -74,7 +74,7 @@ class SearchParams:
     n_probes: int = 20
     query_tile: int = 256  # per_query path: bounds the per-step intermediate
     scan_mode: str = "auto"  # "auto" | "grouped" | "per_query"
-    list_chunk: int = 16     # grouped path: lists scanned per step
+    list_chunk: int = 64     # grouped path: segments scanned per step
 
 
 class IvfFlatIndex(flax.struct.PyTreeNode):
@@ -277,8 +277,7 @@ def _coarse_distances(q, centers, mt):
 
 @partial(jax.jit, static_argnames=("k", "n_probes", "query_tile"))
 def _search_impl(index: IvfFlatIndex, queries: jax.Array, k: int,
-                 n_probes: int, query_tile: int, filter_bits=None,
-                 probes=None):
+                 n_probes: int, query_tile: int, filter_bits=None):
     mt = resolve_metric(index.metric)
     q_all = queries.astype(jnp.float32)
     m = q_all.shape[0]
@@ -286,9 +285,8 @@ def _search_impl(index: IvfFlatIndex, queries: jax.Array, k: int,
     sqrt_out = mt == DistanceType.L2SqrtExpanded
     select_min = mt != DistanceType.InnerProduct
 
-    if probes is None:  # callers with precomputed probes pass them in
-        coarse, coarse_min = _coarse_distances(q_all, index.centers, mt)
-        _, probes = _select_k(coarse, n_probes, select_min=coarse_min)
+    coarse, coarse_min = _coarse_distances(q_all, index.centers, mt)
+    _, probes = _select_k(coarse, n_probes, select_min=coarse_min)
 
     def search_tile(args):
         q, probe = args  # [t, dim], [t, P]
@@ -339,45 +337,34 @@ def _search_impl(index: IvfFlatIndex, queries: jax.Array, k: int,
             ids.reshape(n_tiles * query_tile, k)[:m])
 
 
-@partial(jax.jit, static_argnames=("n_probes",))
-def _select_probes(index: IvfFlatIndex, queries: jax.Array,
-                   n_probes: int) -> jax.Array:
-    """Coarse probe selection → [B, n_probes] list ids (reference:
-    select_clusters). Split out so search() can size the grouped scan's
-    queues from the actual probe histogram before staging the scan."""
-    q_all = queries.astype(jnp.float32)
-    coarse, coarse_min = _coarse_distances(q_all, index.centers,
-                                           resolve_metric(index.metric))
-    _, probes = _select_k(coarse, n_probes, select_min=coarse_min)
-    return probes
-
-
-@partial(jax.jit, static_argnames=("k", "qmax", "list_chunk", "use_pallas"))
-def _search_grouped(index: IvfFlatIndex, queries: jax.Array,
-                    probes: jax.Array, qtable: jax.Array, rank: jax.Array,
-                    k: int, qmax: int, list_chunk: int,
+@partial(jax.jit, static_argnames=("k", "n_probes", "seg", "n_seg",
+                                   "seg_chunk", "use_pallas"))
+def _search_grouped(index: IvfFlatIndex, queries: jax.Array, k: int,
+                    n_probes: int, seg: int, n_seg: int, seg_chunk: int,
                     use_pallas: bool = False, filter_bits=None):
-    """List-centric batch scan (see ivf_common module docstring): stream
-    each list block through the MXU once per batch, queries grouped by
-    probed list. TPU counterpart of the reference's interleaved scan
-    (ivf_flat_interleaved_scan-inl.cuh) with the loop order inverted.
-    ``qtable``/``rank`` come from the probe inversion (ivf_common) —
-    computed by search() so their sort is shared with the qmax sizing;
-    ``qmax`` covers the max per-list load, making the scan drop-free.
-    ``use_pallas`` (static) routes the per-chunk scan to the fused
-    Pallas kernel."""
+    """Segmented list-centric batch scan (see ivf_common module
+    docstring): probe selection, probe segmenting, the MXU scan over
+    segment chunks, and the final merge — ONE jitted program, statically
+    shaped by (B, n_probes, n_lists, seg). TPU counterpart of the
+    reference's interleaved scan (ivf_flat_interleaved_scan-inl.cuh)
+    with the loop order inverted. ``use_pallas`` (static) routes the
+    per-chunk scan to the fused Pallas kernel."""
     from raft_tpu.neighbors import ivf_common as ic
 
     mt = resolve_metric(index.metric)
     q_all = queries.astype(jnp.float32)
     B = q_all.shape[0]
-    n_probes = probes.shape[1]
     n_lists, L, d = index.packed_data.shape
     sqrt_out = mt == DistanceType.L2SqrtExpanded
     ip = mt == DistanceType.InnerProduct
     cos = mt == DistanceType.CosineExpanded
     select_min = not ip
     invalid = -jnp.inf if ip else jnp.inf
+
+    coarse, coarse_min = _coarse_distances(q_all, index.centers, mt)
+    _, probes = _select_k(coarse, n_probes, select_min=coarse_min)
+    seg_list, seg_q, pair_seg, pair_slot = ic.segment_probes(
+        probes, n_lists, seg, n_seg)
 
     q_sq = jnp.sum(q_all * q_all, axis=1)                 # [B]
     qn = jnp.sqrt(jnp.maximum(q_sq, 1e-30))
@@ -387,35 +374,42 @@ def _search_grouped(index: IvfFlatIndex, queries: jax.Array,
 
         valid_full &= passes(filter_bits, index.packed_ids)
 
-    G = list_chunk
-    n_chunks = n_lists // G
-    data_r = index.packed_data.reshape(n_chunks, G, L, d)
-    norms_r = index.packed_norms.reshape(n_chunks, G, L)
-    lids_r = index.packed_ids.reshape(n_chunks, G, L)
-    valid_r = valid_full.reshape(n_chunks, G, L)
-    qt_r = qtable.reshape(n_chunks, G, qmax)
+    C = seg_chunk
+    n_chunks = -(-n_seg // C)
+    nsp = n_chunks * C
+    seg_list = jnp.pad(seg_list, (0, nsp - n_seg))
+    seg_q = jnp.pad(seg_q, ((0, nsp - n_seg), (0, 0)), constant_values=-1)
 
     from raft_tpu.ops import pallas_kernels as _pk
 
+    kk = min(k, L)  # a single list holds at most L candidates
+
     def scan_chunk(args):
-        data, norms, lids, valid, qt = args
-        qi = jnp.clip(qt, 0, B - 1)                       # [G, qmax]
-        qv = q_all[qi]                                    # [G, qmax, d]
+        sl, qt = args                                     # [C], [C, seg]
+        data = index.packed_data[sl].astype(jnp.float32)  # [C, L, d]
+        norms = index.packed_norms[sl]
+        lids = index.packed_ids[sl]
+        valid = valid_full[sl]
+        qi = jnp.clip(qt, 0, B - 1)                       # [C, seg]
+        qv = q_all[qi]                                    # [C, seg, d]
+        # pad slots (qt == -1) compute against query 0 and are simply
+        # never gathered back — masking them would cost more than the
+        # wasted lanes
         if use_pallas:
             # fused contraction + epilogue + local top-k in VMEM — the
-            # [G·qmax, L] distance block never reaches HBM (reference:
+            # [C·seg, L] distance block never reaches HBM (reference:
             # the fused scan kernels, ivf_flat_interleaved_scan-inl.cuh)
             met = "ip" if ip else ("cos" if cos else "l2")
             mask_add = jnp.where(valid, 0.0, jnp.inf)
             keys, pos = _pk.grouped_scan_topk(
-                qv, data.astype(jnp.float32), mask_add, kk, met,
+                qv, data, mask_add, kk, met, bq=seg,
                 interpret=not _pk._on_tpu())
             vals = -keys if ip else keys
             vals = jnp.where(pos < 0, invalid, vals)
             cids = jax.vmap(lambda l, p: l[jnp.clip(p, 0, L - 1)])(lids, pos)
             cids = jnp.where(pos < 0, -1, cids)
             return vals, cids
-        scores = jnp.einsum("gqd,gld->gql", qv, data.astype(jnp.float32),
+        scores = jnp.einsum("gqd,gld->gql", qv, data,
                             precision=get_precision(),
                             preferred_element_type=jnp.float32)
         if ip:
@@ -427,20 +421,21 @@ def _search_grouped(index: IvfFlatIndex, queries: jax.Array,
             dists = jnp.maximum(
                 q_sq[qi][:, :, None] + norms[:, None, :] - 2.0 * scores, 0.0)
         dists = jnp.where(valid[:, None, :], dists, invalid)
-        vals, pos = _select_k(dists.reshape(G * qmax, L), kk,
+        vals, pos = _select_k(dists.reshape(C * seg, L), kk,
                               select_min=select_min)
-        vals = vals.reshape(G, qmax, kk)
-        pos = pos.reshape(G, qmax, kk)
-        cids = jax.vmap(lambda l, p: l[p])(lids, pos)     # [G, qmax, kk]
-        cids = jnp.where(vals == invalid, -1, cids)       # filtered/padded slots
+        vals = vals.reshape(C, seg, kk)
+        pos = pos.reshape(C, seg, kk)
+        cids = jax.vmap(lambda l, p: l[p])(lids, pos)     # [C, seg, kk]
+        cids = jnp.where(vals == invalid, -1, cids)       # filtered/padded
         return vals, cids
 
-    kk = min(k, L)  # a single list holds at most L candidates
-    vals, cids = lax.map(scan_chunk, (data_r, norms_r, lids_r, valid_r, qt_r))
-    vals = vals.reshape(n_lists, qmax, kk)
-    cids = cids.reshape(n_lists, qmax, kk)
+    vals, cids = lax.map(
+        scan_chunk, (seg_list.reshape(n_chunks, C),
+                     seg_q.reshape(n_chunks, C, seg)))
+    vals = vals.reshape(nsp, seg, kk)
+    cids = cids.reshape(nsp, seg, kk)
 
-    pv, pi = ic.gather_pair_results(vals, cids, probes, rank, invalid)
+    pv, pi = ic.gather_segment_results(vals, cids, pair_seg, pair_slot)
     out_vals, out_ids = _select_k(pv.reshape(B, n_probes * kk),
                                   min(k, n_probes * kk),
                                   select_min=select_min,
@@ -480,37 +475,23 @@ def search(index: IvfFlatIndex, queries: jax.Array, k: int,
     if mode == "grouped":
         from raft_tpu.neighbors import ivf_common as ic
 
-        # size the per-list queues from the ACTUAL probe histogram, so the
-        # grouped scan never drops (query, probe) pairs. Skew-hot lists
-        # inflate qmax toward B — that wastes scan FLOPs on cold lists'
-        # padding, but measured on-chip the per_query gather path is an
-        # order of magnitude slower still (TPUs hate gathers, love the
-        # MXU), so grouped stays preferred until the queue TABLE itself
-        # is memory-hostile. One stable sort feeds the histogram, the
-        # ranks, and the queue table.
-        probes = _select_probes(index, queries, n_probes)
-        max_load, sorted_l, rank_sorted, q_of, rank = ic.probe_sort(
-            probes, index.n_lists)
-        qmax = ic.exact_qmax(int(max_load))
+        # segmented scan: the table shape is a function of (B, n_probes,
+        # n_lists, seg) alone — no probe histogram, no host sync, one
+        # jitted program per static config (see ivf_common docstring)
+        seg = ic.SEGMENT_SIZE
+        pairs = B * n_probes
+        n_seg = ic.n_segments(pairs, index.n_lists, seg)
         L = index.max_list_size
         kk = min(k, L)
         if params.scan_mode == "grouped" or ic.grouped_mem_ok(
-                index.n_lists, qmax, kk, B * n_probes):
-            qtable = ic.qtable_from_sort(sorted_l, rank_sorted, q_of,
-                                         index.n_lists, qmax)
-            chunk = ic.fit_list_chunk(index.n_lists, qmax, L,
-                                      params.list_chunk)
+                n_seg, seg, kk, pairs):
+            chunk = ic.fit_seg_chunk(seg, L, index.dim, params.list_chunk)
             from raft_tpu.ops import pallas_kernels as _pk
 
-            wants = _pk.pallas_grouped_wanted(kk, L, index.dim)
-            return _search_grouped(index, queries, probes, qtable, rank,
-                                   k, qmax, chunk, use_pallas=wants,
+            wants = _pk.pallas_grouped_wanted(kk, L, index.dim, bq=seg)
+            return _search_grouped(index, queries, k, n_probes, seg,
+                                   n_seg, chunk, use_pallas=wants,
                                    filter_bits=filter_bitset)
-        # hot-list fallback: reuse the probes, don't redo coarse selection
-        return _search_impl(index, queries, k, n_probes,
-                            _fit_query_tile(params.query_tile, n_probes,
-                                            index),
-                            filter_bits=filter_bitset, probes=probes)
     return _search_impl(index, queries, k, n_probes,
                         _fit_query_tile(params.query_tile, n_probes, index),
                         filter_bits=filter_bitset)

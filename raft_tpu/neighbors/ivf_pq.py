@@ -92,7 +92,7 @@ class SearchParams:
     n_probes: int = 20
     query_tile: int = 64
     scan_mode: str = "auto"  # "auto" | "grouped" | "per_query"
-    list_chunk: int = 8
+    list_chunk: int = 64
     lut_dtype: str = "float32"
 
 
@@ -854,7 +854,7 @@ def extend(index: IvfPqIndex, new_vectors: jax.Array,
                                    "lut_dtype"))
 def _search_impl(index: IvfPqIndex, queries: jax.Array, k: int,
                  n_probes: int, query_tile: int, filter_bits=None,
-                 probes=None, lut_dtype: str = "float32"):
+                 lut_dtype: str = "float32"):
     mt = resolve_metric(index.metric)
     q_all = jnp.asarray(queries, jnp.float32)
     if mt == DistanceType.CosineExpanded:
@@ -873,13 +873,12 @@ def _search_impl(index: IvfPqIndex, queries: jax.Array, k: int,
     qc = lax.dot_general(q_all, index.centers, (((1,), (1,)), ((), ())),
                          precision=get_precision(),
                          preferred_element_type=jnp.float32)  # [m, n_lists]
-    if probes is None:
-        if ip_like:
-            _, probes = _select_k(qc, n_probes, select_min=False)
-        else:
-            c_sq = jnp.sum(index.centers**2, axis=1)
-            _, probes = _select_k(c_sq[None, :] - 2.0 * qc, n_probes,
-                                  select_min=True)
+    if ip_like:
+        _, probes = _select_k(qc, n_probes, select_min=False)
+    else:
+        c_sq = jnp.sum(index.centers**2, axis=1)
+        _, probes = _select_k(c_sq[None, :] - 2.0 * qc, n_probes,
+                              select_min=True)
 
     q_rot_all = q_all @ index.rotation.T
     q_sq_all = jnp.sum(q_rot_all * q_rot_all, axis=1)
@@ -1005,43 +1004,21 @@ def _search_impl(index: IvfPqIndex, queries: jax.Array, k: int,
     return (vals.reshape(-1, k)[:m], ids.reshape(-1, k)[:m])
 
 
-@partial(jax.jit, static_argnames=("n_probes",))
-def _select_probes(index: IvfPqIndex, queries: jax.Array,
-                   n_probes: int) -> jax.Array:
-    """Coarse probe selection → [B, n_probes] list ids (reference:
-    select_clusters, ivf_pq_search.cuh:70-156). Split out so search()
-    can size the grouped scan's queues from the probe histogram."""
-    mt = resolve_metric(index.metric)
-    q_all = jnp.asarray(queries, jnp.float32)
-    if mt == DistanceType.CosineExpanded:
-        q_all = q_all / jnp.sqrt(jnp.maximum(
-            jnp.sum(q_all * q_all, -1, keepdims=True), 1e-12))
-    qc = lax.dot_general(q_all, index.centers, (((1,), (1,)), ((), ())),
-                         precision=get_precision(),
-                         preferred_element_type=jnp.float32)
-    if mt in (DistanceType.InnerProduct, DistanceType.CosineExpanded):
-        _, probes = _select_k(qc, n_probes, select_min=False)
-    else:
-        c_sq = jnp.sum(index.centers**2, axis=1)
-        _, probes = _select_k(c_sq[None, :] - 2.0 * qc, n_probes,
-                              select_min=True)
-    return probes
-
-
-@partial(jax.jit, static_argnames=("k", "qmax", "list_chunk", "use_pallas"))
-def _search_grouped(index: IvfPqIndex, queries: jax.Array,
-                    probes: jax.Array, qtable: jax.Array, rank: jax.Array,
-                    k: int, qmax: int, list_chunk: int,
+@partial(jax.jit, static_argnames=("k", "n_probes", "seg", "n_seg",
+                                   "seg_chunk", "use_pallas"))
+def _search_grouped(index: IvfPqIndex, queries: jax.Array, k: int,
+                    n_probes: int, seg: int, n_seg: int, seg_chunk: int,
                     use_pallas: bool = False, filter_bits=None):
-    """List-centric batch scan (see ivf_common): each list's codes are
-    decoded ONCE per query batch (one-hot MXU contraction — or skipped
-    entirely when the bf16 reconstruction cache is present) and scanned
-    against its queued queries with one batched MXU contraction.
-    Counterpart of the reference's compute_similarity kernel
+    """Segmented list-centric batch scan (see ivf_common): each probed
+    list's codes are decoded once per owned segment (one-hot MXU
+    contraction — or skipped entirely when the bf16 reconstruction cache
+    is present) and scanned against that segment's queries with one
+    batched MXU contraction. Probe selection, segmenting, scan and merge
+    are ONE jitted program, statically shaped by (B, n_probes, n_lists,
+    seg). Counterpart of the reference's compute_similarity kernel
     (ivf_pq_compute_similarity-inl.cuh) with the loop order inverted:
     the reference re-reads packed codes per query, this reads them per
-    batch. ``qmax`` must cover the probe table's max per-list load
-    (search() sizes it exactly) — the scan is then drop-free."""
+    query *segment*."""
     from raft_tpu.neighbors import ivf_common as ic
 
     mt = resolve_metric(index.metric)
@@ -1050,7 +1027,6 @@ def _search_grouped(index: IvfPqIndex, queries: jax.Array,
         q_all = q_all / jnp.sqrt(jnp.maximum(
             jnp.sum(q_all * q_all, -1, keepdims=True), 1e-12))
     B = q_all.shape[0]
-    n_probes = probes.shape[1]
     n_lists, L, nb = index.packed_codes.shape
     per_cluster = index.codebook_kind == "per_cluster"
     ip_like = mt in (DistanceType.InnerProduct, DistanceType.CosineExpanded)
@@ -1062,6 +1038,18 @@ def _search_grouped(index: IvfPqIndex, queries: jax.Array,
 
     use_pallas = use_pallas and index.packed_recon is not None
 
+    qc = lax.dot_general(q_all, index.centers, (((1,), (1,)), ((), ())),
+                         precision=get_precision(),
+                         preferred_element_type=jnp.float32)
+    if ip_like:
+        _, probes = _select_k(qc, n_probes, select_min=False)
+    else:
+        c_sq = jnp.sum(index.centers**2, axis=1)
+        _, probes = _select_k(c_sq[None, :] - 2.0 * qc, n_probes,
+                              select_min=True)
+    seg_list, seg_q, pair_seg, pair_slot = ic.segment_probes(
+        probes, n_lists, seg, n_seg)
+
     q_rot = q_all @ index.rotation.T                      # [B, rot_dim]
     q_sq = jnp.sum(q_rot * q_rot, axis=1)
     valid_full = index.packed_ids >= 0
@@ -1070,31 +1058,31 @@ def _search_grouped(index: IvfPqIndex, queries: jax.Array,
 
         valid_full &= passes(filter_bits, index.packed_ids)
 
-    G = list_chunk
-    n_chunks = n_lists // G
-    codes_r = index.packed_codes.reshape(n_chunks, G, L, nb)
-    norms_r = index.packed_norms.reshape(n_chunks, G, L)
-    lids_r = index.packed_ids.reshape(n_chunks, G, L)
-    valid_r = valid_full.reshape(n_chunks, G, L)
-    qt_r = qtable.reshape(n_chunks, G, qmax)
-    crot_r = index.centers_rot.reshape(n_chunks, G, -1)
-    recon_r = (None if index.packed_recon is None
-               else index.packed_recon.reshape(n_chunks, G, L, -1))
+    C = seg_chunk
+    n_chunks = -(-n_seg // C)
+    nsp = n_chunks * C
+    seg_list = jnp.pad(seg_list, (0, nsp - n_seg))
+    seg_q = jnp.pad(seg_q, ((0, nsp - n_seg), (0, 0)), constant_values=-1)
+    has_recon = index.packed_recon is not None
 
     def scan_chunk(args):
-        if recon_r is None and per_cluster:
-            codes_p, norms, lids, valid, qt, crot, cb = args
-            decoded = _decode_lists_cluster(index.unpack_codes(codes_p), cb)
-            recon = decoded + crot[:, None, :]
-        elif recon_r is None:
-            codes_p, norms, lids, valid, qt, crot = args
-            codes = index.unpack_codes(codes_p)
-            decoded = _decode_codes(codes, index.codebooks)  # [G, L, rot]
-            recon = decoded + crot[:, None, :]
+        sl, qt = args                                     # [C], [C, seg]
+        norms = index.packed_norms[sl]
+        lids = index.packed_ids[sl]
+        valid = valid_full[sl]
+        if has_recon:
+            recon = index.packed_recon[sl]                # [C, L, rot]
         else:
-            recon, norms, lids, valid, qt = args
+            codes = index.unpack_codes(index.packed_codes[sl])
+            if per_cluster:
+                decoded = _decode_lists_cluster(codes, index.codebooks[sl])
+            else:
+                decoded = _decode_codes(codes, index.codebooks)
+            recon = decoded + index.centers_rot[sl][:, None, :]
         qi = jnp.clip(qt, 0, B - 1)
-        qv = q_rot[qi]                                    # [G, qmax, rot]
+        qv = q_rot[qi]                                    # [C, seg, rot]
+        # pad slots (qt == -1) compute against query 0 and are simply
+        # never gathered back
         if use_pallas:
             # fused contraction + epilogue + local top-k in VMEM over the
             # bf16 reconstructions (reference: compute_similarity's fused
@@ -1104,7 +1092,8 @@ def _search_grouped(index: IvfPqIndex, queries: jax.Array,
             met = "ip" if ip_like else "l2"
             mask_add = jnp.where(valid, 0.0, jnp.inf)
             keys, pos = _pk.grouped_scan_topk(
-                qv, recon, mask_add, kk, met, interpret=not _pk._on_tpu())
+                qv, recon, mask_add, kk, met, bq=seg,
+                interpret=not _pk._on_tpu())
             vals = -keys if ip_like else keys
             vals = jnp.where(pos < 0, invalid, vals)
             cids = jax.vmap(lambda l, p: l[jnp.clip(p, 0, L - 1)])(lids, pos)
@@ -1120,28 +1109,22 @@ def _search_grouped(index: IvfPqIndex, queries: jax.Array,
             dists = jnp.maximum(
                 q_sq[qi][:, :, None] + norms[:, None, :] - 2.0 * scores, 0.0)
         dists = jnp.where(valid[:, None, :], dists, invalid)
-        vals, pos = _select_k(dists.reshape(G * qmax, L), kk,
+        vals, pos = _select_k(dists.reshape(C * seg, L), kk,
                               select_min=select_min)
-        vals = vals.reshape(G, qmax, kk)
-        pos = pos.reshape(G, qmax, kk)
+        vals = vals.reshape(C, seg, kk)
+        pos = pos.reshape(C, seg, kk)
         cids = jax.vmap(lambda l, p: l[p])(lids, pos)
         cids = jnp.where(vals == invalid, -1, cids)
         return vals, cids
 
     kk = min(k, L)  # a single list holds at most L candidates
-    if recon_r is None and per_cluster:
-        K, P = index.codebooks.shape[1:]
-        ins = (codes_r, norms_r, lids_r, valid_r, qt_r, crot_r,
-               index.codebooks.reshape(n_chunks, G, K, P))
-    elif recon_r is None:
-        ins = (codes_r, norms_r, lids_r, valid_r, qt_r, crot_r)
-    else:
-        ins = (recon_r, norms_r, lids_r, valid_r, qt_r)
-    vals, cids = lax.map(scan_chunk, ins)
-    vals = vals.reshape(n_lists, qmax, kk)
-    cids = cids.reshape(n_lists, qmax, kk)
+    vals, cids = lax.map(
+        scan_chunk, (seg_list.reshape(n_chunks, C),
+                     seg_q.reshape(n_chunks, C, seg)))
+    vals = vals.reshape(nsp, seg, kk)
+    cids = cids.reshape(nsp, seg, kk)
 
-    pv, pi = ic.gather_pair_results(vals, cids, probes, rank, invalid)
+    pv, pi = ic.gather_segment_results(vals, cids, pair_seg, pair_slot)
     out_vals, out_ids = _select_k(pv.reshape(B, n_probes * kk),
                                   min(k, n_probes * kk),
                                   select_min=select_min,
@@ -1180,38 +1163,24 @@ def search(index: IvfPqIndex, queries: jax.Array, k: int,
     if mode == "grouped":
         from raft_tpu.neighbors import ivf_common as ic
 
-        # size the per-list queues from the ACTUAL probe histogram, so the
-        # grouped scan never drops (query, probe) pairs. Skew-hot lists
-        # inflate qmax toward B — that wastes scan FLOPs on cold lists'
-        # padding, but measured on-chip the per_query gather path is an
-        # order of magnitude slower still (TPUs hate gathers, love the
-        # MXU), so grouped stays preferred until the queue TABLE itself
-        # is memory-hostile. One stable sort feeds the histogram, the
-        # ranks, and the queue table.
-        probes = _select_probes(index, queries, n_probes)
-        max_load, sorted_l, rank_sorted, q_of, rank = ic.probe_sort(
-            probes, index.n_lists)
-        qmax = ic.exact_qmax(int(max_load))
+        # segmented scan: the table shape is a function of (B, n_probes,
+        # n_lists, seg) alone — no probe histogram, no host sync, one
+        # jitted program per static config (see ivf_common docstring)
+        seg = ic.SEGMENT_SIZE
+        pairs = B * n_probes
+        n_seg = ic.n_segments(pairs, index.n_lists, seg)
         L = index.max_list_size
         kk = min(k, L)
         if params.scan_mode == "grouped" or ic.grouped_mem_ok(
-                index.n_lists, qmax, kk, B * n_probes):
-            qtable = ic.qtable_from_sort(sorted_l, rank_sorted, q_of,
-                                         index.n_lists, qmax)
-            chunk = ic.fit_list_chunk(index.n_lists, qmax, L,
-                                      params.list_chunk)
+                n_seg, seg, kk, pairs):
+            chunk = ic.fit_seg_chunk(seg, L, index.rot_dim,
+                                     params.list_chunk)
             from raft_tpu.ops import pallas_kernels as _pk
 
-            wants = _pk.pallas_grouped_wanted(kk, L, index.rot_dim)
-            return _search_grouped(index, queries, probes, qtable, rank,
-                                   k, qmax, chunk, use_pallas=wants,
+            wants = _pk.pallas_grouped_wanted(kk, L, index.rot_dim, bq=seg)
+            return _search_grouped(index, queries, k, n_probes, seg,
+                                   n_seg, chunk, use_pallas=wants,
                                    filter_bits=filter_bitset)
-        # hot-list fallback: reuse the probes, don't redo coarse selection
-        return _search_impl(index, queries, k, n_probes,
-                            _fit_query_tile(params.query_tile, n_probes,
-                                            index),
-                            filter_bits=filter_bitset, probes=probes,
-                            lut_dtype=params.lut_dtype)
     return _search_impl(index, queries, k, n_probes,
                         _fit_query_tile(params.query_tile, n_probes, index),
                         filter_bits=filter_bitset, lut_dtype=params.lut_dtype)

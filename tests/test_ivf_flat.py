@@ -220,6 +220,27 @@ class TestGroupedScan:
         got = np.asarray(ids)
         assert (got[got >= 0] % 2 == 1).all()
 
+    def test_grouped_skewed_batch_dropfree(self, corpus):
+        """Adversarial skew: every query probes the SAME lists, so a few
+        hot lists own many segments. The segmented scan must still agree
+        exactly with per_query (it is drop-free by construction)."""
+        x, q = corpus
+        idx = ivf_flat.build(jnp.asarray(x), IndexParams(n_lists=32, seed=0))
+        qskew = jnp.asarray(np.repeat(q[:1], 256, axis=0) +
+                            np.random.default_rng(7).normal(
+                                0, 1e-3, (256, x.shape[1])).astype(np.float32))
+        dg, ig = ivf_flat.search(idx, qskew, 10,
+                                 SearchParams(n_probes=4,
+                                              scan_mode="grouped"))
+        dp, ip_ = ivf_flat.search(idx, qskew, 10,
+                                  SearchParams(n_probes=4,
+                                               scan_mode="per_query"))
+        np.testing.assert_allclose(np.asarray(dg), np.asarray(dp),
+                                   rtol=1e-4, atol=1e-4)
+        same = np.mean([len(set(a) & set(b)) / 10.0
+                        for a, b in zip(np.asarray(ig), np.asarray(ip_))])
+        assert same >= 0.99
+
     def test_auto_dispatch_large_batch(self, corpus):
         x, _ = corpus
         idx = ivf_flat.build(jnp.asarray(x), IndexParams(n_lists=16, seed=0))
