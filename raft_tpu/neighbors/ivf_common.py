@@ -39,6 +39,7 @@ makes IVF beat brute force on TPU at large batch sizes.
 
 from __future__ import annotations
 
+from functools import partial
 from typing import Tuple
 
 import jax
@@ -189,6 +190,13 @@ def pack_lists(row_arrays, labels: jax.Array, row_ids: jax.Array,
     sizes = jnp.minimum(counts, L)
     n_dropped = jnp.sum(counts - sizes)
     return packed, ids, sizes, n_dropped
+
+
+pack_lists_jit = partial(jax.jit, static_argnames=("n_lists", "L"))(
+    lambda row_arrays, labels, row_ids, n_lists, L, fill_values: pack_lists(
+        row_arrays, labels, row_ids, n_lists, L, fill_values))
+"""Jitted :func:`pack_lists` — single-program builds on remote devices
+(eager packing costs a dispatch round-trip per op through a tunnel)."""
 
 
 def choose_list_chunk(n_lists: int, target: int) -> int:

@@ -193,18 +193,31 @@ def build(dataset: jax.Array, params: Optional[IndexParams] = None) -> IvfFlatIn
                             packed_ids=ids, packed_norms=norms,
                             list_sizes=sizes, metric=mt.value)
 
-    labels = np.asarray(kmeans_balanced.predict(centers, x.astype(jnp.float32),
-                                                km_params))
-    counts = np.bincount(labels, minlength=params.n_lists)
+    # assign + pack ON DEVICE (ivf_common.pack_lists, the same sort+
+    # scatter the distributed build uses): the data never round-trips the
+    # host, only the [n_lists] histogram does (it sizes the static padded
+    # list capacity). The host packer remains for memmapped/chunked flows.
+    from raft_tpu.neighbors import ivf_common as ic
+
+    labels = kmeans_balanced.predict(centers, x.astype(jnp.float32),
+                                     km_params)
+    # histogram on host: the [n] labels transfer is small, and a device
+    # scatter-add histogram serializes on TPU
+    counts = np.bincount(np.asarray(labels), minlength=params.n_lists)
     max_list_size = _fit_list_size(counts, avg, params.list_size_cap_factor)
-    packed, ids, sizes = _pack_lists(np.asarray(x), labels, params.n_lists,
-                                     max_list_size, np.asarray(x).dtype)
-    packed_j = jnp.asarray(packed)
-    norms = jnp.sum(packed_j.astype(jnp.float32) ** 2, axis=-1)
-    return IvfFlatIndex(centers=centers, packed_data=packed_j,
-                        packed_ids=jnp.asarray(ids),
-                        packed_norms=norms,
-                        list_sizes=jnp.asarray(sizes), metric=mt.value)
+    (packed,), ids, sizes, dropped = ic.pack_lists_jit(
+        [x], labels, jnp.arange(n, dtype=jnp.int32),
+        n_lists=params.n_lists, L=max_list_size,
+        fill_values=[jnp.zeros((), x.dtype)])
+    n_drop = int(dropped)
+    if n_drop:
+        from raft_tpu.core import logging as _log
+        _log.warn("ivf_flat: dropped %d overflow vectors (raise "
+                  "list_size_cap_factor)", n_drop)
+    norms = jnp.sum(packed.astype(jnp.float32) ** 2, axis=-1)
+    return IvfFlatIndex(centers=centers, packed_data=packed,
+                        packed_ids=ids, packed_norms=norms,
+                        list_sizes=sizes, metric=mt.value)
 
 
 @traced("raft_tpu.ivf_flat.extend")

@@ -612,24 +612,35 @@ def build(dataset: jax.Array, params: Optional[IndexParams] = None) -> IvfPqInde
             metric=mt.value, codebook_kind=params.codebook_kind,
             pq_bits=params.pq_bits, pq_dim_static=pq_dim)
 
-    # 4. encode + bit-pack + pack all rows into lists
+    # 4. encode + bit-pack + pack all rows into lists — ON DEVICE (same
+    # pack the distributed build uses); only the [n_lists] histogram
+    # round-trips the host to size the static padded capacity
     from raft_tpu.neighbors.ivf_flat import _fit_list_size
+    from raft_tpu.neighbors import ivf_common as ic
 
     labels = kmeans_balanced.predict(centers, x, km)
     codes, norms = _encode_with_norms(x @ rotation.T, centers_rot, labels,
                                       codebooks, params.codebook_kind)
 
-    labels_h = np.asarray(labels)
-    counts = np.bincount(labels_h, minlength=params.n_lists)
+    # histogram on host: the [n] labels transfer is small, and a device
+    # scatter-add histogram serializes on TPU
+    counts = np.bincount(np.asarray(labels), minlength=params.n_lists)
     max_list_size = _fit_list_size(counts, avg, params.list_size_cap_factor)
-    packed, ids, pnorm, sizes = _pack_codes(
-        pack_bits_np(np.asarray(codes), params.pq_bits), labels_h,
-        np.asarray(norms), params.n_lists, max_list_size, np.arange(n))
+    codes_p = pack_bits(codes, params.pq_bits)
+    (packed, pnorm), ids, sizes, dropped = ic.pack_lists_jit(
+        [codes_p, norms], labels, jnp.arange(n, dtype=jnp.int32),
+        n_lists=params.n_lists, L=max_list_size,
+        fill_values=[jnp.zeros((), jnp.uint8), jnp.zeros((), jnp.float32)])
+    n_drop = int(dropped)
+    if n_drop:
+        from raft_tpu.core import logging as _log
+        _log.warn("ivf_pq: dropped %d overflow vectors (raise "
+                  "list_size_cap_factor)", n_drop)
     index = IvfPqIndex(
         centers=centers, centers_rot=centers_rot, rotation=rotation,
-        codebooks=codebooks, packed_codes=jnp.asarray(packed),
-        packed_ids=jnp.asarray(ids), packed_norms=jnp.asarray(pnorm),
-        list_sizes=jnp.asarray(sizes), metric=mt.value,
+        codebooks=codebooks, packed_codes=packed,
+        packed_ids=ids, packed_norms=pnorm,
+        list_sizes=sizes, metric=mt.value,
         codebook_kind=params.codebook_kind, pq_bits=params.pq_bits,
         pq_dim_static=pq_dim)
     if _want_recon_cache(params, params.n_lists, max_list_size, rot_dim):
@@ -850,6 +861,25 @@ def extend(index: IvfPqIndex, new_vectors: jax.Array,
 # search
 # ---------------------------------------------------------------------------
 
+def _coarse_probes(index: IvfPqIndex, q_all: jax.Array, n_probes: int,
+                   ip_like: bool):
+    """Coarse probe selection on q·c (reference: select_clusters,
+    ivf_pq_search.cuh:70-156) — plain helper traced inside both jitted
+    search paths (per_query and grouped), so the metric-dependent
+    expansion lives in ONE place. Returns (qc [m, n_lists], probes
+    [m, n_probes])."""
+    qc = lax.dot_general(q_all, index.centers, (((1,), (1,)), ((), ())),
+                         precision=get_precision(),
+                         preferred_element_type=jnp.float32)
+    if ip_like:
+        _, probes = _select_k(qc, n_probes, select_min=False)
+    else:
+        c_sq = jnp.sum(index.centers**2, axis=1)
+        _, probes = _select_k(c_sq[None, :] - 2.0 * qc, n_probes,
+                              select_min=True)
+    return qc, probes
+
+
 @partial(jax.jit, static_argnames=("k", "n_probes", "query_tile",
                                    "lut_dtype"))
 def _search_impl(index: IvfPqIndex, queries: jax.Array, k: int,
@@ -868,17 +898,8 @@ def _search_impl(index: IvfPqIndex, queries: jax.Array, k: int,
     sqrt_out = mt == DistanceType.L2SqrtExpanded
     select_min = not ip_like
 
-    # probe selection on q·c (select_clusters, ivf_pq_search.cuh:70-156);
     # qc itself is needed regardless — the ⟨q,c⟩ term of the decomposition
-    qc = lax.dot_general(q_all, index.centers, (((1,), (1,)), ((), ())),
-                         precision=get_precision(),
-                         preferred_element_type=jnp.float32)  # [m, n_lists]
-    if ip_like:
-        _, probes = _select_k(qc, n_probes, select_min=False)
-    else:
-        c_sq = jnp.sum(index.centers**2, axis=1)
-        _, probes = _select_k(c_sq[None, :] - 2.0 * qc, n_probes,
-                              select_min=True)
+    qc, probes = _coarse_probes(index, q_all, n_probes, ip_like)
 
     q_rot_all = q_all @ index.rotation.T
     q_sq_all = jnp.sum(q_rot_all * q_rot_all, axis=1)
@@ -1038,15 +1059,7 @@ def _search_grouped(index: IvfPqIndex, queries: jax.Array, k: int,
 
     use_pallas = use_pallas and index.packed_recon is not None
 
-    qc = lax.dot_general(q_all, index.centers, (((1,), (1,)), ((), ())),
-                         precision=get_precision(),
-                         preferred_element_type=jnp.float32)
-    if ip_like:
-        _, probes = _select_k(qc, n_probes, select_min=False)
-    else:
-        c_sq = jnp.sum(index.centers**2, axis=1)
-        _, probes = _select_k(c_sq[None, :] - 2.0 * qc, n_probes,
-                              select_min=True)
+    _, probes = _coarse_probes(index, q_all, n_probes, ip_like)
     seg_list, seg_q, pair_seg, pair_slot = ic.segment_probes(
         probes, n_lists, seg, n_seg)
 

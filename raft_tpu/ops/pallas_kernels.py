@@ -280,16 +280,17 @@ def grouped_scan_topk(q_gathered: jax.Array, list_data: jax.Array,
                       mask_add: jax.Array, kk: int, metric: str = "l2",
                       bq: int = 128, interpret: bool = False
                       ) -> Tuple[jax.Array, jax.Array]:
-    """Fused grouped IVF scan over one list chunk.
+    """Fused grouped IVF scan over one segment chunk.
 
-    q_gathered [G, qmax, d] — each list's queued queries (gathered by the
-    caller from the probe inversion, see neighbors/ivf_common.py);
-    list_data [G, L, d] — raw vectors (ivf_flat) or bf16 reconstructions
-    (ivf_pq recon cache); mask_add [G, L] — 0 for valid slots, +inf for
-    padding/filtered.  Returns (keys [G, qmax, kk], pos [G, qmax, kk]):
-    minimized sort keys (ip keys are negated scores) and in-list column
-    positions (-1 when the slot saw fewer than kk valid candidates).
-    """
+    q_gathered [G, S, d] — each segment's queued queries (gathered by
+    the caller from the segment tables, see ivf_common.segment_probes);
+    list_data [G, L, d] — each segment's list block: raw vectors
+    (ivf_flat) or bf16 reconstructions (ivf_pq recon cache); mask_add
+    [G, L] — 0 for valid slots, +inf for padding/filtered.  Returns
+    (keys [G, S, kk], pos [G, S, kk]): minimized sort keys (ip keys are
+    negated scores) and in-list column positions (-1 when the slot saw
+    fewer than kk valid candidates).  ``bq`` tiles the S axis; callers
+    pass the segment size."""
     G, qmax, d = q_gathered.shape
     L = list_data.shape[1]
     assert metric in ("l2", "ip", "cos")
