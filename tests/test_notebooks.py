@@ -1,0 +1,27 @@
+"""Notebooks parity (reference: notebooks/*.ipynb, SURVEY §2.17).
+
+Full execution is exercised manually / in docs builds; here we keep the
+cheap invariants: valid nbformat JSON and code cells that compile.
+"""
+import glob
+import json
+import os
+
+import pytest
+
+NB_DIR = os.path.join(os.path.dirname(__file__), "..", "notebooks")
+
+
+@pytest.mark.parametrize("path", sorted(glob.glob(os.path.join(NB_DIR, "*.ipynb"))))
+def test_notebook_wellformed(path):
+    nb = json.load(open(path))
+    assert nb["nbformat"] == 4
+    assert any(c["cell_type"] == "markdown" for c in nb["cells"])
+    for i, cell in enumerate(nb["cells"]):
+        if cell["cell_type"] == "code":
+            compile("".join(cell["source"]), f"{path}#cell{i}", "exec")
+
+
+def test_notebooks_exist():
+    names = {os.path.basename(p) for p in glob.glob(os.path.join(NB_DIR, "*.ipynb"))}
+    assert {"ivf_flat_example.ipynb", "tutorial_ivf_pq.ipynb"} <= names
