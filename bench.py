@@ -39,7 +39,8 @@ def build_config(n: int, n_queries: int, algos):
         index.append({
             "name": "ivf_pq.n1024.d64", "algo": "ivf_pq",
             "build_param": {"n_lists": 1024, "pq_dim": 64},
-            "search_params": [{"n_probes": 64, "refine_ratio": 2}],
+            "search_params": [{"n_probes": 64, "refine_ratio": 2},
+                              {"n_probes": 64, "refine_ratio": 4}],
         })
     if "cagra" in algos:
         index.append({

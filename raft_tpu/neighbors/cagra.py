@@ -46,7 +46,7 @@ from raft_tpu.neighbors import ivf_pq as _ivf_pq
 from raft_tpu.neighbors.refine import refine as _refine
 from raft_tpu.utils.precision import get_precision
 
-_SERIAL_VERSION = 1
+_SERIAL_VERSION = 2
 
 
 @dataclasses.dataclass
@@ -56,7 +56,11 @@ class IndexParams:
     intermediate_graph_degree: int = 128
     graph_degree: int = 64
     metric: str = "sqeuclidean"
-    build_algo: str = "ivf_pq"  # | "nn_descent"
+    # "auto" → "cluster": the TPU-native cluster-blocked exact self-kNN
+    # (see cluster_knn_graph). The reference's two build algos remain
+    # selectable: "ivf_pq" (ANN self-search + refine, cagra_build.cuh:89)
+    # and "nn_descent" (GNND).
+    build_algo: str = "auto"  # | "cluster" | "ivf_pq" | "nn_descent"
     nn_descent_niter: int = 20
     seed: int = 0
 
@@ -66,28 +70,42 @@ class SearchParams:
     """reference: ``cagra::search_params`` (cagra_types.hpp:54-112).
 
     ``num_seeds``: random entry points sampled per query (the
-    ``num_random_samplings``/rand_xor_mask analog). 0 → auto, scaled
-    with index size: a graph over strongly clustered data is near-
-    disconnected across clusters, so greedy traversal only finds a
-    query's cluster if some entry lands in it — entry count is the
-    recall floor, and it must grow with n (measured: recall 0.35 at
-    n=100k with 128 seeds on 316-cluster data; the miss probability
-    (1 - c/n_clusters)^seeds matches exactly)."""
+    ``num_random_samplings``/rand_xor_mask analog). 0 → auto. On an
+    index with cluster-seeded entries (default build; see CagraIndex)
+    the auto count is max(itopk, 512) random pads on top of the
+    nearest-cluster entry points — coverage comes from the entries, not
+    the randoms. Without entries (reference build algos) the auto count
+    scales with n (max(2·itopk, min(2048, n/64))): a graph over
+    strongly clustered data is near-disconnected across clusters, so
+    greedy traversal only finds a query's cluster if some random entry
+    lands in it — the miss probability (1 - c/n_clusters)^seeds is the
+    recall floor (measured: recall 0.35 at n=100k with 128 seeds on
+    316-cluster data)."""
 
     itopk_size: int = 64
     search_width: int = 4
     max_iterations: int = 0   # 0 → auto: ceil(itopk/search_width) * 2
-    query_tile: int = 256
+    query_tile: int = 1024
     seed: int = 0             # entry-point sampling (rand_xor_mask analog)
-    num_seeds: int = 0        # 0 → auto: max(2·itopk, min(2048, n/64))
+    num_seeds: int = 0        # 0 → auto (see class docstring)
 
 
 class CagraIndex(flax.struct.PyTreeNode):
-    """reference: ``cagra::index`` (cagra_types.hpp)."""
+    """reference: ``cagra::index`` (cagra_types.hpp).
+
+    ``centers``/``entry_ids`` are a TPU-native extension the cluster
+    build algo provides for free: greedy graph traversal over strongly
+    clustered data only reaches a query's cluster if an entry point
+    lands in it, so random entries put a coverage floor on recall
+    (≈ 1 − e^{−seeds/n_clusters}). Seeding from the nearest clusters'
+    members removes that floor AND needs far fewer seed distances.
+    ``None`` (reference build algos) falls back to random entries."""
 
     dataset: jax.Array   # [n, dim]
     graph: jax.Array     # [n, graph_degree] i32
     metric: str = flax.struct.field(pytree_node=False, default="sqeuclidean")
+    centers: Optional[jax.Array] = None    # [n_lists, dim] f32
+    entry_ids: Optional[jax.Array] = None  # [n_lists, E] i32, -1 pad
 
     @property
     def size(self) -> int:
@@ -136,10 +154,136 @@ def build_knn_graph(dataset: jax.Array, k: int, metric: str = "sqeuclidean",
         _, ids = _refine(x, q, cand, k + 1, metric=metric)
         knn_parts.append(ids)
     knn_ids = jnp.concatenate(knn_parts, axis=0)[:n]
-    # drop self-edges: if a row's first hit is itself, skip it, else drop last
+    return _drop_self_edges(knn_ids, n, k)
+
+
+@partial(jax.jit, static_argnames=("k", "n_lists", "T", "chunk", "ip"))
+def _cluster_blocked_knn(packed, pids, centers, row_list, row_slot,
+                         k: int, n_lists: int, T: int, chunk: int,
+                         ip: bool):
+    """Exact kNN of every row against its cluster neighborhood — one
+    jitted program. ``packed [n_lists, L, d]`` / ``pids [n_lists, L]``
+    are the balanced-kmeans-packed rows; each list's members scan the
+    members of its ``T`` nearest lists with one batched MXU contraction
+    per list chunk, and ``approx_min_k`` (the TPU-native top-k) selects
+    ``k`` candidates per row. Results return in row order via the
+    (list, slot) addresses."""
+    nbc = lax.dot_general(centers, centers, (((1,), (1,)), ((), ())),
+                          precision=get_precision(),
+                          preferred_element_type=jnp.float32)
+    c_sq = jnp.sum(centers * centers, axis=1)
+    cd = c_sq[:, None] + c_sq[None, :] - 2.0 * nbc
+    _, nbrs = lax.top_k(-cd, T)                            # [n_lists, T]
+
+    L = packed.shape[1]
+    n_chunks = -(-n_lists // chunk)
+    nsp = n_chunks * chunk
+    lists_pad = jnp.pad(jnp.arange(n_lists, dtype=jnp.int32),
+                        (0, nsp - n_lists))
+
+    def scan_chunk(ls):
+        mem = packed[ls].astype(jnp.float32)               # [C, L, d]
+        mids = pids[ls]                                    # [C, L]
+        nb = nbrs[ls]                                      # [C, T]
+        cand = packed[nb].astype(jnp.float32).reshape(
+            ls.shape[0], T * L, -1)                        # [C, T·L, d]
+        cids = pids[nb].reshape(ls.shape[0], T * L)        # [C, T·L]
+        s = jnp.einsum("cld,cmd->clm", mem, cand,
+                       precision=get_precision(),
+                       preferred_element_type=jnp.float32)
+        if ip:
+            score = s                                      # maximize
+        else:
+            m_sq = jnp.sum(mem * mem, axis=-1)
+            q_sq = jnp.sum(cand * cand, axis=-1)
+            score = -(m_sq[:, :, None] + q_sq[:, None, :] - 2.0 * s)
+        bad = (cids[:, None, :] < 0) | (cids[:, None, :] == mids[:, :, None])
+        score = jnp.where(bad, -jnp.inf, score)
+        _, pos = jax.lax.approx_max_k(
+            score.reshape(-1, T * L), k, recall_target=0.95)
+        ids = jnp.take_along_axis(
+            jnp.repeat(cids, L, axis=0), pos, axis=1)      # [C·L, k]
+        return ids.reshape(ls.shape[0], L, k)
+
+    res = lax.map(scan_chunk, lists_pad.reshape(n_chunks, chunk))
+    res = res.reshape(nsp, L, k)
+    return res[row_list, row_slot]                         # [n, k]
+
+
+def cluster_knn_graph(dataset: jax.Array, k: int, metric: str = "sqeuclidean",
+                      seed: int = 0, rows_per_list: int = 1024,
+                      neighborhood: int = 16, return_entries: bool = False):
+    """TPU-native k-NN graph: cluster-blocked exact self-kNN.
+
+    The reference builds CAGRA's knn graph by ANN self-search (IVF-PQ +
+    refine, cagra_build.cuh:89) — a per-query gather/scan structure. On
+    TPU the natural shape is block-dense: balanced-kmeans the rows into
+    ~n/1024 lists, then give each list's members EXACT brute-force
+    distances against the members of its ``neighborhood`` nearest lists
+    — large square MXU contractions, no codes, no refine pass. Candidate
+    coverage matches an IVF search probing ``neighborhood`` lists; the
+    distances are exact f32 (better rank quality than PQ+refine), and
+    graph build time at 1M×128 drops from tens of minutes to ~1 minute
+    on a v5e.
+    """
+    x = jnp.asarray(dataset, jnp.float32)
+    n, d = x.shape
+    mt = resolve_metric(metric)
+    ip = mt == DistanceType.InnerProduct
+    if n <= (1 << 14) or n // rows_per_list < 4:
+        # small corpus: plain exact kNN (one tiled program)
+        from raft_tpu.neighbors import brute_force as _bf
+
+        idx = _bf.build(x, metric="inner_product" if ip else "sqeuclidean")
+        _, knn_ids = _bf.knn(idx, x, min(n, k + 1))
+        g = _drop_self_edges(knn_ids, n, k)
+        return (g, None, None) if return_entries else g
+
+    from raft_tpu.cluster import kmeans_balanced
+    from raft_tpu.cluster.kmeans_balanced import KMeansBalancedParams
+    from raft_tpu.neighbors import ivf_common as ic
+    from raft_tpu.neighbors.ivf_flat import _fit_list_size
+
+    n_lists = max(8, n // rows_per_list)
+    km = KMeansBalancedParams(n_iters=10, metric="l2", seed=seed)
+    n_train = min(n, max(n_lists * 4, n // 4))
+    if n_train < n:
+        rng = np.random.default_rng(seed)
+        trainset = x[jnp.asarray(np.sort(rng.choice(n, n_train, replace=False)))]
+    else:
+        trainset = x
+    centers = kmeans_balanced.fit(trainset, n_lists, km)
+    labels = kmeans_balanced.predict(centers, x, km)
+    counts = np.bincount(np.asarray(labels), minlength=n_lists)
+    L = _fit_list_size(counts, max(1, n // n_lists), 4.0)
+    (packed,), pids, _, dropped, (row_list, row_slot) = ic.pack_lists_jit(
+        [x], labels, jnp.arange(n, dtype=jnp.int32),
+        n_lists=n_lists, L=L, fill_values=[jnp.zeros((), x.dtype)])
+    if int(dropped):
+        from raft_tpu.core import logging as _log
+        _log.warn("cluster_knn_graph: %d rows overflowed their list; "
+                  "their graph rows fall back to in-list neighbors",
+                  int(dropped))
+    row_slot = jnp.clip(row_slot, 0, L - 1)  # overflow rows borrow slot L-1
+
+    T = min(neighborhood, n_lists)
+    kk = min(k, T * L - 1)
+    # chunk bound: [C, L, T·L] f32 distance block under ~192 MB
+    chunk = max(1, (192 << 20) // max(1, L * T * L * 4))
+    graph = _cluster_blocked_knn(packed, pids, centers, row_list, row_slot,
+                                 kk, n_lists, T, min(chunk, n_lists), ip)
+    if kk < k:
+        graph = jnp.pad(graph, ((0, 0), (0, k - kk)), mode="edge")
+    graph = graph.astype(jnp.int32)
+    if return_entries:
+        return graph, centers, pids[:, :min(32, L)]
+    return graph
+
+
+def _drop_self_edges(knn_ids: jax.Array, n: int, k: int) -> jax.Array:
+    """Stable-partition self hits out of a [n, >=k+1] id table → [n, k]."""
     self_col = knn_ids == jnp.arange(n, dtype=knn_ids.dtype)[:, None]
-    # stable partition: non-self entries first, keep k of them
-    order = jnp.argsort(self_col, axis=1, stable=True)  # False (non-self) first
+    order = jnp.argsort(self_col, axis=1, stable=True)
     cleaned = jnp.take_along_axis(knn_ids, order, axis=1)[:, :k]
     return cleaned.astype(jnp.int32)
 
@@ -213,14 +357,23 @@ def build(dataset: jax.Array, params: Optional[IndexParams] = None) -> CagraInde
     n = x.shape[0]
     inter_d = min(params.intermediate_graph_degree, n - 1)
     out_d = min(params.graph_degree, inter_d)
-    if params.build_algo == "nn_descent":
+    algo = params.build_algo
+    if algo == "auto":
+        algo = "cluster"
+    centers = entry_ids = None
+    if algo == "nn_descent":
         from raft_tpu.neighbors.nn_descent import build_knn_graph as _nnd
         knn = _nnd(x, inter_d, metric=mt.value, n_iters=params.nn_descent_niter,
                    seed=params.seed)
+    elif algo == "cluster":
+        knn, centers, entry_ids = cluster_knn_graph(
+            x, inter_d, metric=mt.value, seed=params.seed,
+            return_entries=True)
     else:
         knn = build_knn_graph(x, inter_d, metric=mt.value, seed=params.seed)
     graph = optimize_graph(knn, out_d)
-    return CagraIndex(dataset=x, graph=graph, metric=mt.value)
+    return CagraIndex(dataset=x, graph=graph, metric=mt.value,
+                      centers=centers, entry_ids=entry_ids)
 
 
 # ---------------------------------------------------------------------------
@@ -265,19 +418,45 @@ def _search_impl(index: CagraIndex, queries: jax.Array, k: int,
         # tiling and entry sets are decorrelated across queries
         qidx = qstart + jnp.arange(t, dtype=jnp.uint32)
         keys = jax.vmap(lambda i: jax.random.fold_in(base_key, i))(qidx)
-        # oversample candidates and keep the best itopk — the reference's
-        # random_sampling makes multiple hashed draws per itopk slot the
-        # same way (compute_random_samples / num_random_samplings). The
-        # count scales with n (see SearchParams.num_seeds): entry
-        # coverage is the recall floor on clustered data
-        # clamp: the buffer init takes top itopk of the seeds, so fewer
-        # seeds than itopk slots would break lax.top_k; round to a
-        # multiple of 128 so the seed phase can chunk evenly
-        n_seed = max(num_seeds or max(2 * itopk_size, min(2048, n // 64)),
-                     itopk_size)
-        n_seed = -(-n_seed // 128) * 128
-        init_ids = jax.vmap(
-            lambda kk: jax.random.randint(kk, (n_seed,), 0, n))(keys)
+        if index.centers is not None:
+            # cluster-seeded entries (see CagraIndex): members of the
+            # query's nearest clusters, padded with random draws — the
+            # random-only floor (1 − e^{−seeds/n_clusters}) disappears
+            # and far fewer seed distances are needed
+            cts = index.centers
+            qc = jnp.einsum("td,ld->tl", q, cts,
+                            precision=get_precision(),
+                            preferred_element_type=jnp.float32)
+            c_score = qc if ip else 2.0 * qc - jnp.sum(cts * cts, 1)[None, :]
+            c_sel = min(4, cts.shape[0])
+            _, top_l = lax.top_k(c_score, c_sel)           # [t, c_sel]
+            ent = index.entry_ids[top_l].reshape(t, -1)    # [t, c_sel·E]
+            n_rand = max(num_seeds or max(itopk_size, 512), itopk_size)
+            # total seeds rounded UP to a multiple of 128 so the seed-
+            # distance chunking below always finds a divisor (c_sel·E is
+            # not 128 for narrow entry tables)
+            n_seed = -(-(ent.shape[1] + n_rand) // 128) * 128
+            ent = jnp.concatenate(
+                [ent, jnp.full((t, n_seed - ent.shape[1]), -1, ent.dtype)],
+                axis=1)
+            rnd = jax.vmap(
+                lambda kk: jax.random.randint(kk, (n_seed,), 0, n))(keys)
+            init_ids = jnp.where(ent >= 0, ent, rnd)
+        else:
+            # oversample candidates and keep the best itopk — the
+            # reference's random_sampling makes multiple hashed draws per
+            # itopk slot the same way (compute_random_samples /
+            # num_random_samplings). The count scales with n (see
+            # SearchParams.num_seeds): entry coverage is the recall floor
+            # on clustered data. Clamp: the buffer init takes top itopk
+            # of the seeds, so fewer seeds than itopk slots would break
+            # lax.top_k; round to a multiple of 128 so the seed phase can
+            # chunk evenly
+            n_seed = max(num_seeds or max(2 * itopk_size, min(2048, n // 64)),
+                         itopk_size)
+            n_seed = -(-n_seed // 128) * 128
+            init_ids = jax.vmap(
+                lambda kk: jax.random.randint(kk, (n_seed,), 0, n))(keys)
         # sampled with replacement: demote duplicate entry slots so an id
         # can never surface twice in the buffer. Sort-based dedup — the
         # quadratic pairwise mask would be O(n_seed²) per query
@@ -422,16 +601,23 @@ def save(index: CagraIndex, path: str, include_dataset: bool = True) -> None:
     arrays = {"graph": index.graph}
     if include_dataset:
         arrays["dataset"] = index.dataset
+    if index.centers is not None:
+        arrays["centers"] = index.centers
+        arrays["entry_ids"] = index.entry_ids
     ser.save_arrays(path, "cagra", _SERIAL_VERSION,
                     {"metric": index.metric}, arrays)
 
 
 def load(path: str, dataset: Optional[jax.Array] = None) -> CagraIndex:
     version, meta, a = ser.load_arrays(path, "cagra")
-    expects(version == _SERIAL_VERSION, "unsupported cagra version %d", version)
+    # v1 files lack centers/entry_ids (random-entry search still works)
+    expects(version in (1, _SERIAL_VERSION),
+            "unsupported cagra version %d", version)
     ds = jnp.asarray(a["dataset"]) if "dataset" in a else jnp.asarray(dataset)
-    return CagraIndex(dataset=ds, graph=jnp.asarray(a["graph"]),
-                      metric=meta["metric"])
+    return CagraIndex(
+        dataset=ds, graph=jnp.asarray(a["graph"]), metric=meta["metric"],
+        centers=jnp.asarray(a["centers"]) if "centers" in a else None,
+        entry_ids=jnp.asarray(a["entry_ids"]) if "entry_ids" in a else None)
 
 
 def serialize_to_hnswlib(index: CagraIndex, path: str,

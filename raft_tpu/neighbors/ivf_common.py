@@ -66,9 +66,13 @@ def segment_probes(probes: jax.Array, n_lists: int, seg: int, n_seg: int):
 
     One stable sort of the flattened probe table gives each pair its
     within-list rank; segment ids follow from a cumsum of per-list
-    segment counts. TPU note: this is one sort + one scatter of B·P
-    elements — the scatter-free alternatives (bincount histograms)
-    measured slower on a v5e chip because TPU scatters serialize.
+    segment counts. TPU note: everything here is sorts + GATHERS — the
+    segment table is filled by computing, per (segment, slot), which
+    sorted pair occupies it (``i = starts[list] + local_seg·seg +
+    slot``), and pair-order addresses come from the sort's inverse
+    permutation (a second argsort). XLA scatters serialize on TPU
+    (~100 ms at 10⁵ elements, measured), so the scatter formulation of
+    the same table costs more than the whole rest of the scan.
 
     Parameters
     ----------
@@ -91,26 +95,35 @@ def segment_probes(probes: jax.Array, n_lists: int, seg: int, n_seg: int):
     order = jnp.argsort(l_flat, stable=True)
     sorted_l = l_flat[order]
     starts = jnp.searchsorted(sorted_l, jnp.arange(n_lists, dtype=jnp.int32))
-    rank_sorted = (jnp.arange(BP, dtype=jnp.int32)
-                   - starts[sorted_l].astype(jnp.int32))
     counts = jnp.diff(jnp.append(starts, BP)).astype(jnp.int32)
     segs_per_list = (counts + seg - 1) // seg
     seg_base = jnp.cumsum(segs_per_list) - segs_per_list  # exclusive
-    seg_sorted = seg_base[sorted_l] + rank_sorted // seg
-    slot_sorted = rank_sorted % seg
-    q_of = (order // P).astype(jnp.int32)
-    seg_q = jnp.full((n_seg, seg), -1, jnp.int32).at[
-        seg_sorted, slot_sorted].set(q_of, mode="drop")
     # segment → owning list: rightmost list whose base is <= s (right-
     # side search steps over zero-segment lists, whose base repeats)
+    seg_ids = jnp.arange(n_seg, dtype=jnp.int32)
     seg_list = jnp.clip(
-        jnp.searchsorted(seg_base, jnp.arange(n_seg, dtype=jnp.int32),
-                         side="right") - 1, 0, n_lists - 1).astype(jnp.int32)
-    # pair-order addresses: one combined scatter, then split
-    comb = jnp.zeros((BP,), jnp.int32).at[order].set(
-        seg_sorted * seg + slot_sorted)
+        jnp.searchsorted(seg_base, seg_ids, side="right") - 1,
+        0, n_lists - 1).astype(jnp.int32)
+    # seg_q by gather: slot (s, j) holds sorted pair i = starts[l] +
+    # local_seg·seg + j, valid while that rank is inside l's load
+    # (covers both partial tail segments and unused segments, whose
+    # local rank lands beyond the owning list's count)
+    rank0 = (seg_ids - seg_base[seg_list]) * seg           # [n_seg]
+    i0 = starts[seg_list] + rank0
+    j = jnp.arange(seg, dtype=jnp.int32)
+    rank = rank0[:, None] + j[None, :]
+    valid = rank < counts[seg_list][:, None]
+    q_of = (order // P).astype(jnp.int32)
+    seg_q = jnp.where(
+        valid, q_of[jnp.clip(i0[:, None] + j[None, :], 0, BP - 1)], -1)
+    # pair-order addresses via the sort's inverse permutation
+    rank_sorted = (jnp.arange(BP, dtype=jnp.int32)
+                   - starts[sorted_l].astype(jnp.int32))
+    seg_sorted = seg_base[sorted_l] + rank_sorted // seg
+    slot_sorted = rank_sorted % seg
+    inv = jnp.argsort(order)
     return (seg_list, seg_q,
-            (comb // seg).reshape(B, P), (comb % seg).reshape(B, P))
+            seg_sorted[inv].reshape(B, P), slot_sorted[inv].reshape(B, P))
 
 
 def gather_segment_results(seg_vals: jax.Array, seg_ids: jax.Array,
@@ -171,7 +184,11 @@ def pack_lists(row_arrays, labels: jax.Array, row_ids: jax.Array,
 
     Returns (packed_arrays [n_lists, L, ...], ids [n_lists, L] (-1 pad),
     sizes [n_lists] int32, n_dropped () int32 — rows lost to list
-    overflow; callers should surface it, the host packers warn).
+    overflow; callers should surface it, the host packers warn —
+    row_addr = (row_list [n], row_slot [n]) int32: each input row's
+    packed (list, slot) address; slot >= L marks an overflow-dropped
+    row. Returning the addresses here keeps consumers (e.g. CAGRA's
+    cluster-blocked graph) from re-deriving the packing order.)
     """
     n = labels.shape[0]
     labels = labels.astype(jnp.int32)
@@ -189,7 +206,10 @@ def pack_lists(row_arrays, labels: jax.Array, row_ids: jax.Array,
     counts = jnp.zeros((n_lists,), jnp.int32).at[labels].add(1, mode="drop")
     sizes = jnp.minimum(counts, L)
     n_dropped = jnp.sum(counts - sizes)
-    return packed, ids, sizes, n_dropped
+    # row-order addresses via the sort's inverse permutation (gathers,
+    # not scatters — see segment_probes)
+    inv = jnp.argsort(order)
+    return packed, ids, sizes, n_dropped, (sorted_l[inv], rank[inv])
 
 
 pack_lists_jit = partial(jax.jit, static_argnames=("n_lists", "L"))(

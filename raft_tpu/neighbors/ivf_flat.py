@@ -205,7 +205,7 @@ def build(dataset: jax.Array, params: Optional[IndexParams] = None) -> IvfFlatIn
     # scatter-add histogram serializes on TPU
     counts = np.bincount(np.asarray(labels), minlength=params.n_lists)
     max_list_size = _fit_list_size(counts, avg, params.list_size_cap_factor)
-    (packed,), ids, sizes, dropped = ic.pack_lists_jit(
+    (packed,), ids, sizes, dropped, _ = ic.pack_lists_jit(
         [x], labels, jnp.arange(n, dtype=jnp.int32),
         n_lists=params.n_lists, L=max_list_size,
         fill_values=[jnp.zeros((), x.dtype)])

@@ -329,7 +329,7 @@ def _train_per_cluster(tr_res: jax.Array, tr_labels: jax.Array,
     # dim lane-pads to 128 — an unbounded cap at large n_lists would
     # blow HBM for no statistical gain
     cap = min(max(2 * K, -(-4 * avg // 8) * 8), max(2 * K, 8192))
-    (packed,), _, sizes, _ = ic.pack_lists(
+    (packed,), _, sizes, _, _ = ic.pack_lists(
         (flat_sub,), flat_lbl,
         jnp.arange(n_train * pq_dim, dtype=jnp.int32),
         n_lists, cap, (jnp.float32(0),))
@@ -627,7 +627,7 @@ def build(dataset: jax.Array, params: Optional[IndexParams] = None) -> IvfPqInde
     counts = np.bincount(np.asarray(labels), minlength=params.n_lists)
     max_list_size = _fit_list_size(counts, avg, params.list_size_cap_factor)
     codes_p = pack_bits(codes, params.pq_bits)
-    (packed, pnorm), ids, sizes, dropped = ic.pack_lists_jit(
+    (packed, pnorm), ids, sizes, dropped, _ = ic.pack_lists_jit(
         [codes_p, norms], labels, jnp.arange(n, dtype=jnp.int32),
         n_lists=params.n_lists, L=max_list_size,
         fill_values=[jnp.zeros((), jnp.uint8), jnp.zeros((), jnp.float32)])

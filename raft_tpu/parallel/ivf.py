@@ -247,7 +247,7 @@ def build_ivf_pq(params: _pq.IndexParams, dataset: jax.Array, mesh: Mesh,
         recon = centers_rot[safe] + decoded
         norms = jnp.sum(recon * recon, axis=1)
         codes_p = _pq.pack_bits(codes, params.pq_bits)  # n-bit device pack
-        (pcodes, pnorms), ids, sizes, dropped = ic.pack_lists(
+        (pcodes, pnorms), ids, sizes, dropped, _ = ic.pack_lists(
             (codes_p, norms), labels, gid, n_lists, L,
             (jnp.uint8(0), jnp.float32(0)))
         return pcodes[None], ids[None], pnorms[None], sizes[None], dropped[None]
@@ -336,7 +336,7 @@ def build_ivf_flat(params: _flat.IndexParams, dataset: jax.Array, mesh: Mesh,
         _, labels = fused_l2_nn_argmin(x_blk, centers)
         labels = jnp.where(gid < n_real, labels, n_lists)
         norms = jnp.sum(x_blk * x_blk, axis=1)
-        (pdata, pnorms), ids, sizes, dropped = ic.pack_lists(
+        (pdata, pnorms), ids, sizes, dropped, _ = ic.pack_lists(
             (x_blk, norms), labels, gid, n_lists, L,
             (jnp.float32(0), jnp.float32(0)))
         return pdata[None], ids[None], pnorms[None], sizes[None], dropped[None]

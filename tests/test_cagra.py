@@ -162,3 +162,27 @@ class TestCagraSearch:
         _, i2 = cagra.search(idx2, jnp.asarray(q), 5)
         _, i1 = cagra.search(built_index, jnp.asarray(q), 5)
         np.testing.assert_array_equal(np.asarray(i1), np.asarray(i2))
+
+
+class TestClusterKnnGraph:
+    def test_matches_exact_graph(self):
+        """Cluster-blocked graph (n>16384 path) edges vs exact 32-NN."""
+        from scipy.spatial.distance import cdist
+        rng = np.random.default_rng(3)
+        centers = rng.normal(0, 10, (64, 16)).astype(np.float32)
+        x = (centers[rng.integers(0, 64, 20_000)]
+             + rng.normal(0, 0.5, (20_000, 16)).astype(np.float32))
+        g = cagra.cluster_knn_graph(jnp.asarray(x), 16, rows_per_list=512,
+                                    neighborhood=8)
+        g = np.asarray(g)
+        assert g.shape == (20_000, 16)
+        # spot-check recall of graph edges against exact kNN on a sample
+        sample = rng.choice(20_000, 200, replace=False)
+        d = cdist(x[sample], x, "sqeuclidean")
+        d[np.arange(200), sample] = np.inf
+        exact = np.argsort(d, axis=1)[:, :16]
+        rec = np.mean([len(set(exact[i]) & set(g[s])) / 16
+                       for i, s in enumerate(sample)])
+        assert rec >= 0.85, rec
+        # no self edges
+        assert not (g[sample] == sample[:, None]).any()
