@@ -33,14 +33,17 @@ def build_config(n: int, n_queries: int, algos):
         index.append({
             "name": "ivf_flat.n1024", "algo": "ivf_flat",
             "build_param": {"n_lists": 1024},
-            "search_params": [{"n_probes": 32}, {"n_probes": 64}],
+            "search_params": [{"n_probes": 32},
+                              {"n_probes": 32, "scan_select": "approx"},
+                              {"n_probes": 64, "scan_select": "approx"}],
         })
     if "ivf_pq" in algos:
         index.append({
             "name": "ivf_pq.n1024.d64", "algo": "ivf_pq",
             "build_param": {"n_lists": 1024, "pq_dim": 64},
-            "search_params": [{"n_probes": 64, "refine_ratio": 2},
-                              {"n_probes": 64, "refine_ratio": 4}],
+            "search_params": [{"n_probes": 64, "refine_ratio": 4},
+                              {"n_probes": 64, "refine_ratio": 4,
+                               "scan_select": "approx"}],
         })
     if "cagra" in algos:
         index.append({

@@ -135,6 +135,40 @@ def gather_segment_results(seg_vals: jax.Array, seg_ids: jax.Array,
     return seg_vals[pair_seg, pair_slot], seg_ids[pair_seg, pair_slot]
 
 
+def merge_bin_results(keys: jax.Array, kids: jax.Array,
+                      pair_seg: jax.Array, pair_slot: jax.Array,
+                      k: int, kk: int, select_min: bool, invalid,
+                      recall: float, select_k_fn):
+    """Merge the scalar-prefetch kernel's per-bin output into final
+    (distances [B, k], ids [B, k]) — shared by IVF-Flat and IVF-PQ.
+
+    ``keys/kids [n_seg, S, nbins]`` are minimized sort keys + global
+    candidate ids (-1 invalid) from ops.pallas_kernels.segmented_scan_
+    topk; per-slot candidates are cut to ``kk`` with the hardware top-k
+    (an exact top_k over the bin table measured ~124 ms of a 264 ms
+    search), gathered to (query, probe) order, and merged per query.
+    Metric epilogues (sqrt, 1−cos) stay with the callers."""
+    n_seg, seg, nbins = keys.shape
+    B, P = pair_seg.shape
+    mk, sel = jax.lax.approx_min_k(keys.reshape(-1, nbins), kk,
+                                   recall_target=recall)
+    cids = jnp.take_along_axis(kids.reshape(-1, nbins), sel, axis=1)
+    vals = mk if select_min else -mk  # keys are minimized; ip flips back
+    vals = jnp.where(cids < 0, invalid, vals)
+    pv, pi = gather_segment_results(vals.reshape(n_seg, seg, kk),
+                                    cids.reshape(n_seg, seg, kk),
+                                    pair_seg, pair_slot)
+    out_vals, out_ids = select_k_fn(pv.reshape(B, P * kk),
+                                    min(k, P * kk), select_min=select_min,
+                                    input_indices=pi.reshape(B, P * kk))
+    if k > P * kk:
+        pad = k - P * kk
+        out_vals = jnp.pad(out_vals, ((0, 0), (0, pad)),
+                           constant_values=invalid)
+        out_ids = jnp.pad(out_ids, ((0, 0), (0, pad)), constant_values=-1)
+    return out_vals, out_ids
+
+
 # Auto-dispatch guard: fall back from grouped to per_query only when the
 # segmented scan's allocations would be memory-hostile. Measured
 # on-chip, grouped beats the gather-bound per_query path, so this is a

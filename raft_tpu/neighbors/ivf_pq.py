@@ -94,6 +94,10 @@ class SearchParams:
     scan_mode: str = "auto"  # "auto" | "grouped" | "per_query"
     list_chunk: int = 64
     lut_dtype: str = "float32"
+    # grouped-path per-segment selection: "exact" (reference semantics)
+    # or "approx" (TPU hardware top-k, recall-targeted; see ivf_flat)
+    scan_select: str = "exact"  # | "approx"
+    scan_recall: float = 0.95
 
 
 _LUT_DTYPES = {"float32": jnp.float32, "bfloat16": jnp.bfloat16,
@@ -762,7 +766,11 @@ def _want_recon_cache(params: IndexParams, n_lists: int, L: int,
         return False
     if params.cache_reconstruction == "always":
         return True
-    return n_lists * L * rot_dim * 2 <= (1 << 30)  # "auto": ≤ 1 GB
+    # "auto": ≤ 3 GB — the scan reads the cache instead of decoding
+    # codes per probe, and the fast scalar-prefetch kernel requires it;
+    # 3 GB covers 1M×128 f32-equivalent datasets on a 16 GB chip with
+    # room for the codes, queries and accumulators
+    return n_lists * L * rot_dim * 2 <= (3 << 30)
 
 
 @jax.jit
@@ -1026,10 +1034,14 @@ def _search_impl(index: IvfPqIndex, queries: jax.Array, k: int,
 
 
 @partial(jax.jit, static_argnames=("k", "n_probes", "seg", "n_seg",
-                                   "seg_chunk", "use_pallas"))
+                                   "seg_chunk", "use_pallas", "select_impl",
+                                   "select_recall", "use_segk"))
 def _search_grouped(index: IvfPqIndex, queries: jax.Array, k: int,
                     n_probes: int, seg: int, n_seg: int, seg_chunk: int,
-                    use_pallas: bool = False, filter_bits=None):
+                    use_pallas: bool = False, filter_bits=None,
+                    select_impl: str = "exact",
+                    select_recall: float = 0.95,
+                    use_segk: bool = False):
     """Segmented list-centric batch scan (see ivf_common): each probed
     list's codes are decoded once per owned segment (one-hot MXU
     contraction — or skipped entirely when the bf16 reconstruction cache
@@ -1070,6 +1082,24 @@ def _search_grouped(index: IvfPqIndex, queries: jax.Array, k: int,
         from raft_tpu.neighbors.sample_filter import passes
 
         valid_full &= passes(filter_bits, index.packed_ids)
+
+    kk_ = min(k, L)
+    if use_segk:
+        # scalar-prefetch kernel over the bf16 recon cache (see ivf_flat:
+        # the XLA gather of list blocks runs ~20 GB/s and dominates)
+        met = "ip" if ip_like else "l2"
+        qv_all = q_rot[jnp.clip(seg_q, 0, B - 1)]         # [n_seg, S, rot]
+        keys, kids = _pk.segmented_scan_topk(
+            seg_list, qv_all, index.packed_recon, index.packed_ids, met,
+            interpret=not _pk._on_tpu())
+        out_vals, out_ids = ic.merge_bin_results(
+            keys, kids, pair_seg, pair_slot, k, kk_, select_min, invalid,
+            select_recall, _select_k)
+        if sqrt_out:
+            out_vals = jnp.sqrt(out_vals)
+        if mt == DistanceType.CosineExpanded:
+            out_vals = 1.0 - out_vals
+        return out_vals, out_ids
 
     C = seg_chunk
     n_chunks = -(-n_seg // C)
@@ -1122,8 +1152,19 @@ def _search_grouped(index: IvfPqIndex, queries: jax.Array, k: int,
             dists = jnp.maximum(
                 q_sq[qi][:, :, None] + norms[:, None, :] - 2.0 * scores, 0.0)
         dists = jnp.where(valid[:, None, :], dists, invalid)
-        vals, pos = _select_k(dists.reshape(C * seg, L), kk,
-                              select_min=select_min)
+        if select_impl == "approx":
+            # hardware top-k (TPU approx reduction) — see ivf_flat
+            if select_min:
+                vals, pos = lax.approx_min_k(
+                    dists.reshape(C * seg, L), kk,
+                    recall_target=select_recall)
+            else:
+                vals, pos = lax.approx_max_k(
+                    dists.reshape(C * seg, L), kk,
+                    recall_target=select_recall)
+        else:
+            vals, pos = _select_k(dists.reshape(C * seg, L), kk,
+                                  select_min=select_min)
         vals = vals.reshape(C, seg, kk)
         pos = pos.reshape(C, seg, kk)
         cids = jax.vmap(lambda l, p: l[p])(lids, pos)
@@ -1190,10 +1231,19 @@ def search(index: IvfPqIndex, queries: jax.Array, k: int,
                                      params.list_chunk)
             from raft_tpu.ops import pallas_kernels as _pk
 
-            wants = _pk.pallas_grouped_wanted(kk, L, index.rot_dim, bq=seg)
+            approx = params.scan_select == "approx"
+            segk = (approx and filter_bitset is None
+                    and index.packed_recon is not None
+                    and _pk.pallas_segmented_wanted(kk, L, index.rot_dim,
+                                                    S=seg))
+            wants = (not approx) and _pk.pallas_grouped_wanted(
+                kk, L, index.rot_dim, bq=seg)
             return _search_grouped(index, queries, k, n_probes, seg,
                                    n_seg, chunk, use_pallas=wants,
-                                   filter_bits=filter_bitset)
+                                   filter_bits=filter_bitset,
+                                   select_impl=params.scan_select,
+                                   select_recall=params.scan_recall,
+                                   use_segk=segk)
     return _search_impl(index, queries, k, n_probes,
                         _fit_query_tile(params.query_tile, n_probes, index),
                         filter_bits=filter_bitset, lut_dtype=params.lut_dtype)

@@ -250,3 +250,42 @@ class TestGroupedScan:
         # nearest neighbor of a dataset row is itself
         hits = (np.asarray(i)[:, 0] == np.arange(512)).mean()
         assert hits >= 0.95
+
+
+class TestApproxScanSelect:
+    """scan_select="approx" (TPU hardware top-k) must stay close to the
+    exact grouped path — it is the documented recall-targeted fast knob."""
+
+    def test_approx_recall_close_to_exact(self, corpus):
+        x, q = corpus
+        idx = ivf_flat.build(jnp.asarray(x), IndexParams(n_lists=32, seed=0))
+        _, ie = ivf_flat.search(idx, jnp.asarray(q), 10,
+                                SearchParams(n_probes=16, scan_mode="grouped"))
+        _, ia = ivf_flat.search(idx, jnp.asarray(q), 10,
+                                SearchParams(n_probes=16, scan_mode="grouped",
+                                             scan_select="approx"))
+        ie, ia = np.asarray(ie), np.asarray(ia)
+        same = np.mean([len(set(a) & set(b)) / 10.0 for a, b in zip(ie, ia)])
+        assert same >= 0.9, same
+
+
+    def test_segk_kernel_path_interpret(self, corpus, monkeypatch):
+        """End-to-end through the scalar-prefetch kernel path (interpret
+        mode off-TPU via RAFT_TPU_PALLAS_GROUPED=always), including a
+        tiny-list index (L < 128 exercises the lane padding)."""
+        x, q = corpus
+        monkeypatch.setenv("RAFT_TPU_PALLAS_GROUPED", "always")
+        for n_lists in (32, 256):   # 256 lists over 5000 rows -> L < 128
+            idx = ivf_flat.build(jnp.asarray(x),
+                                 IndexParams(n_lists=n_lists, seed=0))
+            _, ie = ivf_flat.search(
+                idx, jnp.asarray(q), 10,
+                SearchParams(n_probes=16, scan_mode="grouped"))
+            _, ia = ivf_flat.search(
+                idx, jnp.asarray(q), 10,
+                SearchParams(n_probes=16, scan_mode="grouped",
+                             scan_select="approx"))
+            ie, ia = np.asarray(ie), np.asarray(ia)
+            same = np.mean([len(set(a) & set(b)) / 10.0
+                            for a, b in zip(ie, ia)])
+            assert same >= 0.9, (n_lists, same)

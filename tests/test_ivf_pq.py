@@ -388,3 +388,37 @@ class TestGroupedScanPq:
         ref = np.argsort(full, 1)[:, :10]
         hits = sum(len(set(g) & set(r)) for g, r in zip(np.asarray(ids), ref))
         assert hits / ref.size >= 0.9
+
+
+class TestApproxScanSelect:
+    def test_approx_recall_close_to_exact(self, corpus):
+        x, q = corpus
+        idx = ivf_pq.build(jnp.asarray(x),
+                           IndexParams(n_lists=16, pq_dim=16, seed=0))
+        _, ie = ivf_pq.search(idx, jnp.asarray(q), 10,
+                              SearchParams(n_probes=8, scan_mode="grouped"))
+        _, ia = ivf_pq.search(idx, jnp.asarray(q), 10,
+                              SearchParams(n_probes=8, scan_mode="grouped",
+                                           scan_select="approx"))
+        ie, ia = np.asarray(ie), np.asarray(ia)
+        same = np.mean([len(set(a) & set(b)) / 10.0 for a, b in zip(ie, ia)])
+        assert same >= 0.85, same
+
+
+    def test_segk_kernel_path_interpret(self, corpus, monkeypatch):
+        """End-to-end PQ through the scalar-prefetch kernel over the
+        recon cache (interpret mode off-TPU)."""
+        x, q = corpus
+        monkeypatch.setenv("RAFT_TPU_PALLAS_GROUPED", "always")
+        idx = ivf_pq.build(jnp.asarray(x),
+                           IndexParams(n_lists=16, pq_dim=16, seed=0,
+                                       cache_reconstruction="always"))
+        assert idx.packed_recon is not None
+        _, ia = ivf_pq.search(idx, jnp.asarray(q), 10,
+                              SearchParams(n_probes=8, scan_mode="grouped",
+                                           scan_select="approx"))
+        _, ie = ivf_pq.search(idx, jnp.asarray(q), 10,
+                              SearchParams(n_probes=8, scan_mode="grouped"))
+        ia, ie = np.asarray(ia), np.asarray(ie)
+        same = np.mean([len(set(a) & set(b)) / 10.0 for a, b in zip(ie, ia)])
+        assert same >= 0.8, same

@@ -61,3 +61,64 @@ def test_fused_dispatch_cpu_falls_back(rng):
     d, i = fused_l2_nn_argmin(jnp.asarray(x), jnp.asarray(y))
     full = ((x[:, None, :] - y[None]) ** 2).sum(-1)
     np.testing.assert_array_equal(np.asarray(i), np.argmin(full, 1))
+
+
+class TestSegmentedScan:
+    """segmented_scan_topk (interpret mode off-TPU) vs numpy reference:
+    per-strided-bin mins (bin = position mod 128) of each segment's
+    distance row."""
+
+    def test_bin_mins_match_numpy(self):
+        from raft_tpu.ops.pallas_kernels import segmented_scan_topk
+
+        rng = np.random.default_rng(0)
+        n_lists, L, d, n_seg, S = 8, 1408, 64, 12, 16
+        packed = rng.standard_normal((n_lists, L, d)).astype(np.float32)
+        ids = rng.integers(-1, 10_000, (n_lists, L)).astype(np.int32)
+        seg_list = rng.integers(0, n_lists, n_seg).astype(np.int32)
+        qv = rng.standard_normal((n_seg, S, d)).astype(np.float32)
+
+        keys, pos = segmented_scan_topk(
+            jnp.asarray(seg_list), jnp.asarray(qv), jnp.asarray(packed),
+            jnp.asarray(ids), "l2", interpret=True)
+        keys, pos = np.asarray(keys), np.asarray(pos)
+        T = L // 128
+        assert keys.shape == (n_seg, S, 256)
+
+        for s in (0, 5, n_seg - 1):
+            li = seg_list[s]
+            dist = ((qv[s][:, None, :] - packed[li][None, :, :]) ** 2).sum(-1)
+            dist[:, ids[li] < 0] = np.inf
+            d3 = dist.reshape(S, T, 128)
+            m1 = d3.min(axis=1)                            # [S, 128] bins
+            a1 = d3.argmin(axis=1)
+            d3b = d3.copy()
+            d3b[np.arange(S)[:, None], a1, np.arange(128)[None, :]] = np.inf
+            m2 = d3b.min(axis=1)
+            a2 = d3b.argmin(axis=1)
+            ref_min = np.concatenate([m1, m2], axis=1)
+            np.testing.assert_allclose(keys[s], ref_min, rtol=1e-4, atol=1e-4)
+            lanes = np.arange(128)[None, :]
+            ref_pos = np.concatenate([a1 * 128 + lanes, a2 * 128 + lanes], 1)
+            ref_ids = ids[li][ref_pos]                     # kernel emits ids
+            okmask = np.isfinite(ref_min)
+            assert (pos[s][okmask] == ref_ids[okmask]).all()
+            assert (pos[s][~okmask] == -1).all()
+
+    def test_ip_metric(self):
+        from raft_tpu.ops.pallas_kernels import segmented_scan_topk
+
+        rng = np.random.default_rng(1)
+        packed = rng.standard_normal((4, 256, 32)).astype(np.float32)
+        ids = np.where(rng.random((4, 256)) < 0.1, -1, 1).astype(np.int32)
+        seg_list = np.array([2, 0, 3], np.int32)
+        qv = rng.standard_normal((3, 8, 32)).astype(np.float32)
+        keys, pos = segmented_scan_topk(
+            jnp.asarray(seg_list), jnp.asarray(qv), jnp.asarray(packed),
+            jnp.asarray(ids), "ip", interpret=True)
+        keys, pos = np.asarray(keys), np.asarray(pos)
+        s = 0
+        score = -(qv[s] @ packed[2].T)
+        score[:, ids[2] < 0] = np.inf
+        ref = score.reshape(8, 2, 128).min(axis=1)
+        np.testing.assert_allclose(keys[s][:, :128], ref, rtol=1e-4, atol=1e-4)
