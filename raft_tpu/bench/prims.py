@@ -16,6 +16,14 @@ CLI::
                                     kmeans|ivf_scan|all] [--csv out.csv]
 
 Each row: {bench, params, impl, ms, throughput}.
+
+Caveat on tunnelled/remote devices: times are end-to-end per call
+(dispatch + execute + result fetch — ``block_until_ready`` alone does
+not reliably synchronize there), so a per-call transport floor
+(~100 ms over an HTTP device tunnel) can swamp sub-ms kernels. For
+per-op device time in that setting, chain iterations inside one jit
+with a data dependency and difference two iteration counts — see
+docs/tpu_design_notes.md for measured examples.
 """
 
 from __future__ import annotations
@@ -40,13 +48,17 @@ class PrimResult:
 
 
 def _time(fn: Callable[[], Any], iters: int = 10, warmup: int = 2) -> float:
-    """Median wall ms of ``fn`` (jax-aware: blocks on the result)."""
+    """Median wall ms of ``fn``, synchronized by fetching the result —
+    ``block_until_ready`` alone does not reliably synchronize on
+    remote-device (tunnelled) backends (a 25-GFLOP matmul "measured"
+    0.05 ms, 10× over hardware peak); ``device_get`` is the honest
+    fence, matching bench/runner.py's end-to-end methodology."""
     for _ in range(warmup):
-        jax.block_until_ready(fn())
+        jax.device_get(fn())
     times = []
     for _ in range(iters):
         t0 = time.perf_counter()
-        jax.block_until_ready(fn())
+        jax.device_get(fn())
         times.append((time.perf_counter() - t0) * 1e3)
     return float(np.median(times))
 
@@ -122,7 +134,14 @@ def bench_pairwise(grid=None, iters: int = 10) -> List[PrimResult]:
     for metric, m, n, d in grid:
         x = jnp.asarray(rng.random((m, d), dtype=np.float32))
         y = jnp.asarray(rng.random((n, d), dtype=np.float32))
-        ms = _time(lambda: pairwise_distance(x, y, metric=metric), iters)
+        # reduce INSIDE the measured program: the [m, n] output is tens
+        # of MB, and the device_get fence would otherwise time the
+        # host-transfer, not the kernel (the sum blocks DCE; XLA may
+        # fuse away the final HBM write, which a real consumer often
+        # does too)
+        f = jax.jit(lambda x_, y_, _mt=metric: jnp.sum(
+            pairwise_distance(x_, y_, metric=_mt)))
+        ms = _time(lambda: f(x, y), iters)
         rows.append(PrimResult(
             "pairwise", metric, ms, m * n * 1e3 / ms, "pairs/s",
             {"m": m, "n": n, "d": d, "metric": metric}))
