@@ -34,6 +34,7 @@ def build_config(n: int, n_queries: int, algos):
             "name": "ivf_flat.n1024", "algo": "ivf_flat",
             "build_param": {"n_lists": 1024},
             "search_params": [{"n_probes": 32},
+                              {"n_probes": 16, "scan_select": "approx"},
                               {"n_probes": 32, "scan_select": "approx"},
                               {"n_probes": 64, "scan_select": "approx"}],
         })
