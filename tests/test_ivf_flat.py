@@ -289,3 +289,20 @@ class TestApproxScanSelect:
             same = np.mean([len(set(a) & set(b)) / 10.0
                             for a, b in zip(ie, ia)])
             assert same >= 0.9, (n_lists, same)
+
+    def test_segk_k_exceeds_candidates(self, monkeypatch):
+        """k > n_probes*kk exercises merge_bin_results' invalid padding."""
+        monkeypatch.setenv("RAFT_TPU_PALLAS_GROUPED", "always")
+        rng = np.random.default_rng(0)
+        x = rng.standard_normal((256, 16)).astype(np.float32)
+        q = rng.standard_normal((64, 16)).astype(np.float32)
+        idx = ivf_flat.build(jnp.asarray(x), IndexParams(n_lists=32, seed=0))
+        d, i = ivf_flat.search(idx, jnp.asarray(q), 12,
+                               SearchParams(n_probes=1, scan_mode="grouped",
+                                            scan_select="approx"))
+        d, i = np.asarray(d), np.asarray(i)
+        assert d.shape == (64, 12)
+        # slots beyond the single probed list's capacity pad with -1/inf
+        assert ((i >= -1) & (i < 256)).all()
+        pad = i < 0
+        assert np.isinf(d[pad]).all() or not pad.any()
