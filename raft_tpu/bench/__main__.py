@@ -18,7 +18,61 @@ def main(argv=None):
                                  "(reference: cpp/bench/prims)")
     primsp.add_argument("benches", nargs="*", default=["all"])
     primsp.add_argument("--csv", default=None)
+    getp = sub.add_parser("get-dataset",
+                          help="fetch/convert an ann-benchmarks hdf5 "
+                               "dataset (reference: get_dataset)")
+    getp.add_argument("--dataset", default=None,
+                      help="dataset name, e.g. sift-128-euclidean")
+    getp.add_argument("--hdf5", default=None,
+                      help="convert a local .hdf5 instead of fetching")
+    getp.add_argument("--out", default="datasets",
+                      help="dataset root directory")
+    getp.add_argument("--normalize", action="store_true",
+                      help="L2-normalize rows (angular → inner product)")
+    splitp = sub.add_parser("split-groundtruth",
+                            help="split a big-ann groundtruth binary "
+                                 "(reference: split_groundtruth)")
+    splitp.add_argument("groundtruth")
+    splitp.add_argument("--out", default=None)
+    plotp = sub.add_parser("plot", help="QPS/recall + build-time plots "
+                                        "(reference: plot)")
+    plotp.add_argument("csv", help="results CSV from `run --out`")
+    plotp.add_argument("--out", default="search.png")
+    plotp.add_argument("--build-out", default=None,
+                       help="also write a build-time bar chart")
+    plotp.add_argument("--x-scale", default="logit",
+                       choices=["logit", "linear"])
     args = p.parse_args(argv)
+
+    if args.cmd == "get-dataset":
+        from raft_tpu.bench import ingest
+
+        if args.hdf5:
+            d = ingest.convert_hdf5(args.hdf5, args.out,
+                                    normalize=args.normalize)
+        elif args.dataset:
+            d = ingest.fetch(args.dataset, args.out,
+                             normalize=args.normalize)
+        else:
+            p.error("get-dataset needs --dataset or --hdf5")
+        print(f"[bench] dataset ready at {d}")
+        return 0
+    if args.cmd == "split-groundtruth":
+        from raft_tpu.bench import ingest
+
+        d = ingest.split_groundtruth(args.groundtruth, args.out)
+        print(f"[bench] groundtruth written under {d}")
+        return 0
+    if args.cmd == "plot":
+        from raft_tpu.bench import plot as plot_mod
+
+        rows = plot_mod.read_csv(args.csv)
+        out = plot_mod.plot_search(rows, args.out, x_scale=args.x_scale)
+        print(f"[bench] wrote {out}")
+        if args.build_out:
+            print(f"[bench] wrote "
+                  f"{plot_mod.plot_build(rows, args.build_out)}")
+        return 0
 
     if args.cmd == "prims":
         from raft_tpu.bench import prims

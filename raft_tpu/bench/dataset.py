@@ -91,9 +91,16 @@ def load_dataset(root: str, name: str, metric: str = "sqeuclidean",
 
 def make_synthetic(name: str, n: int, dim: int, n_queries: int,
                    metric: str = "sqeuclidean", seed: int = 0,
-                   clustered: bool = True) -> Dataset:
+                   clustered: bool = True, hard: bool = False) -> Dataset:
     """Synthetic benchmark set shaped like the reference's standard ones
-    (SIFT-style clustered f32)."""
+    (SIFT-style clustered f32).
+
+    ``hard=True`` selects :func:`make_synthetic_hard` — overlapping
+    low-intrinsic-dimension clusters calibrated so IVF recall curves
+    bend like real SIFT's, instead of the near-separable default."""
+    if hard:
+        return make_synthetic_hard(name, n, dim, n_queries, metric=metric,
+                                   seed=seed)
     rng = np.random.default_rng(seed)
     if clustered:
         n_centers = max(16, int(np.sqrt(n)))
@@ -106,6 +113,61 @@ def make_synthetic(name: str, n: int, dim: int, n_queries: int,
     else:
         base = rng.random((n, dim), dtype=np.float32)
         queries = rng.random((n_queries, dim), dtype=np.float32)
+    return Dataset(name=name, base=base, queries=queries, metric=metric)
+
+
+def make_synthetic_hard(name: str, n: int, dim: int, n_queries: int,
+                        metric: str = "sqeuclidean", seed: int = 0,
+                        n_centers: int = 0, lid: int = 16,
+                        overlap: float = 1.0) -> Dataset:
+    """Hard clustered synthetic: overlapping low-LID clusters.
+
+    The default :func:`make_synthetic` places ~1000 Gaussian balls ~8×
+    farther apart than their radius — a kmeans partition separates them
+    perfectly and IVF recall saturates at tiny n_probes (VERDICT r3:
+    0.9991 at n_probes=16 where real SIFT-1M needs far more). Here:
+
+    - each cluster lives on a random ``lid``-dimensional affine subspace
+      (local intrinsic dimension matched to SIFT's ~12-16, which is what
+      makes graph/IVF search meaningfully hard, not the ambient 128);
+    - cluster radius ≈ ``overlap`` × the distance to the nearest other
+      center, so every neighborhood near a partition boundary spans
+      several clusters and true top-k sets cross kmeans cells;
+    - queries are perturbed copies of held-out base-like points (the
+      ann-benchmarks convention: queries come from the data
+      distribution, not from cluster centers).
+    """
+    rng = np.random.default_rng(seed)
+    if not n_centers:
+        n_centers = max(64, int(np.sqrt(n)))
+    centers = rng.standard_normal((n_centers, dim)).astype(np.float32)
+    # nearest-other-center distance sets the radius scale
+    # (sample-estimate on a subset to stay O(C·S))
+    sub = centers[rng.choice(n_centers, min(n_centers, 256), replace=False)]
+    d2 = (np.sum(centers**2, 1)[:, None] + np.sum(sub**2, 1)[None, :]
+          - 2.0 * centers @ sub.T)
+    np.clip(d2, 0, None, out=d2)
+    d2[d2 < 1e-6] = np.inf                      # self pairs
+    nearest = np.sqrt(d2.min(axis=1))           # [C]
+    lid = min(lid, dim)
+    bases = rng.standard_normal((n_centers, dim, lid)).astype(np.float32)
+    bases /= np.linalg.norm(bases, axis=1, keepdims=True)
+    scale = (overlap * nearest / np.sqrt(lid)).astype(np.float32)
+
+    def sample(m, assign):
+        z = rng.standard_normal((m, lid)).astype(np.float32)
+        z *= scale[assign][:, None]
+        pts = centers[assign]
+        pts = pts + np.einsum("mdl,ml->md", bases[assign], z)
+        # small full-dim noise so points are near, not on, the manifold
+        pts += (0.05 * scale[assign][:, None]
+                * rng.standard_normal((m, dim)).astype(np.float32))
+        return pts.astype(np.float32)
+
+    assign = rng.integers(0, n_centers, n)
+    base = sample(n, assign)
+    q_assign = rng.integers(0, n_centers, n_queries)
+    queries = sample(n_queries, q_assign)
     return Dataset(name=name, base=base, queries=queries, metric=metric)
 
 

@@ -1,0 +1,123 @@
+"""Real-dataset ingestion — ann-benchmarks hdf5 → .fbin/.ibin dataset
+directories, plus big-ann groundtruth splitting.
+
+TPU-native counterpart of the reference's dataset tooling
+(python/raft-ann-bench get_dataset/__main__.py:34 convert_hdf5_to_fbin +
+hdf5_to_fbin.py; split_groundtruth/__main__.py + split_groundtruth.pl).
+Re-designed host-side: one streaming pass per file (h5py chunk reads →
+appended fbin payload), no subprocess/perl helpers.
+
+ann-benchmarks hdf5 layout: datasets ``train`` [n, d] f32, ``test``
+[m, d] f32, ``neighbors`` [m, k] int, ``distances`` [m, k] f32.
+Angular sets are L2-normalized on conversion (``normalize=True``) so
+inner-product search is exact cosine — the reference's ``-n`` flag.
+
+big-ann groundtruth binary (split_groundtruth.pl's input): header
+``[n, k] u32`` then ``n·k`` int32 neighbor ids then ``n·k`` float32
+distances; :func:`split_groundtruth` splits it into the
+``groundtruth.ibin`` / ``groundtruth_dist.fbin`` pair the bench loader
+reads.
+"""
+
+from __future__ import annotations
+
+import os
+import struct
+from typing import Optional
+
+import numpy as np
+
+from .. import native
+
+_CHUNK_ROWS = 1 << 18
+
+
+def _write_fbin_streaming(path: str, src, dtype, normalize: bool = False):
+    """Stream ``src`` (h5py dataset / array-like) into a .fbin/.ibin
+    file in row chunks — billion-scale trains never materialize."""
+    n, d = src.shape
+    with open(path, "wb") as f:
+        f.write(struct.pack("<ii", n, d))
+        for start in range(0, n, _CHUNK_ROWS):
+            block = np.asarray(src[start:start + _CHUNK_ROWS], dtype=dtype)
+            if normalize:
+                norms = np.linalg.norm(block, axis=1, keepdims=True)
+                block = block / np.maximum(norms, 1e-30)
+            f.write(np.ascontiguousarray(block, dtype).tobytes())
+
+
+def convert_hdf5(hdf5_path: str, out_dir: str,
+                 normalize: bool = False) -> str:
+    """Convert one ann-benchmarks hdf5 file into a dataset directory
+    (reference: hdf5_to_fbin.py driven by get_dataset/__main__.py:34).
+
+    Writes ``base.fbin``, ``query.fbin``, ``groundtruth.ibin`` and
+    (when present) ``groundtruth_dist.fbin`` under
+    ``out_dir/<dataset-name>``; returns that directory. ``normalize``
+    L2-normalizes base and queries (angular → inner-product search),
+    matching the reference's convention of renaming *-angular to
+    *-inner."""
+    import h5py
+
+    name = os.path.splitext(os.path.basename(hdf5_path))[0]
+    if normalize and "angular" in name:
+        name = name.replace("angular", "inner")
+    d = os.path.join(out_dir, name)
+    os.makedirs(d, exist_ok=True)
+    with h5py.File(hdf5_path, "r") as f:
+        _write_fbin_streaming(os.path.join(d, "base.fbin"), f["train"],
+                              np.float32, normalize)
+        _write_fbin_streaming(os.path.join(d, "query.fbin"), f["test"],
+                              np.float32, normalize)
+        if "neighbors" in f:
+            _write_fbin_streaming(os.path.join(d, "groundtruth.ibin"),
+                                  f["neighbors"], np.int32)
+        if "distances" in f:
+            _write_fbin_streaming(os.path.join(d, "groundtruth_dist.fbin"),
+                                  f["distances"], np.float32)
+    return d
+
+
+def split_groundtruth(gt_path: str, out_dir: Optional[str] = None) -> str:
+    """Split a big-ann-benchmarks groundtruth file (ids+distances in one
+    binary) into ``groundtruth.ibin`` + ``groundtruth_dist.fbin``
+    (reference: split_groundtruth/__main__.py + split_groundtruth.pl).
+    Returns the output directory (defaults to the file's)."""
+    out_dir = out_dir or os.path.dirname(os.path.abspath(gt_path))
+    os.makedirs(out_dir, exist_ok=True)
+    with open(gt_path, "rb") as f:
+        n, k = struct.unpack("<ii", f.read(8))
+        ids = np.frombuffer(f.read(n * k * 4), dtype=np.int32).reshape(n, k)
+        rest = f.read(n * k * 4)
+    native.bin_write(os.path.join(out_dir, "groundtruth.ibin"), ids)
+    if len(rest) == n * k * 4:  # distances present
+        dist = np.frombuffer(rest, dtype=np.float32).reshape(n, k)
+        native.bin_write(os.path.join(out_dir, "groundtruth_dist.fbin"),
+                         dist)
+    return out_dir
+
+
+def fetch(name: str, data_dir: str, normalize: bool = False) -> str:
+    """Download an ann-benchmarks dataset by name and convert it
+    (reference: get_dataset/__main__.py download). In an air-gapped
+    environment place ``<name>.hdf5`` under ``data_dir`` yourself and
+    this converts it without network access."""
+    os.makedirs(data_dir, exist_ok=True)
+    hdf5_path = os.path.join(data_dir, f"{name}.hdf5")
+    if not os.path.exists(hdf5_path):
+        from urllib.request import urlretrieve
+
+        url = f"https://ann-benchmarks.com/{name}.hdf5"
+        # download to a temp name and rename on success: a partial file
+        # at the final path would be mistaken for complete on retry
+        tmp = hdf5_path + ".part"
+        try:
+            urlretrieve(url, tmp)
+            os.replace(tmp, hdf5_path)
+        except Exception as e:  # air-gapped: point at the manual path
+            if os.path.exists(tmp):
+                os.remove(tmp)
+            raise RuntimeError(
+                f"cannot download {url} ({e}); place the file at "
+                f"{hdf5_path} and re-run") from e
+    return convert_hdf5(hdf5_path, data_dir, normalize=normalize)

@@ -1,0 +1,108 @@
+"""QPS-vs-recall plotting — the reference's ``plot`` module
+(python/raft-ann-bench/src/raft-ann-bench/plot/__main__.py), re-designed
+around this harness's BenchResult rows / CSV export.
+
+One figure per call: each index's measurement points, its pareto
+frontier drawn solid, non-frontier points faded — the shape every
+raft-ann-bench README curve uses. X axis defaults to a logit-like
+scale so the interesting 0.9..0.999 recall region is readable.
+"""
+
+from __future__ import annotations
+
+import csv
+import json
+from typing import Iterable, List, Optional, Sequence
+
+from .runner import BenchResult, pareto_frontier
+
+
+def read_csv(path: str) -> List[BenchResult]:
+    """Load rows written by runner.export_csv back into BenchResult."""
+    out: List[BenchResult] = []
+    with open(path) as f:
+        for row in csv.DictReader(f):
+            out.append(BenchResult(
+                algo=row["algo"], index_name=row["index_name"],
+                dataset=row["dataset"], k=int(row["k"]),
+                batch_size=int(row["batch_size"]),
+                build_s=float(row["build_s"]),
+                search_s=float(row["search_s"]), qps=float(row["qps"]),
+                recall=float(row["recall"]),
+                build_param=json.loads(row["build_param"]),
+                search_param=json.loads(row["search_param"])))
+    return out
+
+
+def plot_search(results: Iterable[BenchResult], out_path: str,
+                title: Optional[str] = None,
+                x_scale: str = "logit") -> str:
+    """Write the QPS-vs-recall plot (reference: plot/__main__.py
+    create_plot_search). Returns ``out_path``."""
+    import matplotlib
+
+    matplotlib.use("Agg")
+    import matplotlib.pyplot as plt
+
+    rows = list(results)
+    if not rows:
+        raise ValueError("no results to plot")
+    fig, ax = plt.subplots(figsize=(10, 7))
+    names = sorted({r.index_name for r in rows})
+    cmap = plt.get_cmap("tab10")
+
+    # logit(1.0) is non-finite: exact-recall points (brute force, or
+    # 0.99995+ rounded to 1.0 by the CSV) must clamp INSIDE the open
+    # interval or they silently vanish from the chart
+    def rx(r):
+        return min(r.recall, 1 - 2e-5) if x_scale == "logit" else r.recall
+
+    for i, name in enumerate(names):
+        mine = [r for r in rows if r.index_name == name]
+        color = cmap(i % 10)
+        ax.scatter([rx(r) for r in mine], [r.qps for r in mine],
+                   color=color, alpha=0.35, s=24)
+        front = pareto_frontier(mine)
+        ax.plot([rx(r) for r in front], [r.qps for r in front],
+                color=color, marker="o", label=name, linewidth=2)
+    if x_scale == "logit":
+        # readable 0.9..0.999 region; clamp into (0, 1) open interval
+        ax.set_xscale("logit")
+        lo = min(max(min(rx(r) for r in rows) - 0.05, 0.01), 0.5)
+        hi = min(max(rx(r) for r in rows) + 1e-5, 1 - 1e-5)
+        ax.set_xlim(lo, hi)
+    ax.set_yscale("log")
+    ax.set_xlabel(f"recall@{rows[0].k}")
+    ax.set_ylabel("queries/s")
+    ax.grid(True, which="both", alpha=0.3)
+    ax.legend()
+    ax.set_title(title or f"{rows[0].dataset} (batch={rows[0].batch_size})")
+    fig.savefig(out_path, bbox_inches="tight", dpi=120)
+    plt.close(fig)
+    return out_path
+
+
+def plot_build(results: Iterable[BenchResult], out_path: str,
+               title: Optional[str] = None) -> str:
+    """Build-time bar chart (reference: plot/__main__.py
+    create_plot_build)."""
+    import matplotlib
+
+    matplotlib.use("Agg")
+    import matplotlib.pyplot as plt
+
+    rows = list(results)
+    best: dict = {}
+    for r in rows:  # one bar per index: its build time
+        best[r.index_name] = r.build_s
+    fig, ax = plt.subplots(figsize=(8, 5))
+    names = sorted(best)
+    ax.bar(range(len(names)), [best[n] for n in names],
+           color=[plt.get_cmap("tab10")(i % 10) for i in range(len(names))])
+    ax.set_xticks(range(len(names)), names, rotation=20, ha="right")
+    ax.set_ylabel("build time (s)")
+    ax.grid(True, axis="y", alpha=0.3)
+    ax.set_title(title or (rows[0].dataset if rows else "build times"))
+    fig.savefig(out_path, bbox_inches="tight", dpi=120)
+    plt.close(fig)
+    return out_path

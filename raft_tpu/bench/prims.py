@@ -74,7 +74,11 @@ def bench_select_k(grid=None, iters: int = 10) -> List[PrimResult]:
 
     if grid is None:
         grid = [(256, 2048, 10), (256, 16384, 10), (64, 65536, 10),
-                (256, 16384, 64), (64, 65536, 64)]
+                (256, 16384, 64), (64, 65536, 64),
+                # large-k tier (the reference's radix path covers
+                # k ≤ 2048, select_radix.cuh): tiled two-phase vs the
+                # full-sort fallback
+                (64, 262144, 128), (64, 262144, 512), (256, 65536, 256)]
     rows: List[PrimResult] = []
     rng = np.random.default_rng(0)
     for batch, length, k in grid:
@@ -84,6 +88,9 @@ def bench_select_k(grid=None, iters: int = 10) -> List[PrimResult]:
             "lax.top_k": lambda: jax.lax.top_k(-s, k),
             "select_k.auto": lambda: select_k_auto(s, k),
         }
+        if k > 64 and length >= 4 * 16384:
+            impls["tiled.16k"] = lambda: select_k_auto(s, k,
+                                                       len_tile=16384)
         if _on_tpu() and k <= 64:
             impls["pallas"] = lambda: select_k_pallas(s, k)
         for name, fn in impls.items():

@@ -185,6 +185,7 @@ def run_config(config: Dict[str, Any],
                 int(dcfg["n"]), int(dcfg["dim"]), int(dcfg["n_queries"]),
                 metric=dcfg.get("metric", "sqeuclidean"),
                 seed=int(dcfg.get("seed", 0)),
+                hard=bool(dcfg.get("hard", False)),
             )
     if data.groundtruth is None:
         ds_mod.compute_groundtruth(data, k=max(k, 10))

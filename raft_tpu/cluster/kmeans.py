@@ -161,6 +161,91 @@ def fit(
     return best
 
 
+def update_centroids(x: jax.Array, sample_weights: jax.Array,
+                     centroids: jax.Array, labels: jax.Array
+                     ) -> Tuple[jax.Array, jax.Array]:
+    """One centroid-update step given fixed labels — the helper an
+    external mini-batch loop drives (reference: kmeans::update_centroids,
+    cluster/kmeans.cuh:385-411). Returns (weight_per_cluster [k],
+    new_centroids [k, d]); empty clusters keep their old centroid."""
+    k = centroids.shape[0]
+    new_c, counts = _update_centroids(x.astype(jnp.float32),
+                                      sample_weights.astype(jnp.float32),
+                                      labels, k, centroids)
+    return counts, new_c
+
+
+@partial(jax.jit, static_argnames=("n_clusters", "batch_size", "n_iters"))
+def _minibatch_loop(x, c0, key, n_clusters: int, batch_size: int,
+                    n_iters: int):
+    """Mini-batch Lloyd: each iteration assigns one random batch and
+    moves its centroids by the per-cluster running learning rate
+    1/count (Sculley 2010, the update cuML's MiniBatchKMeans applies
+    through update_centroids). One ``fori_loop`` — no host round trips."""
+    n = x.shape[0]
+    xf = x.astype(jnp.float32)
+
+    def body(i, carry):
+        c, v = carry
+        ki = jax.random.fold_in(key, i)
+        rows = jax.random.randint(ki, (batch_size,), 0, n)
+        xb = xf[rows]
+        _, labels = fused_l2_nn_argmin(xb, c)
+        oh = jax.nn.one_hot(labels, n_clusters, dtype=jnp.float32)
+        bcount = jnp.sum(oh, axis=0)                      # [k]
+        bsum = jnp.einsum("bk,bd->kd", oh, xb,
+                          preferred_element_type=jnp.float32)
+        v = v + bcount
+        # per-cluster EMA toward the batch mean with rate bcount/v
+        lr = jnp.where(v > 0, bcount / jnp.maximum(v, 1.0), 0.0)
+        bmean = bsum / jnp.maximum(bcount, 1.0)[:, None]
+        c = c + lr[:, None] * (bmean - c)
+        return c, v
+
+    c, _ = lax.fori_loop(0, n_iters, body,
+                         (c0.astype(jnp.float32),
+                          jnp.zeros((n_clusters,), jnp.float32)))
+    return c
+
+
+@traced("raft_tpu.kmeans.fit_minibatch")
+def fit_minibatch(params: KMeansParams, x: jax.Array,
+                  batch_size: int = 1024,
+                  n_iters: Optional[int] = None,
+                  init_centroids: Optional[jax.Array] = None
+                  ) -> Tuple[jax.Array, jax.Array, int]:
+    """Mini-batch k-means fit — the TPU counterpart of the mini-batch
+    helpers around ``update_centroids`` (cluster/kmeans.cuh:367-411 area;
+    cuML MiniBatchKMeans drives them the same way). Statically shaped
+    random batches keep the whole fit one compiled program; use for
+    datasets too large for full-batch Lloyd sweeps.
+
+    Returns (centroids [k, d], inertia over a final full pass, n_iters).
+    """
+    n, d = x.shape
+    k = params.n_clusters
+    expects(k <= n, "n_clusters=%d > n_samples=%d", k, n)
+    batch_size = min(batch_size, n)
+    if n_iters is None:
+        # enough batches to see the data ~max_iter/10 times, bounded
+        n_iters = max(20, min(params.max_iter, 10 * n // batch_size))
+    key = RngState(params.seed).key()
+    if init_centroids is not None or params.init == "array":
+        expects(init_centroids is not None,
+                "init='array' requires init_centroids")
+        c0 = init_centroids
+    elif params.init == "random":
+        c0 = init_random(key, x, k)
+    else:
+        # ++ seeding on one batch: full-data D² seeding defeats the
+        # point of mini-batching at scale
+        sub = x[jax.random.randint(jax.random.fold_in(key, n_iters + 1),
+                                   (min(n, max(batch_size, 4 * k)),), 0, n)]
+        c0 = init_plus_plus(key, sub, k)
+    centroids = _minibatch_loop(x, c0, key, k, batch_size, n_iters)
+    return centroids, cluster_cost(centroids, x), n_iters
+
+
 def predict(centroids: jax.Array, x: jax.Array) -> jax.Array:
     """Nearest-centroid labels (reference: kmeans.cuh:152 ``predict``)."""
     _, labels = fused_l2_nn_argmin(x.astype(jnp.float32), centroids)

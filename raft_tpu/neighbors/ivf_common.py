@@ -92,8 +92,10 @@ def segment_probes(probes: jax.Array, n_lists: int, seg: int, n_seg: int):
     B, P = probes.shape
     BP = B * P
     l_flat = probes.reshape(-1).astype(jnp.int32)
-    order = jnp.argsort(l_flat, stable=True)
-    sorted_l = l_flat[order]
+    # sort_key_val, not argsort+gather: values ride the sort for free
+    # (an argsort plus the sorted_l re-gather measured ~3× the cost)
+    iota = jnp.arange(BP, dtype=jnp.int32)
+    sorted_l, order = jax.lax.sort_key_val(l_flat, iota)
     starts = jnp.searchsorted(sorted_l, jnp.arange(n_lists, dtype=jnp.int32))
     counts = jnp.diff(jnp.append(starts, BP)).astype(jnp.int32)
     segs_per_list = (counts + seg - 1) // seg
@@ -117,13 +119,17 @@ def segment_probes(probes: jax.Array, n_lists: int, seg: int, n_seg: int):
     seg_q = jnp.where(
         valid, q_of[jnp.clip(i0[:, None] + j[None, :], 0, BP - 1)], -1)
     # pair-order addresses via the sort's inverse permutation
-    rank_sorted = (jnp.arange(BP, dtype=jnp.int32)
-                   - starts[sorted_l].astype(jnp.int32))
+    rank_sorted = iota - starts[sorted_l].astype(jnp.int32)
     seg_sorted = seg_base[sorted_l] + rank_sorted // seg
     slot_sorted = rank_sorted % seg
-    inv = jnp.argsort(order)
+    # inverse permutation by sorting the (seg, slot) addresses back to
+    # pair order keyed on `order` — one sort carries both payloads, no
+    # argsort + two pointwise gathers
+    addr = seg_sorted * seg + slot_sorted
+    _, addr_pair = jax.lax.sort_key_val(order, addr)
     return (seg_list, seg_q,
-            seg_sorted[inv].reshape(B, P), slot_sorted[inv].reshape(B, P))
+            (addr_pair // seg).reshape(B, P),
+            (addr_pair % seg).reshape(B, P))
 
 
 def gather_segment_results(seg_vals: jax.Array, seg_ids: jax.Array,
@@ -137,32 +143,50 @@ def gather_segment_results(seg_vals: jax.Array, seg_ids: jax.Array,
 
 def merge_bin_results(keys: jax.Array, kids: jax.Array,
                       pair_seg: jax.Array, pair_slot: jax.Array,
-                      k: int, kk: int, select_min: bool, invalid,
-                      recall: float, select_k_fn):
+                      k: int, select_min: bool, invalid, recall: float):
     """Merge the scalar-prefetch kernel's per-bin output into final
     (distances [B, k], ids [B, k]) — shared by IVF-Flat and IVF-PQ.
 
     ``keys/kids [n_seg, S, nbins]`` are minimized sort keys + global
-    candidate ids (-1 invalid) from ops.pallas_kernels.segmented_scan_
-    topk; per-slot candidates are cut to ``kk`` with the hardware top-k
-    (an exact top_k over the bin table measured ~124 ms of a 264 ms
-    search), gathered to (query, probe) order, and merged per query.
-    Metric epilogues (sqrt, 1−cos) stay with the callers."""
+    candidate ids (-1 invalid, key +inf) from ops.pallas_kernels.
+    segmented_scan_topk. Structure (each step sized by measurement on a
+    1M×128 B=10000 search): gather each pair's WHOLE bin row to query
+    order (a [B·P]-row block gather — row gathers are cheap; the former
+    per-slot cut needed a [n_seg·S, nbins]→kk ``take_along_axis``
+    whose ~3M pointwise picks measured 50–137 ms and dominated the
+    whole search), one hardware top-k per query over its P·nbins
+    candidates, then resolve the k winning ids with a [B, k]-pick
+    gather (~100K picks ≈ 2 ms). Metric epilogues (sqrt, 1−cos) stay
+    with the callers."""
     n_seg, seg, nbins = keys.shape
     B, P = pair_seg.shape
+    kk = min(k, nbins)
+    kq = min(k, P * kk)
+    # per-slot cut on KEYS ONLY — the hardware top-k over 256-wide bin
+    # rows is near-exact (measured end recall 0.999+; one cut over the
+    # concatenated [B, P·nbins] row instead loses clustered winners to
+    # reduction-tile collisions, measured 0.97)
     mk, sel = jax.lax.approx_min_k(keys.reshape(-1, nbins), kk,
                                    recall_target=recall)
-    cids = jnp.take_along_axis(kids.reshape(-1, nbins), sel, axis=1)
-    vals = mk if select_min else -mk  # keys are minimized; ip flips back
-    vals = jnp.where(cids < 0, invalid, vals)
-    pv, pi = gather_segment_results(vals.reshape(n_seg, seg, kk),
-                                    cids.reshape(n_seg, seg, kk),
-                                    pair_seg, pair_slot)
-    out_vals, out_ids = select_k_fn(pv.reshape(B, P * kk),
-                                    min(k, P * kk), select_min=select_min,
-                                    input_indices=pi.reshape(B, P * kk))
-    if k > P * kk:
-        pad = k - P * kk
+    # gather the kk-wide cut (values + BIN POSITIONS) to query order —
+    # c-class row gathers, ~1-4 ms. The former formulation gathered the
+    # winning IDS here via a [n_seg·S, nbins]→kk take_along_axis whose
+    # ~3M pointwise picks measured 50–137 ms and dominated the search.
+    pv = mk.reshape(n_seg, seg, kk)[pair_seg, pair_slot].reshape(B, P * kk)
+    pb = sel.reshape(n_seg, seg, kk)[pair_seg, pair_slot].reshape(B, P * kk)
+    # exact final per-query cut over the P·kk survivors
+    nv, pos2 = jax.lax.top_k(-pv, kq)
+    # compose winners back to (seg, slot, bin) and resolve global ids —
+    # [B, kq] picks only (~100K picks ≈ 2 ms)
+    p_of = pos2 // kk
+    bin_of = jnp.take_along_axis(pb, pos2, axis=1)
+    seg_of = jnp.take_along_axis(pair_seg, p_of, axis=1)
+    slot_of = jnp.take_along_axis(pair_slot, p_of, axis=1)
+    out_ids = kids[seg_of, slot_of, bin_of]                 # [B, kq]
+    out_vals = -nv if select_min else nv  # keys minimized; ip flips back
+    out_vals = jnp.where(out_ids < 0, invalid, out_vals)
+    if k > kq:
+        pad = k - kq
         out_vals = jnp.pad(out_vals, ((0, 0), (0, pad)),
                            constant_values=invalid)
         out_ids = jnp.pad(out_ids, ((0, 0), (0, pad)), constant_values=-1)

@@ -408,8 +408,8 @@ def _search_grouped(index: IvfFlatIndex, queries: jax.Array, k: int,
             seg_list, qv_all, index.packed_data, index.packed_ids, met,
             interpret=not _pk._on_tpu())
         out_vals, out_ids = ic.merge_bin_results(
-            keys, kids, pair_seg, pair_slot, k, kk_, select_min, invalid,
-            select_recall, _select_k)
+            keys, kids, pair_seg, pair_slot, k, select_min, invalid,
+            select_recall)
         if sqrt_out:
             out_vals = jnp.sqrt(out_vals)
         return out_vals, out_ids

@@ -766,11 +766,20 @@ def _want_recon_cache(params: IndexParams, n_lists: int, L: int,
         return False
     if params.cache_reconstruction == "always":
         return True
-    # "auto": ≤ 3 GB — the scan reads the cache instead of decoding
-    # codes per probe, and the fast scalar-prefetch kernel requires it;
-    # 3 GB covers 1M×128 f32-equivalent datasets on a 16 GB chip with
-    # room for the codes, queries and accumulators
-    return n_lists * L * rot_dim * 2 <= (3 << 30)
+    # "auto": cap at ~1/5 of the local device's memory (3 GB on a 16 GB
+    # chip — covers 1M×128 f32-equivalent datasets with room for codes,
+    # queries and accumulators). The scan reads the cache instead of
+    # decoding codes per probe, and the fast scalar-prefetch kernel
+    # requires it; devices that don't report memory get the 16 GB-class
+    # default.
+    cap = 3 << 30
+    try:
+        stats = jax.devices()[0].memory_stats()
+        if stats and stats.get("bytes_limit"):
+            cap = min(cap, int(stats["bytes_limit"]) // 5)
+    except Exception:
+        pass
+    return n_lists * L * rot_dim * 2 <= cap
 
 
 @jax.jit
@@ -1093,8 +1102,8 @@ def _search_grouped(index: IvfPqIndex, queries: jax.Array, k: int,
             seg_list, qv_all, index.packed_recon, index.packed_ids, met,
             interpret=not _pk._on_tpu())
         out_vals, out_ids = ic.merge_bin_results(
-            keys, kids, pair_seg, pair_slot, k, kk_, select_min, invalid,
-            select_recall, _select_k)
+            keys, kids, pair_seg, pair_slot, k, select_min, invalid,
+            select_recall)
         if sqrt_out:
             out_vals = jnp.sqrt(out_vals)
         if mt == DistanceType.CosineExpanded:

@@ -106,6 +106,35 @@ class Comms:
         gather; all ranks hold the result and root semantics are a no-op."""
         return lax.all_gather(x, self.axis_name, axis=axis)
 
+    def allgatherv(self, x, count, compact: bool = True):
+        """Variable-length allgather (reference: comms_t::allgatherv,
+        core/comms.hpp:423-444). Ragged shard sizes are what real
+        sharded datasets produce; XLA collectives are statically shaped,
+        so each rank contributes a PADDED shard ``x [cap, ...]`` plus
+        its valid row ``count``. Returns ``(gathered [size·cap, ...],
+        counts [size])`` with every rank's valid rows stable-packed to
+        the front in rank order — ``jnp.sum(counts)`` rows are valid,
+        the tail is pad. ``compact=False`` skips the packing sort and
+        returns the raw padded concatenation (cheaper when the caller
+        masks instead of slicing)."""
+        counts = lax.all_gather(count, self.axis_name)           # [size]
+        g = lax.all_gather(x, self.axis_name, axis=0, tiled=True)
+        if not compact:
+            return g, counts
+        cap = x.shape[0]
+        total = g.shape[0]
+        local = jnp.arange(total, dtype=jnp.int32) % cap
+        rank_of = jnp.arange(total, dtype=jnp.int32) // cap
+        invalid = local >= counts[rank_of]
+        order = jnp.argsort(invalid, stable=True)  # valid first, rank order
+        return jnp.take(g, order, axis=0), counts
+
+    def gatherv(self, x, count, root: int = 0, compact: bool = True):
+        """Variable-length gather (reference: comms_t::gatherv,
+        core/comms.hpp:449-470) — rooted semantics are a no-op in SPMD
+        (see :meth:`gather`); identical wire cost to allgatherv."""
+        return self.allgatherv(x, count, compact=compact)
+
     def reducescatter(self, x, op: Op = Op.SUM, scatter_dimension: int = 0):
         """reference: comms_t::reducescatter."""
         return lax.psum_scatter(x, self.axis_name,

@@ -169,3 +169,68 @@ def test_export_csv(tmp_path):
     runner.export_csv(rows, p)
     text = open(p).read()
     assert "qps" in text and "1000.0" in text
+
+
+def test_hdf5_ingest_roundtrip(tmp_path, rng):
+    """convert_hdf5 writes a loadable dataset dir (reference:
+    get_dataset/__main__.py:34 convert_hdf5_to_fbin)."""
+    import h5py
+
+    from raft_tpu.bench import ingest
+
+    base = rng.random((100, 8), dtype=np.float32)
+    q = rng.random((10, 8), dtype=np.float32)
+    nb = rng.integers(0, 100, (10, 5)).astype(np.int32)
+    h5 = tmp_path / "toy-8-angular.hdf5"
+    with h5py.File(h5, "w") as f:
+        f["train"] = base
+        f["test"] = q
+        f["neighbors"] = nb
+        f["distances"] = rng.random((10, 5), dtype=np.float32)
+    d = ingest.convert_hdf5(str(h5), str(tmp_path), normalize=True)
+    assert d.endswith("toy-8-inner")  # angular → inner rename
+    ds = ds_mod.load_dataset(str(tmp_path), "toy-8-inner")
+    norm = base / np.linalg.norm(base, axis=1, keepdims=True)
+    np.testing.assert_allclose(ds.base, norm, rtol=1e-6)
+    np.testing.assert_array_equal(ds.groundtruth, nb)
+
+
+def test_split_groundtruth(tmp_path, rng):
+    """big-ann gt binary → ibin/fbin pair (reference: split_groundtruth)."""
+    import struct
+
+    from raft_tpu.bench import ingest
+
+    ids = rng.integers(0, 1000, (20, 10)).astype(np.int32)
+    dist = rng.random((20, 10), dtype=np.float32)
+    gt = tmp_path / "gt.bin"
+    with open(gt, "wb") as f:
+        f.write(struct.pack("<ii", 20, 10))
+        f.write(ids.tobytes())
+        f.write(dist.tobytes())
+    out = ingest.split_groundtruth(str(gt))
+    got_ids = native.bin_read(os.path.join(out, "groundtruth.ibin"), np.int32)
+    got_d = native.bin_read(os.path.join(out, "groundtruth_dist.fbin"),
+                            np.float32)
+    np.testing.assert_array_equal(got_ids, ids)
+    np.testing.assert_allclose(got_d, dist)
+
+
+def test_plot_outputs(tmp_path):
+    """plot module renders search + build figures from a results CSV
+    (reference: plot/__main__.py)."""
+    from raft_tpu.bench import plot as plot_mod
+
+    rows = [runner.BenchResult(
+        algo="ivf_flat", index_name=f"ivf.{i}", dataset="toy", k=10,
+        batch_size=100, build_s=1.0 + i, search_s=0.1, qps=1000.0 * (i + 1),
+        recall=0.9 + 0.03 * i, search_param={"n_probes": 2 ** i})
+        for i in range(3)]
+    csv_path = tmp_path / "res.csv"
+    runner.export_csv(rows, str(csv_path))
+    back = plot_mod.read_csv(str(csv_path))
+    assert len(back) == 3 and back[0].search_param == {"n_probes": 1}
+    out = plot_mod.plot_search(back, str(tmp_path / "s.png"))
+    assert os.path.getsize(out) > 1000
+    out2 = plot_mod.plot_build(back, str(tmp_path / "b.png"))
+    assert os.path.getsize(out2) > 1000

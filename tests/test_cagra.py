@@ -186,3 +186,40 @@ class TestClusterKnnGraph:
         assert rec >= 0.85, rec
         # no self edges
         assert not (g[sample] == sample[:, None]).any()
+
+    def test_overflow_rows_get_own_neighbors(self):
+        """Rows dropped by list overflow must get THEIR OWN cluster-local
+        neighbors, not another row's edges (ADVICE r3: cagra.py:267)."""
+        from scipy.spatial.distance import cdist
+        rng = np.random.default_rng(5)
+        # a third of the rows sit in one tiny ball: nearest-center
+        # assignment sends them all to one list, which must overflow the
+        # 4x-mean capacity cap no matter how balanced the centers are
+        centers = rng.normal(0, 50, (40, 8)).astype(np.float32)
+        assign = np.where(rng.random(20_000) < 0.35, 0,
+                          rng.integers(1, 40, 20_000))
+        x = (centers[assign]
+             + rng.normal(0, 0.5, (20_000, 8)).astype(np.float32))
+        x[assign == 0] = centers[0] + rng.normal(
+            0, 1e-3, (int((assign == 0).sum()), 8)).astype(np.float32)
+        import raft_tpu.neighbors.cagra as cagra_mod
+        hits = {}
+        orig = cagra_mod._overflow_knn
+        cagra_mod._overflow_knn = (
+            lambda *a, **k: (hits.setdefault("y", True), orig(*a, **k))[1])
+        try:
+            g = np.asarray(cagra.cluster_knn_graph(
+                jnp.asarray(x), 8, rows_per_list=512, neighborhood=8))
+        finally:
+            cagra_mod._overflow_knn = orig
+        assert hits.get("y"), "overflow patch path was not exercised"
+        # sample rows of the fat cluster (where overflow lands) and check
+        # their edges point at genuinely near vectors
+        fat = np.nonzero(assign == 0)[0]
+        sample = rng.choice(fat, 100, replace=False)
+        d = cdist(x[sample], x, "sqeuclidean")
+        near = np.partition(d, 200, axis=1)[:, 200]  # generous near bar
+        for i, s in enumerate(sample):
+            dist_of_edges = d[i, g[s]]
+            assert (dist_of_edges <= max(near[i], 1.0)).mean() >= 0.5, (
+                f"row {s}: edges are not local")

@@ -74,6 +74,31 @@ class TestKMeans:
         d = np.asarray(c)
         assert len(np.unique(d.round(6), axis=0)) == 5
 
+    def test_minibatch_fit_recovers_blobs(self, blobs):
+        x, labels_true = blobs
+        p = KMeansParams(n_clusters=5, seed=3)
+        c, inertia, n_iters = kmeans.fit_minibatch(p, jnp.asarray(x),
+                                                   batch_size=256)
+        assert c.shape == (5, 8) and n_iters > 0
+        pred = np.asarray(kmeans.predict(c, jnp.asarray(x)))
+        assert _cluster_quality(x, labels_true, pred, 5) > 0.9
+        # mini-batch inertia lands near the full-batch fit's
+        _, full_inertia, _ = kmeans.fit(p, jnp.asarray(x))
+        assert float(inertia) < 2.0 * float(full_inertia) + 1e-3
+
+    def test_update_centroids_step(self, blobs):
+        x, _ = blobs
+        xj = jnp.asarray(x, jnp.float32)
+        c0 = xj[:5]
+        labels = kmeans.predict(c0, xj)
+        w = jnp.ones((x.shape[0],), jnp.float32)
+        counts, c1 = kmeans.update_centroids(xj, w, c0, labels)
+        assert counts.shape == (5,) and c1.shape == c0.shape
+        np.testing.assert_allclose(float(jnp.sum(counts)), x.shape[0])
+        # one exact update step cannot increase the cost
+        assert float(kmeans.cluster_cost(c1, xj)) <= float(
+            kmeans.cluster_cost(c0, xj)) + 1e-3
+
     def test_find_k(self):
         x, _ = make_blobs(600, 4, n_clusters=3, cluster_std=0.2, state=RngState(7))
         best_k, inertias = kmeans.find_k(jnp.asarray(np.asarray(x)), k_max=8,

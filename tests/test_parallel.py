@@ -70,6 +70,35 @@ class TestComms:
         expected = np.roll(np.arange(N_DEV, dtype=np.float32), 1)
         np.testing.assert_allclose(np.asarray(out), expected)
 
+    def test_allgatherv(self, mesh):
+        # ragged shards: rank r holds (r % 3) + 1 valid rows in a cap-4
+        # padded buffer; compacted output packs valid rows front, in
+        # rank order (reference: comms_t::allgatherv, core/comms.hpp:423)
+        comms = Comms("shard")
+        cap = 4
+        counts_h = np.array([(r % 3) + 1 for r in range(N_DEV)], np.int32)
+        x = np.full((N_DEV * cap, 2), -1.0, np.float32)
+        for r in range(N_DEV):
+            for i in range(counts_h[r]):
+                x[r * cap + i] = r * 10 + i
+        out, cnts = shard_map(
+            lambda v, c: comms.allgatherv(v, c[0]),
+            mesh=mesh, in_specs=(P("shard"), P("shard")),
+            out_specs=(P(None), P(None)), check_vma=False)(
+                jnp.asarray(x), jnp.asarray(counts_h))
+        total = int(counts_h.sum())
+        expect = np.concatenate(
+            [x[r * cap: r * cap + counts_h[r]] for r in range(N_DEV)])
+        np.testing.assert_allclose(np.asarray(out)[:total], expect)
+        np.testing.assert_array_equal(np.asarray(cnts), counts_h)
+        # gatherv aliases the same packing
+        out2, _ = shard_map(
+            lambda v, c: comms.gatherv(v, c[0], root=2),
+            mesh=mesh, in_specs=(P("shard"), P("shard")),
+            out_specs=(P(None), P(None)), check_vma=False)(
+                jnp.asarray(x), jnp.asarray(counts_h))
+        np.testing.assert_allclose(np.asarray(out2)[:total], expect)
+
     def test_rank_size(self, mesh):
         comms = Comms("shard")
         x = jnp.zeros((N_DEV,), jnp.int32)
