@@ -279,3 +279,32 @@ def predict(centers: jax.Array, x: jax.Array,
     xn = _maybe_normalize(jnp.asarray(x, jnp.float32), metric)
     _, labels = fused_l2_nn_argmin(xn, centers)
     return labels
+
+
+@partial(jax.jit, static_argnames=("row_tile",))
+def _top2_labels(centers, xn, row_tile: int):
+    c_sq = jnp.sum(centers * centers, axis=1)
+    m, d = xn.shape
+    n_tiles = -(-m // row_tile)
+    xp = jnp.pad(xn, ((0, n_tiles * row_tile - m), (0, 0)))
+
+    def tile(xt):
+        g = lax.dot_general(xt, centers, (((1,), (1,)), ((), ())),
+                            preferred_element_type=jnp.float32)
+        d2 = c_sq[None, :] - 2.0 * g  # rank-equivalent (x² constant/row)
+        _, top2 = lax.top_k(-d2, 2)
+        return top2.astype(jnp.int32)
+
+    out = lax.map(tile, xp.reshape(n_tiles, row_tile, d))
+    return out.reshape(n_tiles * row_tile, 2)[:m]
+
+
+def predict2(centers: jax.Array, x: jax.Array,
+             params: Optional[KMeansBalancedParams] = None) -> jax.Array:
+    """Two nearest centers per row → [m, 2] int32 — feeds the packers'
+    spill-to-second-list capacity capping (ivf_common.spill_assignments).
+    Row-tiled so the [tile, n_lists] distance block stays bounded."""
+    metric = params.metric if params is not None else "l2"
+    xn = _maybe_normalize(jnp.asarray(x, jnp.float32), metric)
+    tile = max(1024, min(x.shape[0], (256 << 20) // max(4 * centers.shape[0], 1)))
+    return _top2_labels(centers, xn, -(-tile // 8) * 8)

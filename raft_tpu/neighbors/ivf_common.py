@@ -221,6 +221,51 @@ def fit_seg_chunk(seg: int, L: int, d: int, want: int) -> int:
     return max(1, min(want, CHUNK_BYTES_TARGET // max(1, per_seg)))
 
 
+@partial(jax.jit, static_argnames=("n_lists", "cap"))
+def spill_assignments(l1: jax.Array, l2: jax.Array, n_lists: int,
+                      cap: int) -> jax.Array:
+    """Cap list loads by spilling overflow rows to their second-nearest
+    list — the TPU-native answer to padded-block waste.
+
+    The padded [n_lists, L, ...] layout sizes L to the FATTEST list, so
+    skewed assignments pay padding on every scan DMA (and at 100M rows
+    can overflow HBM outright). Instead of dropping rows past the cap
+    (the packers' old behavior) or padding to the skew, rows ranked
+    ≥ cap in their first-choice list move to their second choice; rows
+    that overflow both get the drop marker ``n_lists`` (callers warn).
+    A probe set that covers a query's nearest lists almost always
+    includes the second-nearest center too, so the recall cost is
+    marginal while L shrinks from ~(max load) to cap.
+
+    All sorts + gathers (two stable sort passes), jit-safe on host-sized
+    inputs: [n] i32 argsorts are cheap even at 10⁸ rows.
+    """
+    n = l1.shape[0]
+    iota = jnp.arange(n, dtype=jnp.int32)
+    kmax = 2 * n_lists + 2
+
+    def ranks(keys, base):
+        """Stable rank of each row within its group: ``keys`` orders
+        rows inside and across groups, ``base`` is each row's group's
+        smallest key (rank = sorted position − group start)."""
+        sk, order = jax.lax.sort_key_val(keys, iota)
+        base_sorted = base[order]
+        starts = jnp.searchsorted(sk, jnp.arange(kmax, dtype=jnp.int32))
+        rk_sorted = iota - starts[jnp.clip(base_sorted, 0, kmax - 1)]
+        _, rk = jax.lax.sort_key_val(order, rk_sorted)
+        return rk
+
+    k1 = l1.astype(jnp.int32) * 2
+    rank1 = ranks(k1, k1)
+    over = rank1 >= cap
+    lab = jnp.where(over, l2.astype(jnp.int32), l1.astype(jnp.int32))
+    # second pass: moved rows must rank AFTER the kept originals of
+    # their destination list — sort by (list, moved) lexicographically,
+    # rank against the list's start
+    rank2 = ranks(lab * 2 + over.astype(jnp.int32), lab * 2)
+    return jnp.where(rank2 >= cap, jnp.int32(n_lists), lab)
+
+
 def pack_lists(row_arrays, labels: jax.Array, row_ids: jax.Array,
                n_lists: int, L: int, fill_values):
     """Device-side list packing (jit-safe) — the device twin of the host

@@ -58,6 +58,12 @@ class IndexParams:
     kmeans_trainset_fraction: float = 0.5
     add_data_on_build: bool = True
     list_size_cap_factor: float = 4.0  # max_list_size = factor * n/n_lists
+    # TPU-specific: cap padded capacity at the factor above and SPILL
+    # overflow rows to their second-nearest list instead of dropping
+    # them (ivf_common.spill_assignments) — every probe DMAs the padded
+    # block, so skew-driven padding is wasted bandwidth on every scan;
+    # spill with cap_factor ~1.5 shrinks the working set 2-3×
+    spill: bool = False
     seed: int = 0
 
 
@@ -144,19 +150,25 @@ def _pack_lists(dataset: np.ndarray, labels: np.ndarray, n_lists: int,
     return packed, ids, sizes
 
 
-def _fit_list_size(counts: np.ndarray, avg: int, cap_factor: float) -> int:
-    """Padded list capacity: the actual max list size, clamped by the cap
-    factor, rounded up to a lane-friendly multiple — 128 for MXU-shaped
-    scans once lists are that big, but only a multiple of 8 below that so
-    tiny-list indexes (actual max 15 → 16, not 128) aren't padded 8×.
-    Sizing to the real histogram instead of the worst-case cap is a large
-    scan-FLOP saver — padding is wasted work on every probe."""
-    cap = max(8, int(avg * cap_factor))
-    actual = int(counts.max()) if counts.size else 8
-    size = max(8, min(cap, actual))
+def _lane_round(size: int) -> int:
+    """Round a list capacity up to a lane-friendly multiple — 128 for
+    MXU-shaped scans once lists are that big, but only a multiple of 8
+    below that so tiny-list indexes (actual max 15 → 16, not 128)
+    aren't padded 8×."""
+    size = max(8, size)
     if size >= 128:
         return -(-size // 128) * 128
     return -(-size // 8) * 8
+
+
+def _fit_list_size(counts: np.ndarray, avg: int, cap_factor: float) -> int:
+    """Padded list capacity: the actual max list size, clamped by the cap
+    factor, rounded up lane-friendly (see _lane_round). Sizing to the
+    real histogram instead of the worst-case cap is a large scan-FLOP
+    saver — padding is wasted work on every probe."""
+    cap = max(8, int(avg * cap_factor))
+    actual = int(counts.max()) if counts.size else 8
+    return _lane_round(min(cap, actual))
 
 
 @traced("raft_tpu.ivf_flat.build")
@@ -207,12 +219,32 @@ def build(dataset: jax.Array, params: Optional[IndexParams] = None) -> IvfFlatIn
     # list capacity). The host packer remains for memmapped/chunked flows.
     from raft_tpu.neighbors import ivf_common as ic
 
-    labels = kmeans_balanced.predict(centers, x.astype(jnp.float32),
-                                     km_params)
-    # histogram on host: the [n] labels transfer is small, and a device
-    # scatter-add histogram serializes on TPU
-    counts = np.bincount(np.asarray(labels), minlength=params.n_lists)
-    max_list_size = _fit_list_size(counts, avg, params.list_size_cap_factor)
+    if params.spill:
+        # cap capacity at factor × mean and spill overflow rows to
+        # their second-nearest list (see IndexParams.spill)
+        l12 = kmeans_balanced.predict2(centers, x.astype(jnp.float32),
+                                       km_params)
+        max_list_size = _lane_round(
+            int(avg * params.list_size_cap_factor))
+        labels = ic.spill_assignments(l12[:, 0], l12[:, 1],
+                                      params.n_lists, max_list_size)
+        n_marker = int(jnp.sum(labels >= params.n_lists))
+        if n_marker:
+            # pack_lists' drop counter excludes out-of-range labels, so
+            # double-overflow rows must be surfaced here
+            from raft_tpu.core import logging as _log
+            _log.warn("ivf_flat: %d rows overflowed both list choices "
+                      "at cap %d (raise list_size_cap_factor)",
+                      n_marker, max_list_size)
+    else:
+        labels = kmeans_balanced.predict(centers, x.astype(jnp.float32),
+                                         km_params)
+        # histogram on host: the [n] labels transfer is small, and a
+        # device scatter-add histogram serializes on TPU
+        counts = np.bincount(np.asarray(labels),
+                             minlength=params.n_lists)
+        max_list_size = _fit_list_size(counts, avg,
+                                       params.list_size_cap_factor)
     (packed,), ids, sizes, dropped, _ = ic.pack_lists_jit(
         [x], labels, jnp.arange(n, dtype=jnp.int32),
         n_lists=params.n_lists, L=max_list_size,
@@ -221,7 +253,8 @@ def build(dataset: jax.Array, params: Optional[IndexParams] = None) -> IvfFlatIn
     if n_drop:
         from raft_tpu.core import logging as _log
         _log.warn("ivf_flat: dropped %d overflow vectors (raise "
-                  "list_size_cap_factor)", n_drop)
+                  "list_size_cap_factor%s)", n_drop,
+                  "" if params.spill else " or set spill=True")
     norms = jnp.sum(packed.astype(jnp.float32) ** 2, axis=-1)
     return IvfFlatIndex(centers=centers, packed_data=packed,
                         packed_ids=ids, packed_norms=norms,

@@ -67,6 +67,14 @@ class IndexParams:
     codebook_kind: str = "per_subspace"  # | "per_cluster"
     add_data_on_build: bool = True
     list_size_cap_factor: float = 4.0
+    # TPU-specific: cap padded list capacity at list_size_cap_factor ×
+    # mean and SPILL overflow rows to their second-nearest list instead
+    # of dropping them (ivf_common.spill_assignments). The padded
+    # [n_lists, L, ...] layout pays the fattest list's padding on every
+    # scan DMA — and at 10⁸ rows overflows HBM outright — so spill with
+    # cap_factor ~1.5 trades a marginal assignment-quality loss for a
+    # 2-3× smaller scan working set.
+    spill: bool = False
     seed: int = 0
     # TPU-specific: keep a bf16 reconstruction (c + decoded residual) of
     # every list alongside the codes. Trades HBM (2 bytes/dim) for scan
@@ -461,9 +469,13 @@ def _stable_slots(labels: np.ndarray, n_lists: int,
     n = len(labels)
     order = np.argsort(labels, kind="stable")
     sorted_l = labels[order]
-    starts = np.searchsorted(sorted_l, np.arange(n_lists))
+    # tolerate the spill drop marker (label == n_lists): those rows
+    # rank within their own group and every caller's slot/keep mask
+    # rejects them via ``sorted_l < n_lists``
+    starts = np.searchsorted(sorted_l, np.arange(n_lists + 1))
     rank = np.arange(n) - starts[sorted_l]
-    slot = rank if base is None else base[sorted_l] + rank
+    slot = rank if base is None else base[np.clip(sorted_l, 0,
+                                                  n_lists - 1)] + rank
     return order, sorted_l, slot
 
 
@@ -619,17 +631,36 @@ def build(dataset: jax.Array, params: Optional[IndexParams] = None) -> IvfPqInde
     # 4. encode + bit-pack + pack all rows into lists — ON DEVICE (same
     # pack the distributed build uses); only the [n_lists] histogram
     # round-trips the host to size the static padded capacity
-    from raft_tpu.neighbors.ivf_flat import _fit_list_size
+    from raft_tpu.neighbors.ivf_flat import _fit_list_size, _lane_round
     from raft_tpu.neighbors import ivf_common as ic
 
-    labels = kmeans_balanced.predict(centers, x, km)
-    codes, norms = _encode_with_norms(x @ rotation.T, centers_rot, labels,
-                                      codebooks, params.codebook_kind)
-
-    # histogram on host: the [n] labels transfer is small, and a device
-    # scatter-add histogram serializes on TPU
-    counts = np.bincount(np.asarray(labels), minlength=params.n_lists)
-    max_list_size = _fit_list_size(counts, avg, params.list_size_cap_factor)
+    if params.spill:
+        # cap capacity + spill overflow to second-nearest lists (see
+        # IndexParams.spill); encode AFTER spilling so residuals use
+        # the assigned list's center
+        l12 = kmeans_balanced.predict2(centers, x, km)
+        max_list_size = _lane_round(
+            int(avg * params.list_size_cap_factor))
+        labels = ic.spill_assignments(l12[:, 0], l12[:, 1],
+                                      params.n_lists, max_list_size)
+        n_marker = int(jnp.sum(labels >= params.n_lists))
+        if n_marker:
+            # pack_lists' drop counter excludes out-of-range labels
+            from raft_tpu.core import logging as _log
+            _log.warn("ivf_pq: %d rows overflowed both list choices at "
+                      "cap %d (raise list_size_cap_factor)",
+                      n_marker, max_list_size)
+    else:
+        labels = kmeans_balanced.predict(centers, x, km)
+        # histogram on host: the [n] labels transfer is small, and a
+        # device scatter-add histogram serializes on TPU
+        counts = np.bincount(np.asarray(labels), minlength=params.n_lists)
+        max_list_size = _fit_list_size(counts, avg,
+                                       params.list_size_cap_factor)
+    codes, norms = _encode_with_norms(
+        x @ rotation.T, centers_rot,
+        jnp.clip(labels, 0, params.n_lists - 1), codebooks,
+        params.codebook_kind)
     codes_p = pack_bits(codes, params.pq_bits)
     (packed, pnorm), ids, sizes, dropped, _ = ic.pack_lists_jit(
         [codes_p, norms], labels, jnp.arange(n, dtype=jnp.int32),
@@ -655,7 +686,8 @@ def build(dataset: jax.Array, params: Optional[IndexParams] = None) -> IvfPqInde
 @traced("raft_tpu.ivf_pq.build_chunked")
 def build_chunked(dataset, params: Optional[IndexParams] = None,
                   chunk_rows: int = 1 << 18,
-                  max_train_rows: int = 1 << 21) -> IvfPqIndex:
+                  max_train_rows: int = 1 << 21,
+                  progress: bool = False) -> IvfPqIndex:
     """Build from a host array/memmap in O(chunk) device + host working
     memory — the billion-scale path (reference: the bench harness's
     memmapped BinFile + subset datasets, cpp/bench/ann/src/common/
@@ -664,7 +696,17 @@ def build_chunked(dataset, params: Optional[IndexParams] = None,
     ``dataset`` may be a ``np.memmap`` (see bench.dataset.bin_memmap):
     rows are touched once per pass (train-sample, label, encode), so host
     RSS stays bounded by ``chunk_rows`` plus the packed index itself.
+    ``progress`` prints phase/chunk timings (hour-scale 10⁸-row builds
+    are opaque without them).
     """
+    import time as _time
+
+    _t0 = _time.time()
+
+    def _say(msg):
+        if progress:
+            print(f"[build_chunked +{_time.time()-_t0:7.0f}s] {msg}",
+                  flush=True)
     if params is None:
         params = IndexParams()
     mt = resolve_metric(params.metric)
@@ -676,7 +718,12 @@ def build_chunked(dataset, params: Optional[IndexParams] = None,
     normalize = mt == DistanceType.CosineExpanded
 
     def to_device(rows):
-        x = jnp.asarray(np.asarray(rows, np.float32))
+        # device-chunk providers (bench.dataset.DeviceSyntheticChunks)
+        # hand back arrays already on device — don't round-trip them
+        if isinstance(rows, jax.Array):
+            x = rows.astype(jnp.float32)
+        else:
+            x = jnp.asarray(np.asarray(rows, np.float32))
         if normalize:
             x = x / jnp.sqrt(jnp.maximum(
                 jnp.sum(x * x, -1, keepdims=True), 1e-12))
@@ -694,28 +741,66 @@ def build_chunked(dataset, params: Optional[IndexParams] = None,
                       int(n * params.kmeans_trainset_fraction)))
     rng = np.random.default_rng(params.seed)
     tr_idx = np.sort(rng.choice(n, n_train, replace=False))
-    trainset = to_device(dataset[tr_idx])
+    _say(f"sampling {n_train} train rows")
+    if hasattr(dataset, "sample_rows"):  # device-chunk provider
+        trainset = to_device(dataset.sample_rows(tr_idx))
+    else:
+        trainset = to_device(dataset[tr_idx])
+    _say("training quantizers (coarse kmeans + rotation + codebooks)")
     km = KMeansBalancedParams(n_iters=params.kmeans_n_iters,
                               metric="cosine" if spherical else "l2",
                               seed=params.seed)
     centers, rotation, centers_rot, codebooks = _train_quantizers(
         trainset, params, dim, pq_dim, pq_len, K, key, km)
+    jax.block_until_ready(codebooks)
     del trainset
+    _say("quantizers trained; label pass")
 
     # 2. streaming label pass → histogram → list capacity
     from raft_tpu.neighbors.ivf_flat import _fit_list_size
 
     from raft_tpu.core.interruptible import cancellation_point
 
-    labels = np.empty(n, np.int32)
-    for a in range(0, n, chunk_rows):
-        cancellation_point()  # chunk seams are the cancellation points
-        b = min(n, a + chunk_rows)
-        labels[a:b] = np.asarray(
-            kmeans_balanced.predict(centers, to_device(dataset[a:b]), km))
-    counts = np.bincount(labels, minlength=params.n_lists)
     avg = max(1, n // params.n_lists)
-    L = _fit_list_size(counts, avg, params.list_size_cap_factor)
+    if params.spill:
+        # top-2 labels, then cap+spill (see IndexParams.spill): L is
+        # the cap itself, not the skewed max load
+        from raft_tpu.neighbors import ivf_common as ic
+        from raft_tpu.neighbors.ivf_flat import _lane_round
+
+        l12 = np.empty((n, 2), np.int32)
+        for a in range(0, n, chunk_rows):
+            cancellation_point()
+            b = min(n, a + chunk_rows)
+            l12[a:b] = np.asarray(
+                kmeans_balanced.predict2(centers, to_device(dataset[a:b]),
+                                         km))
+            if a % (8 * chunk_rows) == 0:
+                _say(f"labeled {b}/{n}")
+        L = _lane_round(int(avg * params.list_size_cap_factor))
+        _say("spilling assignments")
+        labels = np.asarray(ic.spill_assignments(
+            jnp.asarray(l12[:, 0]), jnp.asarray(l12[:, 1]),
+            params.n_lists, L))
+        del l12
+        _say("spill done; encode pass")
+        n_spill_drop = int((labels >= params.n_lists).sum())
+        if n_spill_drop:
+            from raft_tpu.core import logging as _log
+            _log.warn("ivf_pq chunked build: %d rows overflowed both "
+                      "choices at cap %d", n_spill_drop, L)
+        counts = np.bincount(labels[labels < params.n_lists],
+                             minlength=params.n_lists)
+    else:
+        labels = np.empty(n, np.int32)
+        for a in range(0, n, chunk_rows):
+            cancellation_point()  # chunk seams are cancellation points
+            b = min(n, a + chunk_rows)
+            labels[a:b] = np.asarray(
+                kmeans_balanced.predict(centers, to_device(dataset[a:b]),
+                                        km))
+        counts = np.bincount(labels, minlength=params.n_lists)
+        L = _fit_list_size(counts, avg, params.list_size_cap_factor)
     nbytes = packed_nbytes(pq_dim, params.pq_bits)
 
     # 3. streaming encode + pack into the preallocated index
@@ -735,7 +820,7 @@ def build_chunked(dataset, params: Optional[IndexParams] = None,
         norms_h = np.asarray(norms)
         lb_h = labels[a:b]
         order, sorted_l, slot = _stable_slots(lb_h, params.n_lists, cursor)
-        keep = slot < L
+        keep = (slot < L) & (sorted_l < params.n_lists)
         dropped += int((~keep).sum())
         rows = order[keep]
         ls, sl = sorted_l[keep], slot[keep].astype(np.int64)
@@ -743,7 +828,10 @@ def build_chunked(dataset, params: Optional[IndexParams] = None,
         ids[ls, sl] = (a + rows).astype(np.int32)
         pnorm[ls, sl] = norms_h[rows]
         cursor = np.minimum(
-            cursor + np.bincount(lb_h, minlength=params.n_lists), L)
+            cursor + np.bincount(lb_h, minlength=params.n_lists)[
+                :params.n_lists], L)
+        if a % (8 * chunk_rows) == 0:
+            _say(f"encoded {b}/{n}")
     if dropped:
         from raft_tpu.core import logging as _log
         _log.warn("ivf_pq chunked build: dropped %d overflow vectors", dropped)
