@@ -62,6 +62,13 @@ class IndexParams:
     # and "nn_descent" (GNND).
     build_algo: str = "auto"  # | "cluster" | "ivf_pq" | "nn_descent"
     nn_descent_niter: int = 20
+    # graph-BUILD dimensionality: 0 = full-d; "auto" (-1) projects
+    # wide datasets (d > 256) onto a random orthonormal 128-d basis
+    # for the candidate scans only — the cluster-blocked build's block
+    # gathers scale with d (≈96 GB of HBM traffic at 1M×960), while
+    # 128-d projections preserve neighbor RANKS well enough for graph
+    # candidates; the searched dataset stays full precision
+    build_projection_dim: int = -1  # -1 auto | 0 off | explicit dim
     seed: int = 0
 
 
@@ -264,7 +271,8 @@ def _overflow_knn(x, packed, pids, rows, lists, k: int, ip: bool,
 
 def cluster_knn_graph(dataset: jax.Array, k: int, metric: str = "sqeuclidean",
                       seed: int = 0, rows_per_list: int = 1024,
-                      neighborhood: int = 16, return_entries: bool = False):
+                      neighborhood: int = 16, return_entries: bool = False,
+                      centers_from: Optional[jax.Array] = None):
     """TPU-native k-NN graph: cluster-blocked exact self-kNN.
 
     The reference builds CAGRA's knn graph by ANN self-search (IVF-PQ +
@@ -345,6 +353,16 @@ def cluster_knn_graph(dataset: jax.Array, k: int, metric: str = "sqeuclidean",
         graph = jnp.pad(graph, ((0, 0), (0, k - kk)), mode="edge")
     graph = graph.astype(jnp.int32)
     if return_entries:
+        if centers_from is not None:
+            # projected build (see IndexParams.build_projection_dim):
+            # search seeds score queries against centers in FULL space,
+            # so recompute them as per-list means of the original rows
+            from raft_tpu.cluster.kmeans import _update_centroids
+
+            centers, _ = _update_centroids(
+                centers_from.astype(jnp.float32),
+                jnp.ones((n,), jnp.float32), labels, n_lists,
+                jnp.zeros((n_lists, centers_from.shape[1]), jnp.float32))
         return graph, centers, pids[:, :min(32, L)]
     return graph
 
@@ -446,14 +464,30 @@ def build(dataset: jax.Array, params: Optional[IndexParams] = None) -> CagraInde
     if algo == "auto":
         algo = "cluster"
     centers = entry_ids = None
+    proj_d = params.build_projection_dim
+    if proj_d == -1:
+        proj_d = 128 if x.shape[1] > 256 else 0
+    if proj_d and proj_d < x.shape[1] and mt != DistanceType.InnerProduct:
+        # random orthonormal projection for the BUILD scans only (see
+        # IndexParams.build_projection_dim); L2 ranks are approximately
+        # preserved, and optimize_graph's detour pruning only consumes
+        # ranks. ip metric skips it (projection distorts raw dot
+        # products more than distances).
+        g = jax.random.normal(jax.random.PRNGKey(params.seed ^ 0x5EED),
+                              (x.shape[1], proj_d), jnp.float32)
+        r, _ = jnp.linalg.qr(g)
+        x_build = x @ r
+    else:
+        x_build = x
     if algo == "nn_descent":
         from raft_tpu.neighbors.nn_descent import build_knn_graph as _nnd
-        knn = _nnd(x, inter_d, metric=mt.value, n_iters=params.nn_descent_niter,
-                   seed=params.seed)
+        knn = _nnd(x_build, inter_d, metric=mt.value,
+                   n_iters=params.nn_descent_niter, seed=params.seed)
     elif algo == "cluster":
         knn, centers, entry_ids = cluster_knn_graph(
-            x, inter_d, metric=mt.value, seed=params.seed,
-            return_entries=True)
+            x_build, inter_d, metric=mt.value, seed=params.seed,
+            return_entries=True,
+            centers_from=x if x_build is not x else None)
     else:
         knn = build_knn_graph(x, inter_d, metric=mt.value, seed=params.seed)
     graph = optimize_graph(knn, out_d)
