@@ -43,6 +43,11 @@ def _on_tpu() -> bool:
 _PALLAS_MIN_LEN = 8192
 _PALLAS_MAX_K = 64
 
+# Large-k tier thresholds (64 < k ≤ tile): two-phase tiled select for
+# wide rows (see the dispatch comment in select_k).
+_LARGE_K_TILE = 16384
+_LARGE_K_MIN_LEN = 65536
+
 
 @traced("raft_tpu.select_k")
 def select_k(
@@ -92,6 +97,13 @@ def select_k(
             idx = jnp.take_along_axis(input_indices, idx, axis=1)
         return vals, idx
 
+    # large-k tier (the reference's radix path covers k ≤ 2048 at large
+    # len, select_radix.cuh): the full-row sort's cost grows with len,
+    # so tile + merge once rows are wide enough that the two-phase
+    # cost (n·log(tile) + tiles·k·log(tiles·k)) wins
+    if (len_tile is None and k > _PALLAS_MAX_K and n >= _LARGE_K_MIN_LEN
+            and n >= 4 * _LARGE_K_TILE):
+        len_tile = _LARGE_K_TILE
     if len_tile is not None and n > len_tile and n > k:
         return _select_k_tiled(scores, k, select_min, input_indices, len_tile)
 

@@ -76,3 +76,17 @@ def test_merge_parts(rng):
 def test_k_too_large_raises(rng):
     with pytest.raises(ValueError):
         select_k(jnp.zeros((2, 5)), 6)
+
+
+def test_large_k_auto_tier_matches_sort(rng):
+    """k > 64 on wide rows auto-dispatches to the tiled two-phase path
+    (reference: the radix large-k tier, select_radix.cuh) — results must
+    match the full sort."""
+    from raft_tpu.matrix.select_k import select_k as sk
+
+    s = rng.random((8, 1 << 17), dtype=np.float32)
+    v1, i1 = sk(jnp.asarray(s), 128)
+    ref = np.sort(s, axis=1)[:, :128]
+    np.testing.assert_allclose(np.asarray(v1), ref, rtol=1e-6)
+    got = np.take_along_axis(s, np.asarray(i1), axis=1)
+    np.testing.assert_allclose(got, ref, rtol=1e-6)
