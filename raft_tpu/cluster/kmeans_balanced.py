@@ -89,7 +89,11 @@ def _balanced_lloyd(x, w, c0, n_clusters: int, n_iters: int, key,
         split_score = counts[labels] + u * d2 / (jnp.max(d2) + 1e-12)
         far_score = jnp.where(
             wf > 0, jnp.where(i < split_iters, split_score, d2), -jnp.inf)
-        _, far_idx = lax.top_k(far_score, n_clusters)
+        # re-seed candidates need no exact order — the hardware approx
+        # top-k replaces a full [n] sort per sweep (measured ~20 s at
+        # n=2M, k=8192: it dominated billion-scale coarse training)
+        _, far_idx = lax.approx_max_k(far_score, n_clusters,
+                                      recall_target=0.9)
         # rank starved clusters; the j-th starved cluster takes the j-th
         # farthest point as its new center
         starved_rank = jnp.cumsum(starved.astype(jnp.int32)) - 1
