@@ -199,34 +199,46 @@ def run_config(config: Dict[str, Any],
     # algos that genuinely need the full matrix pull it to device
     dsx = data.base if mmap_mode else jnp.asarray(data.base)
     queries = jnp.asarray(data.queries)
+    # config errors fail loudly BEFORE any work; runtime failures of one
+    # algo keep the other algos' completed rows
+    for index_cfg in config["index"]:
+        if index_cfg["algo"] not in ALGO_REGISTRY:
+            raise ValueError(f"unknown algo {index_cfg['algo']!r} "
+                             f"(have {sorted(ALGO_REGISTRY)})")
     results: List[BenchResult] = []
     for index_cfg in config["index"]:
-        algo = index_cfg["algo"]
-        if algo not in ALGO_REGISTRY:
-            raise ValueError(f"unknown algo {algo!r} (have {sorted(ALGO_REGISTRY)})")
-        bp = dict(index_cfg.get("build_param", {}))
-        t0 = time.perf_counter()
-        search_fn, index_obj = ALGO_REGISTRY[algo](dsx, dict(bp), data.metric)
-        # block on the *index* arrays, not the input: async dispatch would
-        # otherwise let the build overlap the first search timing
-        jax.block_until_ready(
-            [leaf for leaf in jax.tree_util.tree_leaves(index_obj)
-             if hasattr(leaf, "block_until_ready")])
-        build_s = time.perf_counter() - t0
-        for sp in index_cfg.get("search_params", [{}]):
-            ids, dt, qps = _bench_search(search_fn, queries, k, sp, batch_size)
-            rec = ds_mod.recall(ids, data.groundtruth)
-            row = BenchResult(
-                algo=algo, index_name=index_cfg.get("name", algo),
-                dataset=data.name, k=k, batch_size=batch_size,
-                build_s=build_s, search_s=dt, qps=qps, recall=rec,
-                build_param=bp, search_param=dict(sp),
-            )
-            results.append(row)
-            if verbose:
-                print(f"[bench] {row.index_name} {sp}: "
-                      f"qps={qps:,.0f} recall={rec:.4f} build={build_s:.1f}s")
+        try:
+            _run_one_index(index_cfg, index_cfg["algo"], dsx, data,
+                           queries, k, batch_size, results, verbose)
+        except Exception as e:  # keep completed rows if one algo dies
+            print(f"[bench] {index_cfg.get('name')} failed: {e}")
     return results
+
+
+def _run_one_index(index_cfg, algo, dsx, data, queries, k, batch_size,
+               results, verbose):
+    bp = dict(index_cfg.get("build_param", {}))
+    t0 = time.perf_counter()
+    search_fn, index_obj = ALGO_REGISTRY[algo](dsx, dict(bp), data.metric)
+    # block on the *index* arrays, not the input: async dispatch would
+    # otherwise let the build overlap the first search timing
+    jax.block_until_ready(
+        [leaf for leaf in jax.tree_util.tree_leaves(index_obj)
+         if hasattr(leaf, "block_until_ready")])
+    build_s = time.perf_counter() - t0
+    for sp in index_cfg.get("search_params", [{}]):
+        ids, dt, qps = _bench_search(search_fn, queries, k, sp, batch_size)
+        rec = ds_mod.recall(ids, data.groundtruth)
+        row = BenchResult(
+            algo=algo, index_name=index_cfg.get("name", algo),
+            dataset=data.name, k=k, batch_size=batch_size,
+            build_s=build_s, search_s=dt, qps=qps, recall=rec,
+            build_param=bp, search_param=dict(sp),
+        )
+        results.append(row)
+        if verbose:
+            print(f"[bench] {row.index_name} {sp}: "
+                  f"qps={qps:,.0f} recall={rec:.4f} build={build_s:.1f}s")
 
 
 def run_config_file(path: str, **kw) -> List[BenchResult]:

@@ -10,6 +10,7 @@ Index checkpoint files produced here are self-describing and versioned
 
 from __future__ import annotations
 
+import functools
 import io
 import json
 import struct
@@ -50,11 +51,16 @@ def deserialize_scalar(f: BinaryIO):
     raise ValueError(f"bad scalar tag: {tag!r}")
 
 
-# Device→host fetch granularity for big arrays: a single device_get of
-# a multi-GB buffer degrades badly on tunnelled backends (a 9.7 GB
-# fetch measured far below the ~25 MB/s a 512 MB fetch sustains);
-# row-sliced fetches keep the steady rate AND bound host peak memory.
+# Device↔host transfer granularity for big arrays: a single multi-GB
+# RPC degrades badly on tunnelled backends (a 9.7 GB fetch measured far
+# below the ~25 MB/s a 512 MB fetch sustains, and has crashed workers);
+# row slices keep the steady rate AND bound peak memory.
 _FETCH_BYTES = 256 << 20
+
+
+def _rows_per_chunk(arr, chunk_bytes: int = _FETCH_BYTES) -> int:
+    return max(1, int(chunk_bytes
+                      // max(arr.nbytes // max(arr.shape[0], 1), 1)))
 
 
 def serialize_array(f: BinaryIO, arr) -> None:
@@ -62,8 +68,7 @@ def serialize_array(f: BinaryIO, arr) -> None:
     (reference: serialize_mdspan, core/serialize.hpp:35)."""
     if getattr(arr, "nbytes", 0) > _FETCH_BYTES and hasattr(arr, "shape") \
             and arr.ndim >= 1 and not isinstance(arr, np.ndarray):
-        rows = max(1, int(_FETCH_BYTES
-                          // max(arr.nbytes // max(arr.shape[0], 1), 1)))
+        rows = _rows_per_chunk(arr)
         header = np.lib.format.header_data_from_array_1_0(
             np.empty((0,) + tuple(arr.shape[1:]),
                      np.dtype(str(arr.dtype))))
@@ -78,6 +83,41 @@ def serialize_array(f: BinaryIO, arr) -> None:
 
 def deserialize_array(f: BinaryIO) -> np.ndarray:
     return np.load(f, allow_pickle=False)
+
+
+@functools.lru_cache(maxsize=None)
+def _chunk_writer(ndim: int):
+    import jax
+    import jax.numpy as jnp
+
+    def upd(b, blk, i):
+        idx = (i,) + (jnp.int32(0),) * (ndim - 1)
+        return jax.lax.dynamic_update_slice(b, blk, idx)
+
+    return jax.jit(upd, donate_argnums=0)
+
+
+def to_device_chunked(a: np.ndarray, chunk_bytes: int = _FETCH_BYTES):
+    """Host→device transfer in row slices into a donated buffer — the
+    upload mirror of serialize_array's sliced fetches (one multi-GB
+    ``jnp.asarray`` RPC has stalled and even crashed tunnelled
+    workers; ~256 MB slices sustain the steady rate and bound peak
+    device allocation at buffer + one slice)."""
+    import jax.numpy as jnp
+
+    if a.nbytes <= chunk_bytes:
+        return jnp.asarray(a)
+    rows = _rows_per_chunk(a, chunk_bytes)
+    buf = jnp.zeros(a.shape, a.dtype)
+    upd = _chunk_writer(a.ndim)
+    for i in range(0, a.shape[0], rows):
+        if i + rows > a.shape[0] and i > 0:
+            # ragged tail: overlap-write the LAST full-width slice so
+            # every chunk compiles to one shape
+            i = a.shape[0] - rows
+        blk = np.ascontiguousarray(a[i:i + rows])
+        buf = upd(buf, jnp.asarray(blk), jnp.int32(i))
+    return buf
 
 
 def serialize_header(f: BinaryIO, kind: str, version: int, meta: Dict[str, Any]) -> None:

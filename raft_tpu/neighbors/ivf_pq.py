@@ -1384,16 +1384,17 @@ def load(path: str) -> IvfPqIndex:
     expects(version in (1, _SERIAL_VERSION),
             "unsupported ivf_pq version %d", version)
     # v1 files predate codebook_kind/pq_bits/packed codes: byte-per-
-    # subspace per_subspace layout, recoverable from the defaults
-    packed_codes = jnp.asarray(a["packed_codes"])
+    # subspace per_subspace layout, recoverable from the defaults.
+    # Billion-scale arrays upload in row slices (see to_device_chunked).
+    packed_codes = ser.to_device_chunked(a["packed_codes"])
     index = IvfPqIndex(
         centers=jnp.asarray(a["centers"]),
         centers_rot=jnp.asarray(a["centers_rot"]),
         rotation=jnp.asarray(a["rotation"]),
         codebooks=jnp.asarray(a["codebooks"]),
         packed_codes=packed_codes,
-        packed_ids=jnp.asarray(a["packed_ids"]),
-        packed_norms=jnp.asarray(a["packed_norms"]),
+        packed_ids=ser.to_device_chunked(a["packed_ids"]),
+        packed_norms=ser.to_device_chunked(a["packed_norms"]),
         list_sizes=jnp.asarray(a["list_sizes"]),
         metric=meta["metric"],
         codebook_kind=meta.get("codebook_kind", "per_subspace"),
