@@ -14,7 +14,7 @@ at recall@10, batch=10000, k=10, for the flagship ANN indexes
    geometry — wide rows stress the scan and VMEM budgets).
 3. **deep-100m**: 100M × 96 IVF-PQ (BASELINE config 3) — uses the
    on-disk dataset + index cached under /tmp/deep100m when present
-   (building takes ~1 h; scratch/exp_100m_build.py creates the cache),
+   (building takes ~1 h; tools/build_deep100m.py creates the cache),
    else the leg is skipped with a note.
 
 Headline ``value``: best QPS among hard-1M configs reaching recall@10
@@ -122,14 +122,14 @@ def deep100m_rows():
     res_path = os.path.join(root, "results.json")
     if (os.path.exists(res_path)
             and not os.environ.get("RAFT_TPU_BENCH_DEEP100M_LIVE")):
-        # measured-this-round rows (scratch/exp_100m_build.py ran the
+        # measured-this-round rows (tools/build_deep100m.py ran the
         # same search code on the same chip): re-measuring live means
         # re-uploading the ~10 GB index through a ~5-25 MB/s tunnel
         # (~10-35 min) — opt in with RAFT_TPU_BENCH_DEEP100M_LIVE=1
         with open(res_path) as f:
             saved = json.load(f)
         print("[bench] deep-100m: emitting rows measured by "
-              "scratch/exp_100m_build.py (set RAFT_TPU_BENCH_DEEP100M_"
+              "tools/build_deep100m.py (set RAFT_TPU_BENCH_DEEP100M_"
               "LIVE=1 to re-measure live)")
         return [{"dataset": "deep-100m-synth", "algo": "ivf_pq",
                  "index": "deep100m.ivf_pq.n8192.d64",
@@ -141,7 +141,7 @@ def deep100m_rows():
     have = all(os.path.exists(p) for p in (idx_path, gt_path, i8_path))
     if not have:
         print(f"[bench] deep-100m: no cached index under {root}; "
-              "run scratch/exp_100m_build.py first — leg skipped")
+              "run tools/build_deep100m.py first — leg skipped")
         return []
     base_i8 = dsm.bin_memmap(i8_path, np.int8)
     scale, zero = np.load(i8_path + ".dequant.npy")
