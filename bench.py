@@ -47,6 +47,7 @@ def hard_config(n: int, n_queries: int, algos):
                               {"n_probes": 32, "scan_select": "approx"},
                               {"n_probes": 64, "scan_select": "approx"},
                               {"n_probes": 128, "scan_select": "approx"},
+                              {"n_probes": 256, "scan_select": "approx"},
                               {"n_probes": 64}],
         })
     if "ivf_pq" in algos:

@@ -118,8 +118,8 @@ def make_synthetic(name: str, n: int, dim: int, n_queries: int,
 
 def make_synthetic_hard(name: str, n: int, dim: int, n_queries: int,
                         metric: str = "sqeuclidean", seed: int = 0,
-                        rows_per_cluster: int = 16,
-                        sigma: float = 0.55) -> Dataset:
+                        rows_per_cluster: int = 24,
+                        sigma: float = 0.45) -> Dataset:
     """Hard clustered synthetic: MANY tiny clusters, so every query's
     top-k must cross cluster/cell boundaries.
 
