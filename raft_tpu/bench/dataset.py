@@ -95,9 +95,9 @@ def make_synthetic(name: str, n: int, dim: int, n_queries: int,
     """Synthetic benchmark set shaped like the reference's standard ones
     (SIFT-style clustered f32).
 
-    ``hard=True`` selects :func:`make_synthetic_hard` — overlapping
-    low-intrinsic-dimension clusters calibrated so IVF recall curves
-    bend like real SIFT's, instead of the near-separable default."""
+    ``hard=True`` selects :func:`make_synthetic_hard` — many tiny
+    clusters whose top-k sets cross kmeans cells, so IVF recall curves
+    bend like real SIFT's instead of saturating."""
     if hard:
         return make_synthetic_hard(name, n, dim, n_queries, metric=metric,
                                    seed=seed)
