@@ -21,7 +21,7 @@ print(f"index loaded+uploaded in {time.time()-t0:.0f}s", flush=True)
 
 q = jnp.asarray(queries)
 rows = []
-QB = 2500
+QB = 2000  # 2500 left the search program 317 MB over HBM beside the index
 for n_probes in (32, 64):
     sp = ivf_pq.SearchParams(n_probes=n_probes, scan_select="approx")
     parts = [ivf_pq.search(idx, q[a:a + QB], 40, sp)[1]
