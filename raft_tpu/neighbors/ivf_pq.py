@@ -148,6 +148,13 @@ class IvfPqIndex(flax.struct.PyTreeNode):
     pq_bits: int = flax.struct.field(pytree_node=False, default=8)
     # 0 → derive from packed_codes (legacy byte-per-subspace layout)
     pq_dim_static: int = flax.struct.field(pytree_node=False, default=0)
+    # folded code storage: [n_lists, L·nb/128, 128] instead of
+    # [n_lists, L, nb]. A u8 array's trailing dim pads to 128 lanes in
+    # TPU tile layouts, so nb=64-byte code rows would occupy 2× their
+    # bytes in HBM — at 100M rows the difference between a 9.7 GB and a
+    # 19 GB resident index. Row-major bytes are identical either way;
+    # codes_chunk() unfolds per scanned chunk.
+    codes_folded: bool = flax.struct.field(pytree_node=False, default=False)
 
     @property
     def n_lists(self) -> int:
@@ -175,11 +182,19 @@ class IvfPqIndex(flax.struct.PyTreeNode):
 
     @property
     def max_list_size(self) -> int:
-        return self.packed_codes.shape[1]
+        return self.packed_ids.shape[1]
 
     @property
     def size(self) -> int:
         return int(jnp.sum(self.list_sizes))
+
+    def codes_chunk(self, sl) -> jax.Array:
+        """[C, L, nb] code rows for the list chunk ``sl`` — unfolds the
+        lane-folded storage (see ``codes_folded``)."""
+        c = self.packed_codes[sl]
+        if self.codes_folded:
+            return c.reshape(c.shape[0], self.packed_ids.shape[1], -1)
+        return c
 
     def unpack_codes(self, packed: jax.Array) -> jax.Array:
         """[..., nbytes] u8 → [..., pq_dim] u8 code values."""
@@ -836,13 +851,17 @@ def build_chunked(dataset, params: Optional[IndexParams] = None,
         from raft_tpu.core import logging as _log
         _log.warn("ivf_pq chunked build: dropped %d overflow vectors", dropped)
 
+    fold = (nbytes < 128 and packed.nbytes > (1 << 30)
+            and (L * nbytes) % 128 == 0)
+    if fold:  # lane-fold big code arrays (see IvfPqIndex.codes_folded)
+        packed = packed.reshape(params.n_lists, -1, 128)
     index = IvfPqIndex(
         centers=centers, centers_rot=centers_rot, rotation=rotation,
-        codebooks=codebooks, packed_codes=jnp.asarray(packed),
+        codebooks=codebooks, packed_codes=ser.to_device_chunked(packed),
         packed_ids=jnp.asarray(ids), packed_norms=jnp.asarray(pnorm),
         list_sizes=jnp.asarray(np.minimum(counts, L).astype(np.int32)),
         metric=mt.value, codebook_kind=params.codebook_kind,
-        pq_bits=params.pq_bits, pq_dim_static=pq_dim)
+        pq_bits=params.pq_bits, pq_dim_static=pq_dim, codes_folded=fold)
     if _want_recon_cache(params, params.n_lists, L, rot_dim):
         index = index.replace(packed_recon=_build_recon_cache(index))
     return index
@@ -880,7 +899,8 @@ def _build_recon_cache(index: IvfPqIndex) -> jax.Array:
     near the 1 GB "auto" cache cap that is a multi-GB peak."""
     from raft_tpu.neighbors import ivf_common as ic
 
-    n_lists, L, nb = index.packed_codes.shape
+    n_lists, L = index.packed_ids.shape
+    nb = packed_nbytes(index.pq_dim, index.pq_bits)
     S = index.pq_dim
     chunk = ic.choose_list_chunk(n_lists, max(1, -(-4096 // max(L, 1))))
     n_chunks = n_lists // chunk
@@ -897,6 +917,7 @@ def _build_recon_cache(index: IvfPqIndex) -> jax.Array:
                                 index.codebooks).reshape(chunk, L, -1)
         return (dec + crot[:, None, :]).astype(jnp.bfloat16)
 
+    # row-major reshape is layout-agnostic: folded storage unfolds here
     ins = (index.packed_codes.reshape(n_chunks, chunk, L, nb),
            index.centers_rot.reshape(n_chunks, chunk, -1))
     if per_cluster:
@@ -926,7 +947,8 @@ def extend(index: IvfPqIndex, new_vectors: jax.Array,
                                       labels, index.codebooks,
                                       index.codebook_kind)
 
-    n_lists, L, S = index.packed_codes.shape  # S = packed bytes per row
+    n_lists, L = index.packed_ids.shape
+    S = packed_nbytes(index.pq_dim, index.pq_bits)  # bytes per code row
     old_sizes = np.asarray(index.list_sizes)
     labels_h = np.asarray(labels)
     need = old_sizes + np.bincount(labels_h, minlength=n_lists)
@@ -935,7 +957,7 @@ def extend(index: IvfPqIndex, new_vectors: jax.Array,
     packed = np.zeros((n_lists, new_L, S), np.uint8)
     ids = np.full((n_lists, new_L), -1, np.int32)
     pnorm = np.zeros((n_lists, new_L), np.float32)
-    packed[:, :L] = np.asarray(index.packed_codes)
+    packed[:, :L] = np.asarray(index.packed_codes).reshape(n_lists, L, -1)
     ids[:, :L] = np.asarray(index.packed_ids)
     pnorm[:, :L] = np.asarray(index.packed_norms)
     codes_h = pack_bits_np(np.asarray(codes), index.pq_bits)
@@ -952,11 +974,15 @@ def extend(index: IvfPqIndex, new_vectors: jax.Array,
     out = IvfPqIndex(
         centers=index.centers, centers_rot=index.centers_rot,
         rotation=index.rotation, codebooks=index.codebooks,
-        packed_codes=jnp.asarray(packed), packed_ids=jnp.asarray(ids),
+        packed_codes=jnp.asarray(
+            packed.reshape(n_lists, -1, 128)
+            if index.codes_folded and (new_L * S) % 128 == 0 else packed),
+        packed_ids=jnp.asarray(ids),
         packed_norms=jnp.asarray(pnorm),
         list_sizes=jnp.asarray(fill.astype(np.int32)), metric=index.metric,
         codebook_kind=index.codebook_kind, pq_bits=index.pq_bits,
-        pq_dim_static=index.pq_dim)
+        pq_dim_static=index.pq_dim,
+        codes_folded=index.codes_folded and (new_L * S) % 128 == 0)
     if index.packed_recon is not None:
         out = out.replace(packed_recon=_build_recon_cache(out))
     return out
@@ -1035,7 +1061,8 @@ def _search_impl(index: IvfPqIndex, queries: jax.Array, k: int,
                               precision=get_precision(),
                               preferred_element_type=jnp.float32)
             return finish_tile(dots, cand_ids, cand_norms, q_sq)
-        codes_p = index.packed_codes[probe]               # [t, Pr, L, nb]
+        codes_p = index.codes_chunk(probe.reshape(-1)).reshape(
+            t, n_probes, L, -1)                           # [t, Pr, L, nb]
         codes = index.unpack_codes(codes_p)               # [t, Pr, L, S]
         # ⟨q, d⟩: qd[t,c] = Σ_s qlut[t, s, codes[t,c,s]].  On TPU this is
         # formulated as a one-hot contraction: per-lane dynamic gathers
@@ -1157,7 +1184,7 @@ def _search_grouped(index: IvfPqIndex, queries: jax.Array, k: int,
         q_all = q_all / jnp.sqrt(jnp.maximum(
             jnp.sum(q_all * q_all, -1, keepdims=True), 1e-12))
     B = q_all.shape[0]
-    n_lists, L, nb = index.packed_codes.shape
+    n_lists, L = index.packed_ids.shape
     per_cluster = index.codebook_kind == "per_cluster"
     ip_like = mt in (DistanceType.InnerProduct, DistanceType.CosineExpanded)
     sqrt_out = mt == DistanceType.L2SqrtExpanded
@@ -1213,7 +1240,7 @@ def _search_grouped(index: IvfPqIndex, queries: jax.Array, k: int,
         if has_recon:
             recon = index.packed_recon[sl]                # [C, L, rot]
         else:
-            codes = index.unpack_codes(index.packed_codes[sl])
+            codes = index.unpack_codes(index.codes_chunk(sl))
             if per_cluster:
                 decoded = _decode_lists_cluster(codes, index.codebooks[sl])
             else:
@@ -1386,7 +1413,17 @@ def load(path: str) -> IvfPqIndex:
     # v1 files predate codebook_kind/pq_bits/packed codes: byte-per-
     # subspace per_subspace layout, recoverable from the defaults.
     # Billion-scale arrays upload in row slices (see to_device_chunked).
-    packed_codes = ser.to_device_chunked(a["packed_codes"])
+    pc = a["packed_codes"]
+    pq_dim_meta = int(meta.get("pq_dim", 0)) or pc.shape[-1]
+    nb = packed_nbytes(pq_dim_meta, int(meta.get("pq_bits", 8)))
+    folded = pc.ndim == 3 and pc.shape[-1] != nb
+    if (not folded and nb < 128 and pc.nbytes > (1 << 30)
+            and (pc.shape[1] * nb) % 128 == 0):
+        # lane-fold big code arrays (free row-major host view): a u8
+        # trailing dim < 128 pads to 128 lanes on TPU — 2× the HBM
+        pc = pc.reshape(pc.shape[0], -1, 128)
+        folded = True
+    packed_codes = ser.to_device_chunked(pc)
     index = IvfPqIndex(
         centers=jnp.asarray(a["centers"]),
         centers_rot=jnp.asarray(a["centers_rot"]),
@@ -1399,7 +1436,8 @@ def load(path: str) -> IvfPqIndex:
         metric=meta["metric"],
         codebook_kind=meta.get("codebook_kind", "per_subspace"),
         pq_bits=int(meta.get("pq_bits", 8)),
-        pq_dim_static=int(meta.get("pq_dim", packed_codes.shape[2])))
+        pq_dim_static=pq_dim_meta,
+        codes_folded=folded)
     if meta.get("has_recon"):
         index = index.replace(packed_recon=_build_recon_cache(index))
     return index

@@ -422,3 +422,36 @@ class TestApproxScanSelect:
         ia, ie = np.asarray(ia), np.asarray(ie)
         same = np.mean([len(set(a) & set(b)) / 10.0 for a, b in zip(ie, ia)])
         assert same >= 0.8, same
+
+
+def test_folded_codes_storage_matches(rng):
+    """Lane-folded code storage (codes_folded=True) must search
+    identically — it is the same bytes reshaped to a [*, 128] trailing
+    dim (u8 trailing dims < 128 pad to 128 lanes on TPU: 2x HBM)."""
+    import jax.numpy as jnp
+
+    from raft_tpu.neighbors import ivf_pq
+
+    x = rng.random((4000, 32), dtype=np.float32)
+    q = rng.random((64, 32), dtype=np.float32)
+    idx = ivf_pq.build(jnp.asarray(x), ivf_pq.IndexParams(
+        n_lists=16, pq_dim=16, kmeans_n_iters=4))
+    L, nb = idx.packed_codes.shape[1], idx.packed_codes.shape[2]
+    assert (L * nb) % 128 == 0
+    folded = idx.replace(
+        packed_codes=idx.packed_codes.reshape(16, -1, 128),
+        codes_folded=True)
+    d1, i1 = ivf_pq.search(idx, jnp.asarray(q), 10,
+                           ivf_pq.SearchParams(n_probes=8))
+    d2, i2 = ivf_pq.search(folded, jnp.asarray(q), 10,
+                           ivf_pq.SearchParams(n_probes=8))
+    np.testing.assert_array_equal(np.asarray(i1), np.asarray(i2))
+    np.testing.assert_allclose(np.asarray(d1), np.asarray(d2), rtol=1e-6)
+    # per_query path too
+    d3, i3 = ivf_pq.search(folded, jnp.asarray(q), 10,
+                           ivf_pq.SearchParams(n_probes=8,
+                                               scan_mode="per_query"))
+    d4, i4 = ivf_pq.search(idx, jnp.asarray(q), 10,
+                           ivf_pq.SearchParams(n_probes=8,
+                                               scan_mode="per_query"))
+    np.testing.assert_array_equal(np.asarray(i3), np.asarray(i4))
