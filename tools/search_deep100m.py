@@ -23,7 +23,8 @@ q = jnp.asarray(queries)
 rows = []
 QB = 2000  # 2500 left the search program 317 MB over HBM beside the index
 for n_probes in (32, 64):
-    sp = ivf_pq.SearchParams(n_probes=n_probes, scan_select="approx")
+    sp = ivf_pq.SearchParams(n_probes=n_probes, scan_select="approx",
+                            list_chunk=2)
     parts = [ivf_pq.search(idx, q[a:a + QB], 40, sp)[1]
              for a in range(0, NQ, QB)]
     i0_h = np.concatenate([np.asarray(jax.device_get(p_)) for p_ in parts])
