@@ -51,6 +51,10 @@ from raft_tpu.distance.types import DistanceType, resolve_metric
 from raft_tpu.matrix import select_k as _select_k
 from raft_tpu.utils.precision import get_precision
 
+# Code arrays above this size scan via dynamic_slice (see the
+# billion-scale guard in _search_grouped).
+_SLICE_SCAN_BYTES = 2 << 30
+
 _SERIAL_VERSION = 2
 
 
@@ -1232,20 +1236,48 @@ def _search_grouped(index: IvfPqIndex, queries: jax.Array, k: int,
     seg_q = jnp.pad(seg_q, ((0, nsp - n_seg), (0, 0)), constant_values=-1)
     has_recon = index.packed_recon is not None
 
+    # billion-scale guard: a GATHER of list chunks from a multi-GB code
+    # array inside the scan loop provokes XLA into rematerializing
+    # pipelined SLAB COPIES of the whole array (measured: 3× 1.88 GB
+    # temps at 100M — an instant compile OOM next to the resident
+    # index). dynamic_slice at C=1 keeps the loop slab-free.
+    slice_scan = index.packed_codes.nbytes > _SLICE_SCAN_BYTES
+    if slice_scan:
+        C = 1
+        n_chunks = n_seg
+        nsp = n_seg
+        seg_list = seg_list[:n_seg]
+        seg_q = seg_q[:n_seg]
+
+    def _chunk(arr, sl):
+        if slice_scan:
+            return lax.dynamic_slice(
+                arr, (sl[0],) + (0,) * (arr.ndim - 1),
+                (1,) + arr.shape[1:])
+        return arr[sl]
+
     def scan_chunk(args):
         sl, qt = args                                     # [C], [C, seg]
-        norms = index.packed_norms[sl]
-        lids = index.packed_ids[sl]
-        valid = valid_full[sl]
+        norms = _chunk(index.packed_norms, sl)
+        lids = _chunk(index.packed_ids, sl)
+        valid = lids >= 0 if slice_scan else valid_full[sl]
+        if slice_scan and filter_bits is not None:
+            from raft_tpu.neighbors.sample_filter import passes
+
+            valid &= passes(filter_bits, lids)
         if has_recon:
-            recon = index.packed_recon[sl]                # [C, L, rot]
+            recon = _chunk(index.packed_recon, sl)        # [C, L, rot]
         else:
-            codes = index.unpack_codes(index.codes_chunk(sl))
+            cp = _chunk(index.packed_codes, sl)
+            if index.codes_folded:
+                cp = cp.reshape(cp.shape[0], L, -1)
+            codes = index.unpack_codes(cp)
             if per_cluster:
-                decoded = _decode_lists_cluster(codes, index.codebooks[sl])
+                decoded = _decode_lists_cluster(codes,
+                                                _chunk(index.codebooks, sl))
             else:
                 decoded = _decode_codes(codes, index.codebooks)
-            recon = decoded + index.centers_rot[sl][:, None, :]
+            recon = decoded + _chunk(index.centers_rot, sl)[:, None, :]
         qi = jnp.clip(qt, 0, B - 1)
         qv = q_rot[qi]                                    # [C, seg, rot]
         # pad slots (qt == -1) compute against query 0 and are simply

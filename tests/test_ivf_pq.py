@@ -455,3 +455,25 @@ def test_folded_codes_storage_matches(rng):
                            ivf_pq.SearchParams(n_probes=8,
                                                scan_mode="per_query"))
     np.testing.assert_array_equal(np.asarray(i3), np.asarray(i4))
+
+
+def test_slice_scan_matches_gather_scan(rng, monkeypatch):
+    """The billion-scale dynamic_slice scan (C=1) must return the same
+    results as the gather scan."""
+    import jax.numpy as jnp
+
+    import raft_tpu.neighbors.ivf_pq as pq
+
+    x = rng.random((6000, 32), dtype=np.float32)
+    q = rng.random((300, 32), dtype=np.float32)
+    idx = pq.build(jnp.asarray(x), pq.IndexParams(
+        n_lists=16, pq_dim=16, kmeans_n_iters=4,
+        cache_reconstruction="never"))
+    sp = pq.SearchParams(n_probes=8, scan_mode="grouped",
+                         scan_select="approx")
+    d1, i1 = pq.search(idx, jnp.asarray(q), 10, sp)
+    monkeypatch.setattr(pq, "_SLICE_SCAN_BYTES", 0)
+    pq._search_grouped.clear_cache()  # force a re-trace under the patch
+    d2, i2 = pq.search(idx, jnp.asarray(q), 10, sp)
+    np.testing.assert_array_equal(np.asarray(i1), np.asarray(i2))
+    np.testing.assert_allclose(np.asarray(d1), np.asarray(d2), rtol=1e-5)
