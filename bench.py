@@ -66,7 +66,8 @@ def hard_config(n: int, n_queries: int, algos):
             "build_param": {"graph_degree": 64},
             "search_params": [{"itopk_size": 64},
                               {"itopk_size": 64, "search_width": 8,
-                               "max_iterations": 6}],
+                               "max_iterations": 6},
+                              {"itopk_size": 256, "search_width": 16}],
         })
     if "brute_force" in algos:
         index.append({"name": "brute_force", "algo": "brute_force",

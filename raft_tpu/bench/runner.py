@@ -211,6 +211,9 @@ def run_config(config: Dict[str, Any],
             _run_one_index(index_cfg, index_cfg["algo"], dsx, data,
                            queries, k, batch_size, results, verbose)
         except Exception as e:  # keep completed rows if one algo dies
+            import traceback
+
+            traceback.print_exc()
             print(f"[bench] {index_cfg.get('name')} failed: {e}")
     return results
 

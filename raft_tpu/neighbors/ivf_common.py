@@ -322,6 +322,55 @@ pack_lists_jit = partial(jax.jit, static_argnames=("n_lists", "L"))(
 (eager packing costs a dispatch round-trip per op through a tunnel)."""
 
 
+def pack_rows_chunked(x: jax.Array, labels: jax.Array, n_lists: int,
+                      L: int, chunk_rows: int = 1 << 17):
+    """Row-chunked device packing of ``x [n, d]`` into ``[n_lists, L,
+    d]`` for WIDE datasets — the one-shot :func:`pack_lists` peaks at
+    input + full gather copy + padded output (≈ 13.6 GB at 1M×960,
+    an OOM on a 16 GB chip). One sort derives every row's flattened
+    destination; chunks of rows then gather + scatter into a DONATED
+    output buffer, bounding the peak at input + output + one chunk.
+
+    Returns (packed [n_lists, L, d], ids [n_lists, L] (-1 pad),
+    sizes [n_lists], n_dropped)."""
+    n, d = x.shape
+    labels = labels.astype(jnp.int32)
+
+    @partial(jax.jit, static_argnames=("n_lists", "L"))
+    def prep(labels, n_lists, L):
+        order = jnp.argsort(labels, stable=True)
+        sorted_l = labels[order]
+        starts = jnp.searchsorted(sorted_l,
+                                  jnp.arange(n_lists, dtype=jnp.int32))
+        rank = (jnp.arange(n, dtype=jnp.int32)
+                - starts[jnp.clip(sorted_l, 0, n_lists - 1)].astype(jnp.int32))
+        valid = (sorted_l >= 0) & (sorted_l < n_lists) & (rank < L)
+        dest = jnp.where(valid, sorted_l * L + rank, n_lists * L)
+        counts = jnp.zeros((n_lists,), jnp.int32).at[
+            jnp.clip(labels, 0, n_lists - 1)].add(
+                (labels >= 0) & (labels < n_lists), mode="drop")
+        return order, dest, jnp.minimum(counts, L), counts
+
+    order, dest, sizes, counts = prep(labels, n_lists, L)
+
+    @partial(jax.jit, donate_argnums=(0, 1))
+    def write_chunk(out, ids_out, rows, ridx, dst):
+        out = out.at[dst].set(rows, mode="drop")
+        ids_out = ids_out.at[dst].set(ridx, mode="drop")
+        return out, ids_out
+
+    out = jnp.zeros((n_lists * L, d), x.dtype)
+    ids_out = jnp.full((n_lists * L,), -1, jnp.int32)
+    for a in range(0, n, chunk_rows):
+        b = min(n, a + chunk_rows)
+        oc = order[a:b]
+        out, ids_out = write_chunk(out, ids_out, x[oc],
+                                   oc.astype(jnp.int32), dest[a:b])
+    n_dropped = jnp.sum(counts - sizes)
+    return (out.reshape(n_lists, L, d), ids_out.reshape(n_lists, L),
+            sizes, n_dropped)
+
+
 def choose_list_chunk(n_lists: int, target: int) -> int:
     """Largest divisor of ``n_lists`` that is ≤ target (chunked scans
     reshape [n_lists, …] to [n_chunks, chunk, …], so the chunk must

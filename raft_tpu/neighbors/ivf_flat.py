@@ -245,10 +245,17 @@ def build(dataset: jax.Array, params: Optional[IndexParams] = None) -> IvfFlatIn
                              minlength=params.n_lists)
         max_list_size = _fit_list_size(counts, avg,
                                        params.list_size_cap_factor)
-    (packed,), ids, sizes, dropped, _ = ic.pack_lists_jit(
-        [x], labels, jnp.arange(n, dtype=jnp.int32),
-        n_lists=params.n_lists, L=max_list_size,
-        fill_values=[jnp.zeros((), x.dtype)])
+    if (n + params.n_lists * max_list_size) * d * x.dtype.itemsize \
+            > (8 << 30):
+        # wide datasets: the one-shot pack's gather copy OOMs (see
+        # pack_rows_chunked)
+        packed, ids, sizes, dropped = ic.pack_rows_chunked(
+            x, labels, params.n_lists, max_list_size)
+    else:
+        (packed,), ids, sizes, dropped, _ = ic.pack_lists_jit(
+            [x], labels, jnp.arange(n, dtype=jnp.int32),
+            n_lists=params.n_lists, L=max_list_size,
+            fill_values=[jnp.zeros((), x.dtype)])
     n_drop = int(dropped)
     if n_drop:
         from raft_tpu.core import logging as _log
