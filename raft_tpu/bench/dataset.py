@@ -312,7 +312,8 @@ class DeviceSyntheticChunks:
 def compute_groundtruth(ds: Dataset, k: int = 100,
                         device_budget: int = 2 << 30,
                         chunk_rows: int = 1 << 18,
-                        max_queries: int = 0) -> Dataset:
+                        max_queries: int = 0,
+                        device_base=None) -> Dataset:
     """Exact top-k groundtruth via the library's own brute force (the
     reference's split_groundtruth uses its GPU brute force the same way).
 
@@ -327,12 +328,17 @@ def compute_groundtruth(ds: Dataset, k: int = 100,
     queries = ds.queries
     if max_queries and queries.shape[0] > max_queries:
         queries = queries[:max_queries]
-    if ds.base.nbytes <= device_budget:
+    if ds.base.nbytes <= device_budget or device_base is not None:
         from ..neighbors import brute_force
 
-        index = brute_force.build(jnp.asarray(ds.base), metric=ds.metric)
+        # callers that already hold the base on device pass it in —
+        # a second multi-GB copy has OOMed wide-dataset runs
+        base_dev = (device_base if device_base is not None
+                    else jnp.asarray(ds.base))
+        index = brute_force.build(base_dev, metric=ds.metric)
         _, ids = brute_force.knn(index, jnp.asarray(queries), k)
         ds.groundtruth = np.asarray(ids, np.int32)
+        del index
         return ds
 
     from ..core.errors import expects

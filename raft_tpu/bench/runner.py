@@ -192,12 +192,13 @@ def run_config(config: Dict[str, Any],
                 seed=int(dcfg.get("seed", 0)),
                 hard=bool(dcfg.get("hard", False)),
             )
-    if data.groundtruth is None:
-        ds_mod.compute_groundtruth(data, k=max(k, 10))
-
     # memmapped bases stay host-side: chunked builds page them in; only
     # algos that genuinely need the full matrix pull it to device
     dsx = data.base if mmap_mode else jnp.asarray(data.base)
+    if data.groundtruth is None:
+        ds_mod.compute_groundtruth(
+            data, k=max(k, 10),
+            device_base=None if mmap_mode else dsx)
     queries = jnp.asarray(data.queries)
     # config errors fail loudly BEFORE any work; runtime failures of one
     # algo keep the other algos' completed rows
