@@ -88,7 +88,7 @@ def gist_config(n: int, n_queries: int, algos):
         index.append({
             "name": "gist.ivf_flat.n1024", "algo": "ivf_flat",
             "build_param": {"n_lists": 1024, "spill": True,
-                            "list_size_cap_factor": 1.5},
+                            "list_size_cap_factor": 1.25},
             "search_params": [{"n_probes": 32, "scan_select": "approx"},
                               {"n_probes": 64, "scan_select": "approx"}],
         })

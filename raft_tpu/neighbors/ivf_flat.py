@@ -200,6 +200,7 @@ def build(dataset: jax.Array, params: Optional[IndexParams] = None) -> IvfFlatIn
         trainset = x
     centers = kmeans_balanced.fit(trainset.astype(jnp.float32),
                                   params.n_lists, km_params)
+    del trainset  # wide datasets: the subsample copy is GBs
 
     avg = max(1, n // params.n_lists)
 
@@ -250,7 +251,8 @@ def build(dataset: jax.Array, params: Optional[IndexParams] = None) -> IvfFlatIn
         # wide datasets: the one-shot pack's gather copy OOMs (see
         # pack_rows_chunked)
         packed, ids, sizes, dropped = ic.pack_rows_chunked(
-            x, labels, params.n_lists, max_list_size)
+            x, labels, params.n_lists, max_list_size,
+            chunk_rows=1 << 16)
     else:
         (packed,), ids, sizes, dropped, _ = ic.pack_lists_jit(
             [x], labels, jnp.arange(n, dtype=jnp.int32),
