@@ -25,7 +25,7 @@ QB = 2000  # 2500 left the search program 317 MB over HBM beside the index
 for n_probes in (32, 64):
     sp = ivf_pq.SearchParams(n_probes=n_probes, scan_select="approx",
                             list_chunk=2)
-    parts = [ivf_pq.search(idx, q[a:a + QB], 40, sp)[1]
+    parts = [ivf_pq.search(idx, q[a:a + QB], 100, sp)[1]
              for a in range(0, NQ, QB)]
     i0_h = np.concatenate([np.asarray(jax.device_get(p_)) for p_ in parts])
     print(f"np={n_probes}: search pass done", flush=True)
@@ -35,7 +35,7 @@ for n_probes in (32, 64):
     rec = float(np.mean([len(set(gt[r]) & set(ids[r])) / 10
                          for r in range(len(gt))]))
     t0 = time.perf_counter()
-    outs = [ivf_pq.search(idx, q[a:a + QB], 40, sp)[1]
+    outs = [ivf_pq.search(idx, q[a:a + QB], 100, sp)[1]
             for _ in range(4) for a in range(0, NQ, QB)]
     jax.device_get([o[:1] for o in outs])
     search_dt = (time.perf_counter() - t0) / 4
@@ -47,7 +47,7 @@ for n_probes in (32, 64):
     print(f"n_probes={n_probes}: recall@10={rec:.4f} "
           f"search={search_dt*1e3:.0f}ms refine={refine_dt*1e3:.0f}ms "
           f"-> {NQ/dt:,.0f} qps", flush=True)
-    rows.append({"n_probes": n_probes, "refine_ratio": 4,
+    rows.append({"n_probes": n_probes, "refine_ratio": 10,
                  "recall": round(rec, 4), "qps": round(NQ / dt, 1),
                  "search_ms": round(search_dt * 1e3, 1),
                  "refine_ms": round(refine_dt * 1e3, 1),
