@@ -207,7 +207,7 @@ class DeviceSyntheticChunks:
         self._n_centers = n_centers
         self._std = std
         key = jax.random.PRNGKey(seed)
-        ckey, self._akey = jax.random.split(key)
+        ckey, self._akey, self._qkey = jax.random.split(key, 3)
         self.centers = jax.jit(
             lambda k: jax.random.uniform(k, (n_centers, dim)) * scale)(ckey)
 
@@ -250,8 +250,11 @@ class DeviceSyntheticChunks:
         return parts[0] if len(parts) == 1 else jnp.concatenate(parts, 0)
 
     def queries(self, m: int):
-        """Deterministic query set (chunk id = n, disjoint from rows)."""
-        return self._gen(self.centers, self._akey, self.shape[0] + 1, m)
+        """Deterministic query set from a SEPARATE key branch of the
+        root split — a fold_in of the row key at any offset can collide
+        with a base block's key when chunk_rows divides it, silently
+        making queries bit-identical to base rows (and recall trivial)."""
+        return self._gen(self.centers, self._qkey, 0, m)
 
     def sample_rows(self, idx: np.ndarray):
         """Gather arbitrary (sorted) rows by regenerating the covering
@@ -336,7 +339,12 @@ def compute_groundtruth(ds: Dataset, k: int = 100,
         base_dev = (device_base if device_base is not None
                     else jnp.asarray(ds.base))
         index = brute_force.build(base_dev, metric=ds.metric)
-        _, ids = brute_force.knn(index, jnp.asarray(queries), k)
+        # impl="sort": groundtruth must be GUARANTEED exact — the default
+        # strided-bin tile cut is only probabilistically exact (loses a
+        # true neighbor when ≥3 top-k rows collide in one stride bin),
+        # and every recall number in the bench is measured against this
+        _, ids = brute_force.knn(index, jnp.asarray(queries), k,
+                                 impl="sort")
         ds.groundtruth = np.asarray(ids, np.int32)
         del index
         return ds

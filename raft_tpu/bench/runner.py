@@ -169,9 +169,18 @@ def _bench_search(search_fn, queries, k, sp, batch_size, iters=5):
 
 def run_config(config: Dict[str, Any],
                data: Optional[ds_mod.Dataset] = None,
-               verbose: bool = True) -> List[BenchResult]:
+               verbose: bool = True,
+               on_row: Optional[Callable[[BenchResult], None]] = None,
+               deadline: Optional[float] = None) -> List[BenchResult]:
     """Run one benchmark config; returns a result row per
-    (index, search_param) combination."""
+    (index, search_param) combination.
+
+    ``on_row`` fires after every completed measurement — callers that
+    must survive an external timeout (the driver protocol) persist rows
+    incrementally instead of waiting for the full sweep (the
+    reference's per-algo subprocess isolation serves the same purpose,
+    run/__main__.py:48-103). ``deadline`` (time.time() scale) skips
+    remaining index builds / search params once passed."""
     k = int(config.get("k", 10))
     batch_size = int(config.get("batch_size", 10_000))
 
@@ -208,9 +217,14 @@ def run_config(config: Dict[str, Any],
                              f"(have {sorted(ALGO_REGISTRY)})")
     results: List[BenchResult] = []
     for index_cfg in config["index"]:
+        if deadline is not None and time.time() > deadline:
+            print(f"[bench] leg budget exhausted — skipping "
+                  f"{index_cfg.get('name')} and later indexes")
+            break
         try:
             _run_one_index(index_cfg, index_cfg["algo"], dsx, data,
-                           queries, k, batch_size, results, verbose)
+                           queries, k, batch_size, results, verbose,
+                           on_row=on_row, deadline=deadline)
         except Exception as e:  # keep completed rows if one algo dies
             import traceback
 
@@ -220,7 +234,7 @@ def run_config(config: Dict[str, Any],
 
 
 def _run_one_index(index_cfg, algo, dsx, data, queries, k, batch_size,
-               results, verbose):
+               results, verbose, on_row=None, deadline=None):
     bp = dict(index_cfg.get("build_param", {}))
     t0 = time.perf_counter()
     search_fn, index_obj = ALGO_REGISTRY[algo](dsx, dict(bp), data.metric)
@@ -231,6 +245,10 @@ def _run_one_index(index_cfg, algo, dsx, data, queries, k, batch_size,
          if hasattr(leaf, "block_until_ready")])
     build_s = time.perf_counter() - t0
     for sp in index_cfg.get("search_params", [{}]):
+        if deadline is not None and time.time() > deadline:
+            print(f"[bench] leg budget exhausted — skipping remaining "
+                  f"search params of {index_cfg.get('name')}")
+            break
         ids, dt, qps = _bench_search(search_fn, queries, k, sp, batch_size)
         rec = ds_mod.recall(ids, data.groundtruth)
         row = BenchResult(
@@ -240,6 +258,8 @@ def _run_one_index(index_cfg, algo, dsx, data, queries, k, batch_size,
             build_param=bp, search_param=dict(sp),
         )
         results.append(row)
+        if on_row is not None:
+            on_row(row)
         if verbose:
             print(f"[bench] {row.index_name} {sp}: "
                   f"qps={qps:,.0f} recall={rec:.4f} build={build_s:.1f}s")

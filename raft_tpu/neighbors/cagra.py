@@ -69,6 +69,12 @@ class IndexParams:
     # 128-d projections preserve neighbor RANKS well enough for graph
     # candidates; the searched dataset stays full precision
     build_projection_dim: int = -1  # -1 auto | 0 off | explicit dim
+    # store int8 scalar-quantized rows beside the f32 dataset (the
+    # CAGRA-Q compression analog). OPT-IN like the reference's
+    # compression param: it costs +n·d bytes of HBM and, via
+    # SearchParams.traverse="auto", changes default search results
+    # (int8 traversal trades ~3e-3 recall for ~1.8×/hop bandwidth)
+    quantize_dataset: bool = False
     seed: int = 0
 
 
@@ -491,7 +497,9 @@ def build(dataset: jax.Array, params: Optional[IndexParams] = None) -> CagraInde
     else:
         knn = build_knn_graph(x, inter_d, metric=mt.value, seed=params.seed)
     graph = optimize_graph(knn, out_d)
-    codes, scale, zero = _quantize_rows(x)
+    codes = scale = zero = None
+    if params.quantize_dataset:
+        codes, scale, zero = _quantize_rows(x)
     return CagraIndex(dataset=x, graph=graph, metric=mt.value,
                       centers=centers, entry_ids=entry_ids,
                       dataset_q=codes, q_scale=scale, q_zero=zero)

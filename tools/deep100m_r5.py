@@ -1,0 +1,151 @@
+"""DEEP-100M round-5 recall attack (BASELINE config 3, target ≥0.90).
+
+Round-4 capped at recall@10 = 0.81: the SQ8 refine file's quantization
+error (~1e-2 per d²) exceeds neighbor gaps on dense synthetic data, and
+the groundtruth covered only 1,000 of 10,000 queries. This script:
+
+1. recomputes exact streaming GT for ALL 10K cached queries (gt10k.npy;
+   validates its first 1000 rows against round-4's gt.npy),
+2. loads the cached 10.9 GB IVF-PQ index (row-sliced upload),
+3. sweeps (n_probes, k_cand) configs, measuring BOTH candidate-list
+   recall (is the true neighbor in the list at all?) and the final
+   recall@10 after an EXACT f32 re-rank via refine_provider (candidate
+   rows regenerated on device — no SQ8 error, no host traffic),
+4. writes stamped, resumable rows to results_r5.json.
+
+Run under a watchdog; every phase resumes from cached files.
+"""
+import sys, os, time, json, hashlib, subprocess
+sys.path.insert(0, "/root/repo")
+import numpy as np, jax, jax.numpy as jnp
+from raft_tpu.bench import dataset as dsm
+from raft_tpu.neighbors import ivf_pq, refine
+
+ROOT = "/tmp/deep100m"
+IDX = os.path.join(ROOT, "pq.idx")
+GT10K = os.path.join(ROOT, "gt10k.npy")
+RES = os.path.join(ROOT, "results_r5.json")
+N, D, NQ = 100_000_000, 96, 10_000
+QB = 2000   # query batch for the PQ search (HBM bound, see search_deep100m)
+
+prov = dsm.DeviceSyntheticChunks(N, D, n_centers=10_000, seed=7)
+# round-4's cached queries are the truth — do NOT regenerate (the
+# provider's query keying may change; gt files are keyed to this file)
+queries = np.asarray(dsm.bin_memmap(os.path.join(ROOT, "query.fbin"),
+                                    np.float32), np.float32)
+assert queries.shape == (NQ, D), queries.shape
+
+if os.path.exists(GT10K):
+    gt = np.load(GT10K)
+else:
+    ds = dsm.Dataset(name="deep100m", base=prov, queries=queries)
+    t0 = time.time()
+    dsm.compute_groundtruth(ds, k=10, chunk_rows=1 << 20)
+    print(f"GT-10K in {time.time()-t0:.0f}s", flush=True)
+    gt = ds.groundtruth
+    old = np.load(os.path.join(ROOT, "gt.npy"))
+    agree = float(np.mean([len(set(gt[r]) & set(old[r])) / old.shape[1]
+                           for r in range(len(old))]))
+    print(f"GT validation vs round-4 gt.npy (first {len(old)}): "
+          f"agreement={agree:.4f}", flush=True)
+    if agree < 0.999:
+        raise SystemExit("GT mismatch vs round-4 — pipeline changed?")
+    np.save(GT10K, gt)
+
+def stamp():
+    st = os.stat(IDX)
+    h = hashlib.sha256()
+    with open(IDX, "rb") as f:
+        h.update(f.read(16 << 20))
+    commit = subprocess.run(["git", "-C", "/root/repo", "rev-parse",
+                             "--short", "HEAD"], capture_output=True,
+                            text=True).stdout.strip()
+    return {"git_commit": commit, "measured_at": time.strftime("%F %T"),
+            "index_bytes": st.st_size, "index_mtime": int(st.st_mtime),
+            "index_sha16m": h.hexdigest()[:16]}
+
+saved = {"stamp": None, "rows": []}
+if os.path.exists(RES):
+    with open(RES) as f:
+        prior = json.load(f)
+    st = os.stat(IDX)
+    ps = prior.get("stamp") or {}
+    if (ps.get("index_bytes") == st.st_size
+            and ps.get("index_mtime") == int(st.st_mtime)):
+        saved = prior
+    else:
+        # rows measured against a DIFFERENT index file must not be
+        # re-stamped as this one's (silent-stale-replay, ADVICE r4)
+        print("prior results_r5.json stamped against a different index "
+              "— discarding its rows", flush=True)
+done = {(r["n_probes"], r["k_cand"]) for r in saved["rows"]}
+
+t0 = time.time()
+idx = ivf_pq.load(IDX)
+jax.device_get(idx.packed_codes[:1, :1, :1])
+print(f"index loaded+uploaded in {time.time()-t0:.0f}s", flush=True)
+saved["stamp"] = stamp()
+
+def recall_of(ids, k):
+    return float(np.mean([len(set(gt[r, :k]) & set(ids[r])) / k
+                          for r in range(NQ)]))
+
+def refine_chunked(cand, k, max_rows=5_000_000):
+    """refine_provider over query chunks so the gathered-row buffer
+    stays under ~2 GB beside the 10.9 GB index."""
+    m, C = cand.shape
+    qc = max(1, min(m, max_rows // C))
+    dv, iv = [], []
+    for a in range(0, m, qc):
+        d_, i_ = refine.refine_provider(prov, jnp.asarray(queries[a:a+qc]),
+                                        cand[a:a+qc], k)
+        dv.append(np.asarray(jax.device_get(d_)))
+        iv.append(np.asarray(jax.device_get(i_)))
+    return np.concatenate(dv), np.concatenate(iv)
+
+CONFIGS = [(32, 100), (32, 400), (64, 400), (64, 1000), (128, 400)]
+for n_probes, k_cand in CONFIGS:
+    if (n_probes, k_cand) in done:
+        print(f"np={n_probes} k_cand={k_cand}: cached, skip", flush=True)
+        continue
+    try:
+        sp = ivf_pq.SearchParams(n_probes=n_probes, scan_select="approx",
+                                 list_chunk=2)
+        t0 = time.perf_counter()
+        parts = [ivf_pq.search(idx, jnp.asarray(queries[a:a+QB]),
+                               k_cand, sp)[1] for a in range(0, NQ, QB)]
+        i0 = np.concatenate([np.asarray(jax.device_get(p)) for p in parts])
+        first_pass = time.perf_counter() - t0
+        # candidate-list recall: the refine ceiling
+        crec = float(np.mean([len(set(gt[r]) & set(i0[r])) / 10
+                              for r in range(NQ)]))
+        t0 = time.perf_counter()
+        _, iv = refine_chunked(i0, 10)
+        refine_dt = time.perf_counter() - t0
+        rec = recall_of(iv, 10)
+        # timed search (pipelined, warm): 3 reps
+        t0 = time.perf_counter()
+        outs = [ivf_pq.search(idx, jnp.asarray(queries[a:a+QB]),
+                              k_cand, sp)[1]
+                for _ in range(3) for a in range(0, NQ, QB)]
+        jax.device_get([o[:1] for o in outs])
+        search_dt = (time.perf_counter() - t0) / 3
+        qps = NQ / (search_dt + refine_dt)
+        row = {"n_probes": n_probes, "k_cand": k_cand,
+               "cand_recall": round(crec, 4), "recall": round(rec, 4),
+               "qps": round(qps, 1),
+               "search_ms": round(search_dt * 1e3, 1),
+               "refine_ms": round(refine_dt * 1e3, 1),
+               "refine": "f32_regen", "build_s": 2924.0,
+               "gt_queries": NQ, "first_pass_s": round(first_pass, 1)}
+        print(f"np={n_probes} k_cand={k_cand}: cand_recall={crec:.4f} "
+              f"recall@10={rec:.4f} search={search_dt:.1f}s "
+              f"refine={refine_dt:.1f}s -> {qps:,.0f} qps", flush=True)
+        saved["rows"].append(row)
+        with open(RES + ".part", "w") as f:
+            json.dump(saved, f, indent=1)
+        os.replace(RES + ".part", RES)
+    except Exception as e:
+        import traceback; traceback.print_exc()
+        print(f"np={n_probes} k_cand={k_cand} FAILED: {e}", flush=True)
+print("done", flush=True)
