@@ -1,0 +1,99 @@
+"""BASELINE config 3: IVF-PQ at 100M scale on one chip.
+
+The tunnel moves ~25 MB/s, so the 38 GB base is GENERATED on device
+per chunk (bench.dataset.DeviceSyntheticChunks, seed-deterministic);
+an SQ8 copy is persisted for the host-side refine gather. Flow:
+build_chunked(spill) -> save index -> chunked exact GT (1000 queries)
+-> int8 refine file -> n_probes sweep -> results.json.
+"""
+import sys, os, time, json
+sys.path.insert(0, "/root/repo")
+import numpy as np, jax, jax.numpy as jnp
+from raft_tpu.bench import dataset as dsm
+from raft_tpu.neighbors import ivf_pq, refine
+from raft_tpu import native
+
+ROOT = "/tmp/deep100m"
+os.makedirs(ROOT, exist_ok=True)
+IDX = os.path.join(ROOT, "pq.idx")
+GT = os.path.join(ROOT, "gt.npy")
+I8 = os.path.join(ROOT, "base_i8.fbin")
+N, D, NQ = 100_000_000, 96, 10_000
+
+prov = dsm.DeviceSyntheticChunks(N, D, n_centers=10_000, seed=7)
+qdev = prov.queries(NQ)
+queries = np.asarray(jax.device_get(qdev), np.float32)
+native.bin_write(os.path.join(ROOT, "query.fbin"), queries)
+old = os.path.join(ROOT, "base.fbin")
+if os.path.exists(old):
+    os.remove(old)  # stale numpy-generated file: provider is the truth
+print("provider ready", flush=True)
+
+params = ivf_pq.IndexParams(n_lists=8192, pq_dim=64, pq_bits=8,
+                            spill=True, list_size_cap_factor=1.5,
+                            kmeans_n_iters=10)
+build_s = None
+if os.path.exists(IDX):
+    t0 = time.time()
+    idx = ivf_pq.load(IDX)
+    print(f"loaded index in {time.time()-t0:.0f}s", flush=True)
+else:
+    t0 = time.time()
+    idx = ivf_pq.build_chunked(prov, params, chunk_rows=1 << 20,
+                               progress=True)
+    build_s = time.time() - t0
+    print(f"BUILD {build_s:.0f}s  L={idx.packed_codes.shape[1]} "
+          f"codes={idx.packed_codes.nbytes/2**30:.1f}GiB", flush=True)
+    t0 = time.time()
+    ivf_pq.save(idx, IDX + ".part")
+    os.replace(IDX + ".part", IDX)
+    print(f"saved in {time.time()-t0:.0f}s", flush=True)
+
+if os.path.exists(GT):
+    gt = np.load(GT)
+else:
+    ds = dsm.Dataset(name="deep100m", base=prov, queries=queries)
+    t0 = time.time()
+    dsm.compute_groundtruth(ds, k=10, chunk_rows=1 << 20, max_queries=1000)
+    print(f"GT in {time.time()-t0:.0f}s", flush=True)
+    gt = ds.groundtruth
+    np.save(GT, gt)
+
+if not os.path.exists(I8):
+    t0 = time.time()
+    prov.write_int8(I8, progress=True)
+    print(f"int8 refine file in {time.time()-t0:.0f}s", flush=True)
+base_i8 = dsm.bin_memmap(I8, np.int8)
+scale, zero = np.load(I8 + ".dequant.npy")
+
+q = jnp.asarray(queries)
+rows = []
+for n_probes in (32, 64, 128):
+    sp = ivf_pq.SearchParams(n_probes=n_probes, scan_select="approx")
+    d0, i0 = ivf_pq.search(idx, q, 40, sp)
+    i0_h = np.asarray(jax.device_get(i0))
+    dv, iv = refine.refine_gathered(base_i8, queries, i0_h, 10,
+                                    dequant=(scale, zero))
+    ids = np.asarray(iv)
+    rec = float(np.mean([len(set(gt[r]) & set(ids[r])) / 10
+                         for r in range(len(gt))]))
+    t0 = time.perf_counter()
+    outs = [ivf_pq.search(idx, q, 40, sp) for _ in range(4)]
+    jax.device_get([o[1][:1] for o in outs])
+    search_dt = (time.perf_counter() - t0) / 4
+    t0 = time.perf_counter()
+    refine.refine_gathered(base_i8, queries, i0_h, 10,
+                           dequant=(scale, zero))
+    refine_dt = time.perf_counter() - t0
+    dt = search_dt + refine_dt
+    print(f"n_probes={n_probes}: recall@10={rec:.4f} "
+          f"search={search_dt*1e3:.0f}ms refine={refine_dt*1e3:.0f}ms "
+          f"-> {NQ/dt:,.0f} qps", flush=True)
+    rows.append({"n_probes": n_probes, "refine_ratio": 4,
+                 "recall": round(rec, 4), "qps": round(NQ / dt, 1),
+                 "search_ms": round(search_dt * 1e3, 1),
+                 "refine_ms": round(refine_dt * 1e3, 1),
+                 "build_s": build_s})
+with open(os.path.join(ROOT, "results.json"), "w") as f:
+    json.dump(rows, f)
+print("done", flush=True)

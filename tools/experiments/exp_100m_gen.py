@@ -1,0 +1,37 @@
+"""Generate the DEEP-100M-shaped synthetic dataset on disk (38 GB fbin):
+100M x 96 clustered f32 + 10K queries. Host-only, chunked writes."""
+import sys, os, struct, time
+sys.path.insert(0, "/root/repo")
+import numpy as np
+
+OUT = "/tmp/deep100m"
+N, D, NQ = 100_000_000, 96, 10_000
+NC = 10_000
+CHUNK = 1_000_000
+
+os.makedirs(OUT, exist_ok=True)
+base_path = os.path.join(OUT, "base.fbin")
+if os.path.exists(base_path) and os.path.getsize(base_path) == 8 + N * D * 4:
+    print("base.fbin already complete", flush=True)
+    sys.exit(0)
+
+rng = np.random.default_rng(7)
+centers = (rng.random((NC, D), dtype=np.float32) * 10.0)
+t0 = time.time()
+with open(base_path, "wb") as f:
+    f.write(struct.pack("<ii", N, D))
+    for start in range(0, N, CHUNK):
+        m = min(CHUNK, N - start)
+        assign = rng.integers(0, NC, m)
+        block = centers[assign] + 0.5 * rng.standard_normal(
+            (m, D)).astype(np.float32)
+        f.write(block.astype(np.float32).tobytes())
+        if start % 10_000_000 == 0:
+            print(f"  {start/1e6:.0f}M rows, {time.time()-t0:.0f}s", flush=True)
+q_assign = rng.integers(0, NC, NQ)
+queries = centers[q_assign] + 0.5 * rng.standard_normal(
+    (NQ, D)).astype(np.float32)
+with open(os.path.join(OUT, "query.fbin"), "wb") as f:
+    f.write(struct.pack("<ii", NQ, D))
+    f.write(queries.astype(np.float32).tobytes())
+print(f"done in {time.time()-t0:.0f}s", flush=True)

@@ -1,0 +1,64 @@
+"""Brute-force select alternatives on [10000, 16384] tiles (device time
+via chained iterations) + full-path variants at 1M."""
+import sys, time
+sys.path.insert(0, "/root/repo")
+import numpy as np, jax, jax.numpy as jnp
+from functools import partial
+from jax import lax
+
+rng = np.random.default_rng(0)
+
+def dev_time(tag, fn, *args, lo=2, hi=10):
+    t = {}
+    for it in (lo, hi):
+        out = fn(*args, iters=it); jax.device_get(out)
+        t0 = time.perf_counter()
+        for _ in range(3):
+            out = fn(*args, iters=it)
+        jax.device_get(out)
+        t[it] = (time.perf_counter() - t0) / 3
+    per = (t[hi] - t[lo]) / (hi - lo)
+    print(f"{tag:44s} {per*1e3:9.2f} ms/op", flush=True)
+    return per
+
+M, T = 10000, 16384
+s0 = jnp.asarray(rng.standard_normal((M, T)).astype(np.float32))
+
+def chain(body):
+    @partial(jax.jit, static_argnames=("iters",))
+    def run(s, iters):
+        def step(i, carry):
+            s, acc = carry
+            out = body(s)
+            tot = jnp.sum(out[0]) if isinstance(out, tuple) else jnp.sum(out)
+            return s + tot * 1e-30, acc + tot
+        return lax.fori_loop(0, iters, step, (s, jnp.float32(0)))[1]
+    return lambda iters: run(s0, iters)
+
+from raft_tpu.ops import select_k_pallas
+dev_time("select_k_pallas k=10", chain(lambda s: select_k_pallas(s, 10)))
+dev_time("approx_min_k k=10 r95", chain(
+    lambda s: lax.approx_min_k(s, 10, recall_target=0.95)))
+dev_time("approx_min_k k=32 r95", chain(
+    lambda s: lax.approx_min_k(s, 32, recall_target=0.95)))
+dev_time("approx_min_k k=32 r99", chain(
+    lambda s: lax.approx_min_k(s, 32, recall_target=0.99)))
+dev_time("lax.top_k k=10", chain(lambda s: lax.top_k(s, 10)))
+
+q = jnp.asarray(rng.standard_normal((M, 128)).astype(np.float32))
+db = jnp.asarray(rng.standard_normal((T, 128)).astype(np.float32))
+@partial(jax.jit, static_argnames=("iters", "prec"))
+def mm(q, db, iters, prec):
+    def step(i, carry):
+        q, acc = carry
+        g = lax.dot_general(q, db, (((1,), (1,)), ((), ())),
+                            precision=prec,
+                            preferred_element_type=jnp.float32)
+        s = jnp.sum(g)
+        return q + s * 1e-30, acc + s
+    return lax.fori_loop(0, iters, step, (q, jnp.float32(0)))[1]
+for prec in (lax.Precision.HIGHEST, lax.Precision.DEFAULT):
+    def f(iters, prec=prec):
+        return mm(q, db, iters, prec)
+    dev_time(f"matmul 10000x128x16384 {prec}", f)
+print("done", flush=True)

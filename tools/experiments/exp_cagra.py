@@ -1,0 +1,57 @@
+"""CAGRA traversal frontier: (itopk, search_width, degree) -> recall/time
+on the 1M x 128 bench set. Phase 1 of VERDICT r4 #1."""
+import sys, os, time
+sys.path.insert(0, "/root/repo")
+import numpy as np, jax, jax.numpy as jnp
+from raft_tpu.bench import dataset as dsm
+from raft_tpu.neighbors import cagra, brute_force
+
+CIDX = "/tmp/cagra1m.idx"
+GT = "/tmp/gt1m.npy"
+
+ds = dsm.make_synthetic("s", 1_000_000, 128, 10_000, seed=0)
+q = jnp.asarray(ds.queries)
+
+if os.path.exists(GT):
+    gt = np.load(GT)
+else:
+    bf = brute_force.build(jnp.asarray(ds.base))
+    _, ids = brute_force.knn(bf, q, 10)
+    gt = np.asarray(jax.device_get(ids))
+    np.save(GT, gt)
+    del bf
+print("gt ready", flush=True)
+
+if os.path.exists(CIDX):
+    idx = cagra.load(CIDX)
+else:
+    t0 = time.time()
+    idx = cagra.build(jnp.asarray(ds.base), cagra.IndexParams(graph_degree=64))
+    print(f"build {time.time()-t0:.0f}s", flush=True)
+    cagra.save(idx, CIDX)
+print("index ready", flush=True)
+
+def run(tag, idx, itopk, W, deg=None, tile=1024, iters=5):
+    ix = idx if deg is None else idx.replace(graph=idx.graph[:, :deg])
+    sp = cagra.SearchParams(itopk_size=itopk, search_width=W, query_tile=tile)
+    d, i = cagra.search(ix, q, 10, sp)
+    ids = np.asarray(jax.device_get(i))
+    rec = np.mean([len(set(gt[r]) & set(ids[r])) / 10 for r in range(len(gt))])
+    t0 = time.perf_counter()
+    outs = [cagra.search(ix, q, 10, sp) for _ in range(iters)]
+    jax.device_get([o[1][:1] for o in outs])
+    dt = (time.perf_counter() - t0) / iters
+    print(f"{tag:28s} itopk={itopk:3d} W={W} deg={deg or 64} tile={tile}: "
+          f"recall={rec:.4f} {dt*1e3:7.1f} ms -> {10000/dt:7,.0f} qps", flush=True)
+
+run("base", idx, 64, 4)
+run("it32w8", idx, 32, 8)
+run("it32w4", idx, 32, 4)
+run("it16w8", idx, 16, 8)
+run("it32w8d32", idx, 32, 8, deg=32)
+run("it32w4d32", idx, 32, 4, deg=32)
+run("it64w4d32", idx, 64, 4, deg=32)
+run("it32w8t4096", idx, 32, 8, tile=4096)
+run("it32w16", idx, 32, 16)
+run("it16w16d32", idx, 16, 16, deg=32)
+print("done", flush=True)

@@ -1,0 +1,42 @@
+"""CAGRA phase 2: optimized loop (inline norms, sort dedup) and int8
+traversal + exact re-rank, vs phase-1 numbers."""
+import sys, os, time
+sys.path.insert(0, "/root/repo")
+import numpy as np, jax, jax.numpy as jnp
+from raft_tpu.bench import dataset as dsm
+from raft_tpu.neighbors import cagra
+
+ds = dsm.make_synthetic("s", 1_000_000, 128, 10_000, seed=0)
+q = jnp.asarray(ds.queries)
+gt = np.load("/tmp/gt1m.npy")
+idx = cagra.load("/tmp/cagra1m.idx")
+codes, scale, zero = cagra._quantize_rows(idx.dataset)
+idx = idx.replace(dataset_q=codes, q_scale=scale, q_zero=zero)
+print("index ready (quantized)", flush=True)
+
+def run(tag, itopk, W, trav, deg=None, nseeds=0, iters=5):
+    ix = idx if deg is None else idx.replace(graph=idx.graph[:, :deg])
+    sp = cagra.SearchParams(itopk_size=itopk, search_width=W,
+                            traverse=trav, num_seeds=nseeds)
+    d, i = cagra.search(ix, q, 10, sp)
+    ids = np.asarray(jax.device_get(i))
+    rec = np.mean([len(set(gt[r]) & set(ids[r])) / 10 for r in range(len(gt))])
+    t0 = time.perf_counter()
+    outs = [cagra.search(ix, q, 10, sp) for _ in range(iters)]
+    jax.device_get([o[1][:1] for o in outs])
+    dt = (time.perf_counter() - t0) / iters
+    print(f"{tag:24s} itopk={itopk:3d} W={W:2d} {trav:4s} deg={deg or 64} "
+          f"seeds={nseeds}: recall={rec:.4f} {dt*1e3:7.1f} ms -> "
+          f"{10000/dt:7,.0f} qps", flush=True)
+
+run("f32-opt base", 64, 4, "f32")
+run("f32-opt it32w16", 32, 16, "f32")
+run("int8 base", 64, 4, "int8")
+run("int8 it32w16", 32, 16, "int8")
+run("int8 it32w8", 32, 8, "int8")
+run("int8 it16w16", 16, 16, "int8")
+run("int8 it16w8", 16, 8, "int8")
+run("int8 it32w16 s128", 32, 16, "int8", nseeds=128)
+run("int8 it16w16 s128", 16, 16, "int8", nseeds=128)
+run("int8 it24w12", 24, 12, "int8")
+print("done", flush=True)

@@ -1,0 +1,55 @@
+"""Clean A/B: dedup strategy x traversal dtype for CAGRA; plus IVF
+merge-recall fix check. Run ALONE on the chip."""
+import sys, os, time
+sys.path.insert(0, "/root/repo")
+import numpy as np, jax, jax.numpy as jnp
+from raft_tpu.bench import dataset as dsm
+from raft_tpu.neighbors import cagra, ivf_flat
+
+ds = dsm.make_synthetic("s", 1_000_000, 128, 10_000, seed=0)
+q = jnp.asarray(ds.queries)
+gt = np.load("/tmp/gt1m.npy")
+
+# --- IVF recall fix check first (loads its own index) ---
+idx_f = ivf_flat.load("/tmp/ivf1m.idx")
+for np_ in (16, 64):
+    sp = ivf_flat.SearchParams(n_probes=np_, scan_select="approx")
+    d, i = ivf_flat.search(idx_f, q, 10, sp)
+    ids = np.asarray(jax.device_get(i))
+    rec = np.mean([len(set(gt[r]) & set(ids[r])) / 10 for r in range(len(gt))])
+    t0 = time.perf_counter()
+    outs = [ivf_flat.search(idx_f, q, 10, sp) for _ in range(8)]
+    jax.device_get([o[1][:1] for o in outs])
+    dt = (time.perf_counter() - t0) / 8
+    print(f"ivf n_probes={np_}: recall={rec:.4f} {dt*1e3:6.1f} ms "
+          f"-> {10000/dt:,.0f} qps", flush=True)
+del idx_f
+
+idx = cagra.load("/tmp/cagra1m.idx")
+codes, scale, zero = cagra._quantize_rows(idx.dataset)
+idx = idx.replace(dataset_q=codes, q_scale=scale, q_zero=zero)
+print("cagra index ready", flush=True)
+
+def run(tag, itopk, W, trav, dedup, iters=5):
+    sp = cagra.SearchParams(itopk_size=itopk, search_width=W,
+                            traverse=trav, dedup=dedup)
+    d, i = cagra.search(idx, q, 10, sp)
+    ids = np.asarray(jax.device_get(i))
+    rec = np.mean([len(set(gt[r]) & set(ids[r])) / 10 for r in range(len(gt))])
+    t0 = time.perf_counter()
+    outs = [cagra.search(idx, q, 10, sp) for _ in range(iters)]
+    jax.device_get([o[1][:1] for o in outs])
+    dt = (time.perf_counter() - t0) / iters
+    print(f"{tag:26s} it={itopk:3d} W={W:2d} {trav:4s} {dedup:8s}: "
+          f"recall={rec:.4f} {dt*1e3:7.1f} ms -> {10000/dt:7,.0f} qps",
+          flush=True)
+
+run("A f32 pair", 64, 4, "f32", "pairwise")
+run("B f32 sort", 64, 4, "f32", "sort")
+run("C int8 pair", 64, 4, "int8", "pairwise")
+run("D int8 sort", 64, 4, "int8", "sort")
+run("E int8 pair it32w16", 32, 16, "int8", "pairwise")
+run("F int8 sort it32w16", 32, 16, "int8", "sort")
+run("G int8 pair it32w8", 32, 8, "int8", "pairwise")
+run("H int8 pair it16w16", 16, 16, "int8", "pairwise")
+print("done", flush=True)

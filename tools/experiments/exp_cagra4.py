@@ -1,0 +1,57 @@
+"""max_iterations sweep (early-stop traversal) + IVF merge-v3 check."""
+import sys, os, time
+sys.path.insert(0, "/root/repo")
+import numpy as np, jax, jax.numpy as jnp
+from raft_tpu.bench import dataset as dsm
+from raft_tpu.neighbors import cagra, ivf_flat
+
+ds = dsm.make_synthetic("s", 1_000_000, 128, 10_000, seed=0)
+q = jnp.asarray(ds.queries)
+gt = np.load("/tmp/gt1m.npy")
+
+idx_f = ivf_flat.load("/tmp/ivf1m.idx")
+for np_ in (16, 32, 64):
+    sp = ivf_flat.SearchParams(n_probes=np_, scan_select="approx")
+    d, i = ivf_flat.search(idx_f, q, 10, sp)
+    ids = np.asarray(jax.device_get(i))
+    rec = np.mean([len(set(gt[r]) & set(ids[r])) / 10 for r in range(len(gt))])
+    t0 = time.perf_counter()
+    outs = [ivf_flat.search(idx_f, q, 10, sp) for _ in range(8)]
+    jax.device_get([o[1][:1] for o in outs])
+    dt = (time.perf_counter() - t0) / 8
+    print(f"ivf-v3 n_probes={np_}: recall={rec:.4f} {dt*1e3:6.1f} ms "
+          f"-> {10000/dt:,.0f} qps", flush=True)
+del idx_f
+
+idx = cagra.load("/tmp/cagra1m.idx")
+codes, scale, zero = cagra._quantize_rows(idx.dataset)
+idx = idx.replace(dataset_q=codes, q_scale=scale, q_zero=zero)
+print("cagra ready", flush=True)
+
+def run(itopk, W, max_it, nseeds=0, iters=5):
+    sp = cagra.SearchParams(itopk_size=itopk, search_width=W,
+                            max_iterations=max_it, traverse="int8",
+                            num_seeds=nseeds)
+    d, i = cagra.search(idx, q, 10, sp)
+    ids = np.asarray(jax.device_get(i))
+    rec = np.mean([len(set(gt[r]) & set(ids[r])) / 10 for r in range(len(gt))])
+    t0 = time.perf_counter()
+    outs = [cagra.search(idx, q, 10, sp) for _ in range(iters)]
+    jax.device_get([o[1][:1] for o in outs])
+    dt = (time.perf_counter() - t0) / iters
+    print(f"it={itopk:3d} W={W:2d} max_it={max_it:2d} seeds={nseeds:4d}: "
+          f"recall={rec:.4f} {dt*1e3:7.1f} ms -> {10000/dt:7,.0f} qps",
+          flush=True)
+
+run(64, 4, 12)
+run(64, 4, 8)
+run(64, 8, 8)
+run(64, 8, 6)
+run(64, 16, 4)
+run(64, 16, 3)
+run(32, 16, 4)
+run(32, 16, 3)
+run(32, 8, 4)
+run(64, 8, 8, nseeds=128)
+run(64, 16, 4, nseeds=128)
+print("done", flush=True)

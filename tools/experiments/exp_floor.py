@@ -1,0 +1,92 @@
+"""Itemize the ~200ms fixed search floor (VERDICT r3 weak #4).
+
+Stages of a scan_select="approx" (segk) search on ivf_flat 1M x 128,
+B=10000, k=10 — each stage one jitted program (index arrays passed as
+ARGS, never captured), timed blocking vs pipelined (8-deep).
+"""
+import sys, os, time
+sys.path.insert(0, "/root/repo")
+import numpy as np, jax, jax.numpy as jnp
+from functools import partial
+from raft_tpu.neighbors import ivf_flat, ivf_common as ic
+from raft_tpu.ops import pallas_kernels as pk
+from raft_tpu.matrix import select_k as _select_k
+from raft_tpu.distance.types import DistanceType
+
+idx = ivf_flat.load("/tmp/ivf1m.idx")
+q = jnp.asarray(np.load("/tmp/q1m.npy"))
+B = q.shape[0]
+n_lists, L, d = idx.packed_data.shape
+print(f"index: n_lists={n_lists} L={L} d={d} B={B}", flush=True)
+
+def timeit(tag, fn, *args, iters=10):
+    out = fn(*args); jax.device_get(jax.tree_util.tree_leaves(out)[-1][:1])
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        out = fn(*args)
+        jax.device_get(jax.tree_util.tree_leaves(out)[-1][:1])
+    blk = (time.perf_counter() - t0) / iters
+    t0 = time.perf_counter()
+    outs = [fn(*args) for _ in range(iters)]
+    jax.device_get([jax.tree_util.tree_leaves(o)[-1][:1] for o in outs])
+    pip = (time.perf_counter() - t0) / iters
+    print(f"{tag:30s} block={blk*1e3:8.1f} ms  pipe={pip*1e3:8.1f} ms", flush=True)
+    return blk, pip
+
+MT = DistanceType.L2Expanded
+
+for n_probes in (16, 64):
+    seg = ic.SEGMENT_SIZE
+    pairs = B * n_probes
+    n_seg = ic.n_segments(pairs, n_lists, seg)
+    k = 10
+    kk = min(k, L)
+    print(f"--- n_probes={n_probes} n_seg={n_seg} ---", flush=True)
+
+    @jax.jit
+    def s0(qq, centers):
+        coarse, cmin = ivf_flat._coarse_distances(qq, centers, MT)
+        _, probes = _select_k(coarse, n_probes, select_min=cmin)
+        return probes
+
+    @jax.jit
+    def s0a(qq, centers):
+        coarse, cmin = ivf_flat._coarse_distances(qq, centers, MT)
+        _, probes = jax.lax.approx_min_k(coarse, n_probes, recall_target=0.95)
+        return probes
+
+    @jax.jit
+    def s1(qq, centers):
+        probes = s0(qq, centers)
+        return ic.segment_probes(probes, n_lists, seg, n_seg)
+
+    @jax.jit
+    def s2(qq, centers):
+        seg_list, seg_q, pair_seg, pair_slot = s1(qq, centers)
+        return qq[jnp.clip(seg_q, 0, B - 1)], seg_list
+
+    @jax.jit
+    def s3(qq, centers, packed, pids):
+        seg_list, seg_q, pair_seg, pair_slot = s1(qq, centers)
+        qv_all = qq[jnp.clip(seg_q, 0, B - 1)]
+        keys, kids = pk.segmented_scan_topk(seg_list, qv_all, packed, pids, "l2")
+        return keys
+
+    @jax.jit
+    def s4(qq, centers, packed, pids):
+        seg_list, seg_q, pair_seg, pair_slot = s1(qq, centers)
+        qv_all = qq[jnp.clip(seg_q, 0, B - 1)]
+        keys, kids = pk.segmented_scan_topk(seg_list, qv_all, packed, pids, "l2")
+        return ic.merge_bin_results(keys, kids, pair_seg, pair_slot, k, kk,
+                                    True, jnp.inf, 0.95, _select_k)
+
+    timeit("S0 coarse+selectk", s0, q, idx.centers)
+    timeit("S0a coarse+approx_min_k", s0a, q, idx.centers)
+    timeit("S1 +segment_probes", s1, q, idx.centers)
+    timeit("S2 +qv gather", s2, q, idx.centers)
+    timeit("S3 +segk kernel", s3, q, idx.centers, idx.packed_data, idx.packed_ids)
+    timeit("S4 +merge (full)", s4, q, idx.centers, idx.packed_data, idx.packed_ids)
+    fn = lambda: ivf_flat.search(idx, q, 10, ivf_flat.SearchParams(
+        n_probes=n_probes, scan_select="approx"))
+    timeit("api search()", fn)
+print("done", flush=True)

@@ -1,0 +1,90 @@
+"""Gather microbench for CAGRA traversal redesign (VERDICT r3 next #1).
+
+Per-op DEVICE time via chained data-dependent iterations inside one jit
+(difference of two iteration counts — RPC floor cancels).
+
+Questions:
+  A  x[ids] f32 [1M,128], 262144 random rows (one traversal iter,
+     1024 q x W4 x deg64)             -> row-count or byte bound?
+  B  same ids, int8 rows              -> does 4x fewer bytes help?
+  C  neighbor-table: 4096 rows x 8448B int8 (deg64 int8 vecs + ids)
+  C2 neighbor-table: 4096 rows x 2176B int8 (deg16)
+  D  f32 4096 rows (plain few-rows gather, 512B)
+  E  einsum cost on [1024, 256, 128] rows (traversal compute share)
+"""
+import sys, time
+sys.path.insert(0, "/root/repo")
+import numpy as np, jax, jax.numpy as jnp
+from functools import partial
+from jax import lax
+
+rng = np.random.default_rng(0)
+n, d = 1_000_000, 128
+
+@partial(jax.jit, static_argnames=("iters",))
+def chain_gather(x, ids, iters):
+    n = x.shape[0]
+    def body(i, carry):
+        ids, acc = carry
+        rows = x[ids]
+        s = jnp.sum(rows.astype(jnp.float32))
+        ids = (ids + (s.astype(jnp.int32) & 7) + 1) % n
+        return ids, acc + s
+    ids, acc = lax.fori_loop(0, iters, body, (ids, jnp.float32(0)))
+    return acc
+
+@partial(jax.jit, static_argnames=("iters",))
+def chain_einsum(q, rows, iters):
+    def body(i, carry):
+        rows, acc = carry
+        s = jnp.einsum("td,tcd->tc", q, rows,
+                       precision=lax.Precision.HIGHEST,
+                       preferred_element_type=jnp.float32)
+        tot = jnp.sum(s)
+        rows = rows + (tot * 1e-30)
+        return rows, acc + tot
+    rows, acc = lax.fori_loop(0, iters, body, (rows, jnp.float32(0)))
+    return acc
+
+def dev_time(tag, fn, *args, bytes_moved=None, lo=2, hi=12):
+    t = {}
+    for it in (lo, hi):
+        out = fn(*args, iters=it); jax.device_get(out)
+        t0 = time.perf_counter()
+        for _ in range(3):
+            out = fn(*args, iters=it)
+        jax.device_get(out)
+        t[it] = (time.perf_counter() - t0) / 3
+    per = (t[hi] - t[lo]) / (hi - lo)
+    bw = f"  {bytes_moved/per/1e9:8.1f} GB/s" if bytes_moved else ""
+    print(f"{tag:42s} {per*1e3:9.2f} ms/op{bw}", flush=True)
+    return per
+
+x32 = jnp.asarray(rng.standard_normal((n, d), dtype=np.float32))
+x8 = jnp.asarray(rng.integers(-127, 127, (n, d), dtype=np.int8))
+ids_big = jnp.asarray(rng.integers(0, n, 262144, dtype=np.int32))
+ids_4k = jnp.asarray(rng.integers(0, n, 4096, dtype=np.int32))
+
+dev_time("A  f32 262144x512B rows", chain_gather, x32, ids_big,
+         bytes_moved=262144*512)
+dev_time("B  int8 262144x128B rows", chain_gather, x8, ids_big,
+         bytes_moved=262144*128)
+dev_time("D  f32 4096x512B rows", chain_gather, x32, ids_4k,
+         bytes_moved=4096*512)
+
+nt = 250_000
+tbl64 = jnp.asarray(rng.integers(-127, 127, (nt, 8448), dtype=np.int8))
+ids_nt = jnp.asarray(rng.integers(0, nt, 4096, dtype=np.int32))
+dev_time("C  nbr-table 4096x8448B rows", chain_gather, tbl64, ids_nt,
+         bytes_moved=4096*8448)
+tbl16 = jnp.asarray(rng.integers(-127, 127, (nt, 2176), dtype=np.int8))
+dev_time("C2 nbr-table 4096x2176B rows", chain_gather, tbl16, ids_nt,
+         bytes_moved=4096*2176)
+tblf = jnp.asarray(rng.standard_normal((nt, 2112), dtype=np.float32))
+dev_time("C3 nbr-table f32 4096x8448B rows", chain_gather, tblf, ids_nt,
+         bytes_moved=4096*8448)
+
+q = jnp.asarray(rng.standard_normal((1024, d), dtype=np.float32))
+rows = jnp.asarray(rng.standard_normal((1024, 256, d), dtype=np.float32))
+dev_time("E  einsum tq,tcd 1024x256x128", chain_einsum, q, rows)
+print("done", flush=True)

@@ -1,0 +1,59 @@
+"""Hard-synthetic calibration at 1M + spill build effect on the easy set."""
+import sys, os, time
+sys.path.insert(0, "/root/repo")
+import numpy as np, jax, jax.numpy as jnp
+from raft_tpu.bench import dataset as dsm
+from raft_tpu.neighbors import ivf_flat, brute_force
+
+def recall_of(ids, gt):
+    return float(np.mean([len(set(gt[r]) & set(ids[r])) / ids.shape[1]
+                          for r in range(len(gt))]))
+
+def sweep(tag, idx, q, gt, probes=(16, 32, 64, 128)):
+    for np_ in probes:
+        sp = ivf_flat.SearchParams(n_probes=np_, scan_select="approx")
+        d, i = ivf_flat.search(idx, q, 10, sp)
+        ids = np.asarray(jax.device_get(i))
+        rec = recall_of(ids, gt)
+        t0 = time.perf_counter()
+        outs = [ivf_flat.search(idx, q, 10, sp) for _ in range(6)]
+        jax.device_get([o[1][:1] for o in outs])
+        dt = (time.perf_counter() - t0) / 6
+        print(f"{tag} np={np_:3d}: recall={rec:.4f} {dt*1e3:6.1f} ms "
+              f"-> {10000/dt:,.0f} qps", flush=True)
+
+# --- easy set: spill build vs r4 non-spill numbers ---
+ds = dsm.make_synthetic("easy", 1_000_000, 128, 10_000, seed=0)
+q = jnp.asarray(ds.queries)
+gt = np.load("/tmp/gt1m.npy")
+t0 = time.time()
+idx = ivf_flat.build(jnp.asarray(ds.base),
+                     ivf_flat.IndexParams(n_lists=1024, spill=True,
+                                          list_size_cap_factor=1.5))
+print(f"easy spill build {time.time()-t0:.0f}s L={idx.max_list_size}",
+      flush=True)
+sweep("easy-spill", idx, q, gt, probes=(16, 32, 64))
+del idx
+
+# --- hard set ---
+ds_h = dsm.make_synthetic("hard", 1_000_000, 128, 10_000, seed=0, hard=True)
+qh = jnp.asarray(ds_h.queries)
+GT_H = "/tmp/gt1m_hard.npy"
+if os.path.exists(GT_H):
+    gth = np.load(GT_H)
+else:
+    bf = brute_force.build(jnp.asarray(ds_h.base))
+    t0 = time.time()
+    _, ids = brute_force.knn(bf, qh, 10)
+    gth = np.asarray(jax.device_get(ids))
+    print(f"hard GT {time.time()-t0:.0f}s", flush=True)
+    np.save(GT_H, gth)
+    del bf
+t0 = time.time()
+idxh = ivf_flat.build(jnp.asarray(ds_h.base),
+                      ivf_flat.IndexParams(n_lists=1024, spill=True,
+                                           list_size_cap_factor=1.5))
+print(f"hard build {time.time()-t0:.0f}s L={idxh.max_list_size}", flush=True)
+ivf_flat.save(idxh, "/tmp/ivf1m_hard.idx")
+sweep("hard", idxh, qh, gth)
+print("done", flush=True)

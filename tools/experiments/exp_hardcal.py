@@ -1,0 +1,69 @@
+"""Calibrate make_synthetic_hard: recall curve must RISE with n_probes
+and land ~0.95 at np=32-64. Sweep (overlap, noise) at 200K."""
+import sys, time
+sys.path.insert(0, "/root/repo")
+import numpy as np, jax, jax.numpy as jnp
+from raft_tpu.bench import dataset as dsm
+from raft_tpu.neighbors import ivf_flat, brute_force
+
+def curve(tag, ds):
+    q = jnp.asarray(ds.queries)
+    bf = brute_force.build(jnp.asarray(ds.base))
+    _, g = brute_force.knn(bf, q, 10)
+    gt = np.asarray(jax.device_get(g))
+    del bf
+    idx = ivf_flat.build(jnp.asarray(ds.base),
+                         ivf_flat.IndexParams(n_lists=512, spill=True,
+                                              list_size_cap_factor=1.5,
+                                              kmeans_n_iters=10))
+    out = []
+    for np_ in (8, 16, 32, 64):
+        _, i = ivf_flat.search(idx, q, 10, ivf_flat.SearchParams(
+            n_probes=np_, scan_select="approx"))
+        ids = np.asarray(jax.device_get(i))
+        rec = np.mean([len(set(gt[r]) & set(ids[r])) / 10
+                       for r in range(len(gt))])
+        out.append(f"{np_}:{rec:.3f}")
+    print(f"{tag}: " + " ".join(out), flush=True)
+
+import raft_tpu.bench.dataset as dm
+
+for overlap, noise in ((1.0, 0.35), (0.7, 0.35), (0.6, 0.5), (0.8, 0.6)):
+    orig = dm.make_synthetic_hard
+
+    def patched(name, n, dim, n_queries, metric="sqeuclidean", seed=0,
+                n_centers=0, lid=16, overlap=overlap, _noise=noise):
+        rng = np.random.default_rng(seed)
+        if not n_centers:
+            n_centers = max(64, int(np.sqrt(n)))
+        centers = rng.standard_normal((n_centers, dim)).astype(np.float32)
+        sub = centers[rng.choice(n_centers, min(n_centers, 256),
+                                 replace=False)]
+        d2 = (np.sum(centers**2, 1)[:, None] + np.sum(sub**2, 1)[None, :]
+              - 2.0 * centers @ sub.T)
+        np.clip(d2, 0, None, out=d2)
+        d2[d2 < 1e-6] = np.inf
+        nearest = np.sqrt(d2.min(axis=1))
+        lid = min(lid, dim)
+        bases = rng.standard_normal((n_centers, dim, lid)).astype(np.float32)
+        bases /= np.linalg.norm(bases, axis=1, keepdims=True)
+        scale = (overlap * nearest / np.sqrt(lid)).astype(np.float32)
+
+        def sample(m, assign):
+            z = rng.standard_normal((m, lid)).astype(np.float32)
+            z *= scale[assign][:, None]
+            pts = centers[assign] + np.einsum("mdl,ml->md", bases[assign], z)
+            pts += (_noise * scale[assign][:, None] / np.sqrt(dim) * np.sqrt(lid)
+                    * rng.standard_normal((m, dim)).astype(np.float32))
+            return pts.astype(np.float32)
+
+        assign = rng.integers(0, n_centers, n)
+        base = sample(n, assign)
+        q_assign = rng.integers(0, n_centers, n_queries)
+        queries = sample(n_queries, q_assign)
+        return dm.Dataset(name=name, base=base, queries=queries,
+                          metric=metric)
+
+    ds = patched("h", 200_000, 128, 2000)
+    curve(f"ov={overlap} noise={noise}", ds)
+print("calib done", flush=True)

@@ -1,0 +1,203 @@
+"""Cost of each merge_bin_results / segment_probes sub-op (device time
+via chained data-dependent iterations)."""
+import sys, time
+sys.path.insert(0, "/root/repo")
+import numpy as np, jax, jax.numpy as jnp
+from functools import partial
+from jax import lax
+
+rng = np.random.default_rng(0)
+
+def dev_time(tag, make_fn, lo=2, hi=12):
+    fn = make_fn()
+    t = {}
+    for it in (lo, hi):
+        out = fn(it); jax.device_get(out)
+        t0 = time.perf_counter()
+        for _ in range(3):
+            out = fn(it)
+        jax.device_get(out)
+        t[it] = (time.perf_counter() - t0) / 3
+    per = (t[hi] - t[lo]) / (hi - lo)
+    print(f"{tag:46s} {per*1e3:9.2f} ms/op", flush=True)
+    return per
+
+def chained(body, x0):
+    @partial(jax.jit, static_argnames=("iters",))
+    def run(x, iters):
+        def step(i, carry):
+            x, acc = carry
+            out = body(x)
+            s = jnp.sum(out[0].astype(jnp.float32)) if isinstance(out, tuple) \
+                else jnp.sum(out.astype(jnp.float32))
+            x = x + (s * 1e-30).astype(x.dtype) if x.dtype.kind == "f" else x
+            return x, acc + s
+        # jnp dtype kind hack fails under trace; specialize per-caller
+        return lax.fori_loop(0, iters, step, (x, jnp.float32(0)))[1]
+    return lambda iters: run(x0, iters)
+
+for B, P in ((10000, 16), (10000, 64)):
+    n_lists, seg = 1024, 128
+    BP = B * P
+    n_seg = BP // seg + n_lists
+    print(f"--- B={B} P={P} n_seg={n_seg} BP={BP} ---", flush=True)
+
+    keys = jnp.asarray(rng.standard_normal((n_seg * seg, 256)).astype(np.float32))
+    kids = jnp.asarray(rng.integers(0, 1_000_000, (n_seg * seg, 256), dtype=np.int32))
+
+    def mk_a():
+        @partial(jax.jit, static_argnames=("iters",))
+        def f(keys, iters):
+            def step(i, carry):
+                keys, acc = carry
+                mk, sel = lax.approx_min_k(keys, 10, recall_target=0.95)
+                s = jnp.sum(mk)
+                return keys + s * 1e-30, acc + s
+            return lax.fori_loop(0, iters, step, (keys, jnp.float32(0)))[1]
+        return lambda it: f(keys, it)
+    dev_time(f"a approx_min_k [{n_seg*seg},256] k10", mk_a)
+
+    sel = jnp.asarray(rng.integers(0, 256, (n_seg * seg, 10), dtype=np.int32))
+    def mk_b():
+        @partial(jax.jit, static_argnames=("iters",))
+        def f(kids, sel, iters):
+            def step(i, carry):
+                sel, acc = carry
+                out = jnp.take_along_axis(kids, sel, axis=1)
+                s = jnp.sum(out)
+                sel = (sel + (s & 1)) % 256
+                return sel, acc + s
+            return lax.fori_loop(0, iters, step, (sel, jnp.int32(0)))[1]
+        return lambda it: f(kids, sel, it)
+    dev_time(f"b take_along_axis [{n_seg*seg},256]->10", mk_b)
+
+    vals3 = jnp.asarray(rng.standard_normal((n_seg, seg, 10)).astype(np.float32))
+    pair_seg = jnp.asarray(rng.integers(0, n_seg, (B, P), dtype=np.int32))
+    pair_slot = jnp.asarray(rng.integers(0, seg, (B, P), dtype=np.int32))
+    def mk_c():
+        @partial(jax.jit, static_argnames=("iters",))
+        def f(vals3, ps, sl, iters):
+            def step(i, carry):
+                ps, acc = carry
+                out = vals3[ps, sl]                      # [B, P, 10]
+                s = jnp.sum(out)
+                ps = (ps + (s.astype(jnp.int32) & 1)) % n_seg
+                return ps, acc + s
+            return lax.fori_loop(0, iters, step, (ps, jnp.float32(0)))[1]
+        return lambda it: f(vals3, pair_seg, pair_slot, it)
+    dev_time(f"c pair gather [{B},{P},10]", mk_c)
+
+    pv = jnp.asarray(rng.standard_normal((B, P * 10)).astype(np.float32))
+    def mk_d():
+        @partial(jax.jit, static_argnames=("iters",))
+        def f(pv, iters):
+            def step(i, carry):
+                pv, acc = carry
+                v, ix = lax.top_k(-pv, 10)
+                s = jnp.sum(v)
+                return pv + s * 1e-30, acc + s
+            return lax.fori_loop(0, iters, step, (pv, jnp.float32(0)))[1]
+        return lambda it: f(pv, it)
+    dev_time(f"d top_k [{B},{P*10}] k10", mk_d)
+
+    lf = jnp.asarray(rng.integers(0, n_lists, (BP,), dtype=np.int32))
+    def mk_e():
+        @partial(jax.jit, static_argnames=("iters",))
+        def f(lf, iters):
+            def step(i, carry):
+                lf, acc = carry
+                order = jnp.argsort(lf, stable=True)
+                s = jnp.sum(order)
+                lf = (lf + (s & 1)) % n_lists
+                return lf, acc + s
+            return lax.fori_loop(0, iters, step, (lf, jnp.int32(0)))[1]
+        return lambda it: f(lf, it)
+    dev_time(f"e argsort stable [{BP}] i32", mk_e)
+
+    def mk_f():
+        @partial(jax.jit, static_argnames=("iters",))
+        def f(lf, iters):
+            iota = jnp.arange(BP, dtype=jnp.int32)
+            def step(i, carry):
+                lf, acc = carry
+                sl, order = lax.sort_key_val(lf, iota)
+                s = jnp.sum(sl) + order[0]
+                lf = (lf + (s & 1)) % n_lists
+                return lf, acc + s
+            return lax.fori_loop(0, iters, step, (lf, jnp.int32(0)))[1]
+        return lambda it: f(lf, it)
+    dev_time(f"f sort_key_val [{BP}] i32", mk_f)
+
+    big = jnp.asarray(rng.integers(0, 10000, (BP,), dtype=np.int32))
+    idxs = jnp.asarray(rng.integers(0, BP, (BP,), dtype=np.int32))
+    def mk_g():
+        @partial(jax.jit, static_argnames=("iters",))
+        def f(big, idxs, iters):
+            def step(i, carry):
+                idxs, acc = carry
+                out = big[idxs]
+                s = jnp.sum(out)
+                idxs = (idxs + (s & 1)) % BP
+                return idxs, acc + s
+            return lax.fori_loop(0, iters, step, (idxs, jnp.int32(0)))[1]
+        return lambda it: f(big, idxs, it)
+    dev_time(f"g scalar gather [{BP}] from [{BP}]", mk_g)
+
+    i0 = jnp.asarray(np.sort(rng.integers(0, BP - seg, n_seg)).astype(np.int32))
+    def mk_h():
+        @partial(jax.jit, static_argnames=("iters",))
+        def f(big, i0, iters):
+            def step(i, carry):
+                i0, acc = carry
+                out = jax.vmap(lambda s: lax.dynamic_slice(big, (s,), (seg,)))(i0)
+                s = jnp.sum(out)
+                i0 = (i0 + (s & 1)) % (BP - seg)
+                return i0, acc + s
+            return lax.fori_loop(0, iters, step, (i0, jnp.int32(0)))[1]
+        return lambda it: f(big, i0, it)
+    dev_time(f"h vmap dyn_slice [{n_seg},{seg}] windows", mk_h)
+
+# trivial dispatch: per-program floor
+x = jnp.ones((8, 128), jnp.float32)
+f0 = jax.jit(lambda x: x + 1.0)
+jax.device_get(f0(x))
+t0 = time.perf_counter()
+outs = [f0(x) for _ in range(50)]
+jax.device_get(outs)
+print(f"trivial program pipelined: {(time.perf_counter()-t0)/50*1e3:.2f} ms/call", flush=True)
+t0 = time.perf_counter()
+for _ in range(20):
+    jax.device_get(f0(x))
+print(f"trivial program blocking:  {(time.perf_counter()-t0)/20*1e3:.2f} ms/call", flush=True)
+
+# coarse matmul alone, top_k over coarse alone
+q = jnp.asarray(rng.standard_normal((10000, 128)).astype(np.float32))
+c = jnp.asarray(rng.standard_normal((1024, 128)).astype(np.float32))
+@partial(jax.jit, static_argnames=("iters",))
+def mm(q, c, iters):
+    def step(i, carry):
+        q, acc = carry
+        g = lax.dot_general(q, c, (((1,), (1,)), ((), ())),
+                            precision=lax.Precision.HIGHEST,
+                            preferred_element_type=jnp.float32)
+        s = jnp.sum(g)
+        return q + s * 1e-30, acc + s
+    return lax.fori_loop(0, iters, step, (q, jnp.float32(0)))[1]
+def mk_mm():
+    return lambda it: mm(q, c, it)
+dev_time("coarse matmul [10000,128]x[1024,128]", mk_mm)
+
+coarse = jnp.asarray(rng.standard_normal((10000, 1024)).astype(np.float32))
+@partial(jax.jit, static_argnames=("iters", "k"))
+def tk(coarse, iters, k):
+    def step(i, carry):
+        coarse, acc = carry
+        v, ix = lax.top_k(coarse, k)
+        s = jnp.sum(v)
+        return coarse + s * 1e-30, acc + s
+    return lax.fori_loop(0, iters, step, (coarse, jnp.float32(0)))[1]
+for k in (16, 64):
+    def mk_tk(k=k):
+        return lambda it: tk(coarse, it, k)
+    dev_time(f"top_k [10000,1024] k{k}", mk_tk)
+print("done", flush=True)
