@@ -62,6 +62,15 @@ class IndexParams:
     # and "nn_descent" (GNND).
     build_algo: str = "auto"  # | "cluster" | "ivf_pq" | "nn_descent"
     nn_descent_niter: int = 20
+    # cluster build algo tuning (cluster_knn_graph): each row's exact
+    # candidate scan covers the members of its list's `knn_neighborhood`
+    # nearest lists of ~`knn_rows_per_list` rows each. On data whose
+    # true neighborhoods span many kmeans cells (e.g. thousands of tiny
+    # natural clusters), 16 lists cover only ~0.89 of true edges —
+    # raising the neighborhood (or shrinking lists) trades build FLOPs
+    # for graph recall exactly like IVF n_probes at search time
+    knn_rows_per_list: int = 1024
+    knn_neighborhood: int = 16
     # graph-BUILD dimensionality: 0 = full-d; "auto" (-1) projects
     # wide datasets (d > 256) onto a random orthonormal 128-d basis
     # for the candidate scans only — the cluster-blocked build's block
@@ -101,6 +110,12 @@ class SearchParams:
     query_tile: int = 1024
     seed: int = 0             # entry-point sampling (rand_xor_mask analog)
     num_seeds: int = 0        # 0 → auto (see class docstring)
+    # cluster-seeded entries: how many nearest clusters contribute
+    # entry points (indexes built by the cluster algo). On many-tiny-
+    # cluster data the query's true neighborhood spans more kmeans
+    # cells than 4 — raising this widens initial coverage the same way
+    # n_probes does for IVF (cost: entry_clusters·E seed distances)
+    entry_clusters: int = 4
     # traversal dataset precision: "auto" uses the index's int8
     # scalar-quantized rows when present (the CAGRA-Q direction —
     # traversal is HBM-gather-bound, int8 rows move 4× fewer bytes,
@@ -492,6 +507,8 @@ def build(dataset: jax.Array, params: Optional[IndexParams] = None) -> CagraInde
     elif algo == "cluster":
         knn, centers, entry_ids = cluster_knn_graph(
             x_build, inter_d, metric=mt.value, seed=params.seed,
+            rows_per_list=params.knn_rows_per_list,
+            neighborhood=params.knn_neighborhood,
             return_entries=True,
             centers_from=x if x_build is not x else None)
     else:
@@ -511,12 +528,13 @@ def build(dataset: jax.Array, params: Optional[IndexParams] = None) -> CagraInde
 
 @partial(jax.jit, static_argnames=("k", "itopk_size", "search_width",
                                    "max_iterations", "query_tile", "seed",
-                                   "num_seeds", "use_q", "dedup"))
+                                   "num_seeds", "use_q", "dedup",
+                                   "entry_clusters"))
 def _search_impl(index: CagraIndex, queries: jax.Array, k: int,
                  itopk_size: int, search_width: int, max_iterations: int,
                  query_tile: int, seed: int = 0, num_seeds: int = 0,
                  use_q: bool = False, dedup: str = "pairwise",
-                 filter_bits=None):
+                 filter_bits=None, entry_clusters: int = 4):
     mt = resolve_metric(index.metric)
     ip = mt == DistanceType.InnerProduct
     sqrt_out = mt == DistanceType.L2SqrtExpanded
@@ -570,7 +588,7 @@ def _search_impl(index: CagraIndex, queries: jax.Array, k: int,
                             precision=get_precision(),
                             preferred_element_type=jnp.float32)
             c_score = qc if ip else 2.0 * qc - jnp.sum(cts * cts, 1)[None, :]
-            c_sel = min(4, cts.shape[0])
+            c_sel = min(entry_clusters, cts.shape[0])
             _, top_l = lax.top_k(c_score, c_sel)           # [t, c_sel]
             ent = index.entry_ids[top_l].reshape(t, -1)    # [t, c_sel·E]
             n_rand = max(num_seeds or max(itopk_size, 512), itopk_size)
@@ -771,7 +789,8 @@ def search(index: CagraIndex, queries: jax.Array, k: int,
     return _search_impl(index, queries, k, itopk, params.search_width,
                         max_it, params.query_tile, seed=params.seed,
                         num_seeds=params.num_seeds, use_q=use_q,
-                        dedup=params.dedup, filter_bits=filter_bitset)
+                        dedup=params.dedup, filter_bits=filter_bitset,
+                        entry_clusters=params.entry_clusters)
 
 
 # ---------------------------------------------------------------------------

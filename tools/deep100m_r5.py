@@ -26,7 +26,6 @@ IDX = os.path.join(ROOT, "pq.idx")
 GT10K = os.path.join(ROOT, "gt10k.npy")
 RES = os.path.join(ROOT, "results_r5.json")
 N, D, NQ = 100_000_000, 96, 10_000
-QB = 2000   # query batch for the PQ search (HBM bound, see search_deep100m)
 
 prov = dsm.DeviceSyntheticChunks(N, D, n_centers=10_000, seed=7)
 # round-4's cached queries are the truth — do NOT regenerate (the
@@ -103,8 +102,12 @@ def refine_chunked(cand, k, max_rows=5_000_000):
         iv.append(np.asarray(jax.device_get(i_)))
     return np.concatenate(dv), np.concatenate(iv)
 
-CONFIGS = [(32, 100), (32, 400), (64, 400), (64, 1000), (128, 400)]
-for n_probes, k_cand in CONFIGS:
+# (n_probes, k_cand, query_batch): the candidate tables scale with
+# k_cand·QB, so big-k configs run smaller query batches (k=400 at
+# QB=2000 exhausted HBM beside the 10.9 GB index)
+CONFIGS = [(32, 100, 2000), (32, 400, 500), (64, 400, 500),
+           (64, 1000, 250), (128, 400, 500)]
+for n_probes, k_cand, QB in CONFIGS:
     if (n_probes, k_cand) in done:
         print(f"np={n_probes} k_cand={k_cand}: cached, skip", flush=True)
         continue
@@ -131,7 +134,7 @@ for n_probes, k_cand in CONFIGS:
         jax.device_get([o[:1] for o in outs])
         search_dt = (time.perf_counter() - t0) / 3
         qps = NQ / (search_dt + refine_dt)
-        row = {"n_probes": n_probes, "k_cand": k_cand,
+        row = {"n_probes": n_probes, "k_cand": k_cand, "query_batch": QB,
                "cand_recall": round(crec, 4), "recall": round(rec, 4),
                "qps": round(qps, 1),
                "search_ms": round(search_dt * 1e3, 1),

@@ -1,0 +1,214 @@
+"""Brute-force bf16 route (VERDICT r5 #6): where does the time go, and
+can bf16 Gram + f32 norms + exact f32 re-rank beat 21K q/s at recall 1?
+
+r4's strided-bin cut got 21.1K q/s (1.57x r3) — ~5.4 effective TFLOP/s
+on a ~197 bf16-TFLOP/s chip. Pure-bf16 RANKING is known-bad (recall
+0.998->0.67, design notes) but was never tried as a CANDIDATE
+GENERATOR with an exact re-rank. Variants measured here:
+
+  base   current knn(impl=auto)            [exact baseline]
+  mm32   scan, f32-HIGHEST matmul only     [matmul share of base]
+  mmbf   scan, bf16 matmul only            [matmul floor]
+  v2     scan: bf16 Gram + bins cut + C-wide running merge
+         -> gather top-C rows -> exact f32 re-rank   [candidate design]
+  v3     query-tiled FULL-WIDTH bf16 block + depth-4 strided bins
+         (no per-tile merge at all) -> exact f32 re-rank
+
+Exactness: recall vs impl="sort" groundtruth over all 10K queries must
+be 1.0000 (the VERDICT acceptance), plus a margin histogram: how close
+the worst surviving candidate came to the cut.
+"""
+import os, sys, time
+sys.path.insert(0, "/root/repo")
+import numpy as np, jax, jax.numpy as jnp
+from jax import lax
+from functools import partial
+from raft_tpu.bench import dataset as dsm
+from raft_tpu.neighbors import brute_force as bf
+from raft_tpu.matrix import select_k as _select_k
+
+N, NQ, K, D, SEED = 1_000_000, 10_000, 10, 128, 0
+GT = f"/tmp/gt_hard_{N}x{D}_q{NQ}_s{SEED}.npy"  # keyed: stale GT from a
+# different dataset config must never replay silently
+
+print("generating hard set...", flush=True)
+ds = dsm.make_synthetic_hard("hard1m", N, D, NQ, seed=SEED)
+x = jnp.asarray(ds.base)
+q = jnp.asarray(ds.queries)
+jax.device_get(x[:1, :1])
+
+if os.path.exists(GT):
+    gt = np.load(GT)
+else:
+    t0 = time.time()
+    idx = bf.build(x, metric="sqeuclidean")
+    _, ids = bf.knn(idx, q, K, impl="sort")
+    gt = np.asarray(jax.device_get(ids))
+    np.save(GT, gt)
+    print(f"GT in {time.time()-t0:.0f}s", flush=True)
+
+x_sq = jnp.sum(x.astype(jnp.float32) ** 2, axis=1)
+q_sq = jnp.sum(q.astype(jnp.float32) ** 2, axis=1)
+
+
+def timeit(fn, *args, reps=3):
+    out = fn(*args)                      # compile + correctness capture
+    jax.device_get(jax.tree_util.tree_leaves(out)[0].ravel()[:1])
+    t0 = time.perf_counter()
+    outs = [fn(*args) for _ in range(reps)]
+    jax.device_get([jax.tree_util.tree_leaves(o)[0].ravel()[:1]
+                    for o in outs])
+    return out, (time.perf_counter() - t0) / reps
+
+
+def recall_of(ids):
+    ids = np.asarray(jax.device_get(ids))
+    return float(np.mean([len(set(gt[r]) & set(ids[r])) / K
+                          for r in range(NQ)]))
+
+
+# --- baseline ---------------------------------------------------------
+idx = bf.build(x, metric="sqeuclidean")
+(dv, iv), dt = timeit(lambda: bf.knn(idx, q, K))
+print(f"base: {NQ/dt:8,.0f} q/s  recall={recall_of(iv):.4f}", flush=True)
+
+IT = 16384
+n_tiles = -(-N // IT)
+pad = n_tiles * IT - N
+xp = jnp.pad(x.astype(jnp.float32), ((0, pad), (0, 0)))
+xp_sq = jnp.pad(x_sq, (0, pad), constant_values=jnp.inf)
+x_bf = xp.astype(jnp.bfloat16)
+q_bf = q.astype(jnp.bfloat16)
+
+
+# --- matmul-only probes ----------------------------------------------
+@jax.jit
+def mm32():
+    blocks = xp.reshape(n_tiles, IT, D)
+
+    def step(carry, blk):
+        g = lax.dot_general(q.astype(jnp.float32), blk,
+                            (((1,), (1,)), ((), ())),
+                            precision=lax.Precision.HIGHEST,
+                            preferred_element_type=jnp.float32)
+        return carry + jnp.sum(g[:, :8], axis=1), None
+
+    acc, _ = lax.scan(step, jnp.zeros((NQ,), jnp.float32), blocks)
+    return acc
+
+
+@jax.jit
+def mmbf():
+    blocks = x_bf.reshape(n_tiles, IT, D)
+
+    def step(carry, blk):
+        g = lax.dot_general(q_bf, blk, (((1,), (1,)), ((), ())),
+                            preferred_element_type=jnp.float32)
+        return carry + jnp.sum(g[:, :8], axis=1), None
+
+    acc, _ = lax.scan(step, jnp.zeros((NQ,), jnp.float32), blocks)
+    return acc
+
+
+_, dt = timeit(mm32)
+print(f"mm32 (matmul-only scan): {dt*1e3:6.0f} ms", flush=True)
+_, dt = timeit(mmbf)
+print(f"mmbf (matmul-only scan): {dt*1e3:6.0f} ms", flush=True)
+
+
+# --- v2: bf16 scan + C-wide merge + exact refine ---------------------
+@partial(jax.jit, static_argnames=("C",))
+def v2_candidates(C: int):
+    blocks = x_bf.reshape(n_tiles, IT, D)
+    sqb = xp_sq.reshape(n_tiles, IT)
+
+    def step(carry, inp):
+        best_v, best_i = carry
+        blk, sq, base = inp
+        g = lax.dot_general(q_bf, blk, (((1,), (1,)), ((), ())),
+                            preferred_element_type=jnp.float32)
+        dists = sq[None, :] - 2.0 * g       # rank key (q_sq const/row)
+        tv, ti = bf._two_best_per_bin(dists, True)
+        ti = ti.astype(jnp.int32) + base
+        cat_v = jnp.concatenate([best_v, tv], axis=1)
+        cat_i = jnp.concatenate([best_i, ti], axis=1)
+        nv, pos = lax.top_k(-cat_v, C)
+        return (-nv, jnp.take_along_axis(cat_i, pos, axis=1)), None
+
+    init = (jnp.full((NQ, C), jnp.inf, jnp.float32),
+            jnp.zeros((NQ, C), jnp.int32))
+    bases = (jnp.arange(n_tiles) * IT).astype(jnp.int32)
+    (vals, ids), _ = lax.scan(step, init, (blocks, sqb, bases))
+    return vals, ids
+
+
+@jax.jit
+def refine_exact(cand):
+    rows = x[cand]                          # [m, C, d] f32 row gather
+    s = jnp.einsum("md,mcd->mc", q.astype(jnp.float32), rows,
+                   precision=lax.Precision.HIGHEST,
+                   preferred_element_type=jnp.float32)
+    d2 = jnp.sum(rows * rows, axis=-1) - 2.0 * s
+    vals, pos = _select_k(d2, K, select_min=True)
+    return vals, jnp.take_along_axis(cand, pos, axis=1)
+
+
+for C in (64, 128):
+    def v2(C=C):
+        _, cand = v2_candidates(C)
+        return refine_exact(cand)
+
+    (dv2, iv2), dt = timeit(v2)
+    print(f"v2 C={C}: {NQ/dt:8,.0f} q/s  recall={recall_of(iv2):.4f}",
+          flush=True)
+
+
+# --- v3: full-width query-tiled block + depth-4 bins + refine --------
+QT = 1000
+BINW = 128
+n_fold = (N + BINW - 1) // BINW
+padn = n_fold * BINW - N
+x3 = jnp.pad(x.astype(jnp.float32), ((0, padn), (0, 0))).astype(jnp.bfloat16)
+x3_sq = jnp.pad(x_sq, (0, padn), constant_values=jnp.inf)
+
+
+@partial(jax.jit, static_argnames=("depth",))
+def v3_candidates(depth: int):
+    n_qt = NQ // QT
+
+    def tile(qi):
+        qb = lax.dynamic_slice_in_dim(q_bf, qi * QT, QT)
+        g = lax.dot_general(qb, x3, (((1,), (1,)), ((), ())),
+                            preferred_element_type=jnp.float32)
+        d2 = x3_sq[None, :] - 2.0 * g                  # [QT, n_fold*128]
+        d3 = d2.reshape(QT, n_fold, BINW)
+        lane = jnp.arange(BINW, dtype=jnp.int32)[None, :]
+        vs, ps = [], []
+        cur = d3
+        for _ in range(depth):
+            a = jnp.argmin(cur, axis=1).astype(jnp.int32)
+            v = jnp.min(cur, axis=1)
+            vs.append(v)
+            ps.append(a * BINW + lane)
+            ti = lax.broadcasted_iota(jnp.int32, cur.shape, 1)
+            cur = jnp.where(ti == a[:, None, :], jnp.inf, cur)
+        return (jnp.concatenate(vs, axis=1),
+                jnp.concatenate(ps, axis=1))           # [QT, depth*128]
+
+    vals, pos = lax.map(tile, jnp.arange(n_qt))
+    return (vals.reshape(NQ, -1), pos.reshape(NQ, -1))
+
+
+def v3(depth=4):
+    _, cand = v3_candidates(depth)
+    return refine_exact(cand)
+
+
+for depth in (3, 4):
+    try:
+        (dv3, iv3), dt = timeit(lambda d=depth: v3(d))
+        print(f"v3 depth={depth}: {NQ/dt:8,.0f} q/s  "
+              f"recall={recall_of(iv3):.4f}", flush=True)
+    except Exception as e:
+        print(f"v3 depth={depth} FAILED: {e}", flush=True)
+print("done", flush=True)

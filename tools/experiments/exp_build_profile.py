@@ -1,0 +1,88 @@
+"""Itemize where IVF-Flat's 1M x 128 build time goes (VERDICT r5 #5).
+
+r4 measured 185.9 s to build a 1M-row index whose reference twin takes
+seconds-to-tens-of-seconds on one GPU. Hypotheses: (a) XLA compile time
+per program over the remote tunnel (20-40 s each, several programs),
+(b) kmeans_balanced phases (meso fit / per-meso batched fits / joint
+sweeps), (c) host seams (np.asarray round-trips in fit), (d) packing.
+
+Method: monkeypatch timers (device_get-fenced) around the build's
+internal phases; run the SAME build twice in one process (second run
+= warm jit caches => the compile share); optionally enable the
+persistent compilation cache first (JAX_CC_DIR env) to test whether
+compiles survive processes on this backend.
+"""
+import os, sys, time
+sys.path.insert(0, "/root/repo")
+
+cc = os.environ.get("JAX_CC_DIR")
+if cc:
+    import jax
+    jax.config.update("jax_compilation_cache_dir", cc)
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+import numpy as np, jax, jax.numpy as jnp
+from raft_tpu.bench import dataset as dsm
+
+PHASES = []
+
+
+def fence(x):
+    leaves = [l for l in jax.tree_util.tree_leaves(x)
+              if hasattr(l, "shape")]
+    if leaves:
+        jax.device_get(leaves[0].ravel()[:1])
+    return x
+
+
+def timed(mod, name, label=None):
+    orig = getattr(mod, name)
+
+    def wrap(*a, **k):
+        t0 = time.perf_counter()
+        r = fence(orig(*a, **k))
+        PHASES.append((label or name, time.perf_counter() - t0))
+        return r
+
+    setattr(mod, name, wrap)
+
+
+import raft_tpu.cluster.kmeans_balanced as kb
+import raft_tpu.neighbors.ivf_common as ic
+import raft_tpu.neighbors.ivf_flat as ivf
+
+timed(kb, "_balanced_lloyd")
+timed(kb, "_balanced_lloyd_batched")
+timed(kb, "fused_l2_nn_argmin")
+timed(kb, "predict2")
+timed(ic, "pack_lists_jit")
+timed(ic, "spill_assignments")
+
+print("generating hard 1M x 128 on host...", flush=True)
+t0 = time.perf_counter()
+ds = dsm.make_synthetic_hard("prof", 1_000_000, 128, 100)
+print(f"host gen {time.perf_counter()-t0:.1f}s", flush=True)
+t0 = time.perf_counter()
+x = fence(jnp.asarray(ds.base))
+print(f"upload {time.perf_counter()-t0:.1f}s", flush=True)
+
+params = ivf.IndexParams(n_lists=1024, spill=True,
+                         list_size_cap_factor=1.5)
+for run in (1, 2):
+    PHASES.clear()
+    t0 = time.perf_counter()
+    idx = ivf.build(x, params)
+    fence(idx.packed_data)
+    total = time.perf_counter() - t0
+    print(f"\n=== build run {run}: total {total:.1f}s ===", flush=True)
+    agg = {}
+    for name, dt in PHASES:
+        agg.setdefault(name, [0.0, 0])
+        agg[name][0] += dt
+        agg[name][1] += 1
+    acc = 0.0
+    for name, (dt, cnt) in sorted(agg.items(), key=lambda kv: -kv[1][0]):
+        print(f"  {name:28s} {dt:7.1f}s  x{cnt}", flush=True)
+        acc += dt
+    print(f"  {'(unattributed: host seams etc)':28s} {total-acc:7.1f}s",
+          flush=True)
+    del idx

@@ -1,0 +1,88 @@
+"""CAGRA on the hard set (VERDICT r5 #3): graph coverage vs seeding.
+
+r4: itopk=256/W=16 reached only 0.9236 recall at 1.1K q/s while
+IVF-Flat did 0.967 at 74.5K. Hypothesis: the cluster-blocked build's
+T=16-list candidate scan covers ~0.89 of true edges on ~42K-tiny-
+cluster data (IVF-Flat's np=16 point recalls 0.885 — same coverage
+math), so the GRAPH is the cap; secondarily c_sel=4 seed clusters
+limit entry coverage. This sweeps build neighborhood/list size and
+search entry_clusters to separate the two.
+
+Run: python tools/experiments/exp_cagra5.py [buildtags...]
+"""
+import os, sys, time
+sys.path.insert(0, "/root/repo")
+import numpy as np, jax, jax.numpy as jnp
+from raft_tpu.bench import dataset as dsm
+from raft_tpu.neighbors import cagra, brute_force
+
+N, NQ, K, D, SEED = 1_000_000, 10_000, 10, 128, 0
+# caches keyed by the params that shape their content — a stale file
+# from a different config must never replay silently
+GT = f"/tmp/gt_hard_{N}x{D}_q{NQ}_s{SEED}.npy"
+
+print("generating hard set...", flush=True)
+ds = dsm.make_synthetic_hard("hard1m", N, D, NQ, seed=SEED)
+x = jnp.asarray(ds.base)
+q = jnp.asarray(ds.queries)
+jax.device_get(x[:1, :1])
+
+if os.path.exists(GT):
+    gt = np.load(GT)
+else:
+    t0 = time.time()
+    bf = brute_force.build(x, metric="sqeuclidean")
+    _, ids = brute_force.knn(bf, q, K, impl="sort")
+    gt = np.asarray(jax.device_get(ids))
+    np.save(GT, gt)
+    del bf
+    print(f"GT in {time.time()-t0:.0f}s", flush=True)
+
+BUILDS = {
+    "t16": dict(knn_neighborhood=16, knn_rows_per_list=1024),   # r4 baseline
+    "t32": dict(knn_neighborhood=32, knn_rows_per_list=1024),
+    "t32r512": dict(knn_neighborhood=32, knn_rows_per_list=512),
+    "t48": dict(knn_neighborhood=48, knn_rows_per_list=1024),
+}
+tags = sys.argv[1:] or ["t16", "t32", "t32r512"]
+
+SEARCHES = [  # (itopk, width, entry_clusters, max_it)
+    (64, 8, 4, 0), (64, 8, 16, 0), (128, 16, 16, 0), (256, 16, 16, 0),
+]
+
+for tag in tags:
+    bp = BUILDS[tag]
+    pkey = "_".join(f"{k[4:]}{v}" for k, v in sorted(bp.items()))
+    path = f"/tmp/cagra_r5_{tag}_{pkey}.idx"
+    if os.path.exists(path):
+        idx = cagra.load(path, dataset=x)
+        jax.device_get(idx.graph[:1, :1])
+        print(f"[{tag}] loaded", flush=True)
+        build_s = -1.0
+    else:
+        p = cagra.IndexParams(graph_degree=64, **bp)
+        t0 = time.perf_counter()
+        idx = cagra.build(x, p)
+        jax.device_get(idx.graph[:1, :1])
+        build_s = time.perf_counter() - t0
+        print(f"[{tag}] build {build_s:.1f}s", flush=True)
+        cagra.save(idx, path, include_dataset=False)
+    for itopk, w, ec, mi in SEARCHES:
+        sp = cagra.SearchParams(itopk_size=itopk, search_width=w,
+                                entry_clusters=ec, max_iterations=mi)
+        try:
+            _, ids = cagra.search(idx, q, K, sp)
+            ids_h = np.asarray(jax.device_get(ids))
+            rec = float(np.mean([len(set(gt[r]) & set(ids_h[r])) / K
+                                 for r in range(NQ)]))
+            t0 = time.perf_counter()
+            outs = [cagra.search(idx, q, K, sp)[1] for _ in range(3)]
+            jax.device_get([o[:1] for o in outs])
+            qps = NQ / ((time.perf_counter() - t0) / 3)
+            print(f"[{tag}] itopk={itopk} w={w} ec={ec} mi={mi}: "
+                  f"recall={rec:.4f} qps={qps:,.0f}", flush=True)
+        except Exception as e:
+            print(f"[{tag}] itopk={itopk} w={w} ec={ec}: FAILED {e}",
+                  flush=True)
+    del idx
+print("done", flush=True)

@@ -1,0 +1,28 @@
+#!/bin/bash
+# Stall watchdog for long tunnel-RPC jobs (they can wedge silently:
+# r5 measured an index upload parked at ~1 CPU tick/30 s). Restarts
+# the command when its CPU time stops advancing for STALL_MIN minutes.
+# Usage: run_watchdog.sh LOGFILE MAX_RESTARTS STALL_MIN CMD...
+LOG=$1; MAXR=$2; STALL_MIN=$3; shift 3
+for attempt in $(seq 0 "$MAXR"); do
+  "$@" >> "$LOG" 2>&1 &
+  PID=$!
+  echo "[watchdog] attempt $attempt pid $PID" >> "$LOG"
+  last_cpu=-1; idle=0
+  while kill -0 $PID 2>/dev/null; do
+    sleep 60
+    cpu=$(awk '{print $14+$15}' /proc/$PID/stat 2>/dev/null || echo "")
+    [ -z "$cpu" ] && break
+    if [ "$cpu" = "$last_cpu" ]; then idle=$((idle+1)); else idle=0; fi
+    last_cpu=$cpu
+    if [ $idle -ge "$STALL_MIN" ]; then
+      echo "[watchdog] stalled ${STALL_MIN}m — killing $PID" >> "$LOG"
+      kill -9 $PID 2>/dev/null
+      break
+    fi
+  done
+  wait $PID 2>/dev/null; rc=$?  # single reap: the real exit/kill status
+  if [ $rc -eq 0 ]; then echo "[watchdog] done rc=0" >> "$LOG"; exit 0; fi
+  echo "[watchdog] exited rc=$rc — restarting" >> "$LOG"
+done
+echo "[watchdog] gave up after $MAXR restarts" >> "$LOG"; exit 1
