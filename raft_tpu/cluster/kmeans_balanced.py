@@ -224,42 +224,42 @@ def fit(
             fine_k[j] += 1
 
     # level 2: per-mesocluster fine clustering on padded, masked row
-    # blocks, batched into few compiled programs (a per-meso loop would
-    # compile per (size, k) pair and, on a remote device, round-trip the
-    # host per meso; measured 117 s → ~10 s at 100K×1024 on a v5e
-    # tunnel). Mesoclusters are BUCKETED by pow2-padded size: one batch
-    # padded to the single largest meso can be several times the dataset
-    # under meso-size skew (host AND device OOM risk); buckets bound the
-    # padding waste at 2× per meso while keeping the compile count at
-    # the handful of distinct pow2 sizes.
-    xh = np.asarray(xn)                      # ONE device→host transfer
-    pads = np.array([max(8, 1 << (max(int(s), 1) - 1).bit_length())
-                     for s in sizes])
-    cms_per_meso: list = [None] * n_meso
-    for p in sorted(set(pads.tolist())):
-        members = [m for m in range(n_meso)
-                   if pads[m] == p and sizes[m] > 0]
-        if not members:
-            continue
-        k_pad = int(min(max(int(fine_k[m]) for m in members), p))
-        subs = np.zeros((len(members), p, d), np.float32)
-        masks = np.zeros((len(members), p), np.float32)
-        c0s = np.zeros((len(members), k_pad, d), np.float32)
-        kmask = np.zeros((len(members), k_pad), np.float32)
-        for j, m in enumerate(members):
-            rows = np.nonzero(meso_labels_h == m)[0]
-            k_m = int(min(fine_k[m], len(rows), k_pad))
-            subs[j, :len(rows)] = xh[rows]
-            masks[j, :len(rows)] = 1.0
-            sel = rows[np.linspace(0, len(rows) - 1, k_m).astype(int)]
-            c0s[j, :k_m] = xh[sel]
-            kmask[j, :k_m] = 1.0
-        cms = np.asarray(_balanced_lloyd_batched(
-            jnp.asarray(subs), jnp.asarray(masks), jnp.asarray(c0s),
-            jnp.asarray(kmask), k_pad, params.n_iters))
-        for j, m in enumerate(members):
-            cms_per_meso[m] = cms[j]
-    fine_centers = [cms_per_meso[m][:int(min(fine_k[m], sizes[m]))]
+    # blocks, batched into ONE compiled program. The rows are
+    # partitioned into per-meso blocks ON DEVICE (ivf_common.pack_lists
+    # — the same sort+scatter the IVF packers use): the previous host
+    # partition shipped the trainset to the host and the padded blocks
+    # back, ~0.75 GB of tunnel traffic at 500K×128 (~30-60 s at
+    # 25 MB/s) plus one compile per pow2 size bucket. Block capacity is
+    # capped at 2× the mean meso size; overflow rows of a skewed meso
+    # are dropped from ITS TRAINING SAMPLE only (the trainset is a
+    # subsample anyway — balance matters, completeness doesn't).
+    from raft_tpu.neighbors import ivf_common as _ic
+
+    avg_meso = max(1, -(-n // n_meso))
+    L_meso = max(8, -(-2 * avg_meso // 8) * 8)
+    (subs,), _mids, _sd, _drop, _addr = _ic.pack_lists_jit(
+        [xn], meso_labels, jnp.arange(n, dtype=jnp.int32),
+        n_lists=n_meso, L=L_meso, fill_values=[jnp.zeros((), xn.dtype)])
+    masks = (_mids >= 0).astype(jnp.float32)            # [n_meso, L]
+    # active center count per meso, capped by its AVAILABLE block rows
+    # (a meso past the block cap has only L_meso rows to fit on; the
+    # global shortfall is backfilled below like empty mesos)
+    sizes_c = np.minimum(np.maximum(sizes, 1), L_meso)
+    k_active = np.maximum(np.minimum(np.minimum(fine_k, sizes), L_meso), 1)
+    k_pad = int(k_active.max())
+    # init: strided member rows of each block, spread over the FULL
+    # member range per meso with linspace-style endpoints (first AND
+    # last row included — a global k_pad stride clustered a small-
+    # fine_k meso's inits in its first rows, measured to cost balance)
+    pos = np.minimum(np.arange(k_pad)[None, :] * (sizes_c[:, None] - 1)
+                     // np.maximum(k_active[:, None] - 1, 1),
+                     sizes_c[:, None] - 1).astype(np.int32)
+    c0s = jnp.take_along_axis(subs, jnp.asarray(pos)[..., None], axis=1)
+    kmask_h = (np.arange(k_pad)[None, :]
+               < k_active[:, None]).astype(np.float32)
+    cms = np.asarray(_balanced_lloyd_batched(
+        subs, masks, c0s, jnp.asarray(kmask_h), k_pad, params.n_iters))
+    fine_centers = [cms[m, :int(k_active[m])]
                     for m in range(n_meso) if sizes[m] > 0]
     centers = jnp.asarray(np.concatenate(fine_centers, axis=0))
     if centers.shape[0] < n_clusters:  # lost slots to empty mesoclusters
@@ -285,8 +285,8 @@ def predict(centers: jax.Array, x: jax.Array,
     return labels
 
 
-@partial(jax.jit, static_argnames=("row_tile",))
-def _top2_labels(centers, xn, row_tile: int):
+@partial(jax.jit, static_argnames=("row_tile", "k"))
+def _topk_labels(centers, xn, row_tile: int, k: int):
     c_sq = jnp.sum(centers * centers, axis=1)
     m, d = xn.shape
     n_tiles = -(-m // row_tile)
@@ -296,19 +296,26 @@ def _top2_labels(centers, xn, row_tile: int):
         g = lax.dot_general(xt, centers, (((1,), (1,)), ((), ())),
                             preferred_element_type=jnp.float32)
         d2 = c_sq[None, :] - 2.0 * g  # rank-equivalent (x² constant/row)
-        _, top2 = lax.top_k(-d2, 2)
-        return top2.astype(jnp.int32)
+        _, topk = lax.top_k(-d2, k)
+        return topk.astype(jnp.int32)
 
     out = lax.map(tile, xp.reshape(n_tiles, row_tile, d))
-    return out.reshape(n_tiles * row_tile, 2)[:m]
+    return out.reshape(n_tiles * row_tile, k)[:m]
+
+
+def predict_topk(centers: jax.Array, x: jax.Array, k: int = 2,
+                 params: Optional[KMeansBalancedParams] = None) -> jax.Array:
+    """``k`` nearest centers per row → [m, k] int32 — feeds the packers'
+    spill-cascade capacity capping (ivf_common.spill_assignments).
+    Row-tiled so the [tile, n_lists] distance block stays bounded."""
+    metric = params.metric if params is not None else "l2"
+    xn = _maybe_normalize(jnp.asarray(x, jnp.float32), metric)
+    k = min(k, centers.shape[0])
+    tile = max(1024, min(x.shape[0], (256 << 20) // max(4 * centers.shape[0], 1)))
+    return _topk_labels(centers, xn, -(-tile // 8) * 8, k)
 
 
 def predict2(centers: jax.Array, x: jax.Array,
              params: Optional[KMeansBalancedParams] = None) -> jax.Array:
-    """Two nearest centers per row → [m, 2] int32 — feeds the packers'
-    spill-to-second-list capacity capping (ivf_common.spill_assignments).
-    Row-tiled so the [tile, n_lists] distance block stays bounded."""
-    metric = params.metric if params is not None else "l2"
-    xn = _maybe_normalize(jnp.asarray(x, jnp.float32), metric)
-    tile = max(1024, min(x.shape[0], (256 << 20) // max(4 * centers.shape[0], 1)))
-    return _top2_labels(centers, xn, -(-tile // 8) * 8)
+    """Two nearest centers per row → [m, 2] int32 (see predict_topk)."""
+    return predict_topk(centers, x, 2, params)

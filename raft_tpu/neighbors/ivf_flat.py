@@ -221,20 +221,22 @@ def build(dataset: jax.Array, params: Optional[IndexParams] = None) -> IvfFlatIn
     from raft_tpu.neighbors import ivf_common as ic
 
     if params.spill:
-        # cap capacity at factor × mean and spill overflow rows to
-        # their second-nearest list (see IndexParams.spill)
-        l12 = kmeans_balanced.predict2(centers, x.astype(jnp.float32),
-                                       km_params)
+        # cap capacity at factor × mean and cascade overflow rows to
+        # their next-nearest lists (see IndexParams.spill)
+        lk = kmeans_balanced.predict_topk(centers, x.astype(jnp.float32),
+                                          ic.SPILL_DEPTH, km_params)
         max_list_size = _lane_round(
             int(avg * params.list_size_cap_factor))
-        labels = ic.spill_assignments(l12[:, 0], l12[:, 1],
-                                      params.n_lists, max_list_size)
+        labels = ic.spill_assignments(lk[:, 0], lk[:, 1],
+                                      params.n_lists, max_list_size,
+                                      *[lk[:, c] for c in
+                                        range(2, lk.shape[1])])
         n_marker = int(jnp.sum(labels >= params.n_lists))
         if n_marker:
             # pack_lists' drop counter excludes out-of-range labels, so
             # double-overflow rows must be surfaced here
             from raft_tpu.core import logging as _log
-            _log.warn("ivf_flat: %d rows overflowed both list choices "
+            _log.warn("ivf_flat: %d rows overflowed every spill choice "
                       "at cap %d (raise list_size_cap_factor)",
                       n_marker, max_list_size)
     else:

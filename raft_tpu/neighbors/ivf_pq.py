@@ -654,19 +654,21 @@ def build(dataset: jax.Array, params: Optional[IndexParams] = None) -> IvfPqInde
     from raft_tpu.neighbors import ivf_common as ic
 
     if params.spill:
-        # cap capacity + spill overflow to second-nearest lists (see
+        # cap capacity + cascade overflow to next-nearest lists (see
         # IndexParams.spill); encode AFTER spilling so residuals use
         # the assigned list's center
-        l12 = kmeans_balanced.predict2(centers, x, km)
+        lk = kmeans_balanced.predict_topk(centers, x, ic.SPILL_DEPTH, km)
         max_list_size = _lane_round(
             int(avg * params.list_size_cap_factor))
-        labels = ic.spill_assignments(l12[:, 0], l12[:, 1],
-                                      params.n_lists, max_list_size)
+        labels = ic.spill_assignments(lk[:, 0], lk[:, 1],
+                                      params.n_lists, max_list_size,
+                                      *[lk[:, c] for c in
+                                        range(2, lk.shape[1])])
         n_marker = int(jnp.sum(labels >= params.n_lists))
         if n_marker:
             # pack_lists' drop counter excludes out-of-range labels
             from raft_tpu.core import logging as _log
-            _log.warn("ivf_pq: %d rows overflowed both list choices at "
+            _log.warn("ivf_pq: %d rows overflowed every spill choice at "
                       "cap %d (raise list_size_cap_factor)",
                       n_marker, max_list_size)
     else:
@@ -787,21 +789,24 @@ def build_chunked(dataset, params: Optional[IndexParams] = None,
         from raft_tpu.neighbors import ivf_common as ic
         from raft_tpu.neighbors.ivf_flat import _lane_round
 
-        l12 = np.empty((n, 2), np.int32)
+        NC = min(ic.SPILL_DEPTH, params.n_lists)
+        lk = np.empty((n, NC), np.int32)
         for a in range(0, n, chunk_rows):
             cancellation_point()
             b = min(n, a + chunk_rows)
-            l12[a:b] = np.asarray(
-                kmeans_balanced.predict2(centers, to_device(dataset[a:b]),
-                                         km))
+            lk[a:b] = np.asarray(
+                kmeans_balanced.predict_topk(centers,
+                                             to_device(dataset[a:b]),
+                                             NC, km))
             if a % (8 * chunk_rows) == 0:
                 _say(f"labeled {b}/{n}")
         L = _lane_round(int(avg * params.list_size_cap_factor))
         _say("spilling assignments")
         labels = np.asarray(ic.spill_assignments(
-            jnp.asarray(l12[:, 0]), jnp.asarray(l12[:, 1]),
-            params.n_lists, L))
-        del l12
+            jnp.asarray(lk[:, 0]), jnp.asarray(lk[:, 1]),
+            params.n_lists, L,
+            *[jnp.asarray(lk[:, c]) for c in range(2, lk.shape[1])]))
+        del lk
         _say("spill done; encode pass")
         n_spill_drop = int((labels >= params.n_lists).sum())
         if n_spill_drop:

@@ -1,0 +1,94 @@
+"""Driver-contract tests for bench.py's record machinery (no device
+work): headline selection, stamp verification, and the
+always-emits-JSON property under SIGTERM. Round 4's record was lost to
+exactly this machinery not existing (BENCH_r04: rc=124, parsed=null).
+"""
+import importlib.util
+import hashlib
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+
+import pytest
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture()
+def bench():
+    spec = importlib.util.spec_from_file_location(
+        "bench_mod", os.path.join(ROOT, "bench.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def _row(dataset, algo, qps, recall, index="i"):
+    return {"dataset": dataset, "algo": algo, "index": index,
+            "qps": qps, "recall": recall, "build_s": 1.0,
+            "search_param": {}}
+
+
+def test_headline_prefers_recall_bar(bench):
+    bench.STATE["detail"] = [
+        _row("sift-1m-hard-synth", "ivf_flat", 200_000, 0.90, "fast"),
+        _row("sift-1m-hard-synth", "ivf_flat", 70_000, 0.96, "good"),
+        _row("sift-1m-hard-synth", "brute_force", 20_000, 1.0),
+    ]
+    p = bench._payload()
+    assert p["metric"].startswith("ann_qps_at_recall95")
+    assert p["value"] == 70_000 and p["best_algo"] == "good"
+
+
+def test_headline_flags_missed_bar(bench):
+    bench.STATE["detail"] = [
+        _row("sift-1m-hard-synth", "ivf_flat", 200_000, 0.90)]
+    assert bench._payload()["metric"] == \
+        "ann_qps_below_recall_bar_hard1m_b10000_k10"
+
+
+def test_headline_brute_force_only_is_not_ann(bench):
+    bench.STATE["detail"] = [
+        _row("sift-1m-hard-synth", "brute_force", 20_000, 1.0)]
+    assert bench._payload()["metric"] == "brute_force_qps_hard1m_b10000_k10"
+
+
+def test_stamp_verification(bench, tmp_path):
+    idx = tmp_path / "pq.idx"
+    idx.write_bytes(b"x" * 4096)
+    st = os.stat(idx)
+    h = hashlib.sha256(b"x" * 4096).hexdigest()[:16]
+    good = {"index_bytes": st.st_size, "index_mtime": int(st.st_mtime),
+            "index_sha16m": h}
+    assert bench._verify_stamp(str(tmp_path), good)
+    assert not bench._verify_stamp(str(tmp_path), None)
+    assert not bench._verify_stamp(
+        str(tmp_path), {**good, "index_bytes": 1})
+    assert not bench._verify_stamp(
+        str(tmp_path), {**good, "index_sha16m": "0" * 16})
+
+
+def test_sigterm_emits_record():
+    # a real subprocess: SIGTERM mid-run must still print a JSON line
+    code = (
+        "import sys; sys.path.insert(0, %r)\n"
+        "import bench, time\n"
+        "import signal\n"
+        "signal.signal(signal.SIGTERM, bench._die)\n"
+        "bench.STATE['detail'].append({'dataset': 'sift-1m-hard-synth',"
+        " 'algo': 'ivf_flat', 'index': 'i', 'qps': 5.0, 'recall': 0.99,"
+        " 'build_s': 1.0, 'search_param': {}})\n"
+        "print('ready', flush=True)\n"
+        "time.sleep(60)\n" % ROOT
+    )
+    p = subprocess.Popen([sys.executable, "-c", code],
+                         stdout=subprocess.PIPE, text=True)
+    assert p.stdout.readline().strip() == "ready"
+    p.send_signal(signal.SIGTERM)
+    out, _ = p.communicate(timeout=30)
+    payload = json.loads(out.strip().splitlines()[-1])
+    assert payload["detail"][0]["qps"] == 5.0
+    assert any("signal" in n for n in payload["notes"])

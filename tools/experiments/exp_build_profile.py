@@ -53,7 +53,7 @@ import raft_tpu.neighbors.ivf_flat as ivf
 timed(kb, "_balanced_lloyd")
 timed(kb, "_balanced_lloyd_batched")
 timed(kb, "fused_l2_nn_argmin")
-timed(kb, "predict2")
+timed(kb, "predict_topk")  # the spill path's labeling pass
 timed(ic, "pack_lists_jit")
 timed(ic, "spill_assignments")
 

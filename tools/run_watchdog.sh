@@ -10,6 +10,10 @@ for attempt in $(seq 0 "$MAXR"); do
   echo "[watchdog] attempt $attempt pid $PID" >> "$LOG"
   last_cpu=-1; idle=0
   while kill -0 $PID 2>/dev/null; do
+    # a finished child stays a kill-0-able ZOMBIE until reaped: bail to
+    # the wait below instead of counting its frozen CPU time as a stall
+    state=$(awk '{print $3}' /proc/$PID/stat 2>/dev/null || echo "")
+    [ -z "$state" ] || [ "$state" = "Z" ] && break
     sleep 60
     cpu=$(awk '{print $14+$15}' /proc/$PID/stat 2>/dev/null || echo "")
     [ -z "$cpu" ] && break
