@@ -27,7 +27,9 @@ from raft_tpu.bench import dataset as dsm
 from raft_tpu.neighbors import brute_force as bf
 from raft_tpu.matrix import select_k as _select_k
 
-N, NQ, K, D, SEED = 1_000_000, 10_000, 10, 128, 0
+N = int(os.environ.get("BF16_N", 1_000_000))
+NQ = int(os.environ.get("BF16_Q", 10_000))
+K, D, SEED = 10, 128, 0
 GT = f"/tmp/gt_hard_{N}x{D}_q{NQ}_s{SEED}.npy"  # keyed: stale GT from a
 # different dataset config must never replay silently
 
