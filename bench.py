@@ -66,9 +66,13 @@ def _payload():
                     and r["dataset"].startswith("sift"))
         metric = "brute_force_qps_hard1m_b10000_k10"
     else:
-        rows = [r for r in detail if r["recall"] >= RECALL_BAR] or detail
-        best = max(rows, key=lambda r: r["qps"]) if rows else None
-        metric = "ann_qps_at_recall95_b10000_k10"
+        rows = [r for r in detail if r["recall"] >= RECALL_BAR]
+        if rows:
+            best = max(rows, key=lambda r: r["qps"])
+            metric = "ann_qps_at_recall95_b10000_k10"
+        else:  # nothing met the bar: flag it, never mislabel
+            best = max(detail, key=lambda r: r["recall"]) if detail else None
+            metric = "ann_qps_below_recall_bar_b10000_k10"
     out = {
         "metric": metric,
         "value": best["qps"] if best else 0.0,
@@ -248,6 +252,25 @@ def deep100m_rows():
     return []
 
 
+def _device_backend_ok(timeout_s: float = 150.0) -> bool:
+    """Probe the device backend in a KILLABLE subprocess. A wedged
+    remote-device plugin blocks `import jax` in C code where SIGALRM
+    never reaches the Python handler — probing in-process would turn a
+    down backend into a silent rc=124 with the record lost (the exact
+    round-4 failure). The cached deep-100m replay needs no device, so
+    it still lands."""
+    import subprocess
+
+    try:
+        p = subprocess.run(
+            [sys.executable, "-c",
+             "import jax; jax.devices(); print('ok')"],
+            capture_output=True, text=True, timeout=timeout_s)
+        return p.returncode == 0 and "ok" in p.stdout
+    except Exception:
+        return False
+
+
 def _row(dataset_name, r):
     return {"dataset": dataset_name, "algo": r.algo, "index": r.index_name,
             "qps": round(r.qps, 1), "recall": round(r.recall, 4),
@@ -255,8 +278,10 @@ def _row(dataset_name, r):
 
 
 def main():
-    from raft_tpu.bench import runner
-
+    # NOTE: no raft_tpu/jax imports before the signal handlers and the
+    # backend probe below — a wedged device plugin can block in C code
+    # where no Python signal handler runs, and the record must emit
+    # even then (the round-4 lost-record failure)
     budget = float(os.environ.get("RAFT_TPU_BENCH_BUDGET_S", 2400))
     deadline = STATE["t0"] + budget
     signal.signal(signal.SIGTERM, _die)
@@ -289,6 +314,16 @@ def main():
             except Exception as e:  # cached-index leg must never sink the run
                 STATE["notes"].append(f"deep-100m leg failed: {e}")
             emit()
+        if ("hard" in legs or "gist" in legs) \
+                and not _device_backend_ok():
+            STATE["notes"].append(
+                "device backend unavailable (probe subprocess failed/"
+                "timed out) — hard/gist legs skipped; detail holds "
+                "replayed rows only")
+            legs = [x for x in legs if x not in ("hard", "gist")]
+            emit()
+        if "hard" in legs or "gist" in legs:
+            from raft_tpu.bench import runner
         if "hard" in legs:
             try:
                 runner.run_config(
