@@ -240,6 +240,11 @@ def deep100m_rows():
     # explicit live re-measurement: run the r5 sweep as a subprocess
     import subprocess
 
+    if not _device_backend_ok():
+        STATE["notes"].append("deep-100m: live re-measurement requested "
+                              "but the device backend is unavailable — "
+                              "leg skipped")
+        return []
     script = os.path.join(os.path.dirname(os.path.abspath(__file__)),
                           "tools", "deep100m_r5.py")
     print("[bench] deep-100m: live re-measurement via tools/deep100m_r5.py")

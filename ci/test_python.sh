@@ -9,6 +9,14 @@ cd "$(dirname "$0")/.."
 
 export JAX_PLATFORMS=cpu
 export XLA_FLAGS="${XLA_FLAGS:-} --xla_force_host_platform_device_count=8"
+# CPU-mesh CI never needs device-plugin site hooks, and a wedged
+# remote-device plugin can block backend init even under
+# JAX_PLATFORMS=cpu (observed during a tunnel outage) — drop plugin
+# paths so CI is independent of device health (set -e checks the
+# assignment; export alone would mask a failure as an empty path)
+stripped=$(python -S -c "import sys; sys.path.insert(0, '.')
+import __graft_entry__ as g; print(g.plugin_free_pythonpath())")
+export PYTHONPATH="$stripped"
 
 echo "== raft_tpu unit+integration tests (8-device CPU mesh) =="
 python -m pytest tests/ -q "$@"
