@@ -33,6 +33,16 @@ Env: RAFT_TPU_BENCH_N / RAFT_TPU_BENCH_Q override dataset/query count
 (smoke); RAFT_TPU_BENCH_ALGOS comma-list restricts algos;
 RAFT_TPU_BENCH_LEGS comma-list restricts legs (deep100m,hard,gist);
 RAFT_TPU_BENCH_BUDGET_S total wall-clock budget.
+
+Observability (docs/observability.md): RAFT_TPU_BENCH_OBS=1 runs one
+diagnostic batch per measured row under raft_tpu.obs (sync + stage
+mode) and adds a per-stage latency breakdown ("stages": span seconds,
+incl. ivf_pq.search.{coarse_quantize,lut,scan} and refine) plus
+"peak_hbm_bytes" to each detail row; RAFT_TPU_BENCH_OBS_JSONL=path
+appends the captured metric series as JSON lines; RAFT_TPU_XPROF_DIR=
+path brackets one measured batch per row in jax.profiler.trace for
+offline XProf analysis. All of it is off by default and adds nothing to
+the timed QPS loop.
 """
 
 import json
@@ -242,8 +252,10 @@ def deep100m_rows():
 
     if not _device_backend_ok():
         STATE["notes"].append("deep-100m: live re-measurement requested "
-                              "but the device backend is unavailable — "
-                              "leg skipped")
+                              "but the device backend is unavailable ("
+                              + STATE.pop("probe_error",
+                                          "no diagnostics captured")
+                              + ") — leg skipped")
         return []
     script = os.path.join(os.path.dirname(os.path.abspath(__file__)),
                           "tools", "deep100m_r5.py")
@@ -257,13 +269,24 @@ def deep100m_rows():
     return []
 
 
+def _probe_cause(head: str, stderr) -> str:
+    """Format a probe failure for the notes: headline + last ~10 lines
+    of the probe's stderr (round-5 pain: the opaque 'probe subprocess
+    failed/timed out' note left the deep-100m outage undiagnosable)."""
+    if isinstance(stderr, bytes):
+        stderr = stderr.decode("utf-8", "replace")
+    tail = "\n".join((stderr or "").strip().splitlines()[-10:])
+    return head + (f"; stderr tail: {tail}" if tail else "; no stderr")
+
+
 def _device_backend_ok(timeout_s: float = 150.0) -> bool:
     """Probe the device backend in a KILLABLE subprocess. A wedged
     remote-device plugin blocks `import jax` in C code where SIGALRM
     never reaches the Python handler — probing in-process would turn a
     down backend into a silent rc=124 with the record lost (the exact
     round-4 failure). The cached deep-100m replay needs no device, so
-    it still lands."""
+    it still lands. On failure the cause (returncode + stderr tail) is
+    stashed in STATE['probe_error'] for the caller's note."""
     import subprocess
 
     try:
@@ -271,15 +294,33 @@ def _device_backend_ok(timeout_s: float = 150.0) -> bool:
             [sys.executable, "-c",
              "import jax; jax.devices(); print('ok')"],
             capture_output=True, text=True, timeout=timeout_s)
-        return p.returncode == 0 and "ok" in p.stdout
-    except Exception:
-        return False
+        if p.returncode == 0 and "ok" in p.stdout:
+            STATE.pop("probe_error", None)
+            return True
+        STATE["probe_error"] = _probe_cause(
+            f"probe subprocess rc={p.returncode}", p.stderr)
+    except subprocess.TimeoutExpired as e:
+        STATE["probe_error"] = _probe_cause(
+            f"probe subprocess timed out after {timeout_s:.0f}s", e.stderr)
+    except Exception as e:
+        STATE["probe_error"] = f"probe failed to launch: {e!r}"
+    return False
 
 
 def _row(dataset_name, r):
-    return {"dataset": dataset_name, "algo": r.algo, "index": r.index_name,
-            "qps": round(r.qps, 1), "recall": round(r.recall, 4),
-            "build_s": round(r.build_s, 2), "search_param": r.search_param}
+    row = {"dataset": dataset_name, "algo": r.algo, "index": r.index_name,
+           "qps": round(r.qps, 1), "recall": round(r.recall, 4),
+           "build_s": round(r.build_s, 2), "search_param": r.search_param}
+    if getattr(r, "stage_breakdown", None) is not None:
+        # RAFT_TPU_BENCH_OBS=1: per-stage span seconds for one diagnostic
+        # batch + the allocator's process-lifetime peak-HBM high-water
+        # mark (PJRT has no reset, so it includes the build and earlier
+        # rows; None on CPU). stages_path names the program decomposed —
+        # it can differ from the scan mode the timed QPS loop used
+        row["stages"] = r.stage_breakdown
+        row["stages_path"] = getattr(r, "stage_path", None)
+        row["peak_hbm_bytes"] = getattr(r, "peak_hbm_bytes", None)
+    return row
 
 
 def main():
@@ -322,8 +363,9 @@ def main():
         if ("hard" in legs or "gist" in legs) \
                 and not _device_backend_ok():
             STATE["notes"].append(
-                "device backend unavailable (probe subprocess failed/"
-                "timed out) — hard/gist legs skipped; detail holds "
+                "device backend unavailable ("
+                + STATE.pop("probe_error", "no diagnostics captured")
+                + ") — hard/gist legs skipped; detail holds "
                 "replayed rows only")
             legs = [x for x in legs if x not in ("hard", "gist")]
             emit()

@@ -37,4 +37,25 @@ echo "== bench smoke (tiny synthetic) =="
 RAFT_TPU_BENCH_N=20000 RAFT_TPU_BENCH_Q=500 \
 RAFT_TPU_BENCH_ALGOS=ivf_flat python bench.py
 
+echo "== observability smoke (RAFT_TPU_BENCH_OBS=1, instrumented ivf_pq) =="
+rm -f /tmp/raft_tpu_obs_smoke.jsonl
+RAFT_TPU_BENCH_N=20000 RAFT_TPU_BENCH_Q=500 \
+RAFT_TPU_BENCH_ALGOS=ivf_pq RAFT_TPU_BENCH_LEGS=hard \
+RAFT_TPU_BENCH_OBS=1 \
+RAFT_TPU_BENCH_OBS_JSONL=/tmp/raft_tpu_obs_smoke.jsonl python bench.py
+python - <<'EOF'
+from raft_tpu.obs import load_jsonl
+
+rows = load_jsonl("/tmp/raft_tpu_obs_smoke.jsonl")
+names = {r["name"] for r in rows}
+need = {"span.ivf_pq.search.coarse_quantize", "span.ivf_pq.search.lut",
+        "span.ivf_pq.search.scan", "span.refine"}
+missing = need - names
+assert not missing, f"missing expected spans: {sorted(missing)}"
+assert all(r["sum"] > 0 for r in rows
+           if r["kind"] == "histogram" and r["name"] in need)
+print(f"observability smoke OK: {len(rows)} series, spans "
+      f"{sorted(n for n in names if n.startswith('span.'))}")
+EOF
+
 echo "CI: all green"

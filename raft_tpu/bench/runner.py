@@ -26,13 +26,17 @@ from __future__ import annotations
 
 import csv
 import json
+import os
 import time
+
 from dataclasses import dataclass, field
 from typing import Any, Callable, Dict, List, Optional
 
 import jax
 import jax.numpy as jnp
 import numpy as np
+
+from raft_tpu.obs.spans import env_flag as _env_flag
 
 from . import dataset as ds_mod
 
@@ -53,6 +57,17 @@ class BenchResult:
     recall: float
     build_param: Dict[str, Any] = field(default_factory=dict)
     search_param: Dict[str, Any] = field(default_factory=dict)
+    # observability extras (RAFT_TPU_BENCH_OBS=1): per-stage span seconds
+    # for one diagnostic batch, and the allocator's PROCESS-LIFETIME
+    # peak-HBM high-water mark read at capture time — PJRT has no reset,
+    # so this includes the build and all earlier rows (None on backends
+    # that don't report, e.g. CPU). stage_path names the program the
+    # breakdown decomposed (the staged per_query f32-LUT path), which
+    # may DIFFER from the scan mode the timed QPS loop auto-selected —
+    # the breakdown attributes stages, it does not re-measure the row
+    stage_breakdown: Optional[Dict[str, float]] = None
+    stage_path: Optional[str] = None
+    peak_hbm_bytes: Optional[int] = None
 
 
 # ---------------------------------------------------------------------------
@@ -137,6 +152,59 @@ ALGO_REGISTRY: Dict[str, Callable] = {
 # ---------------------------------------------------------------------------
 # driver
 # ---------------------------------------------------------------------------
+
+def _obs_capture(search_fn, queries, k, sp, batch_size, context):
+    """RAFT_TPU_BENCH_OBS=1: run ONE diagnostic batch under the
+    observability layer (sync + stage mode → ivf_pq dispatches
+    coarse_quantize/lut/scan as separate synced programs; refine and the
+    other searches report whole-API spans) and return
+    (stage_seconds_by_span, peak_hbm_bytes). Runs AFTER the timed
+    measurement so the staged dispatch never pollutes QPS. With
+    RAFT_TPU_BENCH_OBS_JSONL set, the captured registry is appended to
+    that file, one JSON line per series, stamped with ``context``."""
+    from raft_tpu import obs
+    from raft_tpu.obs import spans as _spans
+
+    reg = obs.MetricsRegistry()
+    qb = queries[: min(batch_size, queries.shape[0])]
+    prev = _spans._state()  # a RAFT_TPU_OBS=1 enable must survive this
+    try:
+        # warm-up: the timed QPS loop ran the FUSED search, so the staged
+        # programs are still uncompiled — the first staged call pays
+        # trace+compile and would report seconds of "stage time". Burn it
+        # into a throwaway registry; measure the second call.
+        obs.enable(sync=True, stages=True, registry=obs.MetricsRegistry())
+        jax.block_until_ready(search_fn(qb, k, dict(sp)))
+        obs.enable(sync=True, stages=True, registry=reg)
+        jax.block_until_ready(search_fn(qb, k, dict(sp)))
+    finally:
+        _spans._restore(prev)
+    snap = reg.snapshot()
+    stages = {name[len("span."):]: round(h["sum"], 6)
+              for name, h in snap["histograms"].items()
+              if name.startswith("span.")}
+    # which program the breakdown decomposed: ivf_pq with stage spans
+    # means the staged per_query f32-LUT path ran (possibly different
+    # from the scan mode the timed loop used); otherwise spans wrapped
+    # the same whole-API calls the timed loop dispatched
+    path = ("staged_per_query_f32lut"
+            if any(n.count(".") >= 2 for n in stages) else "whole_api")
+    peak = snap["gauges"].get("hbm.peak_bytes")
+    jsonl = os.environ.get("RAFT_TPU_BENCH_OBS_JSONL")
+    if jsonl:
+        reg.dump_jsonl(jsonl, extra={"context": context})
+    return stages, path, (int(peak) if peak else None)
+
+
+def _xprof_capture(search_fn, queries, k, sp, batch_size, xprof_dir):
+    """RAFT_TPU_XPROF_DIR: bracket one measured batch in
+    ``jax.profiler.trace`` for offline XProf/Perfetto analysis."""
+    qb = queries[: min(batch_size, queries.shape[0])]
+    with jax.profiler.trace(xprof_dir):
+        out = search_fn(qb, k, dict(sp))
+        jax.block_until_ready(out)
+    print(f"[bench] xprof capture written under {xprof_dir}")
+
 
 def _bench_search(search_fn, queries, k, sp, batch_size, iters=5):
     m = queries.shape[0]
@@ -251,11 +319,25 @@ def _run_one_index(index_cfg, algo, dsx, data, queries, k, batch_size,
             break
         ids, dt, qps = _bench_search(search_fn, queries, k, sp, batch_size)
         rec = ds_mod.recall(ids, data.groundtruth)
+        stages = stage_path = peak_hbm = None
+        if _env_flag("RAFT_TPU_BENCH_OBS"):
+            try:
+                stages, stage_path, peak_hbm = _obs_capture(
+                    search_fn, queries, k, sp, batch_size,
+                    context=f"{index_cfg.get('name', algo)} {sp}")
+            except Exception as e:  # diagnostics must never cost a row
+                print(f"[bench] obs capture failed ({e!r}) — "
+                      "row kept without stage breakdown")
+        xprof_dir = os.environ.get("RAFT_TPU_XPROF_DIR")
+        if xprof_dir:
+            _xprof_capture(search_fn, queries, k, sp, batch_size, xprof_dir)
         row = BenchResult(
             algo=algo, index_name=index_cfg.get("name", algo),
             dataset=data.name, k=k, batch_size=batch_size,
             build_s=build_s, search_s=dt, qps=qps, recall=rec,
             build_param=bp, search_param=dict(sp),
+            stage_breakdown=stages, stage_path=stage_path,
+            peak_hbm_bytes=peak_hbm,
         )
         results.append(row)
         if on_row is not None:
@@ -263,6 +345,12 @@ def _run_one_index(index_cfg, algo, dsx, data, queries, k, batch_size,
         if verbose:
             print(f"[bench] {row.index_name} {sp}: "
                   f"qps={qps:,.0f} recall={rec:.4f} build={build_s:.1f}s")
+            if stages:
+                parts = ", ".join(f"{n}={v * 1e3:.1f}ms"
+                                  for n, v in sorted(stages.items()))
+                hbm = (f"; peak_hbm={peak_hbm / 2**30:.2f}GiB"
+                       if peak_hbm else "")
+                print(f"[bench]   stages: {parts}{hbm}")
 
 
 def run_config_file(path: str, **kw) -> List[BenchResult]:

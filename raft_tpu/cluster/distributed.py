@@ -13,7 +13,9 @@ from typing import Optional, Tuple
 
 import jax
 import jax.numpy as jnp
-from jax import lax, shard_map
+from jax import lax
+
+from raft_tpu.core.compat import shard_map
 from jax.sharding import Mesh, PartitionSpec as P
 
 from raft_tpu.distance.fused_l2_nn import fused_l2_nn_argmin

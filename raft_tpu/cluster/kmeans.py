@@ -30,7 +30,7 @@ import jax.numpy as jnp
 from jax import lax
 
 from raft_tpu.core.errors import expects
-from raft_tpu.core.tracing import traced
+from raft_tpu.core.tracing import traced, span
 from raft_tpu.distance.fused_l2_nn import fused_l2_nn_argmin
 from raft_tpu.distance.pairwise import l2_expanded
 from raft_tpu.random.rng import RngState, _as_key
@@ -180,14 +180,20 @@ def fit(
     best = None
     for trial in range(max(params.n_init, 1)):
         kt = jax.random.fold_in(key, trial)
-        if init_centroids is not None or params.init == "array":
-            expects(init_centroids is not None, "init='array' requires init_centroids")
-            c0 = init_centroids
-        elif params.init == "random":
-            c0 = init_random(kt, x, k)
-        else:
-            c0 = init_plus_plus(kt, x, k, w)
-        centroids, inertia, n_iter = _lloyd(x, w, c0, k, params.max_iter, params.tol)
+        with span("init") as _sp:
+            if init_centroids is not None or params.init == "array":
+                expects(init_centroids is not None,
+                        "init='array' requires init_centroids")
+                c0 = init_centroids
+            elif params.init == "random":
+                c0 = init_random(kt, x, k)
+            else:
+                c0 = init_plus_plus(kt, x, k, w)
+            _sp.attach(c0)
+        with span("lloyd") as _sp:
+            centroids, inertia, n_iter = _lloyd(x, w, c0, k,
+                                                params.max_iter, params.tol)
+            _sp.attach(centroids, inertia)
         if best is None or float(inertia) < float(best[1]):
             best = (centroids, inertia, n_iter)
     return best

@@ -1,0 +1,35 @@
+"""JAX version compatibility shims.
+
+The codebase targets the modern surface (top-level ``jax.shard_map``
+with ``check_vma=``); older jax (<0.6) ships ``shard_map`` under
+``jax.experimental`` and spells the replication check ``check_rep=``.
+Import :func:`shard_map` from here instead of from jax so both work.
+"""
+
+from __future__ import annotations
+
+import inspect
+
+try:
+    from jax import shard_map as _shard_map
+except ImportError:  # jax<0.6 ships it under experimental
+    from jax.experimental.shard_map import shard_map as _shard_map
+
+if "check_vma" in inspect.signature(_shard_map).parameters:
+    shard_map = _shard_map
+else:
+    def shard_map(*args, **kwargs):
+        if "check_vma" in kwargs:
+            kwargs["check_rep"] = kwargs.pop("check_vma")
+        return _shard_map(*args, **kwargs)
+
+
+try:
+    from jax.lax import axis_size
+except ImportError:
+    def axis_size(axis_name):
+        # psum of a Python literal over a named axis constant-folds to
+        # the axis size (a concrete int) at trace time
+        from jax import lax
+
+        return lax.psum(1, axis_name)

@@ -22,13 +22,16 @@ user-held arrays and exists for API parity.
 from __future__ import annotations
 
 import threading
-from typing import Any, Callable, Dict, Optional
+from typing import TYPE_CHECKING, Any, Callable, Dict, Optional
 
 import jax
 import numpy as np
 
 from raft_tpu.core import logging as _log
 from raft_tpu.core.errors import expects
+
+if TYPE_CHECKING:
+    from raft_tpu.obs import metrics as _obs_metrics
 
 
 class Resources:
@@ -139,6 +142,32 @@ class DeviceResources(Resources):
 
     def set_comms(self, comms) -> None:
         self.set_resource("comms", comms)
+
+    @property
+    def metrics(self) -> "_obs_metrics.MetricsRegistry":
+        """The handle's metrics registry (see raft_tpu.obs.metrics): the
+        one installed via :meth:`set_metrics`, else whatever registry
+        spans currently record into — resolved per access, not cached,
+        so a handle follows both ``obs.set_registry`` swaps and a
+        temporary ``obs.enable(registry=...)`` override (the bench's
+        per-row capture), and handle-recorded metrics land in the same
+        sink as the spans'."""
+        reg = self._resources.get("metrics")
+        if reg is not None:
+            return reg
+        from raft_tpu.obs import spans as _obs_spans
+
+        return _obs_spans.registry()
+
+    def set_metrics(self, registry: "_obs_metrics.MetricsRegistry") -> None:
+        self.set_resource("metrics", registry)
+
+    def memory_stats(self) -> dict:
+        """HBM telemetry for the handle's device (see raft_tpu.obs.hbm);
+        empty dict on backends that don't report (CPU)."""
+        from raft_tpu.obs import hbm as _hbm
+
+        return _hbm.device_memory_stats(self.device)
 
     def next_rng_key(self) -> jax.Array:
         return self.get_resource("rng").next_key()

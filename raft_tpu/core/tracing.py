@@ -13,6 +13,15 @@ The TPU equivalents are:
 :func:`traced` applies both. Like NVTX, the cost when no profiler is
 attached is negligible (a context-manager enter/exit per call), and the
 XLA metadata is baked in at trace time only.
+
+When the observability layer is enabled (:func:`raft_tpu.obs.enable`),
+``traced`` additionally opens a recording :func:`span` named after the
+API (``raft_tpu.`` prefix stripped), so every traced entry point's wall
+time lands in the metrics registry and nested stage spans report under
+dotted names like ``ivf_pq.search.scan``. In sync mode the function's
+outputs are attached, so the span measures device time. With
+observability off this adds one flag check per call — no clock reads,
+no sync points.
 """
 
 from __future__ import annotations
@@ -22,22 +31,39 @@ from typing import Callable, Optional
 
 import jax
 
+from raft_tpu.obs import spans as _spans
+from raft_tpu.obs.spans import span  # noqa: F401  (re-export: the stage timer)
+
 
 def traced(name: Optional[str] = None) -> Callable:
     """Decorator: run the function under a named profiler scope
-    (reference: RAFT_USING_NVTX / nvtx::range at API entry).
+    (reference: RAFT_USING_NVTX / nvtx::range at API entry), plus a
+    recording span when observability is enabled.
+
+    Works with and without parentheses:
 
     >>> @traced("raft_tpu.select_k")
     ... def select_k(...): ...
+    >>> @traced
+    ... def helper(...): ...
     """
+    if callable(name):  # bare @traced form
+        return traced(None)(name)
 
     def deco(fn):
         label = name or f"raft_tpu.{fn.__qualname__}"
+        span_name = label[len("raft_tpu."):] if label.startswith("raft_tpu.") \
+            else label
 
         @functools.wraps(fn)
         def wrapper(*args, **kwargs):
             with jax.profiler.TraceAnnotation(label), jax.named_scope(label):
-                return fn(*args, **kwargs)
+                if not _spans.enabled():
+                    return fn(*args, **kwargs)
+                with span(span_name) as sp:
+                    out = fn(*args, **kwargs)
+                    sp.attach(out)
+                    return out
 
         return wrapper
 

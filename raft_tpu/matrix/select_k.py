@@ -20,6 +20,7 @@ from typing import Optional, Tuple
 import jax
 
 from raft_tpu.core.tracing import traced
+from raft_tpu.obs import spans as _obs_spans
 import jax.numpy as jnp
 from jax import lax
 
@@ -89,6 +90,13 @@ def select_k(
             if _on_tpu() and n >= _PALLAS_MIN_LEN and k <= _PALLAS_MAX_K
             else "xla"
         )
+    if _obs_spans.enabled():
+        # which select engine the dispatch heuristic chose (the #1 thing
+        # perf triage asks about). Counted per DISPATCH DECISION: once
+        # per jit trace for jitted callers (the choice is baked into the
+        # compiled program), once per call in eager code.
+        _obs_spans.registry().inc("select_k.dispatch",
+                                  labels={"impl": impl})
     if impl == "pallas":
         from raft_tpu.ops import select_k_pallas
 

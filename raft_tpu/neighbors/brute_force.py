@@ -141,8 +141,11 @@ def _expanded_block(q, db, q_sq, db_sq, metric):
     return d2
 
 
-@partial(jax.jit, static_argnames=("k", "impl"))
+# traced OUTSIDE jit: the named_scope still labels ops (the first call
+# traces inside the wrapper's context), and the wrapper now runs per
+# call — so the obs span records every search, not just the trace
 @traced("raft_tpu.brute_force.knn")
+@partial(jax.jit, static_argnames=("k", "impl"))
 def knn(
     index: BruteForceIndex,
     queries: jax.Array,

@@ -38,7 +38,7 @@ import numpy as np
 from jax import lax
 
 from raft_tpu.core.errors import expects
-from raft_tpu.core.tracing import traced
+from raft_tpu.core.tracing import traced, span
 from raft_tpu.core import serialize as ser
 from raft_tpu.distance.types import DistanceType, resolve_metric
 from raft_tpu.matrix import select_k as _select_k
@@ -500,23 +500,30 @@ def build(dataset: jax.Array, params: Optional[IndexParams] = None) -> CagraInde
         x_build = x @ r
     else:
         x_build = x
-    if algo == "nn_descent":
-        from raft_tpu.neighbors.nn_descent import build_knn_graph as _nnd
-        knn = _nnd(x_build, inter_d, metric=mt.value,
-                   n_iters=params.nn_descent_niter, seed=params.seed)
-    elif algo == "cluster":
-        knn, centers, entry_ids = cluster_knn_graph(
-            x_build, inter_d, metric=mt.value, seed=params.seed,
-            rows_per_list=params.knn_rows_per_list,
-            neighborhood=params.knn_neighborhood,
-            return_entries=True,
-            centers_from=x if x_build is not x else None)
-    else:
-        knn = build_knn_graph(x, inter_d, metric=mt.value, seed=params.seed)
-    graph = optimize_graph(knn, out_d)
+    with span("knn_graph") as _sp:
+        if algo == "nn_descent":
+            from raft_tpu.neighbors.nn_descent import build_knn_graph as _nnd
+            knn = _nnd(x_build, inter_d, metric=mt.value,
+                       n_iters=params.nn_descent_niter, seed=params.seed)
+        elif algo == "cluster":
+            knn, centers, entry_ids = cluster_knn_graph(
+                x_build, inter_d, metric=mt.value, seed=params.seed,
+                rows_per_list=params.knn_rows_per_list,
+                neighborhood=params.knn_neighborhood,
+                return_entries=True,
+                centers_from=x if x_build is not x else None)
+        else:
+            knn = build_knn_graph(x, inter_d, metric=mt.value,
+                                  seed=params.seed)
+        _sp.attach(knn)
+    with span("optimize") as _sp:
+        graph = optimize_graph(knn, out_d)
+        _sp.attach(graph)
     codes = scale = zero = None
     if params.quantize_dataset:
-        codes, scale, zero = _quantize_rows(x)
+        with span("quantize") as _sp:
+            codes, scale, zero = _quantize_rows(x)
+            _sp.attach(codes)
     return CagraIndex(dataset=x, graph=graph, metric=mt.value,
                       centers=centers, entry_ids=entry_ids,
                       dataset_q=codes, q_scale=scale, q_zero=zero)

@@ -37,7 +37,7 @@ import numpy as np
 from jax import lax
 
 from raft_tpu.core.errors import expects
-from raft_tpu.core.tracing import traced
+from raft_tpu.core.tracing import traced, span
 from raft_tpu.core import serialize as ser
 from raft_tpu.cluster import kmeans_balanced
 from raft_tpu.cluster.kmeans_balanced import KMeansBalancedParams
@@ -198,8 +198,10 @@ def build(dataset: jax.Array, params: Optional[IndexParams] = None) -> IvfFlatIn
         trainset = x[jnp.asarray(train_rows)]
     else:
         trainset = x
-    centers = kmeans_balanced.fit(trainset.astype(jnp.float32),
-                                  params.n_lists, km_params)
+    with span("train") as _sp:
+        centers = kmeans_balanced.fit(trainset.astype(jnp.float32),
+                                      params.n_lists, km_params)
+        _sp.attach(centers)
     del trainset  # wide datasets: the subsample copy is GBs
 
     avg = max(1, n // params.n_lists)
@@ -220,46 +222,51 @@ def build(dataset: jax.Array, params: Optional[IndexParams] = None) -> IvfFlatIn
     # list capacity). The host packer remains for memmapped/chunked flows.
     from raft_tpu.neighbors import ivf_common as ic
 
-    if params.spill:
-        # cap capacity at factor × mean and cascade overflow rows to
-        # their next-nearest lists (see IndexParams.spill)
-        lk = kmeans_balanced.predict_topk(centers, x.astype(jnp.float32),
-                                          ic.SPILL_DEPTH, km_params)
-        max_list_size = _lane_round(
-            int(avg * params.list_size_cap_factor))
-        labels = ic.spill_assignments(lk[:, 0], lk[:, 1],
-                                      params.n_lists, max_list_size,
-                                      *[lk[:, c] for c in
-                                        range(2, lk.shape[1])])
-        n_marker = int(jnp.sum(labels >= params.n_lists))
-        if n_marker:
-            # pack_lists' drop counter excludes out-of-range labels, so
-            # double-overflow rows must be surfaced here
-            from raft_tpu.core import logging as _log
-            _log.warn("ivf_flat: %d rows overflowed every spill choice "
-                      "at cap %d (raise list_size_cap_factor)",
-                      n_marker, max_list_size)
-    else:
-        labels = kmeans_balanced.predict(centers, x.astype(jnp.float32),
-                                         km_params)
-        # histogram on host: the [n] labels transfer is small, and a
-        # device scatter-add histogram serializes on TPU
-        counts = np.bincount(np.asarray(labels),
-                             minlength=params.n_lists)
-        max_list_size = _fit_list_size(counts, avg,
-                                       params.list_size_cap_factor)
-    if (n + params.n_lists * max_list_size) * d * x.dtype.itemsize \
-            > (8 << 30):
-        # wide datasets: the one-shot pack's gather copy OOMs (see
-        # pack_rows_chunked)
-        packed, ids, sizes, dropped = ic.pack_rows_chunked(
-            x, labels, params.n_lists, max_list_size,
-            chunk_rows=1 << 16)
-    else:
-        (packed,), ids, sizes, dropped, _ = ic.pack_lists_jit(
-            [x], labels, jnp.arange(n, dtype=jnp.int32),
-            n_lists=params.n_lists, L=max_list_size,
-            fill_values=[jnp.zeros((), x.dtype)])
+    with span("assign") as _sp:
+        if params.spill:
+            # cap capacity at factor × mean and cascade overflow rows to
+            # their next-nearest lists (see IndexParams.spill)
+            lk = kmeans_balanced.predict_topk(centers,
+                                              x.astype(jnp.float32),
+                                              ic.SPILL_DEPTH, km_params)
+            max_list_size = _lane_round(
+                int(avg * params.list_size_cap_factor))
+            labels = ic.spill_assignments(lk[:, 0], lk[:, 1],
+                                          params.n_lists, max_list_size,
+                                          *[lk[:, c] for c in
+                                            range(2, lk.shape[1])])
+            n_marker = int(jnp.sum(labels >= params.n_lists))
+            if n_marker:
+                # pack_lists' drop counter excludes out-of-range labels,
+                # so double-overflow rows must be surfaced here
+                from raft_tpu.core import logging as _log
+                _log.warn("ivf_flat: %d rows overflowed every spill choice "
+                          "at cap %d (raise list_size_cap_factor)",
+                          n_marker, max_list_size)
+        else:
+            labels = kmeans_balanced.predict(centers, x.astype(jnp.float32),
+                                             km_params)
+            # histogram on host: the [n] labels transfer is small, and a
+            # device scatter-add histogram serializes on TPU
+            counts = np.bincount(np.asarray(labels),
+                                 minlength=params.n_lists)
+            max_list_size = _fit_list_size(counts, avg,
+                                           params.list_size_cap_factor)
+        _sp.attach(labels)
+    with span("pack") as _sp:
+        if (n + params.n_lists * max_list_size) * d * x.dtype.itemsize \
+                > (8 << 30):
+            # wide datasets: the one-shot pack's gather copy OOMs (see
+            # pack_rows_chunked)
+            packed, ids, sizes, dropped = ic.pack_rows_chunked(
+                x, labels, params.n_lists, max_list_size,
+                chunk_rows=1 << 16)
+        else:
+            (packed,), ids, sizes, dropped, _ = ic.pack_lists_jit(
+                [x], labels, jnp.arange(n, dtype=jnp.int32),
+                n_lists=params.n_lists, L=max_list_size,
+                fill_values=[jnp.zeros((), x.dtype)])
+        _sp.attach(packed, ids)
     n_drop = int(dropped)
     if n_drop:
         from raft_tpu.core import logging as _log

@@ -43,8 +43,9 @@ import numpy as np
 from jax import lax
 
 from raft_tpu.core.errors import expects
-from raft_tpu.core.tracing import traced
+from raft_tpu.core.tracing import traced, span
 from raft_tpu.core import serialize as ser
+from raft_tpu.obs import spans as _obs_spans
 from raft_tpu.cluster import kmeans_balanced
 from raft_tpu.cluster.kmeans_balanced import KMeansBalancedParams
 from raft_tpu.distance.types import DistanceType, resolve_metric
@@ -629,8 +630,10 @@ def build(dataset: jax.Array, params: Optional[IndexParams] = None) -> IvfPqInde
                               metric="cosine" if spherical else "l2",
                               seed=params.seed)
     # 2.-3. coarse centers + rotation + codebooks (shared trainer)
-    centers, rotation, centers_rot, codebooks = _train_quantizers(
-        trainset, params, dim, pq_dim, pq_len, K, key, km)
+    with span("train") as _sp:
+        centers, rotation, centers_rot, codebooks = _train_quantizers(
+            trainset, params, dim, pq_dim, pq_len, K, key, km)
+        _sp.attach(centers_rot, codebooks)
 
     avg = max(1, n // params.n_lists)
     nbytes = packed_nbytes(pq_dim, params.pq_bits)
@@ -653,40 +656,48 @@ def build(dataset: jax.Array, params: Optional[IndexParams] = None) -> IvfPqInde
     from raft_tpu.neighbors.ivf_flat import _fit_list_size, _lane_round
     from raft_tpu.neighbors import ivf_common as ic
 
-    if params.spill:
-        # cap capacity + cascade overflow to next-nearest lists (see
-        # IndexParams.spill); encode AFTER spilling so residuals use
-        # the assigned list's center
-        lk = kmeans_balanced.predict_topk(centers, x, ic.SPILL_DEPTH, km)
-        max_list_size = _lane_round(
-            int(avg * params.list_size_cap_factor))
-        labels = ic.spill_assignments(lk[:, 0], lk[:, 1],
-                                      params.n_lists, max_list_size,
-                                      *[lk[:, c] for c in
-                                        range(2, lk.shape[1])])
-        n_marker = int(jnp.sum(labels >= params.n_lists))
-        if n_marker:
-            # pack_lists' drop counter excludes out-of-range labels
-            from raft_tpu.core import logging as _log
-            _log.warn("ivf_pq: %d rows overflowed every spill choice at "
-                      "cap %d (raise list_size_cap_factor)",
-                      n_marker, max_list_size)
-    else:
-        labels = kmeans_balanced.predict(centers, x, km)
-        # histogram on host: the [n] labels transfer is small, and a
-        # device scatter-add histogram serializes on TPU
-        counts = np.bincount(np.asarray(labels), minlength=params.n_lists)
-        max_list_size = _fit_list_size(counts, avg,
-                                       params.list_size_cap_factor)
-    codes, norms = _encode_with_norms(
-        x @ rotation.T, centers_rot,
-        jnp.clip(labels, 0, params.n_lists - 1), codebooks,
-        params.codebook_kind)
-    codes_p = pack_bits(codes, params.pq_bits)
-    (packed, pnorm), ids, sizes, dropped, _ = ic.pack_lists_jit(
-        [codes_p, norms], labels, jnp.arange(n, dtype=jnp.int32),
-        n_lists=params.n_lists, L=max_list_size,
-        fill_values=[jnp.zeros((), jnp.uint8), jnp.zeros((), jnp.float32)])
+    with span("assign") as _sp:
+        if params.spill:
+            # cap capacity + cascade overflow to next-nearest lists (see
+            # IndexParams.spill); encode AFTER spilling so residuals use
+            # the assigned list's center
+            lk = kmeans_balanced.predict_topk(centers, x, ic.SPILL_DEPTH, km)
+            max_list_size = _lane_round(
+                int(avg * params.list_size_cap_factor))
+            labels = ic.spill_assignments(lk[:, 0], lk[:, 1],
+                                          params.n_lists, max_list_size,
+                                          *[lk[:, c] for c in
+                                            range(2, lk.shape[1])])
+            n_marker = int(jnp.sum(labels >= params.n_lists))
+            if n_marker:
+                # pack_lists' drop counter excludes out-of-range labels
+                from raft_tpu.core import logging as _log
+                _log.warn("ivf_pq: %d rows overflowed every spill choice at "
+                          "cap %d (raise list_size_cap_factor)",
+                          n_marker, max_list_size)
+        else:
+            labels = kmeans_balanced.predict(centers, x, km)
+            # histogram on host: the [n] labels transfer is small, and a
+            # device scatter-add histogram serializes on TPU
+            counts = np.bincount(np.asarray(labels),
+                                 minlength=params.n_lists)
+            max_list_size = _fit_list_size(counts, avg,
+                                           params.list_size_cap_factor)
+        _sp.attach(labels)
+    with span("encode") as _sp:
+        codes, norms = _encode_with_norms(
+            x @ rotation.T, centers_rot,
+            jnp.clip(labels, 0, params.n_lists - 1), codebooks,
+            params.codebook_kind)
+        codes_p = pack_bits(codes, params.pq_bits)
+        _sp.attach(codes_p, norms)
+    with span("pack") as _sp:
+        (packed, pnorm), ids, sizes, dropped, _ = ic.pack_lists_jit(
+            [codes_p, norms], labels, jnp.arange(n, dtype=jnp.int32),
+            n_lists=params.n_lists, L=max_list_size,
+            fill_values=[jnp.zeros((), jnp.uint8),
+                         jnp.zeros((), jnp.float32)])
+        _sp.attach(packed, ids)
     n_drop = int(dropped)
     if n_drop:
         from raft_tpu.core import logging as _log
@@ -700,7 +711,10 @@ def build(dataset: jax.Array, params: Optional[IndexParams] = None) -> IvfPqInde
         codebook_kind=params.codebook_kind, pq_bits=params.pq_bits,
         pq_dim_static=pq_dim)
     if _want_recon_cache(params, params.n_lists, max_list_size, rot_dim):
-        index = index.replace(packed_recon=_build_recon_cache(index))
+        with span("recon_cache") as _sp:
+            recon = _build_recon_cache(index)
+            _sp.attach(recon)
+            index = index.replace(packed_recon=recon)
     return index
 
 
@@ -771,9 +785,10 @@ def build_chunked(dataset, params: Optional[IndexParams] = None,
     km = KMeansBalancedParams(n_iters=params.kmeans_n_iters,
                               metric="cosine" if spherical else "l2",
                               seed=params.seed)
-    centers, rotation, centers_rot, codebooks = _train_quantizers(
-        trainset, params, dim, pq_dim, pq_len, K, key, km)
-    jax.block_until_ready(codebooks)
+    with span("train"):
+        centers, rotation, centers_rot, codebooks = _train_quantizers(
+            trainset, params, dim, pq_dim, pq_len, K, key, km)
+        jax.block_until_ready(codebooks)
     del trainset
     _say("quantizers trained; label pass")
 
@@ -783,48 +798,49 @@ def build_chunked(dataset, params: Optional[IndexParams] = None,
     from raft_tpu.core.interruptible import cancellation_point
 
     avg = max(1, n // params.n_lists)
-    if params.spill:
-        # top-2 labels, then cap+spill (see IndexParams.spill): L is
-        # the cap itself, not the skewed max load
-        from raft_tpu.neighbors import ivf_common as ic
-        from raft_tpu.neighbors.ivf_flat import _lane_round
+    with span("label"):
+        if params.spill:
+            # top-2 labels, then cap+spill (see IndexParams.spill): L is
+            # the cap itself, not the skewed max load
+            from raft_tpu.neighbors import ivf_common as ic
+            from raft_tpu.neighbors.ivf_flat import _lane_round
 
-        NC = min(ic.SPILL_DEPTH, params.n_lists)
-        lk = np.empty((n, NC), np.int32)
-        for a in range(0, n, chunk_rows):
-            cancellation_point()
-            b = min(n, a + chunk_rows)
-            lk[a:b] = np.asarray(
-                kmeans_balanced.predict_topk(centers,
-                                             to_device(dataset[a:b]),
-                                             NC, km))
-            if a % (8 * chunk_rows) == 0:
-                _say(f"labeled {b}/{n}")
-        L = _lane_round(int(avg * params.list_size_cap_factor))
-        _say("spilling assignments")
-        labels = np.asarray(ic.spill_assignments(
-            jnp.asarray(lk[:, 0]), jnp.asarray(lk[:, 1]),
-            params.n_lists, L,
-            *[jnp.asarray(lk[:, c]) for c in range(2, lk.shape[1])]))
-        del lk
-        _say("spill done; encode pass")
-        n_spill_drop = int((labels >= params.n_lists).sum())
-        if n_spill_drop:
-            from raft_tpu.core import logging as _log
-            _log.warn("ivf_pq chunked build: %d rows overflowed both "
-                      "choices at cap %d", n_spill_drop, L)
-        counts = np.bincount(labels[labels < params.n_lists],
-                             minlength=params.n_lists)
-    else:
-        labels = np.empty(n, np.int32)
-        for a in range(0, n, chunk_rows):
-            cancellation_point()  # chunk seams are cancellation points
-            b = min(n, a + chunk_rows)
-            labels[a:b] = np.asarray(
-                kmeans_balanced.predict(centers, to_device(dataset[a:b]),
-                                        km))
-        counts = np.bincount(labels, minlength=params.n_lists)
-        L = _fit_list_size(counts, avg, params.list_size_cap_factor)
+            NC = min(ic.SPILL_DEPTH, params.n_lists)
+            lk = np.empty((n, NC), np.int32)
+            for a in range(0, n, chunk_rows):
+                cancellation_point()
+                b = min(n, a + chunk_rows)
+                lk[a:b] = np.asarray(
+                    kmeans_balanced.predict_topk(centers,
+                                                 to_device(dataset[a:b]),
+                                                 NC, km))
+                if a % (8 * chunk_rows) == 0:
+                    _say(f"labeled {b}/{n}")
+            L = _lane_round(int(avg * params.list_size_cap_factor))
+            _say("spilling assignments")
+            labels = np.asarray(ic.spill_assignments(
+                jnp.asarray(lk[:, 0]), jnp.asarray(lk[:, 1]),
+                params.n_lists, L,
+                *[jnp.asarray(lk[:, c]) for c in range(2, lk.shape[1])]))
+            del lk
+            _say("spill done; encode pass")
+            n_spill_drop = int((labels >= params.n_lists).sum())
+            if n_spill_drop:
+                from raft_tpu.core import logging as _log
+                _log.warn("ivf_pq chunked build: %d rows overflowed both "
+                          "choices at cap %d", n_spill_drop, L)
+            counts = np.bincount(labels[labels < params.n_lists],
+                                 minlength=params.n_lists)
+        else:
+            labels = np.empty(n, np.int32)
+            for a in range(0, n, chunk_rows):
+                cancellation_point()  # chunk seams are cancellation points
+                b = min(n, a + chunk_rows)
+                labels[a:b] = np.asarray(
+                    kmeans_balanced.predict(centers,
+                                            to_device(dataset[a:b]), km))
+            counts = np.bincount(labels, minlength=params.n_lists)
+            L = _fit_list_size(counts, avg, params.list_size_cap_factor)
     nbytes = packed_nbytes(pq_dim, params.pq_bits)
 
     # 3. streaming encode + pack into the preallocated index
@@ -833,29 +849,32 @@ def build_chunked(dataset, params: Optional[IndexParams] = None,
     pnorm = np.zeros((params.n_lists, L), np.float32)
     cursor = np.zeros(params.n_lists, np.int64)  # next free slot per list
     dropped = 0
-    for a in range(0, n, chunk_rows):
-        cancellation_point()
-        b = min(n, a + chunk_rows)
-        xb = to_device(dataset[a:b])
-        lb = jnp.asarray(labels[a:b])
-        codes, norms = _encode_with_norms(xb @ rotation.T, centers_rot, lb,
-                                          codebooks, params.codebook_kind)
-        codes_h = pack_bits_np(np.asarray(codes), params.pq_bits)
-        norms_h = np.asarray(norms)
-        lb_h = labels[a:b]
-        order, sorted_l, slot = _stable_slots(lb_h, params.n_lists, cursor)
-        keep = (slot < L) & (sorted_l < params.n_lists)
-        dropped += int((~keep).sum())
-        rows = order[keep]
-        ls, sl = sorted_l[keep], slot[keep].astype(np.int64)
-        packed[ls, sl] = codes_h[rows]
-        ids[ls, sl] = (a + rows).astype(np.int32)
-        pnorm[ls, sl] = norms_h[rows]
-        cursor = np.minimum(
-            cursor + np.bincount(lb_h, minlength=params.n_lists)[
-                :params.n_lists], L)
-        if a % (8 * chunk_rows) == 0:
-            _say(f"encoded {b}/{n}")
+    with span("encode_pack"):
+        for a in range(0, n, chunk_rows):
+            cancellation_point()
+            b = min(n, a + chunk_rows)
+            xb = to_device(dataset[a:b])
+            lb = jnp.asarray(labels[a:b])
+            codes, norms = _encode_with_norms(xb @ rotation.T, centers_rot,
+                                              lb, codebooks,
+                                              params.codebook_kind)
+            codes_h = pack_bits_np(np.asarray(codes), params.pq_bits)
+            norms_h = np.asarray(norms)
+            lb_h = labels[a:b]
+            order, sorted_l, slot = _stable_slots(lb_h, params.n_lists,
+                                                  cursor)
+            keep = (slot < L) & (sorted_l < params.n_lists)
+            dropped += int((~keep).sum())
+            rows = order[keep]
+            ls, sl = sorted_l[keep], slot[keep].astype(np.int64)
+            packed[ls, sl] = codes_h[rows]
+            ids[ls, sl] = (a + rows).astype(np.int32)
+            pnorm[ls, sl] = norms_h[rows]
+            cursor = np.minimum(
+                cursor + np.bincount(lb_h, minlength=params.n_lists)[
+                    :params.n_lists], L)
+            if a % (8 * chunk_rows) == 0:
+                _say(f"encoded {b}/{n}")
     if dropped:
         from raft_tpu.core import logging as _log
         _log.warn("ivf_pq chunked build: dropped %d overflow vectors", dropped)
@@ -888,13 +907,12 @@ def _want_recon_cache(params: IndexParams, n_lists: int, L: int,
     # decoding codes per probe, and the fast scalar-prefetch kernel
     # requires it; devices that don't report memory get the 16 GB-class
     # default.
+    from raft_tpu.obs import hbm as _hbm
+
     cap = 3 << 30
-    try:
-        stats = jax.devices()[0].memory_stats()
-        if stats and stats.get("bytes_limit"):
-            cap = min(cap, int(stats["bytes_limit"]) // 5)
-    except Exception:
-        pass
+    limit = _hbm.bytes_limit()
+    if limit:
+        cap = min(cap, limit // 5)
     return n_lists * L * rot_dim * 2 <= cap
 
 
@@ -1001,6 +1019,54 @@ def extend(index: IvfPqIndex, new_vectors: jax.Array,
 # search
 # ---------------------------------------------------------------------------
 
+def _qd_from_qlut(idx: jax.Array, qlut: jax.Array) -> jax.Array:
+    """⟨q,d⟩ per candidate from the query-only LUT: ``idx`` [t, C, S]
+    i32 code values, ``qlut`` [t, S, K] → [t, C] f32. One-hot MXU
+    contraction on TPU (per-lane gathers are the slowest op there; the
+    iota-compare one-hot fuses into the matmul's operand feed — the TPU
+    counterpart of the reference's fused LUT scan,
+    ivf_pq_compute_similarity-inl.cuh); CPU keeps the natural gather
+    (its XLA doesn't fuse the one-hot and would materialize it)."""
+    if jax.default_backend() != "cpu":
+        onehot = jax.nn.one_hot(idx, qlut.shape[-1], dtype=jnp.float32)
+        return jnp.einsum("tcsk,tsk->tc", onehot, qlut,
+                          precision=get_precision(),
+                          preferred_element_type=jnp.float32)
+    idx_t = jnp.transpose(idx, (0, 2, 1))                       # [t, S, C]
+    gath = jnp.take_along_axis(qlut.astype(jnp.float32), idx_t, axis=2)
+    return jnp.sum(gath, axis=1)                                # [t, C]
+
+
+def _finish_candidates(dots, cand_ids, cand_norms, q_sq, mt, k,
+                       filter_bits=None):
+    """Shared candidate epilogue: ``dots`` = ⟨q, c+d⟩ per candidate (from
+    the LUT decomposition or the recon gather) → metric distances, mask,
+    select, id gather, cosine flip. Used by both the fused per_query
+    path and the stage-decomposed scan, so their results cannot drift."""
+    ip_like = mt in (DistanceType.InnerProduct, DistanceType.CosineExpanded)
+    if ip_like:
+        dists = dots
+        invalid = -jnp.inf
+        final_min = False
+    else:
+        dists = jnp.maximum(q_sq[:, None] - 2.0 * dots + cand_norms, 0.0)
+        if mt == DistanceType.L2SqrtExpanded:
+            dists = jnp.sqrt(dists)
+        invalid = jnp.inf
+        final_min = True
+    valid = cand_ids >= 0
+    if filter_bits is not None:
+        from raft_tpu.neighbors.sample_filter import passes
+
+        valid = passes(filter_bits, cand_ids)
+    dists = jnp.where(valid, dists, invalid)
+    vals, pos = _select_k(dists, k, select_min=final_min)
+    ids = jnp.take_along_axis(cand_ids, pos, axis=1)
+    if ip_like and mt == DistanceType.CosineExpanded:
+        vals = 1.0 - vals  # report cosine distance
+    return vals, ids
+
+
 def _coarse_probes(index: IvfPqIndex, q_all: jax.Array, n_probes: int,
                    ip_like: bool):
     """Coarse probe selection on q·c (reference: select_clusters,
@@ -1035,8 +1101,6 @@ def _search_impl(index: IvfPqIndex, queries: jax.Array, k: int,
     per_cluster = index.codebook_kind == "per_cluster"
     L = index.max_list_size
     ip_like = mt in (DistanceType.InnerProduct, DistanceType.CosineExpanded)
-    sqrt_out = mt == DistanceType.L2SqrtExpanded
-    select_min = not ip_like
 
     # qc itself is needed regardless — the ⟨q,c⟩ term of the decomposition
     qc, probes = _coarse_probes(index, q_all, n_probes, ip_like)
@@ -1106,48 +1170,14 @@ def _search_impl(index: IvfPqIndex, queries: jax.Array, k: int,
                               precision=get_precision())  # [t, S, K]
             qlut = _quantize_lut(qlut, lut_dtype)
             idx = codes.reshape(t, n_probes * L, S).astype(jnp.int32)
-            if jax.default_backend() != "cpu":
-                onehot = jax.nn.one_hot(idx, K, dtype=jnp.float32)
-                qd = jnp.einsum(
-                    "tcsk,tsk->tc", onehot, qlut,
-                    precision=get_precision(),
-                    preferred_element_type=jnp.float32,
-                )
-            else:
-                idx_t = jnp.transpose(idx, (0, 2, 1))           # [t, S, C]
-                gath = jnp.take_along_axis(
-                    qlut.astype(jnp.float32), idx_t, axis=2)    # [t, S, C]
-                qd = jnp.sum(gath, axis=1)                      # [t, C]
+            qd = _qd_from_qlut(idx, qlut)
         qcand = jnp.broadcast_to(qc_probed[:, :, None],
                                  (t, n_probes, L)).reshape(t, n_probes * L)
         return finish_tile(qcand + qd, cand_ids, cand_norms, q_sq)
 
     def finish_tile(dots, cand_ids, cand_norms, q_sq):
-        """Shared epilogue: ``dots`` = ⟨q, c+d⟩ per candidate (from the
-        LUT decomposition or the recon gather) → metric distances, mask,
-        select, id gather, cosine flip."""
-        if ip_like:
-            dists = dots
-            invalid = -jnp.inf
-            final_min = False
-        else:
-            dists = jnp.maximum(
-                q_sq[:, None] - 2.0 * dots + cand_norms, 0.0)
-            if sqrt_out:
-                dists = jnp.sqrt(dists)
-            invalid = jnp.inf
-            final_min = True
-        valid = cand_ids >= 0
-        if filter_bits is not None:
-            from raft_tpu.neighbors.sample_filter import passes
-
-            valid = passes(filter_bits, cand_ids)
-        dists = jnp.where(valid, dists, invalid)
-        vals, pos = _select_k(dists, k, select_min=final_min)
-        ids = jnp.take_along_axis(cand_ids, pos, axis=1)
-        if ip_like and mt == DistanceType.CosineExpanded:
-            vals = 1.0 - vals  # report cosine distance
-        return vals, ids
+        return _finish_candidates(dots, cand_ids, cand_norms, q_sq, mt, k,
+                                  filter_bits=filter_bits)
 
     if m <= query_tile:
         return search_tile((q_rot_all, probes, qc_probed_all, q_sq_all))
@@ -1369,6 +1399,14 @@ def search(index: IvfPqIndex, queries: jax.Array, k: int,
         params = SearchParams()
     expects(queries.ndim == 2 and queries.shape[1] == index.dim,
             "queries must be [m, %d]", index.dim)
+    if (_obs_spans.stages_enabled() and _obs_spans._trace_clean()
+            and filter_bitset is None
+            and index.codebook_kind == "per_subspace"):
+        # observability stage mode: dispatch coarse_quantize / lut / scan
+        # as separate programs, each under a recording span. Never under
+        # an outer jax trace — the routing would be baked into the
+        # caller's jit cache and outlive obs.disable()
+        return search_staged(index, queries, k, params)
     n_probes = min(params.n_probes, index.n_lists)
     B = queries.shape[0]
     mode = params.scan_mode
@@ -1408,6 +1446,119 @@ def search(index: IvfPqIndex, queries: jax.Array, k: int,
     return _search_impl(index, queries, k, n_probes,
                         _fit_query_tile(params.query_tile, n_probes, index),
                         filter_bits=filter_bitset, lut_dtype=params.lut_dtype)
+
+
+# ---------------------------------------------------------------------------
+# stage-decomposed search (observability mode — see raft_tpu.obs)
+# ---------------------------------------------------------------------------
+
+@partial(jax.jit, static_argnames=("n_probes", "ip_like"))
+def _stage_coarse(index: IvfPqIndex, q_all: jax.Array, n_probes: int,
+                  ip_like: bool):
+    return _coarse_probes(index, q_all, n_probes, ip_like)
+
+
+@jax.jit
+def _stage_lut(index: IvfPqIndex, q_all: jax.Array):
+    """Staged stage 2 (per_subspace): rotate queries + build the
+    query-only LUT [m, S, K] in one batched MXU contraction."""
+    q_rot = q_all @ index.rotation.T
+    q_sub = q_rot.reshape(q_rot.shape[0], index.pq_dim, index.pq_len)
+    qlut = jnp.einsum("msp,skp->msk", q_sub, index.codebooks,
+                      precision=get_precision())
+    return q_rot, qlut
+
+
+@partial(jax.jit, static_argnames=("k", "n_probes", "query_tile"))
+def _stage_scan(index: IvfPqIndex, q_rot_all: jax.Array, qlut_all: jax.Array,
+                qc: jax.Array, probes: jax.Array, k: int, n_probes: int,
+                query_tile: int):
+    """Staged stage 3: gather candidates, LUT-sum ⟨q,d⟩, metric epilogue,
+    select — the per_query scan with the LUT precomputed by _stage_lut."""
+    mt = resolve_metric(index.metric)
+    m = q_rot_all.shape[0]
+    S, K, L = index.pq_dim, index.pq_book_size, index.max_list_size
+    q_sq_all = jnp.sum(q_rot_all * q_rot_all, axis=1)
+    qc_probed_all = jnp.take_along_axis(qc, probes, axis=1)
+    # same preemption as the fused path: an oversized one-hot operand
+    # feed faults the device (observed at C≈254k, S=64, K=256) — the
+    # diagnostic mode must not crash exactly the big runs it exists to
+    # diagnose, so scan via the recon cache when it exists and the
+    # one-hot would be dangerous
+    use_recon_dot = (index.packed_recon is not None
+                     and n_probes * L * S * K >= (1 << 28))
+
+    def scan_tile(args):
+        q_rot, qlut, qc_probed, probe, q_sq = args
+        t = q_rot.shape[0]
+        cand_ids = index.packed_ids[probe].reshape(t, n_probes * L)
+        cand_norms = index.packed_norms[probe].reshape(t, n_probes * L)
+        if use_recon_dot:
+            rows = index.packed_recon[probe].reshape(t, n_probes * L, -1)
+            dots = jnp.einsum("td,tcd->tc", q_rot,
+                              rows.astype(jnp.float32),
+                              precision=get_precision(),
+                              preferred_element_type=jnp.float32)
+        else:
+            codes_p = index.codes_chunk(probe.reshape(-1)).reshape(
+                t, n_probes, L, -1)
+            codes = index.unpack_codes(codes_p)
+            idx = codes.reshape(t, n_probes * L, S).astype(jnp.int32)
+            qd = _qd_from_qlut(idx, qlut)
+            dots = jnp.broadcast_to(
+                qc_probed[:, :, None],
+                (t, n_probes, L)).reshape(t, n_probes * L) + qd
+        return _finish_candidates(dots, cand_ids, cand_norms, q_sq, mt, k)
+
+    if m <= query_tile:
+        return scan_tile((q_rot_all, qlut_all, qc_probed_all, probes,
+                          q_sq_all))
+    n_tiles = -(-m // query_tile)
+    pad = n_tiles * query_tile - m
+    padded = tuple(
+        jnp.pad(a, ((0, pad),) + ((0, 0),) * (a.ndim - 1))
+        for a in (q_rot_all, qlut_all, qc_probed_all, probes, q_sq_all))
+    vals, ids = lax.map(scan_tile, tuple(
+        a.reshape((n_tiles, query_tile) + a.shape[1:]) for a in padded))
+    return vals.reshape(-1, k)[:m], ids.reshape(-1, k)[:m]
+
+
+def search_staged(index: IvfPqIndex, queries: jax.Array, k: int,
+                  params: Optional[SearchParams] = None
+                  ) -> Tuple[jax.Array, jax.Array]:
+    """Stage-decomposed search for observability: coarse_quantize / lut /
+    scan dispatch as separate programs, each under a recording
+    :func:`raft_tpu.obs.span` — with sync mode on, spans attribute
+    *device* time per stage (the fused :func:`search` cannot be timed
+    stage-wise from the host). Exact f32-LUT per_query semantics,
+    per_subspace codebooks only; results match ``search()``'s per_query
+    path. ``search()`` routes here when obs stage mode is enabled;
+    production paths never pay for the lost fusion."""
+    if params is None:
+        params = SearchParams()
+    expects(queries.ndim == 2 and queries.shape[1] == index.dim,
+            "queries must be [m, %d]", index.dim)
+    expects(index.codebook_kind == "per_subspace",
+            "search_staged supports per_subspace codebooks only")
+    mt = resolve_metric(index.metric)
+    ip_like = mt in (DistanceType.InnerProduct, DistanceType.CosineExpanded)
+    n_probes = min(params.n_probes, index.n_lists)
+    q_all = jnp.asarray(queries, jnp.float32)
+    if mt == DistanceType.CosineExpanded:
+        q_all = q_all / jnp.sqrt(jnp.maximum(
+            jnp.sum(q_all * q_all, -1, keepdims=True), 1e-12))
+    with span("coarse_quantize") as sp:
+        qc, probes = _stage_coarse(index, q_all, n_probes, ip_like)
+        sp.attach(qc, probes)
+    with span("lut") as sp:
+        q_rot, qlut = _stage_lut(index, q_all)
+        sp.attach(q_rot, qlut)
+    with span("scan") as sp:
+        out = _stage_scan(index, q_rot, qlut, qc, probes, k, n_probes,
+                          _fit_query_tile(params.query_tile, n_probes,
+                                          index))
+        sp.attach(out)
+    return out
 
 
 def _fit_query_tile(want: int, n_probes: int, index: IvfPqIndex) -> int:

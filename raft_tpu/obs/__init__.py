@@ -1,0 +1,37 @@
+"""raft_tpu.obs — in-process observability: metrics, span timers, HBM.
+
+The reference attributes time through NVTX ranges + external profilers;
+this package makes the same attribution available *in process*:
+
+- :mod:`raft_tpu.obs.metrics` — thread-safe counters/gauges/histograms
+  with labels, ``snapshot()`` → dict, ``dump_jsonl`` sink;
+- :mod:`raft_tpu.obs.spans`   — ``span(name)`` stage timers (dotted
+  nesting, optional device-time sync), recorded into the registry;
+- :mod:`raft_tpu.obs.hbm`     — ``device.memory_stats()`` telemetry.
+
+Everything is off by default and adds no sync points until
+:func:`enable` is called (or ``RAFT_TPU_OBS=1`` is set). See
+docs/observability.md.
+"""
+
+from raft_tpu.obs.metrics import (  # noqa: F401
+    Counter,
+    Gauge,
+    Histogram,
+    MetricsRegistry,
+    get_registry,
+    load_jsonl,
+    set_registry,
+)
+from raft_tpu.obs.spans import (  # noqa: F401
+    current_name,
+    disable,
+    enable,
+    enabled,
+    env_flag,
+    registry,
+    span,
+    stages_enabled,
+    sync_enabled,
+)
+from raft_tpu.obs import hbm  # noqa: F401

@@ -1,0 +1,72 @@
+"""HBM telemetry — device memory stats as gauges.
+
+One home for the ``device.memory_stats()`` calls that were previously
+ad-hoc (the recon-cache sizing probe buried in ``neighbors/ivf_pq.py``
+moved here). TPU/GPU PJRT clients report an allocator dict
+(``bytes_in_use``, ``peak_bytes_in_use``, ``bytes_limit``, ...); the CPU
+client reports nothing — every helper degrades to ``None``/``{}``
+instead of raising, so instrumented code runs identically on the CPU
+test mesh.
+
+:func:`sample` writes the readings into a metrics registry
+(``hbm.bytes_in_use`` set-to-current, ``hbm.peak_bytes`` high-water) —
+the span timers call it at root-span exit when observability is on
+(nested-span exits skip it: the ``memory_stats()`` round-trip would
+land inside every ancestor span's timed region).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional
+
+
+def device_memory_stats(device: Optional[Any] = None) -> Dict[str, int]:
+    """``device.memory_stats()`` with all failure modes collapsed to an
+    empty dict (CPU backend, remote plugins mid-outage, very old jax)."""
+    try:
+        if device is None:
+            import jax
+
+            device = jax.devices()[0]
+        stats = device.memory_stats()
+        return dict(stats) if stats else {}
+    except Exception:
+        return {}
+
+
+def bytes_in_use(device: Optional[Any] = None) -> Optional[int]:
+    """Live allocated HBM bytes, or None when the backend doesn't report."""
+    v = device_memory_stats(device).get("bytes_in_use")
+    return int(v) if v is not None else None
+
+
+def peak_bytes(device: Optional[Any] = None) -> Optional[int]:
+    """Allocator high-water mark (process lifetime), or None."""
+    v = device_memory_stats(device).get("peak_bytes_in_use")
+    return int(v) if v is not None else None
+
+
+def bytes_limit(device: Optional[Any] = None,
+                default: Optional[int] = None) -> Optional[int]:
+    """Total HBM the allocator may use (the capacity heuristics' input —
+    e.g. the IVF-PQ recon-cache sizing), or ``default``."""
+    v = device_memory_stats(device).get("bytes_limit")
+    return int(v) if v else default
+
+
+def sample(registry=None, device: Optional[Any] = None) -> Dict[str, int]:
+    """Record current HBM gauges into ``registry`` (default: the global
+    one) and return the raw stats dict ({} when unavailable)."""
+    if registry is None:
+        from raft_tpu.obs import metrics as _metrics
+
+        registry = _metrics.get_registry()
+    stats = device_memory_stats(device)
+    if stats:
+        if "bytes_in_use" in stats:
+            registry.gauge("hbm.bytes_in_use").set(stats["bytes_in_use"])
+        if "peak_bytes_in_use" in stats:
+            registry.gauge("hbm.peak_bytes").max(stats["peak_bytes_in_use"])
+        if "bytes_limit" in stats:
+            registry.gauge("hbm.bytes_limit").set(stats["bytes_limit"])
+    return stats

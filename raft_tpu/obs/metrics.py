@@ -1,0 +1,290 @@
+"""Metrics registry — process-local counters, gauges, histograms.
+
+The reference records per-stage timing/occupancy only through external
+profilers (NVTX ranges consumed by nsys); production TPU serving needs
+the numbers *in process* so the bench harness and a serving loop can
+read them without attaching XProf. This registry is the sink the span
+timers (:mod:`raft_tpu.obs.spans`) and HBM telemetry
+(:mod:`raft_tpu.obs.hbm`) write into.
+
+Design: deliberately tiny and dependency-free —
+
+- three metric kinds (counter / gauge / histogram), each optionally
+  labeled with a small ``dict`` of string labels (one time series per
+  distinct label set, Prometheus-style);
+- thread-safe: one registry lock for series creation, one lock per
+  series for updates (hot-path updates never contend on the registry);
+- ``snapshot()`` returns a plain nested dict (JSON-ready), and
+  ``dump_jsonl(path)`` appends one self-describing JSON line per
+  series — the format ``load_jsonl`` round-trips and the bench OBS
+  smoke test parses.
+
+A process-global default registry backs the module-level helpers;
+:class:`~raft_tpu.core.resources.DeviceResources` hands it out as the
+``"metrics"`` resource so handle-holding code needs no extra plumbing.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+from typing import Any, Dict, Iterable, List, Optional, Tuple
+
+# Default histogram bucket upper bounds (seconds-oriented: spans are the
+# main histogram producer; 10 µs .. 10 min covers a dispatch through a
+# chunked 100M-row build stage).
+DEFAULT_BUCKETS: Tuple[float, ...] = (
+    1e-5, 1e-4, 1e-3, 1e-2, 0.1, 1.0, 10.0, 60.0, 600.0)
+
+
+def _label_key(labels: Optional[Dict[str, str]]) -> Tuple[Tuple[str, str], ...]:
+    if not labels:
+        return ()
+    return tuple(sorted((str(k), str(v)) for k, v in labels.items()))
+
+
+def _render(name: str, lkey: Tuple[Tuple[str, str], ...]) -> str:
+    """Stable display key: ``name`` or ``name{k=v,k2=v2}``."""
+    if not lkey:
+        return name
+    return name + "{" + ",".join(f"{k}={v}" for k, v in lkey) + "}"
+
+
+class Counter:
+    """Monotonic counter (one labeled series)."""
+
+    __slots__ = ("name", "labels", "_value", "_lock")
+
+    def __init__(self, name: str, labels: Optional[Dict[str, str]] = None):
+        self.name = name
+        self.labels = dict(labels or {})
+        self._value = 0.0
+        self._lock = threading.Lock()
+
+    def inc(self, value: float = 1.0) -> None:
+        if value < 0:
+            raise ValueError("counters only go up (got %r)" % (value,))
+        with self._lock:
+            self._value += value
+
+    @property
+    def value(self) -> float:
+        return self._value
+
+
+class Gauge:
+    """Set-to-current-value gauge (one labeled series)."""
+
+    __slots__ = ("name", "labels", "_value", "_lock")
+
+    def __init__(self, name: str, labels: Optional[Dict[str, str]] = None):
+        self.name = name
+        self.labels = dict(labels or {})
+        self._value = 0.0
+        self._lock = threading.Lock()
+
+    def set(self, value: float) -> None:
+        with self._lock:
+            self._value = float(value)
+
+    def max(self, value: float) -> None:
+        """Keep the high-water mark (HBM peak sampling uses this)."""
+        with self._lock:
+            if value > self._value:
+                self._value = float(value)
+
+    @property
+    def value(self) -> float:
+        return self._value
+
+
+class Histogram:
+    """Fixed-bucket histogram with count/sum/min/max (one labeled series).
+
+    ``buckets`` are inclusive upper bounds; an implicit +inf bucket
+    catches the tail (cumulative counts, Prometheus-style).
+    """
+
+    __slots__ = ("name", "labels", "buckets", "_bucket_counts", "_count",
+                 "_sum", "_min", "_max", "_lock")
+
+    def __init__(self, name: str, labels: Optional[Dict[str, str]] = None,
+                 buckets: Optional[Iterable[float]] = None):
+        self.name = name
+        self.labels = dict(labels or {})
+        self.buckets = tuple(sorted(buckets)) if buckets else DEFAULT_BUCKETS
+        self._bucket_counts = [0] * (len(self.buckets) + 1)
+        self._count = 0
+        self._sum = 0.0
+        self._min = float("inf")
+        self._max = float("-inf")
+        self._lock = threading.Lock()
+
+    def observe(self, value: float) -> None:
+        value = float(value)
+        with self._lock:
+            self._count += 1
+            self._sum += value
+            if value < self._min:
+                self._min = value
+            if value > self._max:
+                self._max = value
+            for i, ub in enumerate(self.buckets):
+                if value <= ub:
+                    self._bucket_counts[i] += 1
+                    return
+            self._bucket_counts[-1] += 1
+
+    @property
+    def count(self) -> int:
+        return self._count
+
+    @property
+    def sum(self) -> float:
+        return self._sum
+
+    def state(self) -> Dict[str, Any]:
+        with self._lock:
+            cum, counts = 0, []
+            for c in self._bucket_counts:
+                cum += c
+                counts.append(cum)
+            return {
+                "count": self._count,
+                "sum": self._sum,
+                "min": self._min if self._count else None,
+                "max": self._max if self._count else None,
+                "mean": (self._sum / self._count) if self._count else None,
+                "buckets": {
+                    **{repr(ub): counts[i]
+                       for i, ub in enumerate(self.buckets)},
+                    "+inf": counts[-1],
+                },
+            }
+
+
+class MetricsRegistry:
+    """Thread-safe named-series registry (counters/gauges/histograms)."""
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._counters: Dict[Tuple[str, tuple], Counter] = {}
+        self._gauges: Dict[Tuple[str, tuple], Gauge] = {}
+        self._histograms: Dict[Tuple[str, tuple], Histogram] = {}
+
+    # -- series accessors (get-or-create) ----------------------------------
+    def counter(self, name: str,
+                labels: Optional[Dict[str, str]] = None) -> Counter:
+        key = (name, _label_key(labels))
+        with self._lock:
+            c = self._counters.get(key)
+            if c is None:
+                c = self._counters[key] = Counter(name, labels)
+            return c
+
+    def gauge(self, name: str,
+              labels: Optional[Dict[str, str]] = None) -> Gauge:
+        key = (name, _label_key(labels))
+        with self._lock:
+            g = self._gauges.get(key)
+            if g is None:
+                g = self._gauges[key] = Gauge(name, labels)
+            return g
+
+    def histogram(self, name: str,
+                  labels: Optional[Dict[str, str]] = None,
+                  buckets: Optional[Iterable[float]] = None) -> Histogram:
+        key = (name, _label_key(labels))
+        with self._lock:
+            h = self._histograms.get(key)
+            if h is None:
+                h = self._histograms[key] = Histogram(name, labels, buckets)
+            return h
+
+    # -- shorthand update helpers ------------------------------------------
+    def inc(self, name: str, value: float = 1.0,
+            labels: Optional[Dict[str, str]] = None) -> None:
+        self.counter(name, labels).inc(value)
+
+    def set(self, name: str, value: float,
+            labels: Optional[Dict[str, str]] = None) -> None:
+        self.gauge(name, labels).set(value)
+
+    def observe(self, name: str, value: float,
+                labels: Optional[Dict[str, str]] = None) -> None:
+        self.histogram(name, labels).observe(value)
+
+    # -- export -------------------------------------------------------------
+    def snapshot(self) -> Dict[str, Any]:
+        """Plain-dict view: ``{"counters": {key: v}, "gauges": {key: v},
+        "histograms": {key: state}}`` with ``name{k=v}`` rendered keys."""
+        with self._lock:
+            counters = list(self._counters.items())
+            gauges = list(self._gauges.items())
+            hists = list(self._histograms.items())
+        return {
+            "counters": {_render(n, lk): c.value for (n, lk), c in counters},
+            "gauges": {_render(n, lk): g.value for (n, lk), g in gauges},
+            "histograms": {_render(n, lk): h.state() for (n, lk), h in hists},
+        }
+
+    def dump_jsonl(self, path: str, extra: Optional[Dict[str, Any]] = None
+                   ) -> int:
+        """Append one JSON line per series to ``path``; returns the number
+        of lines written. ``extra`` keys are merged into every line
+        (the bench runner stamps dataset/index/search_param context)."""
+        with self._lock:
+            rows: List[Dict[str, Any]] = []
+            for (n, lk), c in self._counters.items():
+                rows.append({"kind": "counter", "name": n,
+                             "labels": dict(lk), "value": c.value})
+            for (n, lk), g in self._gauges.items():
+                rows.append({"kind": "gauge", "name": n,
+                             "labels": dict(lk), "value": g.value})
+            for (n, lk), h in self._histograms.items():
+                rows.append({"kind": "histogram", "name": n,
+                             "labels": dict(lk), **h.state()})
+        if extra:
+            for r in rows:
+                r.update(extra)
+        with open(path, "a") as f:
+            for r in rows:
+                f.write(json.dumps(r) + "\n")
+        return len(rows)
+
+    def reset(self) -> None:
+        with self._lock:
+            self._counters.clear()
+            self._gauges.clear()
+            self._histograms.clear()
+
+
+def load_jsonl(path: str) -> List[Dict[str, Any]]:
+    """Parse a ``dump_jsonl`` file back into a list of series dicts."""
+    out = []
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if line:
+                out.append(json.loads(line))
+    return out
+
+
+_global_registry = MetricsRegistry()
+_global_lock = threading.Lock()
+
+
+def get_registry() -> MetricsRegistry:
+    """The process-global default registry (what spans record into and
+    ``DeviceResources.metrics`` hands out unless overridden)."""
+    return _global_registry
+
+
+def set_registry(registry: MetricsRegistry) -> MetricsRegistry:
+    """Swap the process-global registry (returns the previous one) — the
+    bench runner installs a fresh one per measured row."""
+    global _global_registry
+    with _global_lock:
+        prev = _global_registry
+        _global_registry = registry
+        return prev

@@ -1,0 +1,209 @@
+"""Span timers — stage-level wall/device timing into the metrics registry.
+
+The NVTX analog in :mod:`raft_tpu.core.tracing` labels profiler
+timelines but *records* nothing; a :class:`span` additionally times the
+covered region and writes a ``span.<dotted.name>`` histogram (seconds)
+into the registry, so per-stage latency is readable in process.
+
+Semantics:
+
+- **Off by default, near-zero when off.** ``span.__enter__``/``__exit__``
+  check one module flag and return — no clock read, no lock, no JAX
+  import, and critically NO sync points, so production dispatch stays
+  fully async (verified by tests/test_obs.py).
+- **Nested spans dot-join**: a ``span("scan")`` inside ``span("search")``
+  inside the traced ``ivf_pq`` entry records under
+  ``span.ivf_pq.search.scan``. The stack is thread-local.
+- **Sync mode** (``enable(sync=True)``): at span exit, arrays attached
+  via :meth:`span.attach` are passed to ``jax.block_until_ready`` before
+  the clock stops, so the span measures *device* time, not dispatch
+  time. Off by default — syncing at stage boundaries serializes the
+  pipeline and is strictly an observability trade.
+- **Jit-safe**: under a JAX trace (inside ``jax.jit``), spans disable
+  themselves — a host timer inside a traced function would measure
+  trace time once and nothing on cached calls, and blocking on tracers
+  would be an error.
+- **Stage mode** (``enable(stages=True)``): hot paths that offer a
+  stage-decomposed variant (``ivf_pq.search`` → ``search_staged``)
+  route to it, trading fusion for per-stage attribution.
+
+Env: ``RAFT_TPU_OBS=1`` enables at import; ``RAFT_TPU_OBS_SYNC=1`` and
+``RAFT_TPU_OBS_STAGES=1`` add the respective modes.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+from typing import Any, Optional
+
+from raft_tpu.obs import metrics as _metrics
+
+_enabled = False
+_sync = False
+_stages = False
+_hbm_sample = True
+_registry: Optional[_metrics.MetricsRegistry] = None
+
+_tls = threading.local()
+
+
+def _stack():
+    s = getattr(_tls, "stack", None)
+    if s is None:
+        s = _tls.stack = []
+    return s
+
+
+def enable(sync: bool = False, stages: bool = False,
+           registry: Optional[_metrics.MetricsRegistry] = None,
+           hbm: bool = True) -> None:
+    """Turn span recording on. ``sync`` blocks on attached arrays at span
+    exit (device time); ``stages`` routes searches through their
+    stage-decomposed variants; ``registry`` overrides the global sink;
+    ``hbm`` samples HBM gauges at root-span exit."""
+    global _enabled, _sync, _stages, _registry, _hbm_sample
+    _sync = bool(sync)
+    _stages = bool(stages)
+    _registry = registry
+    _hbm_sample = bool(hbm)
+    _enabled = True
+
+
+def disable() -> None:
+    global _enabled, _sync, _stages, _registry
+    _enabled = False
+    _sync = False
+    _stages = False
+    _registry = None
+
+
+def _state():
+    """Snapshot the enable state (for save/restore around a temporary
+    enable — e.g. the bench's diagnostic capture must not wipe a
+    RAFT_TPU_OBS=1 enable the user installed at import)."""
+    return (_enabled, _sync, _stages, _registry, _hbm_sample)
+
+
+def _restore(state) -> None:
+    global _enabled, _sync, _stages, _registry, _hbm_sample
+    _enabled, _sync, _stages, _registry, _hbm_sample = state
+
+
+def enabled() -> bool:
+    return _enabled
+
+
+def sync_enabled() -> bool:
+    return _enabled and _sync
+
+
+def stages_enabled() -> bool:
+    return _enabled and _stages
+
+
+def registry() -> _metrics.MetricsRegistry:
+    """The registry spans currently record into."""
+    return _registry if _registry is not None else _metrics.get_registry()
+
+
+def current_name() -> str:
+    """Dotted name of the innermost open span ('' outside any span)."""
+    return ".".join(_stack())
+
+
+def env_flag(name: str) -> bool:
+    """Parse a boolean env var: unset, '', '0', 'false', 'off', 'no' are
+    False; anything else is True (plain string truthiness would read
+    ``RAFT_TPU_OBS=0`` as enabled)."""
+    return os.environ.get(name, "").strip().lower() not in (
+        "", "0", "false", "off", "no")
+
+
+def _trace_clean() -> bool:
+    """True outside any JAX trace (safe to time / block / reroute)."""
+    try:
+        import jax
+
+        return jax.core.trace_state_clean()
+    except Exception:
+        pass
+    try:  # newer jax drops it from the public namespace
+        from jax._src import core as _jax_core
+
+        return _jax_core.trace_state_clean()
+    except Exception:
+        # unknown jax: assume we ARE under a trace — spans go quiet, but
+        # timing/blocking a tracer or baking the staged route into a
+        # caller's jit cache would be worse than missing samples
+        return False
+
+
+class span:
+    """Context manager timing one stage. Usage::
+
+        with span("scan") as sp:
+            out = scan_program(...)
+            sp.attach(out)          # blocked on at exit in sync mode
+
+    Arrays may also be passed at construction: ``span("scan", out)``.
+    """
+
+    __slots__ = ("name", "_arrays", "_t0", "_live")
+
+    def __init__(self, name: str, *arrays: Any):
+        self.name = name
+        self._arrays = list(arrays)
+        self._t0 = 0.0
+        self._live = False
+
+    def attach(self, *arrays: Any) -> "span":
+        """Register arrays (any pytrees) to block on at exit when sync
+        mode is on. No-op (and free) when spans are disabled."""
+        if self._live and _sync:
+            self._arrays.extend(arrays)
+        return self
+
+    def __enter__(self) -> "span":
+        if not _enabled or not _trace_clean():
+            return self
+        self._live = True
+        _stack().append(self.name)
+        self._t0 = time.perf_counter()
+        return self
+
+    def __exit__(self, exc_type, exc, tb) -> bool:
+        if not self._live:
+            return False
+        stack = _stack()
+        try:
+            # a raising block yields a truncated duration (and in sync
+            # mode one with no device time) — don't mix it into the
+            # same series as successful samples
+            if exc_type is None:
+                if _sync and self._arrays:
+                    import jax
+
+                    jax.block_until_ready(self._arrays)
+                dt = time.perf_counter() - self._t0
+                reg = registry()
+                reg.histogram("span." + ".".join(stack)).observe(dt)
+                # sample HBM only at ROOT-span exit: memory_stats() is a
+                # transport round-trip on tunnel-attached devices, and
+                # at a child-span exit every ancestor's clock is still
+                # running — sampling there would inflate parent timings
+                if _hbm_sample and len(stack) == 1:
+                    from raft_tpu.obs import hbm as _hbm
+
+                    _hbm.sample(reg)
+        finally:
+            stack.pop()
+            self._live = False
+            self._arrays = []
+        return False
+
+
+if env_flag("RAFT_TPU_OBS"):  # pragma: no cover - env-driven
+    enable(sync=env_flag("RAFT_TPU_OBS_SYNC"),
+           stages=env_flag("RAFT_TPU_OBS_STAGES"))

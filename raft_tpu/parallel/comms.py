@@ -30,6 +30,8 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
+from raft_tpu.core.compat import axis_size as _axis_size
+
 
 class Op(enum.Enum):
     """Reduction op (reference: core/comms.hpp:36 ``op_t``)."""
@@ -67,7 +69,7 @@ class Comms:
 
     # -- topology ----------------------------------------------------------
     def get_size(self) -> jax.Array:
-        return lax.axis_size(self.axis_name)
+        return _axis_size(self.axis_name)
 
     def get_rank(self) -> jax.Array:
         return lax.axis_index(self.axis_name)
@@ -93,7 +95,6 @@ class Comms:
 
     def bcast(self, x, root: int = 0):
         """reference: comms_t::bcast — select the root's shard and replicate."""
-        n = lax.axis_size(self.axis_name)
         gathered = lax.all_gather(x, self.axis_name, axis=0)
         return gathered[root]
 
@@ -154,7 +155,7 @@ class Comms:
     def send_recv_ring(self, x, shift: int = 1):
         """Ring shift by ``shift`` (send to rank+shift, recv from rank-shift).
         Axis sizes are static at trace time, so the permutation is concrete."""
-        size = int(lax.axis_size(self.axis_name))
+        size = int(_axis_size(self.axis_name))
         perm = [(i, (i + shift) % size) for i in range(size)]
         return lax.ppermute(x, self.axis_name, perm=perm)
 

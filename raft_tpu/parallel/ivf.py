@@ -36,7 +36,9 @@ import flax.struct
 import jax
 import jax.numpy as jnp
 import numpy as np
-from jax import lax, shard_map
+from jax import lax
+
+from raft_tpu.core.compat import shard_map
 from jax.sharding import Mesh, PartitionSpec as P
 
 from raft_tpu.core.errors import expects

@@ -16,7 +16,7 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
-from jax import shard_map
+from raft_tpu.core.compat import shard_map
 
 from raft_tpu.core.errors import expects
 from raft_tpu.distance import DistanceType, SELECT_MIN, resolve_metric

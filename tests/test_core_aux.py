@@ -23,6 +23,23 @@ class TestTracing:
         np.testing.assert_array_equal(np.asarray(out), [2.0, 4.0])
         assert double.__name__ == "double"
 
+    def test_traced_works_bare_and_with_parens(self):
+        # regression: @traced without parentheses must behave like
+        # @traced() (the name falls back to the qualname)
+        @traced
+        def bare(x):
+            return x + 1
+
+        @traced()
+        def empty_parens(x):
+            return x + 2
+
+        assert bare(1) == 2
+        assert empty_parens(1) == 3
+        assert bare.__name__ == "bare"
+        assert hasattr(bare, "__wrapped__")
+        assert hasattr(empty_parens, "__wrapped__")
+
     def test_public_apis_are_traced(self):
         from raft_tpu.matrix import select_k
         from raft_tpu.neighbors import brute_force, ivf_flat, ivf_pq
@@ -32,6 +49,55 @@ class TestTracing:
         for fn in (select_k, brute_force.knn, ivf_flat.search,
                    ivf_pq.search, ivf_pq.build, ivf_pq.build_chunked):
             assert hasattr(fn, "__wrapped__"), fn
+
+
+class TestLoggingCallback:
+    """core/logging.set_callback replacement semantics (reference:
+    callback_sink.hpp — one sink, re-set replaces)."""
+
+    @pytest.fixture(autouse=True)
+    def _restore_logging(self):
+        from raft_tpu.core import logging as rlog
+
+        prev_level = rlog.get_logger().level
+        yield
+        rlog.set_callback(None)
+        rlog.set_level(prev_level)
+
+    def test_callback_receives_level_and_message(self):
+        from raft_tpu.core import logging as rlog
+
+        seen = []
+        rlog.set_level(rlog.TRACE)
+        rlog.set_callback(lambda lvl, msg: seen.append((lvl, msg)))
+        rlog.info("hello %d", 7)
+        assert len(seen) == 1
+        lvl, msg = seen[0]
+        assert lvl == 20 and "hello 7" in msg
+
+    def test_second_callback_replaces_first(self):
+        from raft_tpu.core import logging as rlog
+
+        first, second = [], []
+        rlog.set_level(rlog.TRACE)
+        rlog.set_callback(lambda lvl, msg: first.append(msg))
+        rlog.warn("one")
+        rlog.set_callback(lambda lvl, msg: second.append(msg))
+        rlog.warn("two")
+        assert [m for m in first] == ["one"]  # NOT also "two"
+        assert [m for m in second] == ["two"]
+
+    def test_none_uninstalls(self):
+        from raft_tpu.core import logging as rlog
+
+        seen = []
+        rlog.set_level(rlog.TRACE)
+        rlog.set_callback(lambda lvl, msg: seen.append(msg))
+        rlog.error("before")
+        rlog.set_callback(None)
+        rlog.error("after")
+        assert seen == ["before"]
+        rlog.set_callback(None)  # idempotent
 
 
 class TestInterruptible:

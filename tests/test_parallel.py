@@ -6,7 +6,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 import pytest
-from jax import shard_map
+from raft_tpu.core.compat import shard_map
 from jax.sharding import PartitionSpec as P
 from scipy.spatial.distance import cdist
 
