@@ -72,8 +72,9 @@ def _payload():
         metric = "ann_qps_below_recall_bar_hard1m_b10000_k10"
     elif any(r["algo"] == "brute_force" and r["dataset"].startswith("sift")
              for r in detail):  # brute-force-only smoke run
-        best = next(r for r in detail if r["algo"] == "brute_force"
-                    and r["dataset"].startswith("sift"))
+        best = max((r for r in detail if r["algo"] == "brute_force"
+                    and r["dataset"].startswith("sift")),
+                   key=lambda r: r["qps"])
         metric = "brute_force_qps_hard1m_b10000_k10"
     else:
         rows = [r for r in detail if r["recall"] >= RECALL_BAR]
@@ -109,6 +110,15 @@ def _die(signum, frame):
     STATE["notes"].append(f"terminated by signal {signum} after "
                           f"{time.time() - STATE['t0']:.0f}s — "
                           "partial record")
+    # a live deep-100m child left running would orphan and hold the
+    # device past our exit (ADVICE r5) — kill it before the record goes
+    child = STATE.get("child")
+    if child is not None and child.poll() is None:
+        child.terminate()
+        try:
+            child.wait(timeout=5)
+        except Exception:
+            child.kill()
     emit()
     os._exit(0)
 
@@ -224,14 +234,18 @@ def deep100m_rows():
         print(f"[bench] deep-100m: replaying rows measured at "
               f"{st['measured_at']} (commit {st['git_commit']}; set "
               "RAFT_TPU_BENCH_DEEP100M_LIVE=1 to re-measure)")
+        # rows carry their own measured_at once re-measured (resumed
+        # sweeps re-stamp only NEW rows, ADVICE r5); older files only
+        # stamped globally
         return [{"dataset": "deep-100m-synth", "algo": "ivf_pq",
                  "index": "deep100m.ivf_pq.n8192.d64",
                  "qps": r["qps"], "recall": r["recall"],
                  "build_s": r.get("build_s"), "cached_measurement": True,
-                 "measured_at": st["measured_at"],
+                 "measured_at": r.get("measured_at", st["measured_at"]),
                  "search_param": {"n_probes": r["n_probes"],
                                   "k_cand": r["k_cand"],
-                                  "refine": r.get("refine")}}
+                                  "refine": r.get("refine"),
+                                  "scan": r.get("scan")}}
                 for r in saved["rows"]]
     idx_path = os.path.join(root, "pq.idx")
     if not os.path.exists(idx_path):
@@ -260,12 +274,37 @@ def deep100m_rows():
     script = os.path.join(os.path.dirname(os.path.abspath(__file__)),
                           "tools", "deep100m_r5.py")
     print("[bench] deep-100m: live re-measurement via tools/deep100m_r5.py")
-    proc = subprocess.run([sys.executable, script], check=False)
+    # the child gets the remaining bench budget, both as a hard wait
+    # timeout here and as a deadline env var the sweep honors between
+    # configs (finishing a config beats being killed mid-measurement);
+    # a wedged child is killed rather than orphaned holding the device
+    # (ADVICE r5)
+    deadline = STATE.get("deadline", STATE["t0"] + 2400)
+    remaining = max(60.0, deadline - time.time())
+    env = dict(os.environ)
+    env["RAFT_TPU_DEEP100M_DEADLINE"] = f"{deadline:.0f}"
+    proc = subprocess.Popen([sys.executable, script], env=env)
+    STATE["child"] = proc
+    try:
+        rc = proc.wait(timeout=remaining)
+    except subprocess.TimeoutExpired:
+        proc.terminate()
+        try:
+            proc.wait(timeout=10)
+        except subprocess.TimeoutExpired:
+            proc.kill()
+            proc.wait()
+        rc = "timeout"
+        STATE["notes"].append(
+            f"deep-100m: live run killed at the bench budget "
+            f"({remaining:.0f}s) — partial rows replayed if stamped")
+    finally:
+        STATE["child"] = None
     if os.path.exists(res5):
         os.environ.pop("RAFT_TPU_BENCH_DEEP100M_LIVE", None)
         return deep100m_rows()
     STATE["notes"].append(f"deep-100m: live run produced no results "
-                          f"(rc={proc.returncode}) — leg skipped")
+                          f"(rc={rc}) — leg skipped")
     return []
 
 
@@ -330,6 +369,7 @@ def main():
     # even then (the round-4 lost-record failure)
     budget = float(os.environ.get("RAFT_TPU_BENCH_BUDGET_S", 2400))
     deadline = STATE["t0"] + budget
+    STATE["deadline"] = deadline  # deep100m_rows budgets its child off it
     signal.signal(signal.SIGTERM, _die)
     signal.signal(signal.SIGALRM, _die)
     signal.alarm(max(30, int(budget)))

@@ -54,8 +54,43 @@ missing = need - names
 assert not missing, f"missing expected spans: {sorted(missing)}"
 assert all(r["sum"] > 0 for r in rows
            if r["kind"] == "histogram" and r["name"] in need)
+# the scan-dispatch counter must record which engine search() picked
+disp = [r for r in rows if r["name"] == "ivf_pq.scan.dispatch"]
+assert disp and all(r["value"] > 0 for r in disp), \
+    f"ivf_pq.scan.dispatch counter missing: {sorted(names)}"
 print(f"observability smoke OK: {len(rows)} series, spans "
-      f"{sorted(n for n in names if n.startswith('span.'))}")
+      f"{sorted(n for n in names if n.startswith('span.'))}, dispatch "
+      f"impls {sorted(r['labels'].get('impl') for r in disp)}")
+EOF
+
+echo "== Pallas LUT-scan tier smoke (interpret mode, TPU-shaped dispatch) =="
+RAFT_TPU_PALLAS_LUTSCAN=always python - <<'EOF'
+import numpy as np
+import jax.numpy as jnp
+
+from raft_tpu import obs
+from raft_tpu.obs.metrics import MetricsRegistry
+from raft_tpu.neighbors import ivf_pq
+
+rng = np.random.default_rng(0)
+x = jnp.asarray(rng.random((3000, 32), dtype=np.float32))
+idx = ivf_pq.build(x, ivf_pq.IndexParams(
+    n_lists=16, pq_dim=16, seed=0, cache_reconstruction="never"))
+reg = MetricsRegistry()
+obs.enable(registry=reg, hbm=False)
+try:
+    # oversampled (k_cand >= 400) approx search: must auto-upgrade to
+    # the fused LUT kernel and record span.ivf_pq.search.scan
+    ivf_pq.search(idx, x[:64], 400, ivf_pq.SearchParams(
+        n_probes=8, scan_mode="grouped", scan_select="approx"))
+finally:
+    obs.disable()
+snap = reg.snapshot()
+c = snap["counters"].get("ivf_pq.scan.dispatch{impl=pallas_lut}", 0)
+assert c >= 1, snap["counters"]
+scan_span = snap["histograms"].get("span.ivf_pq.search.scan")
+assert scan_span and scan_span["count"] >= 1, snap["histograms"].keys()
+print("pallas LUT-scan smoke OK: dispatch counter + scan span recorded")
 EOF
 
 echo "CI: all green"

@@ -204,12 +204,75 @@ def bench_ivf_scan(batches=(16, 64, 256, 1024, 4096), n: int = 200_000,
     return rows
 
 
+# ---------------------------------------------------------------------------
+# IVF-PQ scan kernels: XLA one-hot grouped scan vs fused Pallas LUT scan
+# ---------------------------------------------------------------------------
+
+def bench_pq_scan(grid=None, iters: int = 3) -> List[PrimResult]:
+    """One-hot (XLA grouped) vs fused Pallas LUT-scan row per config —
+    the measurement behind the ``scan_select="pallas"`` dispatch tier
+    (reference: the compute_similarity kernel benches under
+    cpp/bench/prims). The index is built WITHOUT the recon cache so the
+    one-hot path actually pays its per-chunk decode, as the DEEP-100M
+    regime does. Off-TPU the Pallas row runs in interpreter mode and its
+    time is meaningless — it is kept tiny and flagged via params."""
+    from raft_tpu.neighbors import ivf_common as ic
+    from raft_tpu.neighbors import ivf_pq
+    from raft_tpu.neighbors.ivf_pq import packed_nbytes
+    from raft_tpu.ops.pallas_kernels import (LUT_SCAN_BINS, _on_tpu,
+                                             pallas_lut_scan_wanted)
+
+    on_tpu = _on_tpu()
+    if grid is None:
+        # (n, d, n_lists, n_probes, k_cand, batch)
+        grid = ([(200_000, 96, 512, 64, 400, 2000)] if on_tpu
+                else [(4_000, 32, 16, 8, 40, 128)])
+    rows: List[PrimResult] = []
+    rng = np.random.default_rng(0)
+    for n, d, n_lists, n_probes, k_cand, batch in grid:
+        x = jnp.asarray(rng.random((n, d), dtype=np.float32))
+        index = ivf_pq.build(x, ivf_pq.IndexParams(
+            n_lists=n_lists, pq_dim=max(8, d // 2 // 8 * 8), seed=0,
+            cache_reconstruction="never"))
+        q = x[:batch]
+        p = {"n": n, "d": d, "n_lists": n_lists, "n_probes": n_probes,
+             "k_cand": k_cand, "batch": batch, "on_tpu": on_tpu}
+        impls = {"one_hot": ivf_pq.SearchParams(
+            n_probes=n_probes, scan_mode="grouped", scan_select="exact")}
+        # gate the pallas row with search()'s FULL dispatch condition —
+        # a declined request silently falls back, which would mislabel
+        # this row: kernel layout/VMEM check, bin capacity for k_cand,
+        # the HBM guard, and the codebook kind
+        n_seg = ic.n_segments(batch * n_probes, n_lists, ic.SEGMENT_SIZE)
+        lut_ok = (n_probes * LUT_SCAN_BINS >= k_cand
+                  and index.codebook_kind == "per_subspace"
+                  and ic.lut_scan_mem_ok(n_seg, ic.SEGMENT_SIZE,
+                                         index.rot_dim, batch * n_probes,
+                                         LUT_SCAN_BINS)
+                  and pallas_lut_scan_wanted(
+                      index.pq_dim, index.pq_book_size, index.pq_len,
+                      packed_nbytes(index.pq_dim, index.pq_bits),
+                      index.packed_codes.shape[-1], index.max_list_size,
+                      index.rot_dim, lut_dtype="bfloat16"))
+        if lut_ok:
+            impls["pallas_lut"] = ivf_pq.SearchParams(
+                n_probes=n_probes, scan_mode="grouped",
+                scan_select="pallas", lut_dtype="bfloat16")
+        for name, sp in impls.items():
+            ms = _time(lambda: ivf_pq.search(index, q, k_cand, sp),
+                       iters=iters, warmup=1)
+            rows.append(PrimResult("ivf_pq.lut_scan", name, ms,
+                                   batch * 1e3 / ms, "queries/s", p))
+    return rows
+
+
 BENCHES: Dict[str, Callable[[], List[PrimResult]]] = {
     "select_k": bench_select_k,
     "fused_l2_nn": bench_fused_l2_nn,
     "pairwise": bench_pairwise,
     "kmeans": bench_kmeans,
     "ivf_scan": bench_ivf_scan,
+    "pq_scan": bench_pq_scan,
 }
 
 

@@ -179,6 +179,25 @@ def build_clusters(
     return centers, labels, sizes.astype(jnp.int32)
 
 
+# Above this fraction of the trainset, level-2 sampling truncation is a
+# visible clustering-bias source, not a rounding error — warn.
+_LEVEL2_DROP_WARN_FRAC = 0.02
+
+
+def _warn_level2_drop(n_drop: int, n: int, cap: int) -> None:
+    """Surface level-2 sampling bias (ADVICE r5): a skew-hot mesocluster
+    past the 2×-mean block cap trains its fine centers on a TRUNCATED
+    sample. Tolerable when rare (the trainset is a subsample anyway);
+    silently losing a meaningful fraction of the trainset is not."""
+    frac = n_drop / max(n, 1)
+    if frac > _LEVEL2_DROP_WARN_FRAC:
+        from raft_tpu.core import logging as _log
+        _log.warn("kmeans_balanced: level-2 sampling dropped %d/%d "
+                  "training rows (%.1f%%) past the per-mesocluster cap "
+                  "%d — fine clusters of hot mesoclusters train on "
+                  "truncated samples", n_drop, n, 100.0 * frac, cap)
+
+
 @traced("raft_tpu.kmeans_balanced.fit")
 def fit(
     x: jax.Array,
@@ -240,6 +259,7 @@ def fit(
     (subs,), _mids, _sd, _drop, _addr = _ic.pack_lists_jit(
         [xn], meso_labels, jnp.arange(n, dtype=jnp.int32),
         n_lists=n_meso, L=L_meso, fill_values=[jnp.zeros((), xn.dtype)])
+    _warn_level2_drop(int(_drop), n, L_meso)
     masks = (_mids >= 0).astype(jnp.float32)            # [n_meso, L]
     # active center count per meso, capped by its AVAILABLE block rows
     # (a meso past the block cap has only L_meso rows to fit on; the

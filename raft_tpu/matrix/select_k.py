@@ -90,14 +90,8 @@ def select_k(
             if _on_tpu() and n >= _PALLAS_MIN_LEN and k <= _PALLAS_MAX_K
             else "xla"
         )
-    if _obs_spans.enabled():
-        # which select engine the dispatch heuristic chose (the #1 thing
-        # perf triage asks about). Counted per DISPATCH DECISION: once
-        # per jit trace for jitted callers (the choice is baked into the
-        # compiled program), once per call in eager code.
-        _obs_spans.registry().inc("select_k.dispatch",
-                                  labels={"impl": impl})
     if impl == "pallas":
+        _obs_spans.count_dispatch("select_k", "pallas")
         from raft_tpu.ops import select_k_pallas
 
         vals, idx = select_k_pallas(scores, k, select_min=select_min)
@@ -113,7 +107,11 @@ def select_k(
             and n >= 4 * _LARGE_K_TILE):
         len_tile = _LARGE_K_TILE
     if len_tile is not None and n > len_tile and n > k:
+        # the tiled tier is a distinct engine — account it as such, not
+        # as plain "xla" (large-k scan triage needs the distinction)
+        _obs_spans.count_dispatch("select_k", "xla_tiled")
         return _select_k_tiled(scores, k, select_min, input_indices, len_tile)
+    _obs_spans.count_dispatch("select_k", "xla")
 
     vals, idx = _top_k_signed(scores, k, select_min)
     if input_indices is not None:

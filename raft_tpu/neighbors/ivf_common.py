@@ -213,6 +213,19 @@ def grouped_mem_ok(n_seg: int, seg: int, kk: int, pairs: int) -> bool:
     return (n_seg * seg * (4 + 8 * kk) + pairs * kk * 8) <= GROUPED_BYTES_CAP
 
 
+def lut_scan_mem_ok(n_seg: int, seg: int, rot: int, pairs: int,
+                    nbins: int = 256) -> bool:
+    """HBM budget for the Pallas LUT-scan tier: the gathered per-segment
+    queries [n_seg, seg, rot] f32, the kernel's [n_seg, seg, nbins]
+    key+id bin tables, and the pair-order gather [pairs, nbins] f32+i32
+    all live at once (everything else stays in VMEM — that is the tier's
+    point). Shares GROUPED_BYTES_CAP with the XLA grouped scan."""
+    qv = n_seg * seg * rot * 4
+    bins = n_seg * seg * nbins * 8
+    gathered = pairs * nbins * 8
+    return qv + bins + gathered <= GROUPED_BYTES_CAP
+
+
 def fit_seg_chunk(seg: int, L: int, d: int, want: int) -> int:
     """Largest segment chunk ≤ ``want`` whose per-step transients — the
     [chunk·seg, L] f32 distance block and the gathered [chunk, L, d]

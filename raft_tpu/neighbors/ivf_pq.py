@@ -100,16 +100,31 @@ class SearchParams:
     ``lut_dtype``: dtype the query LUT is quantized to before the scan
     contraction — the reference's ``search_params::lut_dtype`` fp8 option
     (detail/ivf_pq_fp_8bit.cuh) trading LUT precision for on-chip
-    footprint. One of "float32" | "bfloat16" | "float8_e4m3"."""
+    footprint. One of "float32" | "bfloat16" | "float8_e4m3". The Pallas
+    LUT-scan tier applies the same knob to its codebook operand (see
+    ops.pallas_kernels.ivfpq_lut_scan_topk).
+
+    ``scan_select`` picks the grouped path's selection engine:
+    "exact" (reference semantics), "approx" (TPU hardware top-k,
+    recall-targeted; see ivf_flat), or "pallas" — the fused Pallas
+    LUT-scan kernel over packed codes (no recon cache needed, candidate
+    tables never hit HBM; docs/api_reference.md has the decision
+    table). "approx" auto-upgrades to the pallas tier on TPU for
+    oversampled shapes (n_probes ≥ 64 or k ≥ 400) when no recon cache
+    exists — the configs where the XLA scan's HBM transients are
+    hostile. The tier needs n_probes·256 ≥ k and no filter bitset (its
+    bin pre-selection is filter-blind); ineligible explicit requests
+    warn once and run the approx tier instead."""
 
     n_probes: int = 20
     query_tile: int = 64
     scan_mode: str = "auto"  # "auto" | "grouped" | "per_query"
     list_chunk: int = 64
     lut_dtype: str = "float32"
-    # grouped-path per-segment selection: "exact" (reference semantics)
-    # or "approx" (TPU hardware top-k, recall-targeted; see ivf_flat)
-    scan_select: str = "exact"  # | "approx"
+    # grouped-path per-segment selection: "exact" (reference semantics),
+    # "approx" (TPU hardware top-k, recall-targeted; see ivf_flat), or
+    # "pallas" (fused LUT-scan kernel over packed codes)
+    scan_select: str = "exact"  # | "approx" | "pallas"
     scan_recall: float = 0.95
 
 
@@ -1386,6 +1401,97 @@ def _search_grouped(index: IvfPqIndex, queries: jax.Array, k: int,
     return out_vals, out_ids
 
 
+@partial(jax.jit, static_argnames=("k", "n_probes", "seg", "n_seg",
+                                   "lut_dtype"))
+def _search_lut_pallas(index: IvfPqIndex, queries: jax.Array, k: int,
+                       n_probes: int, seg: int, n_seg: int,
+                       filter_bits=None, lut_dtype: str = "float32"):
+    """The ``scan_select="pallas"`` tier: segmented scan through the fused
+    Pallas LUT kernel (ops.pallas_kernels.ivfpq_lut_scan_topk). Packed
+    codes stream HBM→VMEM per segment, unpack/decode/accumulate/select
+    happen on-chip, and only the [n_seg, seg, 256] bin tables come back —
+    neither the decoded-f32 lists, the one-hot operands, nor the
+    [B, n_probes·L] candidate tables ever exist in HBM. The merged bins
+    run through the shared :func:`_finish_candidates` epilogue, so
+    results cannot drift from the fused/staged paths' semantics.
+
+    ``filter_bits`` applies AFTER the kernel's filter-blind 2×128-bin
+    pre-selection, so under a selective filter kept neighbors outside a
+    probe's unfiltered top bins are unreachable — ``search()`` therefore
+    never routes filtered searches here (same guard as segk)."""
+    from raft_tpu.neighbors import ivf_common as ic
+    from raft_tpu.ops import pallas_kernels as _pk
+
+    mt = resolve_metric(index.metric)
+    q_all = jnp.asarray(queries, jnp.float32)
+    if mt == DistanceType.CosineExpanded:
+        q_all = q_all / jnp.sqrt(jnp.maximum(
+            jnp.sum(q_all * q_all, -1, keepdims=True), 1e-12))
+    B = q_all.shape[0]
+    ip_like = mt in (DistanceType.InnerProduct, DistanceType.CosineExpanded)
+
+    _, probes = _coarse_probes(index, q_all, n_probes, ip_like)
+    seg_list, seg_q, pair_seg, pair_slot = ic.segment_probes(
+        probes, index.n_lists, seg, n_seg)
+    q_rot = q_all @ index.rotation.T
+    q_sq = jnp.sum(q_rot * q_rot, axis=1)
+    qv_all = q_rot[jnp.clip(seg_q, 0, B - 1)]         # [n_seg, seg, rot]
+
+    keys, kids = _pk.ivfpq_lut_scan_topk(
+        seg_list, qv_all, index.packed_codes, index.packed_ids,
+        index.packed_norms, index.centers_rot, index.codebooks,
+        "ip" if ip_like else "l2", pq_bits=index.pq_bits,
+        pq_dim=index.pq_dim, L=index.max_list_size, lut_dtype=lut_dtype,
+        interpret=not _pk._on_tpu())
+    pv, pi = ic.gather_segment_results(keys, kids, pair_seg, pair_slot)
+    C = n_probes * keys.shape[-1]
+    pv = pv.reshape(B, C)
+    pi = pi.reshape(B, C)
+    # the kernel emits minimized keys (l2: ‖c+d‖² − 2⟨q,c+d⟩; ip:
+    # −⟨q,c+d⟩); recover the shared epilogue's ⟨q,c+d⟩ convention with
+    # zero cand_norms so _finish_candidates reconstructs the metric
+    dots = -pv if ip_like else -0.5 * pv
+    kq = min(k, C)
+    out_vals, out_ids = _finish_candidates(
+        dots, pi, jnp.zeros_like(pv), q_sq, mt, kq,
+        filter_bits=filter_bits)
+    if k > kq:
+        invalid = -jnp.inf if ip_like else jnp.inf
+        if mt == DistanceType.CosineExpanded:
+            invalid = jnp.inf  # reported as cosine distance
+        out_vals = jnp.pad(out_vals, ((0, 0), (0, k - kq)),
+                           constant_values=invalid)
+        out_ids = jnp.pad(out_ids, ((0, 0), (0, k - kq)),
+                          constant_values=-1)
+    return out_vals, out_ids
+
+
+def _count_scan_dispatch(impl: str) -> None:
+    """Record which scan engine ``search`` dispatched to (the obs
+    ``ivf_pq.scan.dispatch{impl=...}`` counter) — eager, so it counts
+    dispatch decisions, not device executions."""
+    _obs_spans.count_dispatch("ivf_pq.scan", impl)
+
+
+_lut_fallback_warned = False
+
+
+def _warn_lut_fallback() -> None:
+    """Once-per-process notice that an explicit scan_select="pallas" was
+    downgraded (the obs dispatch counter still records every decision)."""
+    global _lut_fallback_warned
+    if _lut_fallback_warned:
+        return
+    _lut_fallback_warned = True
+    from raft_tpu.core import logging as _log
+    _log.warn("ivf_pq: scan_select='pallas' requested but the fused LUT "
+              "kernel cannot serve this search (per_cluster codebooks, "
+              "unsupported packed layout, memory guard, too few probes "
+              "for the requested k, a filter bitset, or not on TPU "
+              "without RAFT_TPU_PALLAS_LUTSCAN=always) — falling back "
+              "to scan_select='approx'")
+
+
 @traced("raft_tpu.ivf_pq.search")
 def search(index: IvfPqIndex, queries: jax.Array, k: int,
            params: Optional[SearchParams] = None,
@@ -1406,12 +1512,16 @@ def search(index: IvfPqIndex, queries: jax.Array, k: int,
         # as separate programs, each under a recording span. Never under
         # an outer jax trace — the routing would be baked into the
         # caller's jit cache and outlive obs.disable()
+        _count_scan_dispatch("staged")
         return search_staged(index, queries, k, params)
     n_probes = min(params.n_probes, index.n_lists)
     B = queries.shape[0]
     mode = params.scan_mode
     if mode == "auto":
-        mode = ("grouped" if B * n_probes >= 2 * index.n_lists
+        # an explicit pallas tier request is a grouped-scan request: the
+        # LUT kernel is segment-structured, batch size notwithstanding
+        mode = ("grouped" if (B * n_probes >= 2 * index.n_lists
+                              or params.scan_select == "pallas")
                 else "per_query")
     if mode == "grouped":
         from raft_tpu.neighbors import ivf_common as ic
@@ -1424,25 +1534,78 @@ def search(index: IvfPqIndex, queries: jax.Array, k: int,
         n_seg = ic.n_segments(pairs, index.n_lists, seg)
         L = index.max_list_size
         kk = min(k, L)
+        from raft_tpu.ops import pallas_kernels as _pk
+
+        # fused Pallas LUT-scan tier: explicit scan_select="pallas", or
+        # the approx tier auto-upgraded for oversampled shapes where the
+        # XLA scan's HBM transients are hostile and no recon cache
+        # exists to shortcut the decode (the DEEP-100M regime)
+        # the LUT tier emits at most LUT_SCAN_BINS candidates per probed
+        # list — with too few probes for the requested k it would pad
+        # the tail with -1s where the XLA tiers return real neighbors.
+        # Filtered searches are excluded outright (like segk): the bin
+        # pre-selection is filter-blind, so under a selective filter the
+        # kept neighbors outside a probe's unfiltered top-256 would be
+        # unreachable — the grouped XLA scan filters before selection.
+        lut_serviceable = (n_probes * _pk.LUT_SCAN_BINS >= k
+                           and filter_bitset is None)
+        want_lut = (lut_serviceable
+                    and (params.scan_select == "pallas"
+                         or (params.scan_select == "approx"
+                             and index.packed_recon is None
+                             and (n_probes >= 64 or k >= 400))))
+        select_impl = params.scan_select
+        if params.scan_select == "pallas" and not lut_serviceable:
+            _warn_lut_fallback()
+            select_impl = "approx"
+        if want_lut:
+            if (index.codebook_kind == "per_subspace"
+                    and ic.lut_scan_mem_ok(n_seg, seg, index.rot_dim,
+                                           pairs, _pk.LUT_SCAN_BINS)
+                    and _pk.pallas_lut_scan_wanted(
+                        index.pq_dim, index.pq_book_size, index.pq_len,
+                        packed_nbytes(index.pq_dim, index.pq_bits),
+                        index.packed_codes.shape[-1], L, index.rot_dim,
+                        seg=seg, lut_dtype=params.lut_dtype)):
+                _count_scan_dispatch("pallas_lut")
+                with span("scan") as _sp:
+                    out = _search_lut_pallas(
+                        index, queries, k, n_probes, seg, n_seg,
+                        filter_bits=filter_bitset,
+                        lut_dtype=params.lut_dtype)
+                    _sp.attach(out)
+                return out
+            if params.scan_select == "pallas":
+                # an EXPLICIT pallas request that the kernel can't serve
+                # (per_cluster codebooks, unsupported layout, off-TPU, or
+                # a memory guard) must not silently land on the exact
+                # grouped scan — the most HBM-hostile engine at exactly
+                # the oversampled shapes this tier exists for. Fall back
+                # to the recall-targeted approx tier (which re-enables
+                # segk when a recon cache exists) and say so.
+                _warn_lut_fallback()
+                select_impl = "approx"
         if params.scan_mode == "grouped" or ic.grouped_mem_ok(
                 n_seg, seg, kk, pairs):
             chunk = ic.fit_seg_chunk(seg, L, index.rot_dim,
                                      params.list_chunk)
-            from raft_tpu.ops import pallas_kernels as _pk
-
-            approx = params.scan_select == "approx"
+            approx = select_impl == "approx"
             segk = (approx and filter_bitset is None
                     and index.packed_recon is not None
                     and _pk.pallas_segmented_wanted(kk, L, index.rot_dim,
                                                     S=seg))
             wants = (not approx) and _pk.pallas_grouped_wanted(
                 kk, L, index.rot_dim, bq=seg)
+            _count_scan_dispatch("segk" if segk else
+                                 ("grouped_pallas" if wants
+                                  else "grouped_xla"))
             return _search_grouped(index, queries, k, n_probes, seg,
                                    n_seg, chunk, use_pallas=wants,
                                    filter_bits=filter_bitset,
-                                   select_impl=params.scan_select,
+                                   select_impl=select_impl,
                                    select_recall=params.scan_recall,
                                    use_segk=segk)
+    _count_scan_dispatch("per_query")
     return _search_impl(index, queries, k, n_probes,
                         _fit_query_tile(params.query_tile, n_probes, index),
                         filter_bits=filter_bitset, lut_dtype=params.lut_dtype)

@@ -114,6 +114,8 @@ def refine_provider(
     import numpy as np
 
     expects(candidates.ndim == 2, "candidates must be [m, n_candidates]")
+    expects(queries.shape[0] == candidates.shape[0],
+            "queries/candidates row mismatch")
     expects(k <= candidates.shape[1], "k=%d > n_candidates=%d",
             k, candidates.shape[1])
     mt = resolve_metric(metric)
@@ -166,6 +168,8 @@ def refine_gathered(
     import numpy as np
 
     expects(candidates.ndim == 2, "candidates must be [m, n_candidates]")
+    expects(queries.shape[0] == candidates.shape[0],
+            "queries/candidates row mismatch")
     expects(k <= candidates.shape[1], "k=%d > n_candidates=%d",
             k, candidates.shape[1])
     mt = resolve_metric(metric)

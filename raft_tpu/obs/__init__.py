@@ -24,6 +24,7 @@ from raft_tpu.obs.metrics import (  # noqa: F401
     set_registry,
 )
 from raft_tpu.obs.spans import (  # noqa: F401
+    count_dispatch,
     current_name,
     disable,
     enable,

@@ -113,6 +113,17 @@ def current_name() -> str:
     return ".".join(_stack())
 
 
+def count_dispatch(name: str, impl: str) -> None:
+    """Count one dispatch decision under ``<name>.dispatch{impl=...}`` —
+    the #1 thing perf triage asks ("which engine actually ran?"). Free
+    when recording is off. Counted per DISPATCH DECISION: once per jit
+    trace for jitted callers (the choice is baked into the compiled
+    program), once per call in eager dispatchers (``ivf_pq.search``'s
+    scan-tier pick, ``select_k``'s engine pick)."""
+    if _enabled:
+        registry().inc(name + ".dispatch", labels={"impl": impl})
+
+
 def env_flag(name: str) -> bool:
     """Parse a boolean env var: unset, '', '0', 'false', 'off', 'no' are
     False; anything else is True (plain string truthiness would read

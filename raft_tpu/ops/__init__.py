@@ -3,5 +3,7 @@
 from .pallas_kernels import (  # noqa: F401
     fused_l2_argmin,
     grouped_scan_topk,
+    ivfpq_lut_scan_topk,
+    pallas_lut_scan_wanted,
     select_k_pallas,
 )

@@ -27,6 +27,7 @@ from typing import Tuple
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
@@ -461,6 +462,381 @@ def pallas_segmented_wanted(kk: int, L: int, d: int, S: int = 128) -> bool:
     Lp = -(-L // _LANES) * _LANES
     dpad = -(-d // _LANES) * _LANES
     vmem = 4 * (Lp * dpad + S * Lp + S * dpad)
+    if vmem > _GROUPED_VMEM_BUDGET:
+        return False
+    return True if force == "always" else _on_tpu()
+
+
+# ---------------------------------------------------------------------------
+# fused IVF-PQ LUT scan: packed codes streamed from HBM, n-bit unpack +
+# ADC accumulation + 2-deep strided-bin top-k all in VMEM
+# ---------------------------------------------------------------------------
+
+# Candidates emitted per (segment, query) slot: two best per strided bin.
+LUT_SCAN_BINS = 2 * _LANES
+
+
+def _lut_scan_config(S: int, K: int, P: int, nb: int, Wb: int,
+                     lut_dtype: str):
+    """Static tiling for :func:`ivfpq_lut_scan_topk`, or ``None`` when the
+    layout is unsupported.
+
+    ``G`` — code rows per stored byte row (1 unfolded; ``128/nb`` for the
+    lane-folded layout, see ``IvfPqIndex.codes_folded``). ``Sg`` —
+    subspaces decoded per MXU call: the grouped block-diagonal codebook
+    operand is ``[K·Sg, Sg·P]``, so ``Sg·P ≤ 128`` keeps the output
+    inside one lane tile and the operand's VMEM cost (``S·K·P·Sg``
+    entries total) stays bounded. ``Kc`` — codebook entries compared per
+    one-hot pass (bounds the ``[rows, Kc·Sg]`` transient)."""
+    if nb <= 0 or Wb % nb:
+        return None
+    G = Wb // nb
+    # bin spreading rotates lanes by 128/G per fold group; G must divide
+    # the lane count, and deep folds mean tiny pq_dim — not this kernel's
+    # territory
+    if G > 8 or (G & (G - 1)):
+        return None
+    op_bytes = 4 if lut_dtype == "float32" else 2
+    cap = min(_LANES // max(P, 1),
+              (4 << 20) // max(1, S * K * P * op_bytes))
+    if cap < 1:
+        return None
+    Sg = max(d for d in range(1, min(S, cap) + 1) if S % d == 0)
+    # largest power of two ≤ min(K, 2048/Sg): divides K (K = 2^pq_bits)
+    Kc = 1 << (min(K, max(1, 2048 // Sg)).bit_length() - 1)
+    return G, Sg, Kc
+
+
+def _lane_pick(a: jax.Array, start: int, stride: int, n: int) -> jax.Array:
+    """Static strided lane slice of ``a [1, W]`` → ``[1, n]``."""
+    if stride == 1:
+        return jax.lax.slice(a, (0, start), (1, start + n))
+    return jax.lax.slice(a, (0, start),
+                         (1, start + (n - 1) * stride + 1), (1, stride))
+
+
+def _roll_lanes(x: jax.Array, sh: int) -> jax.Array:
+    """Static lane rotate (lane i ← lane (i − sh) mod W) via two slices —
+    unambiguous in both Mosaic and interpret mode (``pltpu.roll``'s
+    interpret path is ``jnp.roll``; its Mosaic path is tpu.dynamic_rotate,
+    and relying on both agreeing is exactly the kind of bet this kernel
+    avoids)."""
+    sh %= x.shape[1]
+    if sh == 0:
+        return x
+    return jnp.concatenate([x[:, -sh:], x[:, :-sh]], axis=1)
+
+
+def _ivfpq_lut_scan_kernel(seg_list_ref, qv_ref, codes_ref, ids_ref,
+                           norms_ref, ctr_ref, sel_lo_ref, sel_hi_ref,
+                           off_ref, cbp_ref, keys_ref, oids_ref, *,
+                           metric: str, pq_bits: int, S: int, P: int,
+                           G: int, Sg: int, Kc: int, L: int, Rt: int,
+                           rot: int, exact: bool):
+    """One (segment, code-tile) program of the fused IVF-PQ scan.
+
+    Grid = (n_seg, n_tiles); the tile axis is the sequential minor axis,
+    so the ``[seg, 2·128]`` output block is the running 2-deep bin buffer
+    (same revisit pattern as ``_select_k_kernel``). Per step:
+
+    1. the pipeline DMAs the owning list's next ``[Rt, Wb]`` block of
+       PACKED u8 codes straight out of the full (possibly lane-folded)
+       array via the scalar-prefetched ``seg_list`` index;
+    2. bytes → code values with integer shifts/masks; the byte columns
+       feeding each (fold-group, subspace) are picked by one exact f32
+       selection matmul (Mosaic has no lane gather — a 0/1 matrix on the
+       MXU is the TPU idiom for a static permutation);
+    3. ADC accumulation Σ_s QLUT[s, code_s] in its MXU-factorized form:
+       QLUT[s, k] = ⟨q_s, cb[s,k]⟩, so Σ_s QLUT[s, code_s] =
+       ⟨q_rot, decoded⟩ with decoded built in VMEM by a grouped
+       block-diagonal one-hot × codebook matmul (``[Rt, Kc·Sg] ×
+       [Kc·Sg, Sg·P]``) — identical math to the reference's fused LUT
+       gather (ivf_pq_compute_similarity-inl.cuh) with the per-code
+       gather replaced by the one-hot contraction, and the decoded block
+       never leaves VMEM (contrast: the XLA grouped path round-trips a
+       decoded f32 chunk through HBM per segment chunk);
+    4. metric epilogue against the streamed f32 norms + the in-kernel
+       ⟨q, c⟩ term, then a 2-deep strided-bin running min with GLOBAL
+       candidate ids (fold groups rotate lanes by 128/G so consecutive
+       code rows land in distinct bins — see _segmented_scan_kernel's
+       clustered-data note).
+    """
+    t = pl.program_id(1)
+    seg = qv_ref.shape[1]
+    Wb = codes_ref.shape[2]
+    K = 1 << pq_bits
+    rotp = qv_ref.shape[2]
+    n_sg = S // Sg
+    slabs = Rt // _LANES
+    opd = jnp.float32 if exact else jnp.bfloat16
+    prec = (jax.lax.Precision.HIGHEST if exact
+            else jax.lax.Precision.DEFAULT)
+
+    @pl.when(t == 0)
+    def _init():
+        keys_ref[:] = jnp.full_like(keys_ref, jnp.inf)
+        oids_ref[:] = jnp.full_like(oids_ref, -1)
+
+    qv = qv_ref[0]                                   # [seg, rotp] f32
+    ctr = ctr_ref[:]                                 # [1, rotp] f32
+    qc = jnp.sum(qv * ctr, axis=1)                   # [seg] ⟨q, c⟩
+    ids_row = ids_ref[:]                             # [1, G·Rt] i32
+    norms_row = norms_ref[:]                         # [1, G·Rt] f32
+
+    # bytes → code values: selection matmul (exact: values ≤ 255 in f32)
+    # then integer shift/mask — the in-kernel unpack_bits
+    bytes_f = codes_ref[0].astype(jnp.int32).astype(jnp.float32)
+    lo = jax.lax.dot_general(
+        bytes_f, sel_lo_ref[:], (((1,), (0,)), ((), ())),
+        precision=jax.lax.Precision.HIGHEST,
+        preferred_element_type=jnp.float32)          # [Rt, G·S]
+    if pq_bits == 8:
+        code = lo.astype(jnp.int32)
+    else:
+        hi = jax.lax.dot_general(
+            bytes_f, sel_hi_ref[:], (((1,), (0,)), ((), ())),
+            precision=jax.lax.Precision.HIGHEST,
+            preferred_element_type=jnp.float32)
+        v16 = lo.astype(jnp.int32) | (hi.astype(jnp.int32) << 8)
+        code = jax.lax.shift_right_logical(v16, off_ref[:]) & (K - 1)
+
+    cur_k = keys_ref[0]                              # [seg, 256]
+    cur_i = oids_ref[0]
+    b1k = jax.lax.slice(cur_k, (0, 0), (seg, _LANES))
+    b2k = jax.lax.slice(cur_k, (0, _LANES), (seg, 2 * _LANES))
+    b1i = jax.lax.slice(cur_i, (0, 0), (seg, _LANES))
+    b2i = jax.lax.slice(cur_i, (0, _LANES), (seg, 2 * _LANES))
+
+    one = jnp.asarray(1.0, opd)
+    zero = jnp.asarray(0.0, opd)
+    for si in range(slabs):
+        for g in range(G):
+            # decode this slab's fold group in VMEM: [128, rot]
+            parts = []
+            for sg in range(n_sg):
+                cs = jax.lax.slice(
+                    code, (si * _LANES, g * S + sg * Sg),
+                    ((si + 1) * _LANES, g * S + (sg + 1) * Sg))
+                tiled = cs
+                for _ in range(Kc.bit_length() - 1):
+                    tiled = jnp.concatenate([tiled, tiled], axis=1)
+                acc = jnp.zeros((_LANES, Sg * P), jnp.float32)
+                for kc in range(K // Kc):
+                    kidx = (jax.lax.broadcasted_iota(
+                        jnp.int32, (_LANES, Kc * Sg), 1) // Sg + kc * Kc)
+                    oh = jnp.where(tiled == kidx, one, zero)
+                    cbp = jax.lax.slice(
+                        cbp_ref[sg], (kc * Kc * Sg, 0),
+                        ((kc + 1) * Kc * Sg, Sg * P))
+                    acc = acc + jax.lax.dot_general(
+                        oh, cbp, (((1,), (0,)), ((), ())),
+                        precision=prec,
+                        preferred_element_type=jnp.float32)
+                parts.append(acc)
+            if rotp > rot:
+                parts.append(jnp.zeros((_LANES, rotp - rot), jnp.float32))
+            dec = jnp.concatenate(parts, axis=1)     # [128, rotp]
+            qd = jax.lax.dot_general(
+                qv, dec, (((1,), (1,)), ((), ())),
+                precision=prec,
+                preferred_element_type=jnp.float32)  # [seg, 128] ⟨q, d⟩
+            lane0 = G * si * _LANES + g
+            ids_g = _lane_pick(ids_row, lane0, G, _LANES)      # [1, 128]
+            # list position of lane r: G·(t·Rt + si·128 + r) + g — OOB
+            # tail lanes of the last tile carry garbage, mask them
+            l_pos = (t * Rt + si * _LANES) * G + g + G * jax.lax.broadcasted_iota(
+                jnp.int32, (1, _LANES), 1)
+            valid = (ids_g >= 0) & (l_pos < L)
+            if metric == "ip":
+                key = -(qc[:, None] + qd)
+            else:  # l2: ‖c+d‖² − 2⟨q, c+d⟩ (caller adds ‖q‖²)
+                norms_g = _lane_pick(norms_row, lane0, G, _LANES)
+                key = norms_g - 2.0 * (qc[:, None] + qd)
+            key = jnp.where(valid, key, jnp.inf)
+            idv = jnp.broadcast_to(jnp.where(valid, ids_g, -1),
+                                   (seg, _LANES))
+            # spread fold groups across bins: lane rotate by g·(128/G)
+            sh = g * (_LANES // G)
+            kn = _roll_lanes(key, sh)
+            inew = _roll_lanes(idv, sh)
+            # 2-deep running bin merge
+            lt1 = kn < b1k
+            lt2 = jnp.logical_and(jnp.logical_not(lt1), kn < b2k)
+            b2k = jnp.where(lt1, b1k, jnp.where(lt2, kn, b2k))
+            b2i = jnp.where(lt1, b1i, jnp.where(lt2, inew, b2i))
+            b1k = jnp.where(lt1, kn, b1k)
+            b1i = jnp.where(lt1, inew, b1i)
+
+    keys_ref[0] = jnp.concatenate([b1k, b2k], axis=1)
+    oids_ref[0] = jnp.concatenate([b1i, b2i], axis=1)
+
+
+@functools.partial(jax.jit, static_argnames=(
+    "metric", "pq_bits", "pq_dim", "L", "lut_dtype", "interpret"))
+def ivfpq_lut_scan_topk(seg_list: jax.Array, qv: jax.Array,
+                        packed: jax.Array, ids: jax.Array,
+                        norms: jax.Array, centers_rot: jax.Array,
+                        codebooks: jax.Array, metric: str = "l2", *,
+                        pq_bits: int, pq_dim: int, L: int,
+                        lut_dtype: str = "float32",
+                        interpret: bool = False
+                        ) -> Tuple[jax.Array, jax.Array]:
+    """Fused segmented IVF-PQ scan over PACKED codes (no recon cache).
+
+    The oversampled DEEP-100M configs (n_probes 64–128, k_cand 400–1000)
+    are hostile to the XLA grouped scan twice over: the decoded-f32 list
+    chunks and the ``[n_seg, seg, k_cand]`` accumulators round-trip HBM
+    (measured OOM at QB=2000 beside a 10.9 GB index), and the per-chunk
+    one-hot decode re-materializes. This kernel streams the packed
+    (optionally lane-folded) u8 codes per segment via scalar-prefetch
+    DMA, unpacks ``pq_bits`` in-kernel, performs the ADC accumulation
+    Σ_s QLUT[s, code_s] on-chip in its MXU-factorized form, and keeps a
+    2-deep strided-bin top buffer per (segment, query) slot — nothing
+    but the ``[n_seg, seg, 256]`` bin tables ever reaches HBM.
+
+    seg_list [n_seg] i32 — owning list per segment (scalar-prefetched);
+    qv [n_seg, seg, rot_dim] f32 — per-segment ROTATED queries;
+    packed [n_lists, R, Wb] u8 — packed codes, native storage layout
+    (``Wb = nb`` unfolded, ``Wb = 128`` lane-folded);
+    ids / norms [n_lists, L] — global ids (-1 pad) and ‖c+d‖²;
+    centers_rot [n_lists, rot_dim] f32; codebooks [S, K, P] f32
+    (per_subspace only).
+
+    ``lut_dtype`` is the reference's ``search_params::lut_dtype`` trade
+    (ivf_pq_fp_8bit.cuh) mapped to TPU: it sets the dtype of the
+    codebook operand and the one-hot/scan contraction ("float32" = exact
+    f32 MXU passes, "bfloat16" = bf16 operands, "float8_e4m3" = fp8-
+    quantized codebook values contracted in bf16). The XLA path
+    quantizes the LUT entries ⟨q_s, cb[s,k]⟩ instead — same knob, same
+    footprint trade, numerically a sibling rather than a twin.
+
+    Returns (keys [n_seg, seg, 256], ids [n_seg, seg, 256]): minimized
+    sort keys per strided bin (l2: ‖c+d‖² − 2⟨q,c+d⟩, add ‖q‖²; ip:
+    −⟨q,c+d⟩) and GLOBAL candidate ids (-1 invalid), two best per bin —
+    merge like ``segmented_scan_topk``'s output.
+    """
+    n_seg, seg, rot = qv.shape
+    S, K, P = codebooks.shape
+    assert metric in ("l2", "ip")
+    assert S == pq_dim and K == (1 << pq_bits)
+    nb = (S * pq_bits + 7) // 8
+    Wb = packed.shape[2]
+    cfg = _lut_scan_config(S, K, P, nb, Wb, lut_dtype)
+    if cfg is None:
+        raise ValueError(
+            f"unsupported packed-code layout for the LUT scan kernel: "
+            f"nb={nb} Wb={Wb} (gate with pallas_lut_scan_wanted)")
+    G, Sg, Kc = cfg
+    exact = lut_dtype == "float32"
+    opd = jnp.float32 if exact else jnp.bfloat16
+
+    R = packed.shape[1]
+    Rt = 2 * _LANES if R >= 2 * _LANES else _LANES
+    if R < Rt:  # tiny index (tests): pad to one full tile
+        packed = _pad_to(packed, Rt, 1, 0)
+        ids = _pad_to(ids, G * Rt, 1, -1)
+        norms = _pad_to(norms, G * Rt, 1, 0.0)
+    n_t = -(-packed.shape[1] // Rt)
+
+    qvp = _pad_to(qv.astype(jnp.float32), _SUBLANES, 1, 0.0)
+    qvp = _pad_to(qvp, _LANES, 2, 0.0)
+    segp, rotp = qvp.shape[1], qvp.shape[2]
+    ctr = _pad_to(centers_rot.astype(jnp.float32), _LANES, 1, 0.0)
+
+    # unpack selection matrices + per-column shift amounts (static)
+    s_idx = np.arange(S)
+    byte_idx = (s_idx * pq_bits) // 8
+    off_np = ((s_idx * pq_bits) % 8).astype(np.int32)
+    sel_lo = np.zeros((Wb, G * S), np.float32)
+    sel_hi = np.zeros((Wb, G * S), np.float32)
+    for g in range(G):
+        for s in range(S):
+            sel_lo[g * nb + byte_idx[s], g * S + s] = 1.0
+            if byte_idx[s] + 1 < nb:
+                sel_hi[g * nb + byte_idx[s] + 1, g * S + s] = 1.0
+    off_arr = jnp.asarray(np.tile(off_np, G)[None, :])
+
+    # grouped block-diagonal codebooks: cbp[gi, k·Sg + j, j·P : (j+1)·P]
+    # = cb[gi·Sg + j, k] — the one-hot's lane order is (k-major, then j)
+    cb = codebooks.astype(jnp.float32)
+    if lut_dtype == "float8_e4m3":
+        cb = cb.astype(jnp.float8_e4m3fn).astype(jnp.bfloat16)
+    n_sg = S // Sg
+    cb_t = cb.reshape(n_sg, Sg, K, P).transpose(0, 2, 1, 3)
+    eye = jnp.eye(Sg, dtype=jnp.float32)
+    cbp = (cb_t.astype(jnp.float32)[:, :, :, None, :]
+           * eye[None, None, :, :, None]).reshape(
+               n_sg, K * Sg, Sg * P).astype(opd)
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=(n_seg, n_t),
+        in_specs=[
+            pl.BlockSpec((1, segp, rotp), lambda s, t, sl: (s, 0, 0)),
+            pl.BlockSpec((1, Rt, Wb), lambda s, t, sl: (sl[s], t, 0)),
+            pl.BlockSpec((1, G * Rt), lambda s, t, sl: (sl[s], t)),
+            pl.BlockSpec((1, G * Rt), lambda s, t, sl: (sl[s], t)),
+            pl.BlockSpec((1, rotp), lambda s, t, sl: (sl[s], 0)),
+            pl.BlockSpec((Wb, G * S), lambda s, t, sl: (0, 0)),
+            pl.BlockSpec((Wb, G * S), lambda s, t, sl: (0, 0)),
+            pl.BlockSpec((1, G * S), lambda s, t, sl: (0, 0)),
+            pl.BlockSpec((n_sg, K * Sg, Sg * P),
+                         lambda s, t, sl: (0, 0, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, segp, LUT_SCAN_BINS),
+                         lambda s, t, sl: (s, 0, 0)),
+            pl.BlockSpec((1, segp, LUT_SCAN_BINS),
+                         lambda s, t, sl: (s, 0, 0)),
+        ],
+    )
+    keys, kids = pl.pallas_call(
+        functools.partial(
+            _ivfpq_lut_scan_kernel, metric=metric, pq_bits=pq_bits, S=S,
+            P=P, G=G, Sg=Sg, Kc=Kc, L=L, Rt=Rt, rot=rot, exact=exact),
+        grid_spec=grid_spec,
+        out_shape=[
+            jax.ShapeDtypeStruct((n_seg, segp, LUT_SCAN_BINS), jnp.float32),
+            jax.ShapeDtypeStruct((n_seg, segp, LUT_SCAN_BINS), jnp.int32),
+        ],
+        interpret=interpret,
+    )(seg_list.astype(jnp.int32), qvp, packed, ids, norms, ctr,
+      jnp.asarray(sel_lo), jnp.asarray(sel_hi), off_arr, cbp)
+    return keys[:, :seg], kids[:, :seg]
+
+
+def pallas_lut_scan_wanted(S: int, K: int, P: int, nb: int, Wb: int,
+                           L: int, rot: int, seg: int = 128,
+                           lut_dtype: str = "float32") -> bool:
+    """Dispatch for :func:`ivfpq_lut_scan_topk` — the ``scan_select=
+    "pallas"`` tier. Needs a per_subspace packed layout the in-kernel
+    unpack supports (byte width dividing the stored lane width, fold
+    group ≤ 8) and a VMEM-sized working set. Env override
+    ``RAFT_TPU_PALLAS_LUTSCAN`` = always | never | auto — "always" runs
+    interpreted off-TPU (tests)."""
+    import os
+
+    force = os.environ.get("RAFT_TPU_PALLAS_LUTSCAN", "auto")
+    if force == "never":
+        return False
+    cfg = _lut_scan_config(S, K, P, nb, Wb, lut_dtype)
+    if cfg is None:
+        return False
+    G, Sg, Kc = cfg
+    op_bytes = 4 if lut_dtype == "float32" else 2
+    rotp = -(-rot // _LANES) * _LANES
+    Rt = 2 * _LANES
+    vmem = (
+        2 * seg * rotp * 4            # qv block (+double buffer)
+        + 2 * Rt * max(Wb, _LANES)    # u8 codes block
+        + Rt * G * S * 8              # unpacked bytes + codes (f32+i32)
+        + S * K * P * Sg * op_bytes   # grouped block-diag codebooks
+        + _LANES * Kc * Sg * 8        # one-hot transient (+tiled codes)
+        + _LANES * rotp * 4           # decoded block
+        + seg * _LANES * 4            # qd block
+        + 2 * seg * LUT_SCAN_BINS * 8  # running bin buffers (keys+ids)
+        + 2 * Wb * G * S * 4          # selection matrices
+    )
     if vmem > _GROUPED_VMEM_BUDGET:
         return False
     return True if force == "always" else _on_tpu()

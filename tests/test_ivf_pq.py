@@ -424,6 +424,204 @@ class TestApproxScanSelect:
         assert same >= 0.8, same
 
 
+class TestPallasLutScanTier:
+    """scan_select="pallas" — the fused LUT-scan kernel over packed
+    codes (interpret mode off-TPU) must agree with the exact per_query
+    LUT path, across pq_bits, metrics, folded storage, and filters."""
+
+    def _corpus(self, d=32):
+        from raft_tpu.random import make_blobs
+        from raft_tpu.random.rng import RngState
+        x, _ = make_blobs(3000, d, n_clusters=30, cluster_std=1.0,
+                          state=RngState(21))
+        q, _ = make_blobs(60, d, n_clusters=30, cluster_std=1.0,
+                          state=RngState(22))
+        return np.asarray(x), np.asarray(q)
+
+    def _build(self, x, **kw):
+        kw.setdefault("n_lists", 16)
+        kw.setdefault("pq_dim", 16)
+        kw.setdefault("seed", 0)
+        kw.setdefault("cache_reconstruction", "never")
+        return ivf_pq.build(jnp.asarray(x), IndexParams(**kw))
+
+    @pytest.mark.parametrize("bits", [4, 5, 6, 8])
+    def test_matches_per_query_nbit(self, bits, monkeypatch):
+        monkeypatch.setenv("RAFT_TPU_PALLAS_LUTSCAN", "always")
+        x, q = self._corpus()
+        idx = self._build(x, pq_bits=bits)
+        dp, ip_ = ivf_pq.search(idx, jnp.asarray(q), 20,
+                                SearchParams(n_probes=8,
+                                             scan_select="pallas"))
+        de, ie = ivf_pq.search(idx, jnp.asarray(q), 20,
+                               SearchParams(n_probes=8,
+                                            scan_mode="per_query"))
+        np.testing.assert_allclose(np.sort(np.asarray(dp), 1),
+                                   np.sort(np.asarray(de), 1),
+                                   rtol=1e-3, atol=1e-3)
+        same = np.mean([len(set(a) & set(b)) / 20.0 for a, b in
+                        zip(np.asarray(ip_), np.asarray(ie))])
+        assert same >= 0.99, same
+
+    @pytest.mark.parametrize(
+        "metric", ["euclidean", "inner_product", "cosine"])
+    def test_matches_per_query_metrics(self, metric, monkeypatch):
+        monkeypatch.setenv("RAFT_TPU_PALLAS_LUTSCAN", "always")
+        x, q = self._corpus()
+        idx = self._build(x, metric=metric)
+        dp, ip_ = ivf_pq.search(idx, jnp.asarray(q), 10,
+                                SearchParams(n_probes=8,
+                                             scan_select="pallas"))
+        de, ie = ivf_pq.search(idx, jnp.asarray(q), 10,
+                               SearchParams(n_probes=8,
+                                            scan_mode="per_query"))
+        np.testing.assert_allclose(np.sort(np.asarray(dp), 1),
+                                   np.sort(np.asarray(de), 1),
+                                   rtol=1e-3, atol=1e-3)
+
+    def test_folded_storage_matches(self, monkeypatch):
+        monkeypatch.setenv("RAFT_TPU_PALLAS_LUTSCAN", "always")
+        x, q = self._corpus()
+        idx = self._build(x)
+        n_lists, L, nb = idx.packed_codes.shape
+        assert (L * nb) % 128 == 0, "fixture must be foldable"
+        folded = idx.replace(
+            packed_codes=idx.packed_codes.reshape(n_lists, -1, 128))
+        folded = ivf_pq.IvfPqIndex(
+            centers=folded.centers, centers_rot=folded.centers_rot,
+            rotation=folded.rotation, codebooks=folded.codebooks,
+            packed_codes=folded.packed_codes,
+            packed_ids=folded.packed_ids,
+            packed_norms=folded.packed_norms,
+            list_sizes=folded.list_sizes, metric=folded.metric,
+            codebook_kind=folded.codebook_kind, pq_bits=folded.pq_bits,
+            pq_dim_static=idx.pq_dim, codes_folded=True)
+        sp = SearchParams(n_probes=8, scan_select="pallas")
+        du, iu = ivf_pq.search(idx, jnp.asarray(q), 10, sp)
+        df, if_ = ivf_pq.search(folded, jnp.asarray(q), 10, sp)
+        np.testing.assert_array_equal(np.asarray(iu), np.asarray(if_))
+        np.testing.assert_allclose(np.asarray(du), np.asarray(df),
+                                   rtol=1e-5, atol=1e-5)
+
+    def test_lut_dtype_tiers_through_search(self, monkeypatch):
+        monkeypatch.setenv("RAFT_TPU_PALLAS_LUTSCAN", "always")
+        x, q = self._corpus()
+        idx = self._build(x)
+        de, ie = ivf_pq.search(idx, jnp.asarray(q), 10,
+                               SearchParams(n_probes=8,
+                                            scan_mode="per_query"))
+        overlaps = {}
+        for lut, bar in (("bfloat16", 0.9), ("float8_e4m3", 0.7)):
+            _, il = ivf_pq.search(idx, jnp.asarray(q), 10,
+                                  SearchParams(n_probes=8,
+                                               scan_select="pallas",
+                                               lut_dtype=lut))
+            same = np.mean([len(set(a) & set(b)) / 10.0 for a, b in
+                            zip(np.asarray(il), np.asarray(ie))])
+            overlaps[lut] = same
+            assert same >= bar, (lut, same)
+
+    def test_filter_bitset_falls_back(self, monkeypatch):
+        """Filtered searches never ride the LUT tier — its bin
+        pre-selection is filter-blind, so a selective filter would lose
+        kept neighbors outside each probe's unfiltered top bins. The
+        request is served correctly by the approx fallback."""
+        from raft_tpu import obs
+        from raft_tpu.core import bitset
+        from raft_tpu.obs.metrics import MetricsRegistry
+        monkeypatch.setenv("RAFT_TPU_PALLAS_LUTSCAN", "always")
+        x, q = self._corpus()
+        idx = self._build(x)
+        mask = np.ones(len(x), bool)
+        mask[::3] = False  # exclude a third of the corpus
+        bits = bitset.from_mask(jnp.asarray(mask))
+        sp = SearchParams(n_probes=8, scan_select="pallas")
+        reg = MetricsRegistry()
+        obs.enable(registry=reg, hbm=False)
+        try:
+            _, ids = ivf_pq.search(idx, jnp.asarray(q), 10, sp,
+                                   filter_bitset=bits)
+        finally:
+            obs.disable()
+        counters = reg.snapshot()["counters"]
+        assert counters.get("ivf_pq.scan.dispatch{impl=pallas_lut}",
+                            0) == 0, counters
+        ids = np.asarray(ids)
+        got = ids[ids >= 0]
+        assert got.size and not np.any(got % 3 == 0)
+
+    def test_falls_back_gracefully_off_tpu(self, monkeypatch):
+        """Without the env force, scan_select="pallas" off-TPU downgrades
+        to the approx grouped tier — same results (approx select is
+        exact on CPU), no crash, and a once-per-process warning."""
+        from raft_tpu.core import logging as rlog
+        monkeypatch.delenv("RAFT_TPU_PALLAS_LUTSCAN", raising=False)
+        monkeypatch.setattr(ivf_pq, "_lut_fallback_warned", False)
+        x, q = self._corpus()
+        idx = self._build(x)
+        msgs = []
+        rlog.set_callback(lambda lvl, msg: msgs.append(msg))
+        try:
+            dp, _ = ivf_pq.search(idx, jnp.asarray(q), 10,
+                                  SearchParams(n_probes=8,
+                                               scan_select="pallas"))
+        finally:
+            rlog.set_callback(None)
+        assert any("scan_select='pallas' requested" in m for m in msgs)
+        de, _ = ivf_pq.search(idx, jnp.asarray(q), 10,
+                              SearchParams(n_probes=8,
+                                           scan_mode="per_query"))
+        np.testing.assert_allclose(np.sort(np.asarray(dp), 1),
+                                   np.sort(np.asarray(de), 1),
+                                   rtol=1e-3, atol=1e-3)
+
+    def test_no_upgrade_when_bins_cannot_cover_k(self, monkeypatch):
+        """k beyond n_probes·256 must NOT upgrade to the LUT tier — its
+        bin cap would pad the tail with -1s where the approx tier
+        returns real neighbors."""
+        from raft_tpu import obs
+        from raft_tpu.obs.metrics import MetricsRegistry
+        monkeypatch.setenv("RAFT_TPU_PALLAS_LUTSCAN", "always")
+        x, q = self._corpus()
+        idx = self._build(x)
+        reg = MetricsRegistry()
+        obs.enable(registry=reg, hbm=False)
+        try:
+            _, ids = ivf_pq.search(
+                idx, jnp.asarray(q), 400,
+                SearchParams(n_probes=1, scan_mode="grouped",
+                             scan_select="approx"))
+        finally:
+            obs.disable()
+        counters = reg.snapshot()["counters"]
+        assert counters.get("ivf_pq.scan.dispatch{impl=pallas_lut}",
+                            0) == 0, counters
+        # the approx tier serves every real candidate the probed list
+        # holds (well beyond the LUT tier's 256-per-probe bin cap)
+        assert (np.asarray(ids) >= 0).sum(1).max() > 256
+
+    def test_approx_auto_upgrades_on_oversampled_shapes(self, monkeypatch):
+        """The DEEP-100M regime (k_cand ≥ 400, no recon cache) upgrades
+        scan_select="approx" to the LUT kernel; the dispatch counter
+        records the decision."""
+        from raft_tpu import obs
+        from raft_tpu.obs.metrics import MetricsRegistry
+        monkeypatch.setenv("RAFT_TPU_PALLAS_LUTSCAN", "always")
+        x, q = self._corpus()
+        idx = self._build(x)
+        reg = MetricsRegistry()
+        obs.enable(registry=reg, hbm=False)
+        try:
+            ivf_pq.search(idx, jnp.asarray(q), 400,
+                          SearchParams(n_probes=8, scan_mode="grouped",
+                                       scan_select="approx"))
+        finally:
+            obs.disable()
+        counters = reg.snapshot()["counters"]
+        assert counters.get("ivf_pq.scan.dispatch{impl=pallas_lut}", 0) \
+            >= 1, counters
+
+
 def test_folded_codes_storage_matches(rng):
     """Lane-folded code storage (codes_folded=True) must search
     identically — it is the same bytes reshaped to a [*, 128] trailing

@@ -161,3 +161,39 @@ class TestDistributedKMeans:
         assert np.isfinite(float(inertia))
         labels = dkm.predict(c, jnp.asarray(np.asarray(x)), mesh)
         assert labels.shape == (997,)
+
+
+def test_balanced_level2_drop_warning():
+    """Level-2 sampling truncation past the per-mesocluster cap must be
+    surfaced as a warning above the threshold and stay silent below it
+    (ADVICE r5) — silent sampling bias is otherwise invisible."""
+    from raft_tpu.cluster.kmeans_balanced import _warn_level2_drop
+    from raft_tpu.core import logging as rlog
+
+    msgs = []
+    rlog.set_callback(lambda lvl, msg: msgs.append(msg))
+    try:
+        _warn_level2_drop(1, 1000, 504)      # 0.1% — below threshold
+        assert not msgs
+        _warn_level2_drop(150, 1000, 504)    # 15% — must warn
+    finally:
+        rlog.set_callback(None)
+    assert any("level-2 sampling dropped" in m for m in msgs), msgs
+
+
+def test_balanced_fit_no_drop_warning_on_blobs():
+    """A well-behaved dataset through the full hierarchical fit must not
+    trigger the level-2 drop warning (wiring check)."""
+    from raft_tpu.cluster import kmeans_balanced
+    from raft_tpu.cluster.kmeans_balanced import KMeansBalancedParams
+    from raft_tpu.core import logging as rlog
+
+    x, _ = make_blobs(2000, 8, n_clusters=16, cluster_std=1.0)
+    msgs = []
+    rlog.set_callback(lambda lvl, msg: msgs.append(msg))
+    try:
+        kmeans_balanced.fit(jnp.asarray(np.asarray(x)), 64,
+                            KMeansBalancedParams(n_iters=4, seed=0))
+    finally:
+        rlog.set_callback(None)
+    assert not any("level-2 sampling dropped" in m for m in msgs), msgs

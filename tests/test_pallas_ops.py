@@ -122,3 +122,206 @@ class TestSegmentedScan:
         score[:, ids[2] < 0] = np.inf
         ref = score.reshape(8, 2, 128).min(axis=1)
         np.testing.assert_allclose(keys[s][:, :128], ref, rtol=1e-4, atol=1e-4)
+
+
+class TestIvfPqLutScan:
+    """ivfpq_lut_scan_topk (interpret mode) vs a numpy ADC reference:
+    in-kernel unpack of packed pq_bits codes, Σ_s QLUT[s, code_s]
+    accumulation, masked list tails, and the 2-deep bin running merge."""
+
+    def _mk(self, rng, n_lists, L, S, pq_bits, P, n_seg, seg, sizes=None,
+            fold=False):
+        from raft_tpu.neighbors.ivf_pq import pack_bits_np
+
+        K = 1 << pq_bits
+        rot = S * P
+        codes = rng.integers(0, K, (n_lists, L, S)).astype(np.uint8)
+        packed = np.stack([pack_bits_np(codes[li], pq_bits)
+                           for li in range(n_lists)])
+        if fold:
+            nb = packed.shape[-1]
+            assert (L * nb) % 128 == 0
+            packed = packed.reshape(n_lists, -1, 128)
+        cb = rng.standard_normal((S, K, P)).astype(np.float32)
+        ids = np.full((n_lists, L), -1, np.int32)
+        if sizes is None:
+            sizes = [L] * n_lists
+        for li, sz in enumerate(sizes):
+            # unique ids per list: the parity checks key by id
+            ids[li, :sz] = li * L + rng.permutation(L)[:sz]
+        norms = rng.random((n_lists, L)).astype(np.float32) + 0.5
+        ctr = rng.standard_normal((n_lists, rot)).astype(np.float32)
+        qv = rng.standard_normal((n_seg, seg, rot)).astype(np.float32)
+        seg_list = rng.integers(0, n_lists, n_seg).astype(np.int32)
+        return codes, packed, cb, ids, norms, ctr, qv, seg_list
+
+    def _ref_keys(self, codes, cb, ids, norms, ctr, qv, li, s, metric):
+        """All-candidate reference: {id: key} for segment s over list li."""
+        S = codes.shape[-1]
+        dec = cb[np.arange(S)[:, None], codes[li].T].transpose(1, 0, 2)
+        dec = dec.reshape(codes.shape[1], -1)             # [L, rot]
+        qd = qv[s] @ dec.T                                # [seg, L]
+        qc = qv[s] @ ctr[li]                              # [seg]
+        if metric == "ip":
+            key = -(qc[:, None] + qd)
+        else:
+            key = norms[li][None, :] - 2.0 * (qc[:, None] + qd)
+        return key
+
+    @pytest.mark.parametrize("pq_bits", [4, 5, 6, 8])
+    def test_unpack_and_adc_parity(self, pq_bits):
+        """L ≤ bins → the emitted candidate set is LOSSLESS: every valid
+        candidate appears exactly once with its exact ADC key."""
+        from raft_tpu.ops.pallas_kernels import ivfpq_lut_scan_topk
+
+        rng = np.random.default_rng(3 + pq_bits)
+        n_lists, L, S, P, n_seg, seg = 4, 256, 16, 2, 5, 8
+        codes, packed, cb, ids, norms, ctr, qv, seg_list = self._mk(
+            rng, n_lists, L, S, pq_bits, P, n_seg, seg,
+            sizes=[L, L - 37, 3, 0])
+        keys, kids = ivfpq_lut_scan_topk(
+            jnp.asarray(seg_list), jnp.asarray(qv), jnp.asarray(packed),
+            jnp.asarray(ids), jnp.asarray(norms), jnp.asarray(ctr),
+            jnp.asarray(cb), "l2", pq_bits=pq_bits, pq_dim=S, L=L,
+            lut_dtype="float32", interpret=True)
+        keys, kids = np.asarray(keys), np.asarray(kids)
+        assert keys.shape == (n_seg, seg, 256)
+        for s in (0, 2, n_seg - 1):
+            li = seg_list[s]
+            ref = self._ref_keys(codes, cb, ids, norms, ctr, qv, li, s,
+                                 "l2")
+            for q in range(seg):
+                got = {int(i): k for i, k in zip(kids[s, q], keys[s, q])
+                       if i >= 0}
+                want = {int(ids[li, l]): ref[q, l]
+                        for l in range(L) if ids[li, l] >= 0}
+                assert set(got) == set(want)
+                for i in want:
+                    np.testing.assert_allclose(got[i], want[i],
+                                               rtol=1e-4, atol=1e-4)
+
+    def test_folded_layout_parity(self):
+        """Lane-folded packed codes (codes_folded storage) decode
+        identically — the fold-group strided unpack and bin spreading."""
+        from raft_tpu.ops.pallas_kernels import ivfpq_lut_scan_topk
+
+        rng = np.random.default_rng(7)
+        # S=16, pq_bits=8 → nb=16 → G=8 fold groups per 128-byte row
+        n_lists, L, S, P, n_seg, seg = 3, 240, 16, 2, 4, 8
+        codes, packed, cb, ids, norms, ctr, qv, seg_list = self._mk(
+            rng, n_lists, L, S, 8, P, n_seg, seg,
+            sizes=[L, 100, 17], fold=True)
+        assert packed.shape[-1] == 128
+        keys, kids = ivfpq_lut_scan_topk(
+            jnp.asarray(seg_list), jnp.asarray(qv), jnp.asarray(packed),
+            jnp.asarray(ids), jnp.asarray(norms), jnp.asarray(ctr),
+            jnp.asarray(cb), "l2", pq_bits=8, pq_dim=S, L=L,
+            lut_dtype="float32", interpret=True)
+        keys, kids = np.asarray(keys), np.asarray(kids)
+        for s in range(n_seg):
+            li = seg_list[s]
+            ref = self._ref_keys(codes, cb, ids, norms, ctr, qv, li, s,
+                                 "l2")
+            for q in (0, seg - 1):
+                got = {int(i): k for i, k in zip(kids[s, q], keys[s, q])
+                       if i >= 0}
+                want = {int(ids[li, l]): ref[q, l]
+                        for l in range(L) if ids[li, l] >= 0}
+                assert set(got) == set(want)
+                for i in want:
+                    np.testing.assert_allclose(got[i], want[i],
+                                               rtol=1e-4, atol=1e-4)
+
+    def test_two_deep_bins_lossy_tail(self):
+        """L > bins: each bin keeps the TWO smallest of its strided
+        candidates (unfolded mapping: bin = position mod 128)."""
+        from raft_tpu.ops.pallas_kernels import ivfpq_lut_scan_topk
+
+        rng = np.random.default_rng(11)
+        n_lists, L, S, P, n_seg, seg = 2, 512, 16, 2, 3, 8
+        codes, packed, cb, ids, norms, ctr, qv, seg_list = self._mk(
+            rng, n_lists, L, S, 8, P, n_seg, seg)
+        keys, kids = ivfpq_lut_scan_topk(
+            jnp.asarray(seg_list), jnp.asarray(qv), jnp.asarray(packed),
+            jnp.asarray(ids), jnp.asarray(norms), jnp.asarray(ctr),
+            jnp.asarray(cb), "l2", pq_bits=8, pq_dim=S, L=L,
+            lut_dtype="float32", interpret=True)
+        keys, kids = np.asarray(keys), np.asarray(kids)
+        s = 1
+        li = seg_list[s]
+        ref = self._ref_keys(codes, cb, ids, norms, ctr, qv, li, s, "l2")
+        for q in (0, 3):
+            for lane in (0, 17, 127):
+                cand = sorted(ref[q, lane::128])
+                got = sorted([keys[s, q, lane], keys[s, q, 128 + lane]])
+                np.testing.assert_allclose(got, cand[:2],
+                                           rtol=1e-4, atol=1e-4)
+
+    def test_ip_metric_keys(self):
+        from raft_tpu.ops.pallas_kernels import ivfpq_lut_scan_topk
+
+        rng = np.random.default_rng(13)
+        n_lists, L, S, P, n_seg, seg = 3, 128, 8, 4, 3, 8
+        codes, packed, cb, ids, norms, ctr, qv, seg_list = self._mk(
+            rng, n_lists, L, S, 8, P, n_seg, seg, sizes=[L, 60, L])
+        keys, kids = ivfpq_lut_scan_topk(
+            jnp.asarray(seg_list), jnp.asarray(qv), jnp.asarray(packed),
+            jnp.asarray(ids), jnp.asarray(norms), jnp.asarray(ctr),
+            jnp.asarray(cb), "ip", pq_bits=8, pq_dim=S, L=L,
+            lut_dtype="float32", interpret=True)
+        keys, kids = np.asarray(keys), np.asarray(kids)
+        s, q = 1, 2
+        li = seg_list[s]
+        ref = self._ref_keys(codes, cb, ids, norms, ctr, qv, li, s, "ip")
+        got = {int(i): k for i, k in zip(kids[s, q], keys[s, q]) if i >= 0}
+        want = {int(ids[li, l]): ref[q, l]
+                for l in range(L) if ids[li, l] >= 0}
+        assert set(got) == set(want)
+        for i in want:
+            np.testing.assert_allclose(got[i], want[i], rtol=1e-4,
+                                       atol=1e-4)
+
+    def test_lut_dtype_tolerance_tiers(self):
+        """bf16 keys track f32 keys loosely; fp8 more loosely — the
+        quantization ladder the lut_dtype knob buys."""
+        from raft_tpu.ops.pallas_kernels import ivfpq_lut_scan_topk
+
+        rng = np.random.default_rng(17)
+        n_lists, L, S, P, n_seg, seg = 2, 128, 16, 2, 2, 8
+        codes, packed, cb, ids, norms, ctr, qv, seg_list = self._mk(
+            rng, n_lists, L, S, 8, P, n_seg, seg)
+        outs = {}
+        for dt in ("float32", "bfloat16", "float8_e4m3"):
+            k_, _ = ivfpq_lut_scan_topk(
+                jnp.asarray(seg_list), jnp.asarray(qv),
+                jnp.asarray(packed), jnp.asarray(ids), jnp.asarray(norms),
+                jnp.asarray(ctr), jnp.asarray(cb), "l2", pq_bits=8,
+                pq_dim=S, L=L, lut_dtype=dt, interpret=True)
+            outs[dt] = np.asarray(k_)
+        fin = np.isfinite(outs["float32"])
+        assert (np.isfinite(outs["bfloat16"]) == fin).all()
+        scale = np.abs(outs["float32"][fin]).max()
+        bf16_err = np.abs(outs["bfloat16"][fin]
+                          - outs["float32"][fin]).max() / scale
+        fp8_err = np.abs(outs["float8_e4m3"][fin]
+                         - outs["float32"][fin]).max() / scale
+        assert bf16_err < 0.05, bf16_err
+        assert fp8_err < 0.30, fp8_err
+        assert bf16_err <= fp8_err
+
+    def test_dispatch_heuristic(self, monkeypatch):
+        from raft_tpu.ops.pallas_kernels import pallas_lut_scan_wanted
+
+        monkeypatch.delenv("RAFT_TPU_PALLAS_LUTSCAN", raising=False)
+        # off-TPU, no force → not wanted
+        assert not pallas_lut_scan_wanted(64, 256, 2, 64, 64, 1024, 128)
+        monkeypatch.setenv("RAFT_TPU_PALLAS_LUTSCAN", "always")
+        assert pallas_lut_scan_wanted(64, 256, 2, 64, 64, 1024, 128)
+        # folded deep-100m shape: nb=64 inside 128-byte rows (G=2)
+        assert pallas_lut_scan_wanted(64, 256, 2, 64, 128, 18312, 128)
+        # byte width not dividing the stored row width → unsupported
+        assert not pallas_lut_scan_wanted(96, 256, 1, 96, 128, 1024, 96)
+        # fold group too deep (G=16)
+        assert not pallas_lut_scan_wanted(8, 256, 2, 8, 128, 1024, 16)
+        monkeypatch.setenv("RAFT_TPU_PALLAS_LUTSCAN", "never")
+        assert not pallas_lut_scan_wanted(64, 256, 2, 64, 64, 1024, 128)

@@ -27,3 +27,13 @@ def test_ivf_scan_crossover_smoke():
                                 n_lists=32, n_probes=8, iters=1)
     modes = {(r.params["batch"], r.impl) for r in rows}
     assert (16, "grouped") in modes and (128, "per_query") in modes
+
+
+def test_pq_scan_bench_rows(monkeypatch):
+    """The scan-kernel microbench must emit a one-hot row and, with the
+    interpret-mode force on, a pallas_lut row (ISSUE 2 acceptance)."""
+    monkeypatch.setenv("RAFT_TPU_PALLAS_LUTSCAN", "always")
+    rows = prims.bench_pq_scan(grid=[(2000, 32, 16, 8, 40, 64)], iters=1)
+    impls = {r.impl for r in rows}
+    assert impls == {"one_hot", "pallas_lut"}, impls
+    assert all(r.ms > 0 and np.isfinite(r.throughput) for r in rows)

@@ -67,3 +67,16 @@ def test_refine_provider_multi_chunk_callers(prov):
     np.testing.assert_array_equal(
         np.asarray(i_full),
         np.concatenate([np.asarray(p[1]) for p in parts]))
+
+
+def test_refine_provider_validates_row_mismatch(prov):
+    # refine() validated the queries/candidates row match; the provider
+    # and host-gather variants must too (ADVICE r5)
+    from raft_tpu.core.errors import LogicError
+
+    q = jnp.asarray(np.asarray(prov.queries(8)))
+    cand = jnp.asarray(np.zeros((4, 16), np.int32))  # 4 != 8 rows
+    with pytest.raises(LogicError):
+        refine.refine_provider(prov, q, cand, 5)
+    with pytest.raises(LogicError):
+        refine.refine_gathered(np.zeros((100, 16), np.float32), q, cand, 5)
