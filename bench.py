@@ -61,8 +61,11 @@ STATE = {"detail": [], "t0": time.time(), "notes": []}
 
 def _payload():
     detail = STATE["detail"]
+    # headline stays the batch-10000 protocol: the batch-1/10 latency
+    # legs ride along in detail but never compete for the metric
     ann = [r for r in detail if r["dataset"].startswith("sift")
-           and r["algo"] != "brute_force"]
+           and r["algo"] != "brute_force"
+           and r.get("batch_size", 10_000) == 10_000]
     good = [r for r in ann if r["recall"] >= RECALL_BAR]
     if good:
         best = max(good, key=lambda r: r["qps"])
@@ -123,6 +126,23 @@ def _die(signum, frame):
     os._exit(0)
 
 
+def _small_batch_legs(base_sp, n_queries):
+    """Batch-10 and batch-1 variants of one representative search param
+    (the reference ANN protocol measures batch 1/10/10000 — VERDICT r5).
+    Small batches measure LATENCY — the runner fences every call to the
+    host before dispatching the next (fence_per_call defaults on for
+    reduced-batch legs), so the row's qps is the serial single-request
+    rate, not pipelined throughput. A trimmed query set suffices; the
+    dataset/groundtruth/built index are shared with the batch-10000
+    rows."""
+    return [
+        {**base_sp, "batch_size": 10,
+         "n_queries": min(200, n_queries)},
+        {**base_sp, "batch_size": 1,
+         "n_queries": min(50, n_queries)},
+    ]
+
+
 def hard_config(n: int, n_queries: int, algos):
     index = []
     if "ivf_flat" in algos:
@@ -136,7 +156,9 @@ def hard_config(n: int, n_queries: int, algos):
             "search_params": [{"n_probes": 16, "scan_select": "approx"},
                               {"n_probes": 32, "scan_select": "approx"},
                               {"n_probes": 64, "scan_select": "approx"},
-                              {"n_probes": 128, "scan_select": "approx"}],
+                              {"n_probes": 128, "scan_select": "approx"}]
+            + _small_batch_legs({"n_probes": 32, "scan_select": "approx"},
+                                n_queries),
         })
     if "ivf_pq" in algos:
         index.append({
@@ -146,14 +168,18 @@ def hard_config(n: int, n_queries: int, algos):
             "search_params": [{"n_probes": 64, "refine_ratio": 4,
                                "scan_select": "approx"},
                               {"n_probes": 128, "refine_ratio": 4,
-                               "scan_select": "approx"}],
+                               "scan_select": "approx"}]
+            + _small_batch_legs({"n_probes": 64, "refine_ratio": 4,
+                                 "scan_select": "approx"}, n_queries),
         })
     if "cagra" in algos:
         index.append({
             "name": "cagra.d64", "algo": "cagra",
             "build_param": {"graph_degree": 64},
             "search_params": [{"itopk_size": 64, "search_width": 8},
-                              {"itopk_size": 128, "search_width": 16}],
+                              {"itopk_size": 128, "search_width": 16}]
+            + _small_batch_legs({"itopk_size": 64, "search_width": 8},
+                                n_queries),
         })
     if "brute_force" in algos:
         index.append({"name": "brute_force", "algo": "brute_force",
@@ -346,10 +372,36 @@ def _device_backend_ok(timeout_s: float = 150.0) -> bool:
     return False
 
 
+def _git_commit():
+    """Short HEAD hash, cached (None outside a git checkout)."""
+    if "git_commit" not in STATE:
+        import subprocess
+
+        try:
+            p = subprocess.run(
+                ["git", "rev-parse", "--short", "HEAD"],
+                capture_output=True, text=True, timeout=10,
+                cwd=os.path.dirname(os.path.abspath(__file__)))
+            STATE["git_commit"] = p.stdout.strip() if p.returncode == 0 \
+                else None
+        except Exception:
+            STATE["git_commit"] = None
+    return STATE["git_commit"]
+
+
 def _row(dataset_name, r):
+    # every measured row self-stamps (same measured_at/git_commit fields
+    # the deep-100m replay rows carry) so a replayed or archived record
+    # always says when and at what commit its numbers were true
     row = {"dataset": dataset_name, "algo": r.algo, "index": r.index_name,
            "qps": round(r.qps, 1), "recall": round(r.recall, 4),
-           "build_s": round(r.build_s, 2), "search_param": r.search_param}
+           "build_s": round(r.build_s, 2), "search_param": r.search_param,
+           "batch_size": r.batch_size,
+           "measured_at": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
+           "git_commit": _git_commit()}
+    if getattr(r, "fence_per_call", False):
+        # latency-protocol row: qps includes the per-call host fence
+        row["fence_per_call"] = True
     if getattr(r, "stage_breakdown", None) is not None:
         # RAFT_TPU_BENCH_OBS=1: per-stage span seconds for one diagnostic
         # batch + the allocator's process-lifetime peak-HBM high-water

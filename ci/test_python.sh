@@ -18,8 +18,21 @@ stripped=$(python -S -c "import sys; sys.path.insert(0, '.')
 import __graft_entry__ as g; print(g.plugin_free_pythonpath())")
 export PYTHONPATH="$stripped"
 
+echo "== graftlint static analysis (blocking; CPU-only, no device) =="
+# cache-bust-proof by construction: a pure-stdlib AST pass over the
+# tree — no XLA compile cache, no pytest cache, no device backend, so
+# it cannot go stale or flake with the environment. Zero unsuppressed
+# findings is the gate (tools/graftlint, docs/developer_guide.md).
+python -m tools.graftlint raft_tpu
+
 echo "== raft_tpu unit+integration tests (8-device CPU mesh) =="
 python -m pytest tests/ -q "$@"
+
+echo "== sanitizer-mode subset (RAFT_TPU_SANITIZE=1: rank-promotion raise"
+echo "   + debug_nans + transfer guards + recompile budgets) =="
+RAFT_TPU_SANITIZE=1 python -m pytest \
+    tests/test_sanitize.py tests/test_graftlint.py tests/test_core.py \
+    -q -p no:cacheprovider
 
 echo "== driver contract: entry() compiles, dryrun_multichip(8) executes =="
 python - <<'EOF'

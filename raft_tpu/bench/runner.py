@@ -68,6 +68,9 @@ class BenchResult:
     stage_breakdown: Optional[Dict[str, float]] = None
     stage_path: Optional[str] = None
     peak_hbm_bytes: Optional[int] = None
+    # True when the row was measured under the fenced LATENCY protocol
+    # (reduced-batch legs): qps includes the per-call host round-trip
+    fence_per_call: bool = False
 
 
 # ---------------------------------------------------------------------------
@@ -206,7 +209,8 @@ def _xprof_capture(search_fn, queries, k, sp, batch_size, xprof_dir):
     print(f"[bench] xprof capture written under {xprof_dir}")
 
 
-def _bench_search(search_fn, queries, k, sp, batch_size, iters=5):
+def _bench_search(search_fn, queries, k, sp, batch_size, iters=5,
+                  fence_per_call=False):
     m = queries.shape[0]
     # pre-split batches ONCE: eager slicing inside the timed loop costs a
     # per-op dispatch round-trip on remote-device (tunnelled) backends
@@ -219,18 +223,28 @@ def _bench_search(search_fn, queries, k, sp, batch_size, iters=5):
         d, i = search_fn(qb, k, sp)
         ids_all.append(np.asarray(jax.device_get(i)))
     ids = np.concatenate(ids_all, axis=0)
-    # timed THROUGHPUT protocol: dispatch all iterations, then fetch a
-    # 1-element slice of every result as the sync fence (gbench's
-    # stream-pipelined items_per_second measures the same way). Blocking
-    # per call instead adds the full per-call transport round-trip
-    # (~70-100 ms on a tunnelled device) to every iteration — that is
-    # LATENCY, reported separately below. device_get is the fence
-    # because block_until_ready alone does not reliably synchronize on
-    # remote-device backends.
     t0 = time.perf_counter()
-    outs = [search_fn(qb, k, sp)[1]
-            for _ in range(iters) for qb in batches]
-    jax.device_get(outs)  # FULL results cross to the host, pipelined
+    if fence_per_call:
+        # LATENCY protocol (the reference's batch-1/10 legs): every call
+        # is fenced to the host before the next one dispatches, so the
+        # reported rate includes the full per-call round-trip — the
+        # number a single-request serving loop would see. Pipelining
+        # here would report throughput mislabeled as latency.
+        for _ in range(iters):
+            for qb in batches:
+                jax.device_get(search_fn(qb, k, sp)[1])
+    else:
+        # timed THROUGHPUT protocol: dispatch all iterations, then fetch
+        # every result as the sync fence (gbench's stream-pipelined
+        # items_per_second measures the same way). Blocking per call
+        # instead adds the full per-call transport round-trip
+        # (~70-100 ms on a tunnelled device) to every iteration — that
+        # is the fenced LATENCY protocol above. device_get is the fence
+        # because block_until_ready alone does not reliably synchronize
+        # on remote-device backends.
+        outs = [search_fn(qb, k, sp)[1]
+                for _ in range(iters) for qb in batches]
+        jax.device_get(outs)  # FULL results cross to the host, pipelined
     dt = (time.perf_counter() - t0) / iters
     return ids, dt, m / dt
 
@@ -317,33 +331,50 @@ def _run_one_index(index_cfg, algo, dsx, data, queries, k, batch_size,
             print(f"[bench] leg budget exhausted — skipping remaining "
                   f"search params of {index_cfg.get('name')}")
             break
-        ids, dt, qps = _bench_search(search_fn, queries, k, sp, batch_size)
-        rec = ds_mod.recall(ids, data.groundtruth)
+        # per-search-param batch/query overrides: the reference ANN
+        # protocol measures batch 1/10/10000 (raft_ann_benchmarks), so a
+        # search_param may carry "batch_size" (and a trimmed "n_queries"
+        # — small batches measure latency, they don't need the full
+        # query set) while sharing the dataset, groundtruth and built
+        # index with the big-batch rows
+        sp = dict(sp)
+        row_bs = int(sp.pop("batch_size", batch_size))
+        row_nq = sp.pop("n_queries", None)
+        # reduced-batch legs default to the fenced LATENCY protocol
+        # (that is what batch 1/10 measures); override with
+        # "fence_per_call": false to pipeline anyway
+        fenced = bool(sp.pop("fence_per_call", row_bs < batch_size))
+        q_leg = queries if row_nq is None else \
+            queries[: min(int(row_nq), queries.shape[0])]
+        ids, dt, qps = _bench_search(search_fn, q_leg, k, sp, row_bs,
+                                     fence_per_call=fenced)
+        rec = ds_mod.recall(ids, data.groundtruth[: q_leg.shape[0]])
         stages = stage_path = peak_hbm = None
         if _env_flag("RAFT_TPU_BENCH_OBS"):
             try:
                 stages, stage_path, peak_hbm = _obs_capture(
-                    search_fn, queries, k, sp, batch_size,
+                    search_fn, q_leg, k, sp, row_bs,
                     context=f"{index_cfg.get('name', algo)} {sp}")
             except Exception as e:  # diagnostics must never cost a row
                 print(f"[bench] obs capture failed ({e!r}) — "
                       "row kept without stage breakdown")
         xprof_dir = os.environ.get("RAFT_TPU_XPROF_DIR")
         if xprof_dir:
-            _xprof_capture(search_fn, queries, k, sp, batch_size, xprof_dir)
+            _xprof_capture(search_fn, q_leg, k, sp, row_bs, xprof_dir)
         row = BenchResult(
             algo=algo, index_name=index_cfg.get("name", algo),
-            dataset=data.name, k=k, batch_size=batch_size,
+            dataset=data.name, k=k, batch_size=row_bs,
             build_s=build_s, search_s=dt, qps=qps, recall=rec,
             build_param=bp, search_param=dict(sp),
             stage_breakdown=stages, stage_path=stage_path,
-            peak_hbm_bytes=peak_hbm,
+            peak_hbm_bytes=peak_hbm, fence_per_call=fenced,
         )
         results.append(row)
         if on_row is not None:
             on_row(row)
         if verbose:
-            print(f"[bench] {row.index_name} {sp}: "
+            bs_note = f" b={row_bs}" if row_bs != batch_size else ""
+            print(f"[bench] {row.index_name} {sp}{bs_note}: "
                   f"qps={qps:,.0f} recall={rec:.4f} build={build_s:.1f}s")
             if stages:
                 parts = ", ".join(f"{n}={v * 1e3:.1f}ms"

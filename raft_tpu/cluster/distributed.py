@@ -20,9 +20,11 @@ from jax.sharding import Mesh, PartitionSpec as P
 
 from raft_tpu.distance.fused_l2_nn import fused_l2_nn_argmin
 from raft_tpu.cluster.kmeans import KMeansParams, init_random
+from raft_tpu.core.tracing import traced
 from raft_tpu.random.rng import RngState
 
 
+@traced("raft_tpu.distributed_kmeans.fit")
 def fit(
     params: KMeansParams,
     x: jax.Array,
@@ -87,6 +89,7 @@ def fit(
     return fn(x.astype(jnp.float32), w, init_centroids.astype(jnp.float32))
 
 
+@traced("raft_tpu.distributed_kmeans.predict")
 def predict(centroids: jax.Array, x: jax.Array, mesh: Mesh,
             axis: str = "shard") -> jax.Array:
     """Sharded nearest-centroid assignment; labels return sharded."""

@@ -194,8 +194,19 @@ def fit(
             centroids, inertia, n_iter = _lloyd(x, w, c0, k,
                                                 params.max_iter, params.tol)
             _sp.attach(centroids, inertia)
-        if best is None or float(inertia) < float(best[1]):
+        if best is None:
             best = (centroids, inertia, n_iter)
+        else:
+            # device-side running best: no host sync in the restart loop
+            # (the old per-trial float(inertia) comparison serialized
+            # every restart behind a round-trip — graftlint GL01), O(1)
+            # extra memory, and a NaN inertia (diverged restart) never
+            # beats a finite best (NaN < x is False) — while a NaN best
+            # (trial 0 diverged) is always replaced
+            better = (inertia < best[1]) | jnp.isnan(best[1])
+            best = tuple(jnp.where(better, new, old)
+                         for new, old in zip((centroids, inertia, n_iter),
+                                             best))
     return best
 
 
@@ -284,12 +295,14 @@ def fit_minibatch(params: KMeansParams, x: jax.Array,
     return centroids, cluster_cost(centroids, x), n_iters
 
 
+@traced("raft_tpu.kmeans.predict")
 def predict(centroids: jax.Array, x: jax.Array) -> jax.Array:
     """Nearest-centroid labels (reference: kmeans.cuh:152 ``predict``)."""
     _, labels = fused_l2_nn_argmin(x.astype(jnp.float32), centroids)
     return labels
 
 
+@traced("raft_tpu.kmeans.fit_predict")
 def fit_predict(params: KMeansParams, x: jax.Array,
                 sample_weights: Optional[jax.Array] = None):
     """reference: kmeans.cuh:215."""
@@ -297,6 +310,7 @@ def fit_predict(params: KMeansParams, x: jax.Array,
     return centroids, predict(centroids, x), inertia, n_iter
 
 
+@traced("raft_tpu.kmeans.transform")
 def transform(centroids: jax.Array, x: jax.Array) -> jax.Array:
     """Distances to all centroids (reference: kmeans.cuh:244)."""
     return l2_expanded(x, centroids, sqrt=True)

@@ -199,7 +199,10 @@ def _warn_level2_drop(n_drop: int, n: int, cap: int) -> None:
 
 
 @traced("raft_tpu.kmeans_balanced.fit")
-def fit(
+# the hierarchical fit partitions fine-cluster quotas on the host BY
+# DESIGN (documented in the level-2 block below) — its syncs are the
+# algorithm, not an accident
+def fit(  # graftlint: disable-fn=GL01
     x: jax.Array,
     n_clusters: int,
     params: Optional[KMeansBalancedParams] = None,
@@ -296,6 +299,7 @@ def fit(
     return _maybe_normalize(centers, params.metric)
 
 
+@traced("raft_tpu.kmeans_balanced.predict")
 def predict(centers: jax.Array, x: jax.Array,
             params: Optional[KMeansBalancedParams] = None) -> jax.Array:
     """Nearest balanced-center labels (reference: kmeans_balanced::predict)."""

@@ -25,6 +25,8 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from raft_tpu.core.tracing import traced
+
 
 @dataclass
 class SingleLinkageOutput:
@@ -92,6 +94,7 @@ def _cut(children, n, n_clusters):
     return labels
 
 
+@traced("raft_tpu.single_linkage")
 def single_linkage(
     dataset,
     n_clusters: int,

@@ -4,9 +4,23 @@ TPU-native counterpart of ``raft::core::bitset`` (core/bitset.cuh: test :235,
 flip :279). Bits pack little-endian into uint32 words; all ops are pure
 functions on the packed array (value semantics — no in-place mutation),
 which is the idiomatic JAX shape of the reference's device-mutable bitset.
+
+The builder ops (:func:`create`, :func:`from_mask`, :func:`set_bits`,
+:func:`to_mask`, :func:`count`) are jitted: each is ONE compiled program
+instead of a chain of eager dispatches, and no implicit host↔device
+scalar lifting happens at call time — verified by the sanitizer-mode
+tests running them under ``jax.transfer_guard("disallow")``
+(tests/test_sanitize.py). Broadcasts are explicit (``shifts[None, :]``):
+the suite passes under ``jax_numpy_rank_promotion="raise"``.
+:func:`test` is jitted with no static args — called inside the jitted
+search paths (``sample_filter.passes`` inside ``_search_impl``) it
+traces inline; called eagerly it is one program with the ``WORD_BITS``
+constants baked in rather than lifted per call.
 """
 
 from __future__ import annotations
+
+from functools import partial
 
 import jax
 import jax.numpy as jnp
@@ -18,12 +32,14 @@ def n_words(bitset_len: int) -> int:
     return (bitset_len + WORD_BITS - 1) // WORD_BITS
 
 
+@partial(jax.jit, static_argnames=("bitset_len", "default_value"))
 def create(bitset_len: int, default_value: bool = True) -> jax.Array:
     """All-set (or all-clear) bitset of ``bitset_len`` bits."""
     fill = jnp.uint32(0xFFFFFFFF) if default_value else jnp.uint32(0)
     return jnp.full((n_words(bitset_len),), fill, dtype=jnp.uint32)
 
 
+@jax.jit
 def from_mask(mask: jax.Array) -> jax.Array:
     """Pack a boolean vector into a bitset."""
     n = mask.shape[0]
@@ -31,16 +47,18 @@ def from_mask(mask: jax.Array) -> jax.Array:
     m = jnp.concatenate([mask.astype(jnp.uint32), jnp.zeros((pad,), jnp.uint32)])
     m = m.reshape(-1, WORD_BITS)
     shifts = jnp.arange(WORD_BITS, dtype=jnp.uint32)
-    return jnp.sum(m << shifts, axis=1, dtype=jnp.uint32)
+    return jnp.sum(m << shifts[None, :], axis=1, dtype=jnp.uint32)
 
 
+@partial(jax.jit, static_argnames=("bitset_len",))
 def to_mask(bits: jax.Array, bitset_len: int) -> jax.Array:
     """Unpack into a boolean vector of length ``bitset_len``."""
     shifts = jnp.arange(WORD_BITS, dtype=jnp.uint32)
-    m = ((bits[:, None] >> shifts) & 1).astype(jnp.bool_).reshape(-1)
+    m = ((bits[:, None] >> shifts[None, :]) & 1).astype(jnp.bool_).reshape(-1)
     return m[:bitset_len]
 
 
+@jax.jit
 def test(bits: jax.Array, idx) -> jax.Array:
     """Test bit(s) at ``idx`` (reference: bitset::test, core/bitset.cuh:235)."""
     idx = jnp.asarray(idx)
@@ -48,6 +66,7 @@ def test(bits: jax.Array, idx) -> jax.Array:
     return ((word >> (idx % WORD_BITS).astype(jnp.uint32)) & 1).astype(jnp.bool_)
 
 
+@partial(jax.jit, static_argnames=("value",))
 def set_bits(bits: jax.Array, idx, value: bool = True) -> jax.Array:
     """Return a new bitset with bit(s) at ``idx`` set/cleared.
 
@@ -73,6 +92,7 @@ def flip(bits: jax.Array) -> jax.Array:
     return ~bits
 
 
+@partial(jax.jit, static_argnames=("bitset_len",))
 def count(bits: jax.Array, bitset_len: int) -> jax.Array:
     """Population count over the valid prefix."""
     return jnp.sum(to_mask(bits, bitset_len).astype(jnp.int32))

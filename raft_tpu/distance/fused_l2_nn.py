@@ -17,6 +17,7 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
+from raft_tpu.core.tracing import traced
 from raft_tpu.utils.precision import get_precision
 
 # Column-tile width of the running-argmin scan: large enough to keep the MXU
@@ -39,6 +40,7 @@ def _on_tpu() -> bool:
         return False
 
 
+@traced("raft_tpu.fused_l2_nn_argmin")
 def fused_l2_nn_argmin(
     x: jax.Array,
     y: jax.Array,
@@ -95,6 +97,7 @@ def fused_l2_nn_argmin(
     return best_d, best_i
 
 
+@traced("raft_tpu.masked_l2_nn_argmin")
 def masked_l2_nn_argmin(
     x: jax.Array,
     y: jax.Array,

@@ -33,6 +33,7 @@ class KernelParams:
     coef0: float = 0.0
 
 
+from raft_tpu.core.tracing import traced
 from raft_tpu.utils.precision import get_precision
 
 
@@ -42,6 +43,7 @@ def _gram(x, y):
                            preferred_element_type=jnp.float32)
 
 
+@traced("raft_tpu.gram_matrix")
 def gram_matrix(x: jax.Array, y: jax.Array, params: KernelParams) -> jax.Array:
     """Evaluate the kernel Gram matrix K[i,j] = k(x_i, y_j)
     (reference: detail/kernels/gram_matrix.cuh ``evaluate``)."""

@@ -30,6 +30,7 @@ import jax.numpy as jnp
 from jax import lax
 
 from raft_tpu.core.errors import expects
+from raft_tpu.core.tracing import traced
 from raft_tpu.distance.types import DistanceType, resolve_metric
 from raft_tpu.utils.precision import get_precision
 
@@ -212,6 +213,7 @@ def haversine(x, y):
 # public API
 # ---------------------------------------------------------------------------
 
+@traced("raft_tpu.pairwise_distance")
 def pairwise_distance(
     x: jax.Array,
     y: jax.Array,
@@ -268,6 +270,7 @@ def pairwise_distance(
     return _tiled_over_rows(x, y, _make_block(cores[mt]))
 
 
+@traced("raft_tpu.distance")
 def distance(x, y, metric="euclidean", metric_arg: float = 2.0):
     """Alias matching the reference's ``raft::distance::distance``
     (distance/distance-inl.cuh:67)."""

@@ -33,6 +33,7 @@ from jax import lax
 import flax.struct
 
 from ..core.errors import expects
+from ..core.tracing import traced
 from ..distance.pairwise import pairwise_distance
 from ..distance.types import DistanceType, resolve_metric
 from ..matrix.select_k import select_k as _select_k
@@ -69,7 +70,9 @@ def _metric_dist(a: jax.Array, b: jax.Array, mt: DistanceType) -> jax.Array:
     return pairwise_distance(a, b, metric=mt)
 
 
-def build(
+@traced("raft_tpu.ball_cover.build")
+# host-side list pack (bincount + np scatter) by design — build is eager
+def build(  # graftlint: disable-fn=GL01
     dataset: jax.Array,
     metric: str = "euclidean",
     n_landmarks: Optional[int] = None,
@@ -160,6 +163,7 @@ def _probe_round(index: BallCoverIndex, q, ranked_lists, start, best_d, best_i,
     return vals, jnp.take_along_axis(all_i, pos, axis=1)
 
 
+@traced("raft_tpu.ball_cover.knn")
 def knn(
     index: BallCoverIndex,
     queries: jax.Array,
@@ -208,6 +212,7 @@ def knn(
     return best_d, best_i
 
 
+@traced("raft_tpu.ball_cover.eps_nn")
 def eps_nn(
     index: BallCoverIndex, queries: jax.Array, eps: float
 ) -> Tuple[jax.Array, jax.Array]:

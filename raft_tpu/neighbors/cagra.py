@@ -166,6 +166,7 @@ class CagraIndex(flax.struct.PyTreeNode):
 # build
 # ---------------------------------------------------------------------------
 
+@traced("raft_tpu.cagra.build_knn_graph")
 def build_knn_graph(dataset: jax.Array, k: int, metric: str = "sqeuclidean",
                     seed: int = 0, search_batch: int = 16384) -> jax.Array:
     """k-NN graph via IVF-PQ self-search + exact refine

@@ -14,9 +14,11 @@ from typing import Tuple
 import jax
 import jax.numpy as jnp
 
+from ..core.tracing import traced
 from ..distance.pairwise import pairwise_distance
 
 
+@traced("raft_tpu.eps_neighbors_l2sq")
 def eps_neighbors_l2sq(
     x: jax.Array, y: jax.Array, eps_sq: float
 ) -> Tuple[jax.Array, jax.Array]:

@@ -172,7 +172,7 @@ def _fit_list_size(counts: np.ndarray, avg: int, cap_factor: float) -> int:
 
 
 @traced("raft_tpu.ivf_flat.build")
-def build(dataset: jax.Array, params: Optional[IndexParams] = None) -> IvfFlatIndex:
+def build(dataset: jax.Array, params: Optional[IndexParams] = None) -> IvfFlatIndex:  # graftlint: disable-fn=GL01 (host-side histogram/pack by design)
     """Build the index (reference: ivf_flat::build, ivf_flat-inl.cuh:65):
     balanced-kmeans coarse fit on a trainset subsample, assign all rows,
     pack padded lists."""
@@ -280,7 +280,7 @@ def build(dataset: jax.Array, params: Optional[IndexParams] = None) -> IvfFlatIn
 
 
 @traced("raft_tpu.ivf_flat.extend")
-def extend(index: IvfFlatIndex, new_vectors: jax.Array,
+def extend(index: IvfFlatIndex, new_vectors: jax.Array,  # graftlint: disable-fn=GL01 (host re-pack by design)
            new_ids: Optional[jax.Array] = None) -> IvfFlatIndex:
     """Append vectors (reference: ivf_flat::extend). Host-side re-pack with
     capacity growth; centers unchanged."""

@@ -611,7 +611,7 @@ def _encode_with_norms(x_rot: jax.Array, centers_rot: jax.Array,
 
 
 @traced("raft_tpu.ivf_pq.build")
-def build(dataset: jax.Array, params: Optional[IndexParams] = None) -> IvfPqIndex:
+def build(dataset: jax.Array, params: Optional[IndexParams] = None) -> IvfPqIndex:  # graftlint: disable-fn=GL01 (host-side histogram/pack by design)
     """Build the index (reference: ivf_pq::build, detail/ivf_pq_build.cuh:1511)."""
     if params is None:
         params = IndexParams()
@@ -734,7 +734,7 @@ def build(dataset: jax.Array, params: Optional[IndexParams] = None) -> IvfPqInde
 
 
 @traced("raft_tpu.ivf_pq.build_chunked")
-def build_chunked(dataset, params: Optional[IndexParams] = None,
+def build_chunked(dataset, params: Optional[IndexParams] = None,  # graftlint: disable-fn=GL01 (streaming memmap build syncs per chunk by design)
                   chunk_rows: int = 1 << 18,
                   max_train_rows: int = 1 << 21,
                   progress: bool = False) -> IvfPqIndex:
@@ -970,7 +970,7 @@ def _build_recon_cache(index: IvfPqIndex) -> jax.Array:
 
 
 @traced("raft_tpu.ivf_pq.extend")
-def extend(index: IvfPqIndex, new_vectors: jax.Array,
+def extend(index: IvfPqIndex, new_vectors: jax.Array,  # graftlint: disable-fn=GL01 (host re-pack by design)
            new_ids: Optional[jax.Array] = None) -> IvfPqIndex:
     """Append vectors (reference: ivf_pq::extend): encode against existing
     centers/codebooks, host re-pack with capacity growth."""

@@ -26,6 +26,7 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
+from raft_tpu.core.tracing import traced
 from raft_tpu.distance.types import DistanceType, resolve_metric
 from raft_tpu.utils.precision import get_precision
 
@@ -106,6 +107,7 @@ def _nn_descent_impl(x: jax.Array, k: int, n_iters: int, n_samples: int,
     return graph_ids, graph_d
 
 
+@traced("raft_tpu.nn_descent.build_knn_graph")
 def build_knn_graph(
     dataset: jax.Array,
     k: int,
@@ -123,6 +125,7 @@ def build_knn_graph(
     return ids
 
 
+@traced("raft_tpu.nn_descent.build_knn_graph_with_distances")
 def build_knn_graph_with_distances(
     dataset: jax.Array,
     k: int,

@@ -93,7 +93,10 @@ def _fill_rows(buf, blk, lidx, pos):
     return buf.at[pos].set(blk[lidx].astype(jnp.float32))
 
 
-def refine_provider(
+@traced("raft_tpu.refine_provider")
+# the provider path exists to gather candidate rows on the HOST (memmap
+# bases) — its device_get round-trip is the point, not a leak
+def refine_provider(  # graftlint: disable-fn=GL01
     provider,
     queries: jax.Array,
     candidates: jax.Array,
@@ -147,7 +150,10 @@ def refine_provider(
     return _refine_rows(rows, queries, jnp.asarray(cand), k, mt.value)
 
 
-def refine_gathered(
+@traced("raft_tpu.refine_gathered")
+# host-side candidate-row gather by design (memmap bases — jitted refine
+# would materialize the whole base in HBM)
+def refine_gathered(  # graftlint: disable-fn=GL01
     host_base,
     queries: jax.Array,
     candidates: jax.Array,

@@ -41,9 +41,14 @@ def make_filter(
     return bits
 
 
+@jax.jit
 def passes(filter_bits: Optional[jax.Array], ids: jax.Array) -> jax.Array:
     """Vectorized filter test for candidate id arrays (negative ids —
-    padding — always fail)."""
+    padding — always fail). Jitted: inside the jitted search paths it
+    traces inline (a ``None`` filter is pytree structure, so the branch
+    is trace-static); called eagerly it is one program with no implicit
+    scalar lifting — the sanitizer-mode transfer guard stays quiet
+    (tests/test_sanitize.py)."""
     if filter_bits is None:
         return jnp.ones(ids.shape, jnp.bool_)
     ok = bitset.test(filter_bits, jnp.clip(ids, 0))

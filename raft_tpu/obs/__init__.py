@@ -7,7 +7,10 @@ this package makes the same attribution available *in process*:
   with labels, ``snapshot()`` → dict, ``dump_jsonl`` sink;
 - :mod:`raft_tpu.obs.spans`   — ``span(name)`` stage timers (dotted
   nesting, optional device-time sync), recorded into the registry;
-- :mod:`raft_tpu.obs.hbm`     — ``device.memory_stats()`` telemetry.
+- :mod:`raft_tpu.obs.hbm`     — ``device.memory_stats()`` telemetry;
+- :mod:`raft_tpu.obs.sanitize` — runtime sanitizer harness
+  (``RAFT_TPU_SANITIZE=1``): rank-promotion/NaN config, transfer-guard
+  scopes, and a jit-cache-miss counter with budget assertions.
 
 Everything is off by default and adds no sync points until
 :func:`enable` is called (or ``RAFT_TPU_OBS=1`` is set). See
@@ -30,9 +33,11 @@ from raft_tpu.obs.spans import (  # noqa: F401
     enable,
     enabled,
     env_flag,
+    env_tristate,
     registry,
     span,
     stages_enabled,
     sync_enabled,
 )
 from raft_tpu.obs import hbm  # noqa: F401
+from raft_tpu.obs import sanitize  # noqa: F401

@@ -1,0 +1,134 @@
+"""Runtime sanitizer harness — jax-native guards for the test suite.
+
+The static half of the correctness tooling (``tools/graftlint``) catches
+what the AST shows; this module wires up what only shows at runtime —
+the TPU-native analog of running the reference's tests under
+compute-sanitizer (RAFT ci/test.sh) :
+
+- :func:`apply_sanitize_config` — the ``RAFT_TPU_SANITIZE=1`` mode:
+  ``jax_numpy_rank_promotion="raise"`` (implicit rank promotion is how
+  a [n]-vs-[n,1] slip silently broadcasts into an O(n²) intermediate)
+  and ``jax_debug_nans`` (NaNs surface at the op that made them, not
+  three layers later in a recall number).
+- :func:`no_host_transfers` — scopes
+  ``jax.transfer_guard("disallow")`` around a search/build hot path:
+  any implicit device↔host round-trip inside raises instead of
+  silently serializing the dispatch pipeline. Prepare inputs on device
+  BEFORE the scope: eager ``jnp.asarray(host_data)`` and Python-scalar
+  lifting inside count as implicit and raise; ``jax.device_get`` /
+  ``jax.device_put`` remain allowed.
+- :func:`recompile_budget` / :func:`compile_count` — a jit-cache-miss
+  counter fed by ``jax.monitoring``'s backend-compile event: a test
+  wraps its steady-state calls in ``recompile_budget(0)`` and an
+  unexpected retrace fails loudly with the count, instead of costing
+  seconds per call in production three PRs later.
+
+Everything here is import-cheap: jax is only imported when a guard is
+actually used, and the monitoring listener is installed once on first
+use (jax has no per-listener unregister across versions, so the
+listener stays; it is a few instructions per compile event).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import threading
+from typing import Iterator, Optional
+
+from raft_tpu.obs.spans import env_flag
+
+# jax.monitoring event recorded once per backend (XLA) compile — i.e.
+# once per jit-cache MISS. Resolved lazily from jax's dispatch module so
+# a rename fails loudly here rather than silently counting nothing.
+_COMPILE_EVENT_FALLBACK = "/jax/core/compile/backend_compile_duration"
+
+_lock = threading.Lock()
+_installed = False
+_compiles = 0
+
+
+def _compile_event_name() -> str:
+    try:
+        from jax._src import dispatch as _dispatch
+
+        return getattr(_dispatch, "BACKEND_COMPILE_EVENT",
+                       _COMPILE_EVENT_FALLBACK)
+    except Exception:  # pragma: no cover - unknown jax layout
+        return _COMPILE_EVENT_FALLBACK
+
+
+def install_compile_counter() -> None:
+    """Register the jit-cache-miss listener (idempotent, stays for the
+    process lifetime — jax.monitoring has no stable unregister API)."""
+    global _installed
+    with _lock:
+        if _installed:
+            return
+        import jax.monitoring
+
+        event_name = _compile_event_name()
+
+        def _on_duration(event: str, duration_secs: float, **kw) -> None:
+            global _compiles
+            if event == event_name:
+                with _lock:
+                    _compiles += 1
+
+        jax.monitoring.register_event_duration_secs_listener(_on_duration)
+        _installed = True
+
+
+def compile_count() -> int:
+    """Backend compiles observed since :func:`install_compile_counter`."""
+    with _lock:
+        return _compiles
+
+
+class RecompileBudgetExceeded(RuntimeError):
+    """A scope compiled more programs than its declared budget."""
+
+
+@contextlib.contextmanager
+def recompile_budget(budget: int, what: str = "scope") -> Iterator[None]:
+    """Fail if the wrapped scope triggers more than ``budget`` backend
+    compiles. ``budget=0`` asserts a fully warm jit cache — the steady-
+    state contract for serving hot paths. Install-on-first-use: the
+    counter misses compiles that happened before the first budget scope
+    in the process, which is fine — budgets measure deltas."""
+    install_compile_counter()
+    start = compile_count()
+    yield
+    spent = compile_count() - start
+    if spent > budget:
+        raise RecompileBudgetExceeded(
+            f"{what}: {spent} backend compile(s), budget {budget} — an "
+            "unexpected retrace (shape/dtype/static-arg churn or a "
+            "non-hashable static) is recompiling the hot path")
+
+
+@contextlib.contextmanager
+def no_host_transfers() -> Iterator[None]:
+    """Scope ``jax.transfer_guard("disallow")`` around a hot path:
+    implicit device↔host transfers raise. Prepare all inputs on device
+    before entering — eager ``jnp.asarray(host_data)`` and Python-scalar
+    lifting inside the scope count as implicit and raise; explicit
+    ``jax.device_get`` / ``jax.device_put`` stay allowed."""
+    import jax
+
+    with jax.transfer_guard("disallow"):
+        yield
+
+
+def apply_sanitize_config() -> None:
+    """Apply the ``RAFT_TPU_SANITIZE=1`` jax.config set (rank-promotion
+    raise + debug_nans) process-wide. Call before tests import the
+    library under test; conftest does this when the env flag is set."""
+    import jax
+
+    jax.config.update("jax_numpy_rank_promotion", "raise")
+    jax.config.update("jax_debug_nans", True)
+
+
+def sanitize_enabled() -> bool:
+    """True when the suite runs in ``RAFT_TPU_SANITIZE=1`` mode."""
+    return env_flag("RAFT_TPU_SANITIZE")

@@ -128,8 +128,26 @@ def env_flag(name: str) -> bool:
     """Parse a boolean env var: unset, '', '0', 'false', 'off', 'no' are
     False; anything else is True (plain string truthiness would read
     ``RAFT_TPU_OBS=0`` as enabled)."""
-    return os.environ.get(name, "").strip().lower() not in (
+    # the canonical flag parser — the one raw read GL02 points everyone at
+    return os.environ.get(name, "").strip().lower() not in (  # graftlint: disable=GL02
         "", "0", "false", "off", "no")
+
+
+def env_tristate(name: str, default: str = "auto") -> str:
+    """Parse a tri-state env var into ``"auto"`` / ``"on"`` / ``"off"``.
+
+    The shared parser for the ``RAFT_TPU_PALLAS_*`` dispatch overrides:
+    ``0/false/off/no/never`` → "off", ``1/true/on/yes/always`` → "on",
+    unset/''/``auto`` → ``default``. The legacy ``always``/``never``
+    spellings stay valid — they were the documented values before this
+    helper existed. Unknown values fall back to ``default`` rather than
+    silently enabling (same conservatism as :func:`env_flag`)."""
+    raw = os.environ.get(name, "").strip().lower()  # graftlint: disable=GL02
+    if raw in ("0", "false", "off", "no", "never"):
+        return "off"
+    if raw in ("1", "true", "on", "yes", "always"):
+        return "on"
+    return default
 
 
 def _trace_clean() -> bool:

@@ -31,6 +31,8 @@ import numpy as np
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+from raft_tpu.obs.spans import env_tristate as _env_tristate
+
 # Lane width constraint: last dim multiples of 128, sublanes of 8 (f32).
 _LANES = 128
 _SUBLANES = 8
@@ -259,12 +261,11 @@ def pallas_grouped_wanted(kk: int, L: int = 0, d: int = 0,
     (the extraction loop is kk VPU rounds) when one program's VMEM
     working set — padded list block + distance block — fits the budget;
     otherwise the XLA grouped path (which tiles freely) handles it.
-    ``RAFT_TPU_PALLAS_GROUPED`` = always | never | auto — "always" runs
-    interpreted off-TPU (tests)."""
-    import os
-
-    force = os.environ.get("RAFT_TPU_PALLAS_GROUPED", "auto")
-    if force == "never" or kk > 64:
+    ``RAFT_TPU_PALLAS_GROUPED`` = always | never | auto (tri-state, see
+    :func:`raft_tpu.obs.env_tristate`) — "on"/"always" runs interpreted
+    off-TPU (tests)."""
+    force = _env_tristate("RAFT_TPU_PALLAS_GROUPED")
+    if force == "off" or kk > 64:
         return False
     if L and d:
         Lp = -(-L // _LANES) * _LANES
@@ -272,7 +273,7 @@ def pallas_grouped_wanted(kk: int, L: int = 0, d: int = 0,
         vmem = 4 * (Lp * dpad + bq * Lp + bq * dpad)
         if vmem > _GROUPED_VMEM_BUDGET:
             return False
-    return True if force == "always" else _on_tpu()
+    return True if force == "on" else _on_tpu()
 
 
 @functools.partial(jax.jit,
@@ -454,17 +455,15 @@ def pallas_segmented_wanted(kk: int, L: int, d: int, S: int = 128) -> bool:
     """Dispatch for :func:`segmented_scan_topk`: needs kk ≤ 128 (two
     candidates per strided bin) and a VMEM-sized list block. Same env override
     as pallas_grouped_wanted."""
-    import os
-
-    force = os.environ.get("RAFT_TPU_PALLAS_GROUPED", "auto")
-    if force == "never" or kk > _LANES:
+    force = _env_tristate("RAFT_TPU_PALLAS_GROUPED")
+    if force == "off" or kk > _LANES:
         return False
     Lp = -(-L // _LANES) * _LANES
     dpad = -(-d // _LANES) * _LANES
     vmem = 4 * (Lp * dpad + S * Lp + S * dpad)
     if vmem > _GROUPED_VMEM_BUDGET:
         return False
-    return True if force == "always" else _on_tpu()
+    return True if force == "on" else _on_tpu()
 
 
 # ---------------------------------------------------------------------------
@@ -812,12 +811,11 @@ def pallas_lut_scan_wanted(S: int, K: int, P: int, nb: int, Wb: int,
     "pallas"`` tier. Needs a per_subspace packed layout the in-kernel
     unpack supports (byte width dividing the stored lane width, fold
     group ≤ 8) and a VMEM-sized working set. Env override
-    ``RAFT_TPU_PALLAS_LUTSCAN`` = always | never | auto — "always" runs
-    interpreted off-TPU (tests)."""
-    import os
-
-    force = os.environ.get("RAFT_TPU_PALLAS_LUTSCAN", "auto")
-    if force == "never":
+    ``RAFT_TPU_PALLAS_LUTSCAN`` = always | never | auto (tri-state, see
+    :func:`raft_tpu.obs.env_tristate`) — "on"/"always" runs interpreted
+    off-TPU (tests)."""
+    force = _env_tristate("RAFT_TPU_PALLAS_LUTSCAN")
+    if force == "off":
         return False
     cfg = _lut_scan_config(S, K, P, nb, Wb, lut_dtype)
     if cfg is None:
@@ -839,7 +837,7 @@ def pallas_lut_scan_wanted(S: int, K: int, P: int, nb: int, Wb: int,
     )
     if vmem > _GROUPED_VMEM_BUDGET:
         return False
-    return True if force == "always" else _on_tpu()
+    return True if force == "on" else _on_tpu()
 
 
 @functools.partial(jax.jit,

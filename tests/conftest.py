@@ -5,6 +5,15 @@ emulated on a single host — the reference uses LocalCUDACluster
 (raft_dask/test/test_comms.py:21); here XLA's host-platform device count
 gives N fake devices so every sharded code path executes for real.
 Must set env vars before the first jax import.
+
+Sanitizer mode (``RAFT_TPU_SANITIZE=1``, docs/developer_guide.md): the
+suite additionally runs under ``jax_numpy_rank_promotion="raise"`` and
+``jax_debug_nans`` (the compute-sanitizer analog — RAFT's CI runs its
+tests under exactly such a lane), and tests marked
+``@pytest.mark.recompile_budget(n)`` assert their body triggers at most
+``n`` backend compiles via the jax.monitoring jit-cache-miss counter —
+an unexpected retrace fails the test instead of silently costing
+seconds per call in production.
 """
 
 import os
@@ -23,6 +32,13 @@ import jax  # noqa: E402
 jax.config.update("jax_platforms", "cpu")
 jax.config.update("jax_enable_x64", False)
 
+from raft_tpu.obs import sanitize as _sanitize  # noqa: E402
+
+if _sanitize.sanitize_enabled():
+    _sanitize.apply_sanitize_config()
+    # install before any compiles so budget deltas see every cache miss
+    _sanitize.install_compile_counter()
+
 import numpy as np  # noqa: E402
 import pytest  # noqa: E402
 
@@ -35,3 +51,19 @@ def devices():
 @pytest.fixture()
 def rng():
     return np.random.default_rng(42)
+
+
+@pytest.fixture(autouse=True)
+def _recompile_budget(request):
+    """Enforce ``@pytest.mark.recompile_budget(n)`` in sanitizer mode.
+
+    Outside sanitizer mode the marker is inert — budgets depend on a
+    cold, deterministic jit cache, which only the dedicated
+    ``RAFT_TPU_SANITIZE=1`` CI lane guarantees."""
+    marker = request.node.get_closest_marker("recompile_budget")
+    if marker is None or not _sanitize.sanitize_enabled():
+        yield
+        return
+    with _sanitize.recompile_budget(int(marker.args[0]),
+                                    what=request.node.nodeid):
+        yield

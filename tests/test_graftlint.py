@@ -1,0 +1,347 @@
+"""graftlint self-test: every rule fires on a known-bad snippet, every
+suppression form silences exactly what it claims, and the real tree
+stays clean (the CI gate's contract — ci/test_python.sh runs
+``python -m tools.graftlint raft_tpu`` as a blocking step).
+
+Pure stdlib under test — no jax import needed; snippets are linted as
+source strings.
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+from tools import graftlint
+
+
+def rules_of(findings):
+    return sorted({f.rule for f in findings})
+
+
+def lint(src, path="raft_tpu/neighbors/fake.py", select=None):
+    return graftlint.lint_source(src, path=path, select=select)
+
+
+# ---------------------------------------------------------------------------
+# GL01 — host syncs in hot bodies
+# ---------------------------------------------------------------------------
+
+GL01_BAD = """
+import jax
+import numpy as np
+
+@jax.jit
+def f(x):
+    v = x.item()
+    h = np.asarray(x)
+    jax.device_get(x)
+    x.block_until_ready()
+    s = float(x)
+    return v, h, s
+"""
+
+
+def test_gl01_fires_on_every_sync_kind():
+    findings = [f for f in lint(GL01_BAD) if f.rule == "GL01"]
+    msgs = " | ".join(f.message for f in findings)
+    assert len(findings) == 5
+    for needle in (".item()", "np.asarray", "jax.device_get",
+                   ".block_until_ready()", "float(x)"):
+        assert needle in msgs
+
+
+def test_gl01_traced_and_kernel_contexts():
+    src = """
+from raft_tpu.core.tracing import traced
+
+@traced("raft_tpu.x")
+def entry(x):
+    return x.item()
+
+def scan_kernel(a_ref, b_ref, o_ref):
+    v = float(a_ref)
+    o_ref[:] = v
+"""
+    findings = [f for f in lint(src) if f.rule == "GL01"]
+    assert len(findings) == 2
+    assert any("@traced function" in f.message for f in findings)
+    assert any("Pallas kernel" in f.message for f in findings)
+
+
+def test_gl01_quiet_on_eager_helpers():
+    src = """
+import numpy as np
+
+def host_helper(x):
+    return np.asarray(x).item()
+"""
+    assert not [f for f in lint(src) if f.rule == "GL01"]
+
+
+# ---------------------------------------------------------------------------
+# GL02 — raw env flag parsing
+# ---------------------------------------------------------------------------
+
+def test_gl02_fires_on_flag_vocab_compare():
+    src = """
+import os
+
+def wanted():
+    force = os.environ.get("RAFT_TPU_X", "auto")
+    if force == "never":
+        return False
+    return force == "always"
+"""
+    assert rules_of(lint(src)) == ["GL02"]
+
+
+def test_gl02_fires_on_inline_truth_test_and_chain():
+    src = """
+import os
+
+def a():
+    if os.environ.get("X"):
+        return 1
+
+def b():
+    return os.environ.get("Y", "").strip().lower() not in ("", "0", "no")
+"""
+    findings = [f for f in lint(src) if f.rule == "GL02"]
+    assert len(findings) == 2
+
+
+def test_gl02_quiet_on_value_reads():
+    src = """
+import os
+
+def paths():
+    jsonl = os.environ.get("RAFT_TPU_BENCH_OBS_JSONL")
+    if jsonl:
+        open(jsonl)
+    n = int(os.environ.get("RAFT_TPU_BENCH_N", 1000))
+    return n
+"""
+    assert not [f for f in lint(src) if f.rule == "GL02"]
+
+
+# ---------------------------------------------------------------------------
+# GL03 — recompile hazards
+# ---------------------------------------------------------------------------
+
+def test_gl03_fires_on_tracer_branch_and_unhashable_static():
+    src = """
+import functools
+import jax
+
+@functools.partial(jax.jit, static_argnames=("k",))
+def f(x, k, opts=[1, 2]):
+    if x > 0:
+        return x
+    return -x
+
+@functools.partial(jax.jit, static_argnames=("opts",))
+def g(x, opts=[1, 2]):
+    return x
+"""
+    findings = [f for f in lint(src) if f.rule == "GL03"]
+    assert len(findings) == 2
+    assert any("traced value" in f.message for f in findings)
+    assert any("unhashable" in f.message for f in findings)
+
+
+def test_gl03_quiet_on_static_and_structure_branches():
+    src = """
+import functools
+import jax
+
+@functools.partial(jax.jit, static_argnames=("k", "interpret"))
+def f(x, mask, k, interpret=False):
+    if interpret:
+        k = k + 1
+    if mask is not None:
+        x = x * 1.0
+    if x.ndim == 2 and k > x.shape[0]:
+        return x
+    return x
+"""
+    assert not [f for f in lint(src) if f.rule == "GL03"]
+
+
+# ---------------------------------------------------------------------------
+# GL04 — observability contract on public entry points
+# ---------------------------------------------------------------------------
+
+GL04_BAD = """
+def build(dataset):
+    return dataset
+
+def search(index, q, k):
+    return index
+"""
+
+GL04_GOOD = """
+from raft_tpu.core.tracing import traced, span
+
+@traced("raft_tpu.fake.build")
+def build(dataset):
+    return dataset
+
+def search(index, q, k):
+    with span("scan"):
+        return index
+
+def _private_helper(x):
+    return x
+
+def not_an_entry_verb(x):
+    return x
+"""
+
+
+def test_gl04_fires_only_in_entry_packages():
+    assert len([f for f in lint(GL04_BAD) if f.rule == "GL04"]) == 2
+    # same source outside neighbors/cluster/distance: no contract
+    assert not lint(GL04_BAD, path="raft_tpu/sparse/fake.py")
+
+
+def test_gl04_satisfied_by_traced_or_span():
+    assert not [f for f in lint(GL04_GOOD) if f.rule == "GL04"]
+
+
+# ---------------------------------------------------------------------------
+# GL05 — Pallas kernel constraints
+# ---------------------------------------------------------------------------
+
+GL05_BAD = """
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+_LANES = 128
+
+def bad_kernel(x_ref, idx_ref, o_ref):
+    o_ref[:] = jnp.take(x_ref[:], idx_ref[:], axis=1)
+
+def caller(x, idx):
+    return pl.pallas_call(
+        bad_kernel,
+        in_specs=[
+            pl.BlockSpec((8, 100), lambda i: (i, 0)),
+            pl.BlockSpec(),
+            pl.BlockSpec((8, _LANES), lambda i: (i, 0)),
+        ],
+    )(x, idx)
+"""
+
+
+def test_gl05_fires_on_lane_tiling_memory_space_and_gather():
+    findings = [f for f in lint(GL05_BAD) if f.rule == "GL05"]
+    msgs = " | ".join(f.message for f in findings)
+    assert len(findings) == 3
+    assert "not a multiple of 128" in msgs
+    assert "memory_space" in msgs
+    assert "lane-axis gather" in msgs
+    # const-resolved _LANES block and the SMEM spec are fine
+    src_ok = GL05_BAD.replace("(8, 100)", "(8, 256)") \
+                     .replace("pl.BlockSpec(),",
+                              "pl.BlockSpec(memory_space='smem'),") \
+                     .replace("jnp.take(x_ref[:], idx_ref[:], axis=1)",
+                              "x_ref[:]")
+    assert not [f for f in lint(src_ok) if f.rule == "GL05"]
+
+
+# ---------------------------------------------------------------------------
+# suppressions
+# ---------------------------------------------------------------------------
+
+def test_line_suppression_is_per_rule():
+    src = """
+import os
+
+def wanted():
+    force = os.environ.get("X", "auto")  # graftlint: disable=GL02
+    return force == "always"
+"""
+    assert not lint(src)
+    # wrong rule id on the line does NOT silence GL02
+    assert lint(src.replace("disable=GL02", "disable=GL01"))
+
+
+def test_fn_scope_suppression():
+    src = """
+import jax
+import numpy as np
+
+@jax.jit
+def f(x):  # graftlint: disable-fn=GL01
+    return np.asarray(x), x.item()
+
+@jax.jit
+def g(x):
+    return np.asarray(x)
+"""
+    findings = [f for f in lint(src) if f.rule == "GL01"]
+    assert len(findings) == 1  # only g's — f is scope-suppressed
+
+
+def test_disable_all():
+    src = """
+import os
+
+def wanted():
+    return os.environ.get("X") == "always"  # graftlint: disable=all
+"""
+    assert not lint(src)
+
+
+def test_every_rule_has_a_suppressible_finding():
+    """Meta-check: each rule id observed above responds to its own
+    line suppression (guards the Finding.line anchoring)."""
+    cases = {
+        "GL01": (GL01_BAD, "    v = x.item()",
+                 "    v = x.item()  # graftlint: disable=GL01"),
+        "GL04": (GL04_BAD, "def build(dataset):",
+                 "def build(dataset):  # graftlint: disable=GL04"),
+    }
+    for rule, (src, old, new) in cases.items():
+        before = [f for f in lint(src) if f.rule == rule]
+        after = [f for f in lint(src.replace(old, new)) if f.rule == rule]
+        assert len(after) == len(before) - 1, rule
+
+
+# ---------------------------------------------------------------------------
+# engine / CLI
+# ---------------------------------------------------------------------------
+
+def test_select_filters_rules():
+    findings = lint(GL01_BAD + GL04_BAD, select={"GL04"})
+    assert rules_of(findings) == ["GL04"]
+
+
+def test_repo_tree_is_clean():
+    """The acceptance gate: zero unsuppressed findings on raft_tpu/."""
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    findings = graftlint.lint_paths([os.path.join(root, "raft_tpu")])
+    assert not findings, "\n".join(f.render() for f in findings)
+
+
+def test_cli_json_and_exit_codes(tmp_path):
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    bad = tmp_path / "neighbors"
+    bad.mkdir()
+    (bad / "mod.py").write_text(GL04_BAD)
+    env = dict(os.environ, PYTHONPATH=root)
+    p = subprocess.run(
+        [sys.executable, "-m", "tools.graftlint", str(bad), "--format",
+         "json"], capture_output=True, text=True, cwd=root, env=env)
+    assert p.returncode == 1
+    payload = json.loads(p.stdout)
+    assert {f["rule"] for f in payload} == {"GL04"}
+    (bad / "mod.py").write_text(GL04_GOOD)
+    p = subprocess.run(
+        [sys.executable, "-m", "tools.graftlint", str(bad)],
+        capture_output=True, text=True, cwd=root, env=env)
+    assert p.returncode == 0, p.stdout + p.stderr
+    assert "clean" in p.stdout

@@ -1,0 +1,655 @@
+"""graftlint — JAX/Pallas-aware static analysis for the raft_tpu tree.
+
+The TPU-native analog of the reference stack's correctness lanes (RAFT
+CI runs clang-tidy over every prim; FAISS gates contrib changes on
+sanitizer jobs): a stdlib-``ast`` pass over the repo's own JAX
+conventions, the failure modes that cost correctness and QPS without
+ever failing a test. No third-party deps — ``ast`` + ``tokenize`` only.
+
+Rules
+-----
+
+GL01  host-sync call inside a ``@jit`` / ``@traced`` / Pallas-kernel
+      body: ``.item()``, ``np.asarray``/``np.array``, ``jax.device_get``,
+      ``block_until_ready``, and ``float()/int()/bool()`` of a bare
+      array variable. Inside jit these either fail at trace time or
+      silently de-async the dispatch pipeline; inside a traced entry
+      point they serialize the hot path behind a device round-trip.
+GL02  raw ``os.environ.get`` flag parsing: comparing an env read against
+      flag vocabulary ("0"/"1"/"on"/"off"/"auto"/"always"/"never"/...)
+      or truth-testing it inline. Plain string truthiness reads
+      ``FLAG=0`` as enabled — use :func:`raft_tpu.obs.env_flag` (bool)
+      or :func:`raft_tpu.obs.env_tristate` (auto/on/off) instead.
+      Presence checks of value-carrying vars (paths, numbers) are fine.
+GL03  recompile hazard: a Python ``if``/``while`` testing a non-static
+      parameter inside a jitted function (tracer branch → trace error
+      or silent per-value recompile), or a ``static_argnames`` entry
+      whose parameter default is a mutable literal (unhashable static →
+      TypeError at call time).
+GL04  public entry point in ``neighbors/``/``cluster/``/``distance/``
+      missing the observability contract (PR 1): the conventional entry
+      verbs (build/search/fit/predict/...) must be ``@traced`` or open
+      a ``span(...)`` so per-stage latency is attributable in process.
+GL05  Pallas TPU kernel constraints: a ``pl.BlockSpec`` whose trailing
+      block dim resolves to a non-multiple of 128 (lane tiling), a
+      bare ``pl.BlockSpec()`` with neither block shape nor
+      ``memory_space`` (scalar operands must name SMEM), and
+      ``jnp.take``/``take_along_axis``/``lax.gather`` inside a kernel
+      body (Mosaic has no lane-axis gather — use a one-hot matmul).
+
+Suppression
+-----------
+
+Append ``# graftlint: disable=GL01`` (comma-separate several rules, or
+``all``) to the flagged line. For a function whose whole body is an
+intentional exception (e.g. an eager builder that packs lists on the
+host by design), put ``# graftlint: disable-fn=GL01`` on its ``def``
+line to scope the suppression to that function. There is no file-level
+kill switch by design — suppressions stay next to the code they excuse.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import io
+import json
+import os
+import re
+import sys
+import tokenize
+from typing import Dict, Iterable, List, Optional, Sequence, Set, Tuple
+
+RULES: Dict[str, str] = {
+    "GL01": "host-sync call inside a jit/traced/Pallas-kernel body",
+    "GL02": "raw os.environ.get flag parsing (use obs.env_flag / "
+            "obs.env_tristate)",
+    "GL03": "recompile hazard (tracer branch / unhashable static arg)",
+    "GL04": "public entry point missing traced/span observability wrapper",
+    "GL05": "Pallas kernel constraint (lane tiling / memory_space / "
+            "lane gather)",
+}
+
+# GL02: string literals that mark an env read as *flag* parsing (vs a
+# path / number / free-form value, which raw reads may keep).
+_FLAG_VOCAB = {"", "0", "1", "true", "false", "on", "off", "yes", "no",
+               "always", "never", "auto"}
+
+# GL04: the entry verbs of the observability contract (PR 1) — public
+# module-level functions with these names in neighbors/cluster/distance
+# must be @traced or open a span.
+_ENTRY_VERBS = {
+    "build", "build_chunked", "extend", "search", "knn", "eps_nn",
+    "eps_neighbors_l2sq", "build_knn_graph",
+    "build_knn_graph_with_distances", "fit", "fit_minibatch",
+    "fit_predict", "predict", "transform", "refine", "refine_gathered",
+    "refine_provider", "single_linkage", "pairwise_distance", "distance",
+    "fused_l2_nn_argmin", "masked_l2_nn_argmin", "gram_matrix",
+}
+_ENTRY_PACKAGES = ("neighbors", "cluster", "distance")
+
+# GL01: attribute calls that synchronize with the device.
+_SYNC_ATTRS = {"item", "block_until_ready"}
+# GL01: module-qualified calls that move device data to the host.
+_SYNC_QUALIFIED = {
+    ("np", "asarray"), ("np", "array"), ("numpy", "asarray"),
+    ("numpy", "array"), ("jax", "device_get"),
+    ("jax", "block_until_ready"),
+}
+
+_SUPPRESS_RE = re.compile(
+    r"#\s*graftlint:\s*disable=([A-Za-z0-9_,\s]+)")
+_SUPPRESS_FN_RE = re.compile(
+    r"#\s*graftlint:\s*disable-fn=([A-Za-z0-9_,\s]+)")
+
+
+@dataclasses.dataclass
+class Finding:
+    path: str
+    line: int
+    col: int
+    rule: str
+    message: str
+
+    def render(self) -> str:
+        return f"{self.path}:{self.line}:{self.col}: {self.rule} {self.message}"
+
+    def as_dict(self) -> Dict[str, object]:
+        return dataclasses.asdict(self)
+
+
+def _parse_rules(spec: str) -> Set[str]:
+    rules = {r.strip().upper() for r in spec.split(",") if r.strip()}
+    return set(RULES) if "ALL" in rules else rules
+
+
+def _suppressions(source: str) -> Tuple[Dict[int, Set[str]],
+                                        Dict[int, Set[str]]]:
+    """(line → rules disabled on that line, line → rules disabled for the
+    function whose ``def`` sits on that line)."""
+    lines: Dict[int, Set[str]] = {}
+    fn_lines: Dict[int, Set[str]] = {}
+    try:
+        toks = tokenize.generate_tokens(io.StringIO(source).readline)
+        for tok in toks:
+            if tok.type != tokenize.COMMENT:
+                continue
+            m = _SUPPRESS_FN_RE.search(tok.string)
+            if m:
+                fn_lines.setdefault(tok.start[0], set()).update(
+                    _parse_rules(m.group(1)))
+                continue
+            m = _SUPPRESS_RE.search(tok.string)
+            if m:
+                lines.setdefault(tok.start[0], set()).update(
+                    _parse_rules(m.group(1)))
+    except (tokenize.TokenError, IndentationError):
+        pass
+    return lines, fn_lines
+
+
+class _Parents(ast.NodeVisitor):
+    """node → parent map (ast has no uplinks)."""
+
+    def __init__(self, tree: ast.AST):
+        self.parent: Dict[ast.AST, ast.AST] = {}
+        self._walk(tree)
+
+    def _walk(self, node: ast.AST) -> None:
+        for child in ast.iter_child_nodes(node):
+            self.parent[child] = node
+            self._walk(child)
+
+
+def _dotted(node: ast.AST) -> str:
+    """Render ``a.b.c`` attribute chains ('' when not a plain chain)."""
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return ""
+
+
+def _const_env(tree: ast.Module) -> Dict[str, int]:
+    """Module-level integer constants (``_LANES = 128`` and simple
+    arithmetic over already-known names), for GL05 block-shape math."""
+    env: Dict[str, int] = {}
+    for node in tree.body:
+        if isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                and isinstance(node.targets[0], ast.Name):
+            val = _const_int(node.value, env)
+            if val is not None:
+                env[node.targets[0].id] = val
+    return env
+
+
+def _const_int(node: ast.AST, env: Dict[str, int]) -> Optional[int]:
+    if isinstance(node, ast.Constant) and isinstance(node.value, int) \
+            and not isinstance(node.value, bool):
+        return node.value
+    if isinstance(node, ast.Name):
+        return env.get(node.id)
+    if isinstance(node, ast.BinOp):
+        lhs, rhs = _const_int(node.left, env), _const_int(node.right, env)
+        if lhs is None or rhs is None:
+            return None
+        try:
+            if isinstance(node.op, ast.Mult):
+                return lhs * rhs
+            if isinstance(node.op, ast.Add):
+                return lhs + rhs
+            if isinstance(node.op, ast.Sub):
+                return lhs - rhs
+            if isinstance(node.op, ast.FloorDiv):
+                return lhs // rhs
+        except (ZeroDivisionError, ValueError):
+            return None
+    return None
+
+
+# ---------------------------------------------------------------------------
+# function-context classification
+# ---------------------------------------------------------------------------
+
+@dataclasses.dataclass
+class _FnCtx:
+    node: ast.FunctionDef
+    is_jit: bool = False
+    is_traced: bool = False
+    is_kernel: bool = False
+    static_params: Set[str] = dataclasses.field(default_factory=set)
+
+    @property
+    def hot(self) -> bool:
+        return self.is_jit or self.is_traced or self.is_kernel
+
+    def kind(self) -> str:
+        if self.is_kernel:
+            return "Pallas kernel"
+        if self.is_jit:
+            return "@jit function"
+        return "@traced function"
+
+
+def _param_names(fn: ast.FunctionDef) -> List[str]:
+    a = fn.args
+    return [p.arg for p in (a.posonlyargs + a.args + a.kwonlyargs)]
+
+
+def _jit_decorator_info(dec: ast.AST) -> Optional[Tuple[Set[str], Set[int]]]:
+    """(static_argnames, static_argnums) when ``dec`` is a jit wrapper:
+    ``jax.jit`` / ``jit`` / ``[functools.]partial(jax.jit, ...)`` /
+    ``jax.jit(...)``; None otherwise."""
+    names: Set[str] = set()
+    nums: Set[int] = set()
+    if _dotted(dec) in ("jax.jit", "jit"):
+        return names, nums
+    if isinstance(dec, ast.Call):
+        callee = _dotted(dec.func)
+        inner = dec.args[0] if dec.args else None
+        is_partial_jit = (callee in ("functools.partial", "partial")
+                          and inner is not None
+                          and _dotted(inner) in ("jax.jit", "jit"))
+        is_direct_jit = callee in ("jax.jit", "jit")
+        if not (is_partial_jit or is_direct_jit):
+            return None
+        for kw in dec.keywords:
+            if kw.arg == "static_argnames":
+                for el in ast.walk(kw.value):
+                    if isinstance(el, ast.Constant) \
+                            and isinstance(el.value, str):
+                        names.add(el.value)
+            elif kw.arg == "static_argnums":
+                for el in ast.walk(kw.value):
+                    if isinstance(el, ast.Constant) \
+                            and isinstance(el.value, int):
+                        nums.add(el.value)
+        return names, nums
+    return None
+
+
+def _classify(fn: ast.FunctionDef) -> _FnCtx:
+    ctx = _FnCtx(fn)
+    params = _param_names(fn)
+    for dec in fn.decorator_list:
+        jit = _jit_decorator_info(dec)
+        if jit is not None:
+            ctx.is_jit = True
+            names, nums = jit
+            ctx.static_params |= names
+            ctx.static_params |= {params[i] for i in nums if i < len(params)}
+            continue
+        base = _dotted(dec.func) if isinstance(dec, ast.Call) else _dotted(dec)
+        if base == "traced" or base.endswith(".traced"):
+            ctx.is_traced = True
+    # Pallas kernels: ref-style params (the pl.pallas_call convention
+    # this repo uses everywhere) or the _kernel naming convention
+    n_refs = sum(1 for p in params if p.endswith("_ref"))
+    if fn.name.endswith("_kernel") or n_refs >= 2:
+        ctx.is_kernel = n_refs >= 2 or fn.name.endswith("_kernel")
+    # kernel static kwargs are bound via functools.partial → every
+    # non-ref param is static by construction
+    if ctx.is_kernel:
+        ctx.static_params |= {p for p in params if not p.endswith("_ref")}
+    return ctx
+
+
+# ---------------------------------------------------------------------------
+# per-rule checks
+# ---------------------------------------------------------------------------
+
+def _check_gl01(fn: _FnCtx, add) -> None:
+    """Host syncs inside hot bodies. Walks the whole body including
+    nested defs — a closure defined inside a jitted/traced function runs
+    in the same hot context."""
+    for node in ast.walk(fn.node):
+        if not isinstance(node, ast.Call):
+            continue
+        msg = None
+        if isinstance(node.func, ast.Attribute):
+            attr = node.func.attr
+            qual = _dotted(node.func)
+            parts = tuple(qual.split(".")) if qual else ()
+            if attr in _SYNC_ATTRS and not node.args:
+                msg = f".{attr}() synchronizes with the device"
+            elif len(parts) == 2 and parts in _SYNC_QUALIFIED:
+                msg = (f"{qual}() synchronizes with the device"
+                       if attr == "block_until_ready"
+                       else f"{qual}() pulls device data to the host")
+        elif isinstance(node.func, ast.Name) \
+                and node.func.id in ("float", "int", "bool") \
+                and len(node.args) == 1 \
+                and isinstance(node.args[0], ast.Name) \
+                and node.args[0].id not in fn.static_params:
+            # static params are Python values at trace time — only
+            # float()/int()/bool() of a potentially-traced name syncs
+            msg = (f"{node.func.id}({node.args[0].id}) forces a device "
+                   "scalar to the host")
+        if msg:
+            add(node, "GL01", f"{msg} inside a {fn.kind()} "
+                f"({fn.node.name})")
+
+
+def _is_flag_literal(node: ast.AST) -> bool:
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return node.value.strip().lower() in _FLAG_VOCAB
+    if isinstance(node, (ast.Tuple, ast.List, ast.Set)):
+        return bool(node.elts) and all(_is_flag_literal(e)
+                                       for e in node.elts)
+    return False
+
+
+def _compare_against_flags(cmp: ast.Compare) -> bool:
+    return any(_is_flag_literal(c) for c in [cmp.left] + list(cmp.comparators))
+
+
+def _in_bool_context(node: ast.AST, parents: _Parents) -> bool:
+    """True when ``node``'s value flows (through attribute/call chains)
+    directly into a truth test — no intervening assignment."""
+    cur: ast.AST = node
+    while True:
+        par = parents.parent.get(cur)
+        if par is None:
+            return False
+        if isinstance(par, (ast.If, ast.While)) and \
+                getattr(par, "test", None) is cur:
+            return True
+        if isinstance(par, ast.IfExp) and par.test is cur:
+            return True
+        if isinstance(par, (ast.BoolOp,)):
+            return True
+        if isinstance(par, ast.UnaryOp) and isinstance(par.op, ast.Not):
+            return True
+        if isinstance(par, (ast.Attribute, ast.Call)):
+            cur = par  # .strip().lower() chains keep the value flowing
+            continue
+        return False
+
+
+def _check_gl02(tree: ast.Module, parents: _Parents, add) -> None:
+    env_gets: List[ast.Call] = [
+        n for n in ast.walk(tree)
+        if isinstance(n, ast.Call)
+        and _dotted(n.func) in ("os.environ.get", "environ.get")
+    ]
+    if not env_gets:
+        return
+    # names assigned directly from an env read (several reads may share
+    # a conventional name like ``force`` across functions — track all)
+    assigned: Dict[str, List[ast.Call]] = {}
+    for call in env_gets:
+        par = parents.parent.get(call)
+        if isinstance(par, ast.Assign) and len(par.targets) == 1 \
+                and isinstance(par.targets[0], ast.Name):
+            assigned.setdefault(par.targets[0].id, []).append(call)
+    flagged: Set[ast.Call] = set()
+    for call in env_gets:
+        # direct flow: comparison against flag vocab or inline truth test
+        cur: ast.AST = call
+        while cur is not None and not isinstance(cur, ast.stmt):
+            if isinstance(cur, ast.Compare) and _compare_against_flags(cur):
+                flagged.add(call)
+                break
+            cur = parents.parent.get(cur)
+        if call not in flagged and _in_bool_context(call, parents):
+            flagged.add(call)
+    # assigned names later compared against flag vocabulary
+    for cmp in ast.walk(tree):
+        if not isinstance(cmp, ast.Compare) or not _compare_against_flags(cmp):
+            continue
+        for part in [cmp.left] + list(cmp.comparators):
+            if isinstance(part, ast.Name) and part.id in assigned:
+                flagged.update(assigned[part.id])
+    for call in flagged:
+        add(call, "GL02",
+            "os.environ.get parsed as a flag — use obs.env_flag (bool) "
+            "or obs.env_tristate (auto/on/off)")
+
+
+def _test_names(test: ast.AST) -> Set[str]:
+    """Bare Names referenced by a branch test. Excluded: any attribute
+    access (x.shape is a trace-time constant, and pytree params carry
+    static aux fields like index.codes_folded — undecidable statically,
+    and the common attribute branches are on static metadata), call
+    callees, and ``is``/``is not`` identity checks — ``if x is None``
+    branches on pytree STRUCTURE, which is part of the trace signature,
+    not a tracer value."""
+    skip: Set[ast.AST] = set()
+    for node in ast.walk(test):
+        if isinstance(node, ast.Compare) and \
+                all(isinstance(op, (ast.Is, ast.IsNot)) for op in node.ops):
+            skip.update(ast.walk(node))
+        elif isinstance(node, ast.Attribute):
+            skip.update(ast.walk(node.value))
+        elif isinstance(node, ast.Call):
+            skip.update(ast.walk(node.func))
+    return {node.id for node in ast.walk(test)
+            if isinstance(node, ast.Name) and node not in skip}
+
+
+def _check_gl03(fn: _FnCtx, add) -> None:
+    # (a) Python branch on a non-static parameter inside a jit body
+    if fn.is_jit or fn.is_kernel:
+        data_params = set(_param_names(fn.node)) - fn.static_params
+        if fn.is_kernel:
+            data_params = {p for p in data_params if p.endswith("_ref")}
+        for node in ast.walk(fn.node):
+            if isinstance(node, (ast.If, ast.While)):
+                hits = _test_names(node.test) & data_params
+                if hits:
+                    add(node, "GL03",
+                        f"Python branch on traced value(s) "
+                        f"{sorted(hits)} inside {fn.kind()} "
+                        f"({fn.node.name}) — traces once per value or "
+                        "errors; use lax.cond/jnp.where")
+    # (b) unhashable static-arg defaults
+    if fn.is_jit and fn.static_params:
+        a = fn.node.args
+        params = a.posonlyargs + a.args
+        defaults = a.defaults
+        off = len(params) - len(defaults)
+        pairs = [(params[off + i].arg, d) for i, d in enumerate(defaults)]
+        pairs += [(p.arg, d) for p, d in zip(a.kwonlyargs, a.kw_defaults)
+                  if d is not None]
+        for name, default in pairs:
+            if name in fn.static_params and \
+                    isinstance(default, (ast.List, ast.Dict, ast.Set)):
+                add(default, "GL03",
+                    f"static arg {name!r} of {fn.node.name} defaults to "
+                    "an unhashable literal — jit statics must be "
+                    "hashable (use a tuple)")
+
+
+def _opens_span(fn: ast.FunctionDef) -> bool:
+    for node in ast.walk(fn):
+        if isinstance(node, ast.With):
+            for item in node.items:
+                expr = item.context_expr
+                if isinstance(expr, ast.Call):
+                    callee = _dotted(expr.func)
+                    if callee == "span" or callee.endswith(".span"):
+                        return True
+    return False
+
+
+def _check_gl04(tree: ast.Module, path: str, add) -> None:
+    norm = path.replace(os.sep, "/")
+    if not any(f"/{pkg}/" in norm for pkg in _ENTRY_PACKAGES):
+        return
+    for node in tree.body:
+        if not isinstance(node, ast.FunctionDef):
+            continue
+        if node.name.startswith("_") or node.name not in _ENTRY_VERBS:
+            continue
+        ctx = _classify(node)
+        if ctx.is_traced or _opens_span(node):
+            continue
+        add(node, "GL04",
+            f"public entry point {node.name}() lacks the observability "
+            "contract — decorate with @traced or open a span(...)")
+
+
+def _check_gl05(tree: ast.Module, fns: Sequence[_FnCtx], add) -> None:
+    env = _const_env(tree)
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        callee = _dotted(node.func)
+        if not (callee == "BlockSpec" or callee.endswith(".BlockSpec")):
+            continue
+        kwargs = {kw.arg for kw in node.keywords}
+        if not node.args and "memory_space" not in kwargs \
+                and "block_shape" not in kwargs:
+            add(node, "GL05",
+                "bare pl.BlockSpec() — scalar operands must name "
+                "memory_space (e.g. pltpu.SMEM)")
+            continue
+        shape = None
+        if node.args and isinstance(node.args[0], ast.Tuple):
+            shape = node.args[0]
+        for kw in node.keywords:
+            if kw.arg == "block_shape" and isinstance(kw.value, ast.Tuple):
+                shape = kw.value
+        if shape is not None and shape.elts:
+            last = _const_int(shape.elts[-1], env)
+            if last is not None and last != 1 and last % 128 != 0:
+                add(shape, "GL05",
+                    f"BlockSpec trailing block dim {last} is not a "
+                    "multiple of 128 — Mosaic lane tiling wants "
+                    "last-dim % 128 == 0")
+    for fn in fns:
+        if not fn.is_kernel:
+            continue
+        for node in ast.walk(fn.node):
+            if isinstance(node, ast.Call):
+                callee = _dotted(node.func)
+                if callee.endswith(("jnp.take", "jnp.take_along_axis")) \
+                        or callee.endswith("lax.gather") \
+                        or callee in ("take", "take_along_axis"):
+                    add(node, "GL05",
+                        f"{callee}() inside Pallas kernel "
+                        f"{fn.node.name} — Mosaic has no lane-axis "
+                        "gather; use a one-hot selection matmul")
+
+
+# ---------------------------------------------------------------------------
+# driver
+# ---------------------------------------------------------------------------
+
+def lint_source(source: str, path: str = "<string>",
+                select: Optional[Set[str]] = None) -> List[Finding]:
+    """Lint one file's source; returns unsuppressed findings."""
+    try:
+        tree = ast.parse(source, filename=path)
+    except SyntaxError as e:
+        return [Finding(path, e.lineno or 0, e.offset or 0, "GL00",
+                        f"syntax error: {e.msg}")]
+    suppress, suppress_fn = _suppressions(source)
+    parents = _Parents(tree)
+    findings: List[Finding] = []
+
+    # function-scoped suppression: (line range, rules) per def whose
+    # signature line carries a disable-fn comment
+    fn_ranges: List[Tuple[int, int, Set[str]]] = []
+    if suppress_fn:
+        for node in ast.walk(tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                for line in range(node.lineno,
+                                  (node.body[0].lineno if node.body
+                                   else node.lineno) + 1):
+                    if line in suppress_fn:
+                        fn_ranges.append((node.lineno,
+                                          node.end_lineno or node.lineno,
+                                          suppress_fn[line]))
+                        break
+
+    def add(node: ast.AST, rule: str, message: str) -> None:
+        if select and rule not in select:
+            return
+        line = getattr(node, "lineno", 0)
+        if rule in suppress.get(line, ()):
+            return
+        for lo, hi, rules in fn_ranges:
+            if lo <= line <= hi and rule in rules:
+                return
+        findings.append(Finding(path, line,
+                                getattr(node, "col_offset", 0) + 1,
+                                rule, message))
+
+    fns = [_classify(n) for n in ast.walk(tree)
+           if isinstance(n, ast.FunctionDef)]
+    for fn in fns:
+        if fn.hot:
+            _check_gl01(fn, add)
+        _check_gl03(fn, add)
+    _check_gl02(tree, parents, add)
+    _check_gl04(tree, path, add)
+    _check_gl05(tree, fns, add)
+    findings.sort(key=lambda f: (f.path, f.line, f.col, f.rule))
+    return findings
+
+
+def lint_paths(paths: Iterable[str],
+               select: Optional[Set[str]] = None) -> List[Finding]:
+    """Lint files / package trees; returns all unsuppressed findings."""
+    files: List[str] = []
+    for p in paths:
+        if os.path.isdir(p):
+            for root, dirs, names in os.walk(p):
+                dirs[:] = [d for d in sorted(dirs)
+                           if d not in ("__pycache__", ".git")]
+                files += [os.path.join(root, n) for n in sorted(names)
+                          if n.endswith(".py")]
+        elif p.endswith(".py"):
+            files.append(p)
+        else:
+            raise FileNotFoundError(f"graftlint: not a .py file or "
+                                    f"directory: {p}")
+    findings: List[Finding] = []
+    for f in files:
+        with open(f, encoding="utf-8") as fh:
+            findings += lint_source(fh.read(), path=f, select=select)
+    return findings
+
+
+def main(argv: Optional[Sequence[str]] = None) -> int:
+    import argparse
+
+    ap = argparse.ArgumentParser(
+        prog="graftlint",
+        description="JAX/Pallas-aware static analysis for raft_tpu")
+    ap.add_argument("paths", nargs="*", default=["raft_tpu"],
+                    help="files or package dirs to lint (default: raft_tpu)")
+    ap.add_argument("--format", choices=("human", "json"), default="human")
+    ap.add_argument("--select", default=None,
+                    help="comma-separated rule ids to run (default: all)")
+    ap.add_argument("--list-rules", action="store_true")
+    args = ap.parse_args(argv)
+
+    if args.list_rules:
+        for rule, desc in sorted(RULES.items()):
+            print(f"{rule}  {desc}")
+        return 0
+
+    select = None
+    if args.select:
+        select = {r.strip().upper() for r in args.select.split(",")
+                  if r.strip()}
+        unknown = select - set(RULES)
+        if unknown:
+            print(f"graftlint: unknown rule(s): {sorted(unknown)}",
+                  file=sys.stderr)
+            return 2
+    findings = lint_paths(args.paths or ["raft_tpu"], select=select)
+    if args.format == "json":
+        print(json.dumps([f.as_dict() for f in findings], indent=2))
+    else:
+        for f in findings:
+            print(f.render())
+        n = len(findings)
+        print(f"graftlint: {n} finding{'s' if n != 1 else ''}"
+              if n else "graftlint: clean")
+    return 1 if findings else 0
