@@ -344,31 +344,47 @@ def _probe_cause(head: str, stderr) -> str:
     return head + (f"; stderr tail: {tail}" if tail else "; no stderr")
 
 
-def _device_backend_ok(timeout_s: float = 150.0) -> bool:
+def _device_backend_ok(timeout_s: float = 150.0, attempts: int = 2,
+                       backoff_s: float = 15.0) -> bool:
     """Probe the device backend in a KILLABLE subprocess. A wedged
     remote-device plugin blocks `import jax` in C code where SIGALRM
     never reaches the Python handler — probing in-process would turn a
     down backend into a silent rc=124 with the record lost (the exact
     round-4 failure). The cached deep-100m replay needs no device, so
-    it still lands. On failure the cause (returncode + stderr tail) is
-    stashed in STATE['probe_error'] for the caller's note."""
+    it still lands.
+
+    A SINGLE flaky probe must not kill a whole leg either (BENCH_r05
+    lost the hard/gist legs to one probe subprocess timeout during a
+    transient tunnel hiccup): retry once after a short backoff before
+    declaring the device dead. On failure the cause (returncode +
+    stderr tail) AND the attempt count are stashed in
+    STATE['probe_error'] for the caller's partial-record note."""
     import subprocess
 
-    try:
-        p = subprocess.run(
-            [sys.executable, "-c",
-             "import jax; jax.devices(); print('ok')"],
-            capture_output=True, text=True, timeout=timeout_s)
-        if p.returncode == 0 and "ok" in p.stdout:
-            STATE.pop("probe_error", None)
-            return True
-        STATE["probe_error"] = _probe_cause(
-            f"probe subprocess rc={p.returncode}", p.stderr)
-    except subprocess.TimeoutExpired as e:
-        STATE["probe_error"] = _probe_cause(
-            f"probe subprocess timed out after {timeout_s:.0f}s", e.stderr)
-    except Exception as e:
-        STATE["probe_error"] = f"probe failed to launch: {e!r}"
+    cause = "no diagnostics captured"
+    for attempt in range(1, attempts + 1):
+        try:
+            p = subprocess.run(
+                [sys.executable, "-c",
+                 "import jax; jax.devices(); print('ok')"],
+                capture_output=True, text=True, timeout=timeout_s)
+            if p.returncode == 0 and "ok" in p.stdout:
+                STATE.pop("probe_error", None)
+                return True
+            cause = _probe_cause(
+                f"probe subprocess rc={p.returncode}", p.stderr)
+        except subprocess.TimeoutExpired as e:
+            cause = _probe_cause(
+                f"probe subprocess timed out after {timeout_s:.0f}s",
+                e.stderr)
+        except Exception as e:
+            cause = f"probe failed to launch: {e!r}"
+        if attempt < attempts:
+            print(f"[bench] device probe attempt {attempt}/{attempts} "
+                  f"failed ({cause.splitlines()[0]}) — retrying in "
+                  f"{backoff_s:.0f}s")
+            time.sleep(backoff_s)
+    STATE["probe_error"] = f"{cause} (after {attempts} probe attempts)"
     return False
 
 

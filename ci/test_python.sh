@@ -106,4 +106,36 @@ assert scan_span and scan_span["count"] >= 1, snap["histograms"].keys()
 print("pallas LUT-scan smoke OK: dispatch counter + scan span recorded")
 EOF
 
+echo "== Pallas gather-refine tier smoke (interpret mode, streamed refine) =="
+RAFT_TPU_PALLAS_REFINE=always python - <<'EOF'
+import numpy as np
+import jax.numpy as jnp
+
+from raft_tpu import obs
+from raft_tpu.obs.metrics import MetricsRegistry
+from raft_tpu.neighbors import refine
+
+rng = np.random.default_rng(0)
+x = jnp.asarray(rng.random((2000, 32), dtype=np.float32))
+q = jnp.asarray(rng.random((32, 32), dtype=np.float32))
+cand = jnp.asarray(rng.integers(0, 2000, (32, 400)).astype(np.int32))
+reg = MetricsRegistry()
+obs.enable(registry=reg, hbm=False)
+try:
+    d_p, i_p = refine.refine(x, q, cand, 10)
+finally:
+    obs.disable()
+d_x, i_x = refine._refine_impl(x, q, cand, 10, "sqeuclidean")
+np.testing.assert_allclose(np.asarray(d_p), np.asarray(d_x),
+                           rtol=2e-4, atol=2e-4)
+np.testing.assert_array_equal(np.asarray(i_p), np.asarray(i_x))
+snap = reg.snapshot()
+c = snap["counters"].get("refine.dispatch{impl=pallas_gather}", 0)
+assert c >= 1, snap["counters"]
+assert "span.refine.fused_scan" in snap["histograms"], \
+    snap["histograms"].keys()
+print("gather-refine smoke OK: fused tier parity + dispatch counter "
+      "+ span recorded")
+EOF
+
 echo "CI: all green"

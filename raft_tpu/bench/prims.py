@@ -266,6 +266,79 @@ def bench_pq_scan(grid=None, iters: int = 3) -> List[PrimResult]:
     return rows
 
 
+# ---------------------------------------------------------------------------
+# refine: XLA einsum-gather vs fused Pallas gather-refine
+# ---------------------------------------------------------------------------
+
+def bench_refine(grid=None, iters: int = 3) -> List[PrimResult]:
+    """Einsum-gather XLA refine vs the fused Pallas gather-refine tier —
+    the measurement behind ``neighbors.refine``'s dispatch (reference:
+    the refinement kernels' gbench rows under cpp/bench/prims). Each
+    impl is forced through the ``RAFT_TPU_PALLAS_REFINE`` override so a
+    silent dispatch fallback cannot mislabel a row. The einsum row
+    materializes the ``[m, C, d]`` gather buffer, so it only runs where
+    that buffer is survivable; the batch-10000 × k_cand-2000 acceptance
+    shape runs fused-only (its skipped einsum twin is recorded in
+    params — at 7.7 GB the buffer IS the reason the tier exists, and a
+    deliberately-OOMing row would kill the whole sweep). Off-TPU the
+    pallas row runs in interpreter mode and its time is meaningless —
+    kept tiny and flagged via params."""
+    import os
+
+    from raft_tpu.neighbors import refine as refine_mod
+    from raft_tpu.ops.pallas_kernels import (_on_tpu,
+                                             pallas_gather_refine_wanted)
+
+    on_tpu = _on_tpu()
+    if grid is None:
+        # (n, d, m, k_cand, k)
+        grid = ([(200_000, 96, 2_500, 2000, 10),
+                 (200_000, 96, 10_000, 2000, 10)] if on_tpu
+                else [(2_000, 32, 64, 256, 8)])
+    rows: List[PrimResult] = []
+    rng = np.random.default_rng(0)
+    prev = os.environ.get("RAFT_TPU_PALLAS_REFINE")
+    try:
+        for n, d, m, C, k in grid:
+            x = jnp.asarray(rng.random((n, d), dtype=np.float32))
+            q = jnp.asarray(rng.random((m, d), dtype=np.float32))
+            cand = jnp.asarray(
+                rng.integers(0, n, (m, C)).astype(np.int32))
+            buf_gib = m * C * d * 4 / 2**30
+            p = {"n": n, "d": d, "m": m, "k_cand": C, "k": k,
+                 "gather_buffer_gib": round(buf_gib, 2), "on_tpu": on_tpu}
+            impls = {}
+            if buf_gib <= 2.5:
+                impls["einsum_gather"] = "never"
+            else:
+                p["einsum_skipped"] = (f"[m, C, d] buffer "
+                                       f"{buf_gib:.1f} GiB")
+            # gate the pallas row under the SAME force it will run with
+            # (off-TPU the auto gate always declines, and an env value
+            # left over from the previous impl must not leak into this
+            # decision); skips are recorded, not silent
+            os.environ["RAFT_TPU_PALLAS_REFINE"] = "always"
+            if pallas_gather_refine_wanted(m, C, d, k):
+                impls["pallas_gather"] = "always"
+            else:
+                p["pallas_skipped"] = "shape outside the kernel gate"
+            for name, force in impls.items():
+                os.environ["RAFT_TPU_PALLAS_REFINE"] = force
+                ms = _time(lambda: refine_mod.refine(x, q, cand, k),
+                           iters=iters, warmup=1)
+                rows.append(PrimResult("refine", name, ms,
+                                       m * 1e3 / ms, "queries/s", p))
+            if not impls:
+                rows.append(PrimResult("refine", "skipped", 0.0, 0.0,
+                                       "queries/s", p))
+    finally:
+        if prev is None:
+            os.environ.pop("RAFT_TPU_PALLAS_REFINE", None)
+        else:
+            os.environ["RAFT_TPU_PALLAS_REFINE"] = prev
+    return rows
+
+
 BENCHES: Dict[str, Callable[[], List[PrimResult]]] = {
     "select_k": bench_select_k,
     "fused_l2_nn": bench_fused_l2_nn,
@@ -273,6 +346,7 @@ BENCHES: Dict[str, Callable[[], List[PrimResult]]] = {
     "kmeans": bench_kmeans,
     "ivf_scan": bench_ivf_scan,
     "pq_scan": bench_pq_scan,
+    "refine": bench_refine,
 }
 
 

@@ -95,6 +95,20 @@ def _algo_ivf_flat(dsx, build_param, metric):
     index = ivf_flat.build(dsx, p)
 
     def search(q, k, sp):
+        # refine_ratio rides the API's own refined path (SearchParams.
+        # refine="f32_regen"): oversample the scan — recovering what
+        # the approx hardware top-k trades away — then re-rank exactly
+        # through neighbors.refine's dispatch tier, residency-routed by
+        # ivf_flat._route_refined (device → fused gather-refine kernel
+        # on TPU oversampled shapes; memmap base → host gather)
+        sp = dict(sp)
+        ratio = sp.pop("refine_ratio", 1)
+        if ratio > 1:
+            return ivf_flat.search(
+                index, q, k,
+                ivf_flat.SearchParams(**sp, refine="f32_regen",
+                                      refine_ratio=float(ratio)),
+                dataset=dsx)
         return ivf_flat.search(index, q, k, ivf_flat.SearchParams(**sp))
 
     return search, index

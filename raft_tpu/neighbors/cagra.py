@@ -194,6 +194,9 @@ def build_knn_graph(dataset: jax.Array, k: int, metric: str = "sqeuclidean",
         if q.shape[0] < b:  # pad the tail chunk: one compiled shape
             q = jnp.pad(q, ((0, b - q.shape[0]), (0, 0)))
         _, cand = _ivf_pq.search(idx, q, gpu_top_k, sp)
+        # the exact re-rank rides neighbors.refine's dispatch tier (the
+        # fused gather-refine kernel once gpu_top_k reaches the
+        # oversampled regime; XLA einsum below it)
         _, ids = _refine(x, q, cand, k + 1, metric=metric)
         knn_parts.append(ids)
     knn_ids = jnp.concatenate(knn_parts, axis=0)[:n]

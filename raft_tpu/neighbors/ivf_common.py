@@ -226,6 +226,31 @@ def lut_scan_mem_ok(n_seg: int, seg: int, rot: int, pairs: int,
     return qv + bins + gathered <= GROUPED_BYTES_CAP
 
 
+def gather_refine_mem_ok(n: int, d: int, itemsize: int = 4,
+                         m: int = 0, C: int = 0) -> bool:
+    """HBM guard for the fused gather-refine tier (ops.pallas_kernels.
+    gather_refine_topk): everything per-candidate stays in VMEM — the
+    tier's point — but a dataset whose minor dim is not lane-aligned
+    pays a PER-CALL padded ``[n, ceil(d/128)·128]`` HBM copy before the
+    kernel (row DMAs address lane-tiled rows; the pad lives inside the
+    jitted wrapper, so every refined search re-materializes it). Two
+    checks: the copy must fit the shared transient cap, and — when the
+    workload shape ``(m, C)`` is known — it must be smaller than the
+    ``[m, C, d]`` f32 gather buffer the tier exists to avoid (a small
+    re-rank against a huge unaligned dataset would otherwise pay MORE
+    HBM than the einsum path it replaces). The XLA path pads per
+    candidate row instead, so declining here is always serviceable."""
+    if d % 128 == 0:
+        return True
+    dpad = -(-d // 128) * 128
+    pad_copy = n * dpad * itemsize
+    if pad_copy > GROUPED_BYTES_CAP:
+        return False
+    if m and C:
+        return pad_copy <= m * C * d * 4
+    return True
+
+
 def fit_seg_chunk(seg: int, L: int, d: int, want: int) -> int:
     """Largest segment chunk ≤ ``want`` whose per-step transients — the
     [chunk·seg, L] f32 distance block and the gathered [chunk, L, d]

@@ -89,6 +89,13 @@ class SearchParams:
     # clustered data)
     scan_select: str = "exact"  # | "approx"
     scan_recall: float = 0.95   # approx select per-op recall target
+    # refinement_rate pattern shared with ivf_pq (reference:
+    # refine-inl.cuh): "f32_regen" scans k·refine_ratio candidates and
+    # re-ranks exactly against search()'s ``dataset`` argument through
+    # neighbors.refine's dispatch tier — recovers the recall the approx
+    # hardware top-k trades away on oversampled configs
+    refine: str = "none"  # | "f32_regen"
+    refine_ratio: float = 2.0
 
 
 class IvfFlatIndex(flax.struct.PyTreeNode):
@@ -561,21 +568,63 @@ def _search_grouped(index: IvfFlatIndex, queries: jax.Array, k: int,
     return out_vals, out_ids
 
 
+def _route_refined(index: IvfFlatIndex, queries: jax.Array, k: int,
+                   params: SearchParams, filter_bitset, dataset
+                   ) -> Tuple[jax.Array, jax.Array]:
+    """``refine="f32_regen"``: oversampled scan + exact re-rank through
+    neighbors.refine's dispatch tier (fused Pallas gather-refine on TPU
+    oversampled shapes / XLA einsum / host gather) — same routing as
+    ivf_pq's refined path."""
+    import dataclasses
+
+    from raft_tpu.neighbors import refine as _refine
+
+    expects(params.refine == "f32_regen",
+            "unknown refine mode %r (supported: 'none', 'f32_regen')",
+            params.refine)
+    expects(dataset is not None,
+            "refine='f32_regen' needs search(..., dataset=...): the "
+            "exact rows to re-rank against")
+    dshape = getattr(dataset, "shape", None)
+    expects(dshape is not None and len(dshape) == 2
+            and dshape[1] == index.dim,
+            "refine dataset shape %s does not match the index dim %d",
+            tuple(dshape) if dshape else None, index.dim)
+    expects(params.refine_ratio >= 1.0,
+            "refine_ratio must be >= 1 (got %s)", params.refine_ratio)
+    k_cand = max(k, int(round(k * params.refine_ratio)))
+    scan_params = dataclasses.replace(params, refine="none")
+    _, i0 = search(index, queries, k_cand, scan_params, filter_bitset)
+    if hasattr(dataset, "_block") and hasattr(dataset, "chunk_rows"):
+        return _refine.refine_provider(dataset, queries, i0, k,
+                                       metric=index.metric)
+    if isinstance(dataset, jax.Array):
+        return _refine.refine(dataset, queries, i0, k, metric=index.metric)
+    return _refine.refine_gathered(dataset, queries, i0, k,
+                                   metric=index.metric)
+
+
 @traced("raft_tpu.ivf_flat.search")
 def search(index: IvfFlatIndex, queries: jax.Array, k: int,
            params: Optional[SearchParams] = None,
-           filter_bitset: Optional[jax.Array] = None) -> Tuple[jax.Array, jax.Array]:
+           filter_bitset: Optional[jax.Array] = None,
+           dataset=None) -> Tuple[jax.Array, jax.Array]:
     """Search the index (reference: ivf_flat::search, ivf_flat-inl.cuh:452;
     filtered overload ivf_flat-inl.cuh search_with_filtering).
 
     Returns (distances [m, k], ids [m, k]); ids are dataset row numbers,
     -1 marks slots beyond the number of valid candidates.
     ``filter_bitset``: optional packed bitset over dataset rows (see
-    neighbors.sample_filter) — cleared bits are excluded."""
+    neighbors.sample_filter) — cleared bits are excluded.
+    ``params.refine="f32_regen"`` + ``dataset`` re-ranks an oversampled
+    scan exactly (see SearchParams.refine)."""
     if params is None:
         params = SearchParams()
     expects(queries.ndim == 2 and queries.shape[1] == index.dim,
             "queries must be [m, %d]", index.dim)
+    if params.refine != "none":
+        return _route_refined(index, queries, k, params, filter_bitset,
+                              dataset)
     n_probes = min(params.n_probes, index.n_lists)
     B = queries.shape[0]
     mode = params.scan_mode

@@ -126,6 +126,16 @@ class SearchParams:
     # "pallas" (fused LUT-scan kernel over packed codes)
     scan_select: str = "exact"  # | "approx" | "pallas"
     scan_recall: float = 0.95
+    # the reference's refinement_rate pattern (refine-inl.cuh) folded
+    # into search(): "f32_regen" scans k·refine_ratio candidates, then
+    # re-ranks them against exact f32 rows through neighbors.refine's
+    # dispatch tier (the fused Pallas gather-refine kernel on TPU
+    # oversampled shapes, XLA einsum otherwise). Needs search()'s
+    # ``dataset`` argument: a device array (fused-eligible), a host
+    # array/memmap (host-gather tier), or a device-chunk provider with
+    # ``_block``/``chunk_rows`` (provider-regen tier).
+    refine: str = "none"  # | "f32_regen"
+    refine_ratio: float = 2.0
 
 
 _LUT_DTYPES = {"float32": jnp.float32, "bfloat16": jnp.bfloat16,
@@ -1473,6 +1483,56 @@ def _count_scan_dispatch(impl: str) -> None:
     _obs_spans.count_dispatch("ivf_pq.scan", impl)
 
 
+def _count_lut_fallback(reason: str) -> None:
+    """Record WHY a search eligible for (or explicitly requesting) the
+    fused Pallas LUT tier ran elsewhere — the obs
+    ``ivf_pq.scan.fallback{reason=...}`` counter. The dispatch counter
+    alone shows only the engine that won; triage of "why isn't the
+    oversampled config on the fast tier?" needs the losing reason:
+    ``filter_bitset`` (the bin pre-selection is filter-blind),
+    ``bin_capacity`` (n_probes·256 < k), ``per_cluster`` codebooks,
+    ``mem_guard`` (lut_scan_mem_ok declined), or ``kernel_ineligible``
+    (packed layout / VMEM / not on TPU)."""
+    _obs_spans.count_fallback("ivf_pq.scan", reason)
+
+
+def _route_refined(index: IvfPqIndex, queries: jax.Array, k: int,
+                   params: "SearchParams", filter_bitset, dataset
+                   ) -> Tuple[jax.Array, jax.Array]:
+    """The ``refine="f32_regen"`` path: oversampled scan (k·refine_ratio
+    candidates through whatever scan tier ``search`` picks), then an
+    exact re-rank routed by what ``dataset`` is — the device refine
+    dispatch tier (fused gather-refine kernel / XLA einsum), the
+    device-chunk provider regen, or the host gather (reference:
+    refine-inl.cuh's refinement_rate; deep-100m's headline rows)."""
+    from raft_tpu.neighbors import refine as _refine
+
+    expects(params.refine == "f32_regen",
+            "unknown refine mode %r (supported: 'none', 'f32_regen')",
+            params.refine)
+    expects(dataset is not None,
+            "refine='f32_regen' needs search(..., dataset=...): the "
+            "exact rows to re-rank against")
+    dshape = getattr(dataset, "shape", None)
+    expects(dshape is not None and len(dshape) == 2
+            and dshape[1] == index.dim,
+            "refine dataset shape %s does not match the index dim %d",
+            tuple(dshape) if dshape else None, index.dim)
+    expects(params.refine_ratio >= 1.0,
+            "refine_ratio must be >= 1 (got %s)", params.refine_ratio)
+    k_cand = max(k, int(round(k * params.refine_ratio)))
+    scan_params = dataclasses.replace(params, refine="none")
+    _, i0 = search(index, queries, k_cand, scan_params, filter_bitset)
+    if hasattr(dataset, "_block") and hasattr(dataset, "chunk_rows"):
+        return _refine.refine_provider(dataset, queries, i0, k,
+                                       metric=index.metric)
+    if isinstance(dataset, jax.Array):
+        return _refine.refine(dataset, queries, i0, k, metric=index.metric)
+    # host array / memmap: gather only candidate rows on the host
+    return _refine.refine_gathered(dataset, queries, i0, k,
+                                   metric=index.metric)
+
+
 _lut_fallback_warned = False
 
 
@@ -1495,16 +1555,25 @@ def _warn_lut_fallback() -> None:
 @traced("raft_tpu.ivf_pq.search")
 def search(index: IvfPqIndex, queries: jax.Array, k: int,
            params: Optional[SearchParams] = None,
-           filter_bitset: Optional[jax.Array] = None) -> Tuple[jax.Array, jax.Array]:
+           filter_bitset: Optional[jax.Array] = None,
+           dataset=None) -> Tuple[jax.Array, jax.Array]:
     """Search (reference: ivf_pq::search, ivf_pq-inl.cuh:478; filtered
     overload search_with_filtering). Distances are PQ-approximate (as the
-    reference's); use neighbors.refine for exact re-ranking.
+    reference's) unless ``params.refine="f32_regen"``, which scans
+    ``k·refine_ratio`` candidates and re-ranks them exactly against
+    ``dataset`` (device array → the fused gather-refine tier on TPU
+    oversampled shapes; host array/memmap → host gather; device-chunk
+    provider → on-device regen). Standalone re-ranking stays available
+    as neighbors.refine.
     ``filter_bitset``: optional packed bitset over dataset rows (see
     neighbors.sample_filter) — cleared bits are excluded."""
     if params is None:
         params = SearchParams()
     expects(queries.ndim == 2 and queries.shape[1] == index.dim,
             "queries must be [m, %d]", index.dim)
+    if params.refine != "none":
+        return _route_refined(index, queries, k, params, filter_bitset,
+                              dataset)
     if (_obs_spans.stages_enabled() and _obs_spans._trace_clean()
             and filter_bitset is None
             and index.codebook_kind == "per_subspace"):
@@ -1547,26 +1616,31 @@ def search(index: IvfPqIndex, queries: jax.Array, k: int,
         # pre-selection is filter-blind, so under a selective filter the
         # kept neighbors outside a probe's unfiltered top-256 would be
         # unreachable — the grouped XLA scan filters before selection.
+        lut_desired = (params.scan_select == "pallas"
+                       or (params.scan_select == "approx"
+                           and index.packed_recon is None
+                           and (n_probes >= 64 or k >= 400)))
         lut_serviceable = (n_probes * _pk.LUT_SCAN_BINS >= k
                            and filter_bitset is None)
-        want_lut = (lut_serviceable
-                    and (params.scan_select == "pallas"
-                         or (params.scan_select == "approx"
-                             and index.packed_recon is None
-                             and (n_probes >= 64 or k >= 400))))
+        want_lut = lut_desired and lut_serviceable
         select_impl = params.scan_select
-        if params.scan_select == "pallas" and not lut_serviceable:
-            _warn_lut_fallback()
-            select_impl = "approx"
+        if lut_desired and not lut_serviceable:
+            # the fallback counter records WHY the tier lost (satellite:
+            # the dispatch counter alone shows only the winner)
+            _count_lut_fallback("filter_bitset" if filter_bitset is not None
+                                else "bin_capacity")
+            if params.scan_select == "pallas":
+                _warn_lut_fallback()
+                select_impl = "approx"
         if want_lut:
-            if (index.codebook_kind == "per_subspace"
-                    and ic.lut_scan_mem_ok(n_seg, seg, index.rot_dim,
-                                           pairs, _pk.LUT_SCAN_BINS)
-                    and _pk.pallas_lut_scan_wanted(
-                        index.pq_dim, index.pq_book_size, index.pq_len,
-                        packed_nbytes(index.pq_dim, index.pq_bits),
-                        index.packed_codes.shape[-1], L, index.rot_dim,
-                        seg=seg, lut_dtype=params.lut_dtype)):
+            mem_ok = ic.lut_scan_mem_ok(n_seg, seg, index.rot_dim,
+                                        pairs, _pk.LUT_SCAN_BINS)
+            kernel_ok = mem_ok and _pk.pallas_lut_scan_wanted(
+                index.pq_dim, index.pq_book_size, index.pq_len,
+                packed_nbytes(index.pq_dim, index.pq_bits),
+                index.packed_codes.shape[-1], L, index.rot_dim,
+                seg=seg, lut_dtype=params.lut_dtype)
+            if index.codebook_kind == "per_subspace" and kernel_ok:
                 _count_scan_dispatch("pallas_lut")
                 with span("scan") as _sp:
                     out = _search_lut_pallas(
@@ -1575,6 +1649,9 @@ def search(index: IvfPqIndex, queries: jax.Array, k: int,
                         lut_dtype=params.lut_dtype)
                     _sp.attach(out)
                 return out
+            _count_lut_fallback(
+                "per_cluster" if index.codebook_kind != "per_subspace"
+                else "mem_guard" if not mem_ok else "kernel_ineligible")
             if params.scan_select == "pallas":
                 # an EXPLICIT pallas request that the kernel can't serve
                 # (per_cluster codebooks, unsupported layout, off-TPU, or

@@ -2,11 +2,26 @@
 
 TPU-native counterpart of ``raft::neighbors::refine`` (refine-inl.cuh;
 device kernel detail/refine_device.cuh, host/OpenMP variant
-detail/refine_host-inl.hpp). Gathers each query's candidate rows and
-recomputes exact distances (one batched MXU contraction), then selects the
-top-k. Used after IVF-PQ search to recover recall lost to quantization
-(the reference's refinement_rate pattern: search k·rate candidates,
-refine down to k).
+detail/refine_host-inl.hpp). Used after IVF-PQ search to recover recall
+lost to quantization (the reference's refinement_rate pattern: search
+k·rate candidates, refine down to k).
+
+Tier dispatch (``refine.dispatch{impl=...}`` obs counter; decision
+table in docs/api_reference.md):
+
+- ``pallas_gather`` — the fused gather-refine kernel
+  (ops.pallas_kernels.gather_refine_topk): candidate rows stream
+  HBM→VMEM per tile and the exact epilogue + top-k run on-chip, so the
+  ``[m, C, d]`` gather buffer never exists (7.7 GB at batch 10000 ×
+  k_cand 2000 × d 96 — the accumulator-OOM shape of the oversampled
+  DEEP-100M configs). Auto-on for TPU oversampled shapes; env override
+  ``RAFT_TPU_PALLAS_REFINE`` (tri-state).
+- ``xla_gather`` — gather each query's candidate rows and recompute
+  exact distances with one batched MXU contraction, then select.
+- ``host_gather`` / ``provider_regen`` — the host-resident-base tiers
+  (:func:`refine_gathered`, :func:`refine_provider`): the gather runs
+  on the host / regenerates device blocks BY DESIGN (memmap bases that
+  do not fit HBM), so the fused device tier does not apply.
 """
 
 from __future__ import annotations
@@ -19,10 +34,43 @@ import jax.numpy as jnp
 from jax import lax
 
 from raft_tpu.core.errors import expects
-from raft_tpu.core.tracing import traced
+from raft_tpu.core.tracing import traced, span
 from raft_tpu.distance.types import DistanceType, resolve_metric
 from raft_tpu.matrix import select_k as _select_k
+from raft_tpu.obs import spans as _obs_spans
 from raft_tpu.utils.precision import get_precision
+
+
+def _check_candidates(queries, candidates, k: int) -> None:
+    """Shared argument validation for every refine entry point — an
+    oversized k or an empty candidate axis otherwise surfaces deep in
+    the jitted program as an opaque take_along_axis/einsum error."""
+    expects(candidates.ndim == 2, "candidates must be [m, n_candidates]")
+    expects(candidates.shape[1] > 0,
+            "candidates must have a non-empty candidate axis "
+            "(got shape %s)", tuple(candidates.shape))
+    expects(queries.shape[0] == candidates.shape[0],
+            "queries/candidates row mismatch: %d queries vs %d candidate "
+            "rows", queries.shape[0], candidates.shape[0])
+    expects(k <= candidates.shape[1],
+            "k=%d > n_candidates=%d — refine can only re-rank the "
+            "candidates it is given (search more candidates or lower k)",
+            k, candidates.shape[1])
+
+
+def _check_base_dim(base, queries) -> None:
+    """Feature-dim agreement between the re-rank base and the queries —
+    a mismatch otherwise dies in the einsum (or the Pallas block spec)
+    with an opaque shape error. Row-count agreement stays the caller's
+    contract: candidate ids past the base clamp to its last row (the
+    historical XLA-gather semantics), and checking it here would cost a
+    device sync per call on indexed structures."""
+    shape = getattr(base, "shape", None)
+    expects(shape is not None and len(shape) == 2
+            and shape[1] == queries.shape[1],
+            "dataset/queries feature-dim mismatch: dataset shape %s vs "
+            "%d-dim queries", tuple(shape) if shape else None,
+            queries.shape[1])
 
 
 @partial(jax.jit, static_argnames=("k", "metric"))
@@ -63,6 +111,55 @@ def _refine_rows(cand_rows, queries, candidates, k: int, metric: str):
     return vals, ids
 
 
+@partial(jax.jit, static_argnames=("metric",))
+def _gather_keys_to_dists(keys, ids, metric: str):
+    """Kernel keys → reported distances: the gather-refine kernel emits
+    minimized sort keys (l2: squared distance, ip: −score, cos: cosine
+    distance); recover :func:`_refine_rows`' reporting convention."""
+    mt = resolve_metric(metric)
+    if mt == DistanceType.InnerProduct:
+        return -keys, ids  # +inf invalid keys flip to -inf, as the XLA path
+    if mt == DistanceType.L2SqrtExpanded:
+        return jnp.sqrt(keys), ids
+    return keys, ids
+
+
+def _fused_refine_wanted(dataset, queries, candidates, k: int) -> bool:
+    """True when the fused gather-refine tier serves this call: a
+    device-resident 2-D dataset whose dtype the row DMAs stream (f32 or
+    the bf16 recon cache) and a shape :func:`pallas_gather_refine_wanted`
+    accepts."""
+    from raft_tpu.neighbors import ivf_common as ic
+    from raft_tpu.ops import pallas_kernels as _pk
+
+    if not isinstance(dataset, jax.Array) or dataset.ndim != 2:
+        return False
+    if dataset.dtype not in (jnp.float32, jnp.bfloat16):
+        return False
+    if not ic.gather_refine_mem_ok(dataset.shape[0], dataset.shape[1],
+                                   dataset.dtype.itemsize,
+                                   m=candidates.shape[0],
+                                   C=candidates.shape[1]):
+        return False
+    return _pk.pallas_gather_refine_wanted(
+        candidates.shape[0], candidates.shape[1], dataset.shape[1], k,
+        itemsize=dataset.dtype.itemsize)
+
+
+def _refine_fused(dataset, queries, candidates, k: int, mt: DistanceType):
+    from raft_tpu.ops import pallas_kernels as _pk
+
+    met = ("ip" if mt == DistanceType.InnerProduct
+           else "cos" if mt == DistanceType.CosineExpanded else "l2")
+    with span("fused_scan") as _sp:
+        keys, ids = _pk.gather_refine_topk(
+            dataset, queries, jnp.asarray(candidates), k, met,
+            interpret=not _pk._on_tpu())
+        out = _gather_keys_to_dists(keys, ids, mt.value)
+        _sp.attach(out)
+    return out
+
+
 @traced("raft_tpu.refine")
 def refine(
     dataset: jax.Array,
@@ -74,14 +171,20 @@ def refine(
     """Re-rank ``candidates`` [m, n_cand] (row ids into ``dataset``, -1 =
     invalid) down to the exact top-k (reference: refine-inl.cuh).
 
-    Returns (distances [m, k], ids [m, k]).
+    Dispatches between the fused Pallas gather-refine kernel (streamed
+    candidate rows, no ``[m, C, d]`` buffer — auto on TPU for
+    oversampled shapes, override ``RAFT_TPU_PALLAS_REFINE``) and the
+    XLA gather+einsum path; both share exact semantics (module
+    docstring has the tier table). Returns (distances [m, k],
+    ids [m, k]).
     """
-    expects(candidates.ndim == 2, "candidates must be [m, n_candidates]")
-    expects(queries.shape[0] == candidates.shape[0],
-            "queries/candidates row mismatch")
-    expects(k <= candidates.shape[1], "k=%d > n_candidates=%d",
-            k, candidates.shape[1])
+    _check_candidates(queries, candidates, k)
+    _check_base_dim(dataset, queries)
     mt = resolve_metric(metric)
+    if _fused_refine_wanted(dataset, queries, candidates, k):
+        _obs_spans.count_dispatch("refine", "pallas_gather")
+        return _refine_fused(dataset, queries, candidates, k, mt)
+    _obs_spans.count_dispatch("refine", "xla_gather")
     return _refine_impl(dataset, queries, candidates, k, mt.value)
 
 
@@ -116,11 +219,9 @@ def refine_provider(  # graftlint: disable-fn=GL01
     """
     import numpy as np
 
-    expects(candidates.ndim == 2, "candidates must be [m, n_candidates]")
-    expects(queries.shape[0] == candidates.shape[0],
-            "queries/candidates row mismatch")
-    expects(k <= candidates.shape[1], "k=%d > n_candidates=%d",
-            k, candidates.shape[1])
+    _check_candidates(queries, candidates, k)
+    _check_base_dim(provider, queries)
+    _obs_spans.count_dispatch("refine", "provider_regen")
     mt = resolve_metric(metric)
     cand = np.asarray(candidates)
     m, C = cand.shape
@@ -173,11 +274,9 @@ def refine_gathered(  # graftlint: disable-fn=GL01
     SQ8 precision easily."""
     import numpy as np
 
-    expects(candidates.ndim == 2, "candidates must be [m, n_candidates]")
-    expects(queries.shape[0] == candidates.shape[0],
-            "queries/candidates row mismatch")
-    expects(k <= candidates.shape[1], "k=%d > n_candidates=%d",
-            k, candidates.shape[1])
+    _check_candidates(queries, candidates, k)
+    _check_base_dim(host_base, queries)
+    _obs_spans.count_dispatch("refine", "host_gather")
     mt = resolve_metric(metric)
     cand = np.asarray(candidates)
     safe = np.clip(cand, 0, host_base.shape[0] - 1)

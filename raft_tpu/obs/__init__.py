@@ -28,6 +28,7 @@ from raft_tpu.obs.metrics import (  # noqa: F401
 )
 from raft_tpu.obs.spans import (  # noqa: F401
     count_dispatch,
+    count_fallback,
     current_name,
     disable,
     enable,

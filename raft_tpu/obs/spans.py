@@ -124,6 +124,17 @@ def count_dispatch(name: str, impl: str) -> None:
         registry().inc(name + ".dispatch", labels={"impl": impl})
 
 
+def count_fallback(name: str, reason: str) -> None:
+    """Count one *declined* preferred tier under
+    ``<name>.fallback{reason=...}`` — the companion of
+    :func:`count_dispatch`: the dispatch counter says which engine ran,
+    this one says WHY the preferred tier did not (filter-blind kernel,
+    memory guard, unsupported layout, ...). Free when recording is off;
+    counted per dispatch decision, like count_dispatch."""
+    if _enabled:
+        registry().inc(name + ".fallback", labels={"reason": reason})
+
+
 def env_flag(name: str) -> bool:
     """Parse a boolean env var: unset, '', '0', 'false', 'off', 'no' are
     False; anything else is True (plain string truthiness would read

@@ -2,8 +2,10 @@
 
 from .pallas_kernels import (  # noqa: F401
     fused_l2_argmin,
+    gather_refine_topk,
     grouped_scan_topk,
     ivfpq_lut_scan_topk,
+    pallas_gather_refine_wanted,
     pallas_lut_scan_wanted,
     select_k_pallas,
 )

@@ -840,6 +840,249 @@ def pallas_lut_scan_wanted(S: int, K: int, P: int, nb: int, Wb: int,
     return True if force == "on" else _on_tpu()
 
 
+# ---------------------------------------------------------------------------
+# fused gather-refine: per-query candidate rows streamed HBM→VMEM by id,
+# exact distance epilogue + running top-k on-chip — the [m, C, d] gather
+# buffer never exists
+# ---------------------------------------------------------------------------
+
+# Queries per program (one f32 sublane tile) and candidates gathered per
+# sequential step (one lane tile).
+GATHER_REFINE_BQ = 8
+GATHER_REFINE_BC = 128
+# Candidate-row DMAs kept in flight per program (row gathers are the
+# bottleneck — ~512 B each — so the queue depth is what hides their
+# issue latency behind the copy engine).
+_GATHER_NBUF = 8
+# In-kernel running-buffer width (one lane tile); the k-round merge
+# extraction bounds serviceable k the same way _select_k_kernel does.
+GATHER_REFINE_MAX_K = 64
+
+
+def _gather_refine_kernel(q_ref, cand_ref, cand_hbm, data_hbm,
+                          vals_ref, ids_ref, ids_smem, rows_vmem,
+                          sem_ids, sems, *, k: int, metric: str,
+                          n_rows: int):
+    """One (query-tile, candidate-tile) program of the fused refine.
+
+    Grid = (m_tiles, c_tiles); the candidate axis is the sequential
+    minor axis, so the ``[bq, kpad]`` output block is the running top-k
+    buffer (same revisit pattern as ``_select_k_kernel``). Per step:
+
+    1. the tile's candidate ids are DMA'd HBM→SMEM (DMA row addresses
+       must be scalar-readable — a VMEM operand cannot index an HBM
+       ref);
+    2. each candidate's dataset row streams HBM→VMEM through its own
+       row DMA, ``_GATHER_NBUF`` in flight — the counterpart of
+       ``refine_device.cuh``'s per-candidate global loads, and the step
+       that replaces the XLA path's materialized ``[m, C, d]`` gather;
+    3. exact distance epilogue on the VPU (all metrics minimized: ip
+       keys are negated scores, cosine keys are 1 − cos; invalid ids
+       masked to +inf) and a k-round merge of (running buffer ++ tile)
+       by iterative extraction, ids resolved gather-free via the
+       argmin one-hot.
+    """
+    i = pl.program_id(0)
+    jc = pl.program_id(1)
+    bq, bc = cand_ref.shape
+    total = bq * bc
+
+    @pl.when(jc == 0)
+    def _init():
+        vals_ref[:] = jnp.full_like(vals_ref, jnp.inf)
+        ids_ref[:] = jnp.full_like(ids_ref, -1)
+
+    # 1. candidate ids HBM→SMEM
+    cp = pltpu.make_async_copy(
+        cand_hbm.at[pl.ds(i * bq, bq), pl.ds(jc * bc, bc)],
+        ids_smem, sem_ids)
+    cp.start()
+    cp.wait()
+
+    # 2. candidate rows HBM→VMEM, NBUF in flight. The wait recomputes
+    # the identical copy descriptor (the documented double-buffer
+    # idiom); a slot is always waited before its next start so two
+    # copies never share a live semaphore.
+    def row_copy(t):
+        qq = t // bc
+        rr = jax.lax.rem(t, bc)
+        row = jnp.clip(ids_smem[qq, rr], 0, n_rows - 1)
+        return pltpu.make_async_copy(
+            data_hbm.at[pl.ds(row, 1), :],
+            rows_vmem.at[pl.ds(t, 1), :],
+            sems.at[jax.lax.rem(t, _GATHER_NBUF)])
+
+    for t in range(_GATHER_NBUF):  # static warm-up fills the queue
+        row_copy(t).start()
+
+    def stream(t, carry):
+        row_copy(t).wait()
+
+        @pl.when(t + _GATHER_NBUF < total)
+        def _():
+            row_copy(t + _GATHER_NBUF).start()
+
+        return carry
+
+    jax.lax.fori_loop(0, total, stream, 0)
+
+    # 3. exact epilogue + running top-k merge
+    r3 = rows_vmem[:].astype(jnp.float32).reshape(bq, bc, -1)
+    q = q_ref[:]                                       # [bq, dpad] f32
+    s = jnp.sum(q[:, None, :] * r3, axis=-1)           # [bq, bc]
+    if metric == "ip":
+        key = -s
+    else:
+        rsq = jnp.sum(r3 * r3, axis=-1)                # [bq, bc]
+        qsq = jnp.sum(q * q, axis=1)                   # [bq]
+        if metric == "cos":
+            # mirror _refine_rows' formula exactly (parity over speed:
+            # rsqrt would drift ~1e-3 relative on near-duplicate rows)
+            qn = jnp.sqrt(jnp.maximum(qsq, 1e-30))
+            cn = jnp.sqrt(jnp.maximum(rsq, 1e-30))
+            key = 1.0 - s / (qn[:, None] * cn)
+        else:  # l2 (sqrt applied by the caller: selection order is equal)
+            key = jnp.maximum(qsq[:, None] + rsq - 2.0 * s, 0.0)
+    cand = cand_ref[:]                                 # [bq, bc] i32
+    valid = cand >= 0
+    key = jnp.where(valid, key, jnp.inf)
+    gid = jnp.where(valid, cand, -1)
+
+    kpad = vals_ref.shape[1]
+    comb_v = jnp.concatenate([vals_ref[:], key], axis=1)
+    comb_i = jnp.concatenate([ids_ref[:], gid], axis=1)
+    out_v = jnp.full((bq, kpad), jnp.inf, jnp.float32)
+    out_i = jnp.full((bq, kpad), -1, jnp.int32)
+    out_cols = jax.lax.broadcasted_iota(jnp.int32, (bq, kpad), 1)
+    imax = jnp.iinfo(jnp.int32).max
+    for j in range(k):  # static unroll (see _select_k_kernel)
+        mn = jnp.min(comb_v, axis=1)
+        am = jnp.argmin(comb_v, axis=1)
+        onehot = jax.lax.broadcasted_iota(
+            jnp.int32, comb_v.shape, 1) == am[:, None]
+        picked = jnp.min(jnp.where(onehot, comb_i, imax), axis=1)
+        picked = jnp.where(jnp.isinf(mn), -1, picked)
+        out_v = jnp.where(out_cols == j, mn[:, None], out_v)
+        out_i = jnp.where(out_cols == j, picked[:, None], out_i)
+        comb_v = jnp.where(onehot, jnp.inf, comb_v)
+    vals_ref[:] = out_v
+    ids_ref[:] = out_i
+
+
+@functools.partial(jax.jit, static_argnames=("k", "metric", "interpret"))
+def gather_refine_topk(dataset: jax.Array, queries: jax.Array,
+                       candidates: jax.Array, k: int, metric: str = "l2",
+                       interpret: bool = False
+                       ) -> Tuple[jax.Array, jax.Array]:
+    """Fused exact re-rank of per-query candidate ids — the streaming
+    refine half of the oversampled IVF-PQ pipeline (reference: the
+    device refine kernel, detail/refine_device.cuh).
+
+    The XLA refine path (`refine.py:_refine_impl`) gathers candidates
+    into a materialized ``[m, C, d]`` f32 HBM buffer before one batched
+    einsum — at batch 10000 × k_cand 2000 × d 96 that is ~7.7 GB, the
+    same accumulator-OOM shape the Pallas LUT scan eliminated on the
+    scan side. This kernel instead streams each query tile's candidate
+    ids HBM→SMEM and the corresponding ``dataset`` rows HBM→VMEM
+    row-by-row (``_GATHER_NBUF`` copies in flight), computes the exact
+    distance epilogue in VMEM and keeps a running top-k per query —
+    nothing but the ``[m, kpad]`` result tables ever reaches HBM.
+
+    ``dataset [n, d]`` — f32 rows or the bf16 reconstruction cache
+    (dtype is preserved through the row DMAs; distances compute in
+    f32); ``queries [m, d]``; ``candidates [m, C]`` i32 row ids, -1
+    invalid (out-of-range ids are clamped for the DMA and masked only
+    if negative, matching the XLA path's clip semantics). A dataset
+    whose minor dim is not lane-aligned pays a PER-CALL padded
+    ``[n, ceil(d/128)·128]`` HBM copy here (the row DMAs address
+    lane-tiled rows) — dispatchers weigh it against the gather buffer
+    via ``ivf_common.gather_refine_mem_ok``.
+
+    Returns (keys [m, k], ids [m, k]): minimized sort keys, sorted
+    ascending (l2: squared distance — callers apply sqrt; ip: negated
+    score; cos: cosine distance) and global candidate ids (-1 when a
+    slot saw fewer than k valid candidates).
+    """
+    m, d = queries.shape
+    n = dataset.shape[0]
+    assert metric in ("l2", "ip", "cos")
+    if k > GATHER_REFINE_MAX_K:
+        raise ValueError(
+            f"k={k} > {GATHER_REFINE_MAX_K} (the in-kernel merge is k "
+            "extraction rounds per tile — gate with "
+            "pallas_gather_refine_wanted)")
+    bq, bc = GATHER_REFINE_BQ, GATHER_REFINE_BC
+    kpad = _LANES
+    qf = _pad_to(queries.astype(jnp.float32), bq, 0, 0.0)
+    qf = _pad_to(qf, _LANES, 1, 0.0)
+    data = _pad_to(dataset, _LANES, 1, 0.0)  # dtype preserved (f32/bf16)
+    cand = _pad_to(candidates.astype(jnp.int32), bq, 0, -1)
+    cand = _pad_to(cand, bc, 1, -1)
+    mp, Cp = cand.shape
+    dpad = data.shape[1]
+
+    grid = (mp // bq, Cp // bc)
+    vals, ids = pl.pallas_call(
+        functools.partial(_gather_refine_kernel, k=k, metric=metric,
+                          n_rows=n),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((bq, dpad), lambda i, j: (i, 0)),
+            # candidates ride twice: a VMEM block for the validity mask,
+            # and the full array in HBM for the in-kernel id DMA (DMA
+            # row addresses must come from scalar memory)
+            pl.BlockSpec((bq, bc), lambda i, j: (i, j)),
+            pl.BlockSpec(memory_space=pltpu.ANY),
+            pl.BlockSpec(memory_space=pltpu.ANY),
+        ],
+        out_specs=[
+            pl.BlockSpec((bq, kpad), lambda i, j: (i, 0)),
+            pl.BlockSpec((bq, kpad), lambda i, j: (i, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((mp, kpad), jnp.float32),
+            jax.ShapeDtypeStruct((mp, kpad), jnp.int32),
+        ],
+        scratch_shapes=[
+            pltpu.SMEM((bq, bc), jnp.int32),
+            pltpu.VMEM((bq * bc, dpad), data.dtype),
+            pltpu.SemaphoreType.DMA,
+            pltpu.SemaphoreType.DMA((_GATHER_NBUF,)),
+        ],
+        interpret=interpret,
+    )(qf, cand, cand, data)
+    return vals[:m, :k], ids[:m, :k]
+
+
+def pallas_gather_refine_wanted(m: int, C: int, d: int, k: int,
+                                itemsize: int = 4) -> bool:
+    """Dispatch for :func:`gather_refine_topk` — the fused refine tier.
+
+    Needs k within the merge budget and a VMEM-sized gathered-row
+    block; auto mode engages on TPU for the oversampled shapes whose
+    ``[m, C, d]`` gather buffer is HBM-hostile (k_cand ≥ 400, the
+    DEEP-100M refinement_rate regime, or a gather buffer past 1 GB) —
+    the XLA einsum path keeps small candidate sets. Env override
+    ``RAFT_TPU_PALLAS_REFINE`` = always | never | auto (tri-state, see
+    :func:`raft_tpu.obs.env_tristate`) — "on"/"always" runs interpreted
+    off-TPU (tests)."""
+    force = _env_tristate("RAFT_TPU_PALLAS_REFINE")
+    if force == "off" or k > GATHER_REFINE_MAX_K or C < 2 * _LANES:
+        return False
+    dpad = -(-d // _LANES) * _LANES
+    bq, bc = GATHER_REFINE_BQ, GATHER_REFINE_BC
+    vmem = (bq * bc * dpad * itemsize     # gathered rows scratch
+            + 2 * bq * dpad * 4           # query block (+double buffer)
+            + 2 * bq * bc * 4             # candidate id block
+            + bq * bc * dpad * 4          # f32 row/broadcast transients
+            + 4 * bq * _LANES * 8)        # running buffers + extraction
+    if vmem > _GROUPED_VMEM_BUDGET:
+        return False
+    if force == "on":
+        return True
+    return _on_tpu() and (C >= 400 or m * C * d * itemsize >= (1 << 30))
+
+
 @functools.partial(jax.jit,
                    static_argnames=("k", "select_min", "bm", "bl", "interpret"))
 def select_k_pallas(scores: jax.Array, k: int, select_min: bool = True,

@@ -675,3 +675,159 @@ def test_slice_scan_matches_gather_scan(rng, monkeypatch):
     d2, i2 = pq.search(idx, jnp.asarray(q), 10, sp)
     np.testing.assert_array_equal(np.asarray(i1), np.asarray(i2))
     np.testing.assert_allclose(np.asarray(d1), np.asarray(d2), rtol=1e-5)
+
+
+class TestRefinedSearch:
+    """search(refine="f32_regen") — the streamed scan→refine pipeline
+    (ISSUE 4): the end-to-end fused path (Pallas LUT scan + Pallas
+    gather-refine, interpret mode off-TPU) must match the recall of the
+    unfused XLA path, and the routing must honor dataset residency."""
+
+    def _corpus(self):
+        x, _ = make_blobs(4000, 32, n_clusters=30, cluster_std=1.0,
+                          state=RngState(31))
+        q, _ = make_blobs(80, 32, n_clusters=30, cluster_std=1.0,
+                          state=RngState(32))
+        return np.asarray(x), np.asarray(q)
+
+    def test_matches_manual_oversample_plus_refine(self):
+        x, q = self._corpus()
+        idx = ivf_pq.build(jnp.asarray(x), IndexParams(n_lists=16,
+                                                       pq_dim=16, seed=0))
+        sp = SearchParams(n_probes=8, refine="f32_regen", refine_ratio=4)
+        dv, iv = ivf_pq.search(idx, jnp.asarray(q), 10, sp,
+                               dataset=jnp.asarray(x))
+        _, i0 = ivf_pq.search(idx, jnp.asarray(q), 40,
+                              SearchParams(n_probes=8))
+        dm, im = refine.refine(jnp.asarray(x), jnp.asarray(q), i0, 10)
+        np.testing.assert_allclose(np.asarray(dv), np.asarray(dm),
+                                   rtol=1e-5, atol=1e-5)
+        np.testing.assert_array_equal(np.asarray(iv), np.asarray(im))
+
+    def test_fused_pipeline_recall_parity(self, monkeypatch):
+        """Oversampled end-to-end: fused scan (LUT kernel) + fused
+        refine (gather kernel) vs the unfused XLA pipeline — recall
+        against exact neighbors must match within the approx-bin
+        tolerance (the refine half is exact; only the scan's 2-deep
+        bin pre-selection is lossy)."""
+        x, q = self._corpus()
+        idx = ivf_pq.build(jnp.asarray(x),
+                           IndexParams(n_lists=16, pq_dim=16, seed=0,
+                                       cache_reconstruction="never"))
+        k, k_cand = 10, 400  # the oversampled regime (k_cand >= 400)
+        sp = SearchParams(n_probes=8, scan_mode="grouped",
+                          refine="f32_regen", refine_ratio=k_cand / k)
+        monkeypatch.setenv("RAFT_TPU_PALLAS_LUTSCAN", "always")
+        monkeypatch.setenv("RAFT_TPU_PALLAS_REFINE", "always")
+        _, i_f = ivf_pq.search(idx, jnp.asarray(q), k, sp,
+                               dataset=jnp.asarray(x))
+        monkeypatch.setenv("RAFT_TPU_PALLAS_LUTSCAN", "never")
+        monkeypatch.setenv("RAFT_TPU_PALLAS_REFINE", "never")
+        _, i_x = ivf_pq.search(idx, jnp.asarray(q), k, sp,
+                               dataset=jnp.asarray(x))
+        ref = np.argsort(cdist(q, x, "sqeuclidean"), 1)[:, :k]
+        r_f = recall_at_k(np.asarray(i_f), ref)
+        r_x = recall_at_k(np.asarray(i_x), ref)
+        assert r_f >= r_x - 0.02, (r_f, r_x)
+        assert r_f >= 0.9, r_f
+
+    def test_refine_validation(self):
+        from raft_tpu.core.errors import LogicError
+
+        x, q = self._corpus()
+        idx = ivf_pq.build(jnp.asarray(x), IndexParams(n_lists=16,
+                                                       pq_dim=16, seed=0))
+        with pytest.raises(LogicError, match="dataset"):
+            ivf_pq.search(idx, jnp.asarray(q), 10,
+                          SearchParams(refine="f32_regen"))
+        with pytest.raises(LogicError, match="refine mode"):
+            ivf_pq.search(idx, jnp.asarray(q), 10,
+                          SearchParams(refine="sq8"),
+                          dataset=jnp.asarray(x))
+
+    def test_host_dataset_routes_to_host_gather(self):
+        from raft_tpu import obs
+
+        x, q = self._corpus()
+        idx = ivf_pq.build(jnp.asarray(x), IndexParams(n_lists=16,
+                                                       pq_dim=16, seed=0))
+        reg = obs.MetricsRegistry()
+        obs.enable(registry=reg, hbm=False)
+        try:
+            ivf_pq.search(idx, jnp.asarray(q), 10,
+                          SearchParams(n_probes=8, refine="f32_regen"),
+                          dataset=x)  # numpy → host gather tier
+        finally:
+            obs.disable()
+        assert reg.snapshot()["counters"].get(
+            "refine.dispatch{impl=host_gather}", 0) >= 1
+
+
+class TestScanFallbackCounter:
+    """ivf_pq.scan.fallback{reason=...} (ISSUE 4 satellite): declined
+    LUT-tier dispatches must be visible with their losing reason, not
+    just the winning impl."""
+
+    def _setup(self, **kw):
+        x, _ = make_blobs(3000, 32, n_clusters=20, cluster_std=1.0,
+                          state=RngState(41))
+        kw.setdefault("n_lists", 16)
+        kw.setdefault("pq_dim", 16)
+        kw.setdefault("seed", 0)
+        kw.setdefault("cache_reconstruction", "never")
+        idx = ivf_pq.build(jnp.asarray(np.asarray(x)), IndexParams(**kw))
+        return np.asarray(x), idx
+
+    def _count(self, fn):
+        from raft_tpu import obs
+
+        reg = obs.MetricsRegistry()
+        obs.enable(registry=reg, hbm=False)
+        try:
+            fn()
+        finally:
+            obs.disable()
+        return reg.snapshot()["counters"]
+
+    def test_filter_bitset_reason(self, monkeypatch):
+        from raft_tpu.core import bitset
+
+        monkeypatch.setenv("RAFT_TPU_PALLAS_LUTSCAN", "always")
+        x, idx = self._setup()
+        bits = bitset.create(x.shape[0], default_value=True)
+        c = self._count(lambda: ivf_pq.search(
+            idx, jnp.asarray(x[:64]), 10,
+            SearchParams(n_probes=8, scan_mode="grouped",
+                         scan_select="pallas"),
+            filter_bitset=bits))
+        assert c.get("ivf_pq.scan.fallback{reason=filter_bitset}", 0) >= 1, c
+
+    def test_bin_capacity_reason(self, monkeypatch):
+        monkeypatch.setenv("RAFT_TPU_PALLAS_LUTSCAN", "always")
+        x, idx = self._setup()
+        # k > n_probes·256: the bin output cannot carry enough candidates
+        c = self._count(lambda: ivf_pq.search(
+            idx, jnp.asarray(x[:64]), 600,
+            SearchParams(n_probes=2, scan_mode="grouped",
+                         scan_select="pallas")))
+        assert c.get("ivf_pq.scan.fallback{reason=bin_capacity}", 0) >= 1, c
+
+    def test_kernel_ineligible_reason(self, monkeypatch):
+        monkeypatch.delenv("RAFT_TPU_PALLAS_LUTSCAN", raising=False)
+        x, idx = self._setup()
+        # explicit pallas request off-TPU without the env force
+        c = self._count(lambda: ivf_pq.search(
+            idx, jnp.asarray(x[:64]), 10,
+            SearchParams(n_probes=8, scan_mode="grouped",
+                         scan_select="pallas")))
+        assert c.get("ivf_pq.scan.fallback{reason=kernel_ineligible}",
+                     0) >= 1, c
+
+    def test_per_cluster_reason(self, monkeypatch):
+        monkeypatch.setenv("RAFT_TPU_PALLAS_LUTSCAN", "always")
+        x, idx = self._setup(codebook_kind="per_cluster")
+        c = self._count(lambda: ivf_pq.search(
+            idx, jnp.asarray(x[:64]), 10,
+            SearchParams(n_probes=8, scan_mode="grouped",
+                         scan_select="pallas")))
+        assert c.get("ivf_pq.scan.fallback{reason=per_cluster}", 0) >= 1, c

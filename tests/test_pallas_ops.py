@@ -325,3 +325,108 @@ class TestIvfPqLutScan:
         assert not pallas_lut_scan_wanted(8, 256, 2, 8, 128, 1024, 16)
         monkeypatch.setenv("RAFT_TPU_PALLAS_LUTSCAN", "never")
         assert not pallas_lut_scan_wanted(64, 256, 2, 64, 64, 1024, 128)
+
+
+class TestGatherRefine:
+    """gather_refine_topk (interpret mode off-TPU) vs numpy reference:
+    streamed candidate-row gather + exact metric epilogue + running
+    top-k, with no [m, C, d] buffer (ISSUE 4 acceptance)."""
+
+    def _ref(self, data, q, cand, metric):
+        rows = data[np.clip(cand, 0, data.shape[0] - 1)].astype(np.float32)
+        s = np.einsum("md,mcd->mc", q, rows)
+        if metric == "ip":
+            key = -s
+        elif metric == "cos":
+            qn = np.sqrt(np.maximum((q * q).sum(1), 1e-30))
+            cn = np.sqrt(np.maximum((rows ** 2).sum(-1), 1e-30))
+            key = 1.0 - s / (qn[:, None] * cn)
+        else:
+            key = np.maximum((q * q).sum(1)[:, None]
+                             + (rows ** 2).sum(-1) - 2.0 * s, 0.0)
+        return np.where(cand >= 0, key, np.inf)
+
+    def _check(self, data, q, cand, k, metric, **kw):
+        from raft_tpu.ops import gather_refine_topk
+
+        keys, ids = gather_refine_topk(jnp.asarray(data), jnp.asarray(q),
+                                       jnp.asarray(cand), k, metric,
+                                       interpret=True)
+        keys, ids = np.asarray(keys), np.asarray(ids)
+        ref = self._ref(np.asarray(data, np.float32), q, cand, metric)
+        order = np.argsort(ref, axis=1, kind="stable")[:, :k]
+        want_v = np.take_along_axis(ref, order, 1)
+        np.testing.assert_allclose(keys, want_v, **kw)
+        want_i = np.where(np.isinf(want_v), -1,
+                          np.take_along_axis(cand, order, 1))
+        # ids must agree wherever keys are strictly ordered (ties may
+        # legally reorder between the buffer merge and a full argsort)
+        strict = np.ones_like(keys, dtype=bool)
+        strict[:, 1:] &= want_v[:, 1:] != want_v[:, :-1]
+        strict[:, :-1] &= want_v[:, :-1] != want_v[:, 1:]
+        np.testing.assert_array_equal(ids[strict], want_i[strict])
+
+    def test_metrics_match_numpy(self, rng):
+        data = rng.standard_normal((700, 96)).astype(np.float32)
+        q = rng.standard_normal((21, 96)).astype(np.float32)
+        cand = rng.integers(0, 700, (21, 300)).astype(np.int32)
+        for metric in ("l2", "ip", "cos"):
+            self._check(data, q, cand, 10, metric, rtol=1e-4, atol=1e-4)
+
+    def test_invalid_and_ragged(self, rng):
+        data = rng.standard_normal((500, 40)).astype(np.float32)
+        q = rng.standard_normal((9, 40)).astype(np.float32)
+        cand = rng.integers(0, 500, (9, 270)).astype(np.int32)
+        cand[0, :] = -1            # fully invalid row
+        cand[1, -31:] = -1         # ragged tail
+        cand[2, 10:30] = cand[2, 9]  # duplicates
+        self._check(data, q, cand, 8, "l2", rtol=1e-4, atol=1e-4)
+
+    def test_bf16_recon_rows(self, rng):
+        """bf16 dataset rows (the recon-cache input) stream through the
+        row DMAs dtype-preserved; keys computed in f32 against the
+        bf16-quantized values."""
+        data = rng.standard_normal((400, 64)).astype(np.float32)
+        data_bf = jnp.asarray(data).astype(jnp.bfloat16)
+        q = rng.standard_normal((10, 64)).astype(np.float32)
+        cand = rng.integers(0, 400, (10, 256)).astype(np.int32)
+        self._check(np.asarray(data_bf.astype(jnp.float32)), q, cand, 8,
+                    "l2", rtol=1e-4, atol=1e-4)
+
+    def test_short_rows_pad_with_invalid(self, rng):
+        from raft_tpu.ops import gather_refine_topk
+
+        data = rng.standard_normal((100, 16)).astype(np.float32)
+        q = rng.standard_normal((3, 16)).astype(np.float32)
+        cand = np.full((3, 200), -1, np.int32)
+        cand[:, :4] = rng.integers(0, 100, (3, 4))
+        keys, ids = gather_refine_topk(jnp.asarray(data), jnp.asarray(q),
+                                       jnp.asarray(cand), 10, "l2",
+                                       interpret=True)
+        keys, ids = np.asarray(keys), np.asarray(ids)
+        assert np.isfinite(keys[:, :4]).all()
+        assert np.isinf(keys[:, 4:]).all() and (ids[:, 4:] == -1).all()
+
+    def test_k_over_merge_budget_raises(self, rng):
+        from raft_tpu.ops import gather_refine_topk
+        from raft_tpu.ops.pallas_kernels import GATHER_REFINE_MAX_K
+
+        with pytest.raises(ValueError):
+            gather_refine_topk(jnp.zeros((10, 16)), jnp.zeros((2, 16)),
+                               jnp.zeros((2, 300), jnp.int32),
+                               GATHER_REFINE_MAX_K + 1, "l2",
+                               interpret=True)
+
+    def test_dispatch_heuristic(self, monkeypatch):
+        from raft_tpu.ops.pallas_kernels import pallas_gather_refine_wanted
+
+        monkeypatch.delenv("RAFT_TPU_PALLAS_REFINE", raising=False)
+        # off-TPU, no force → not wanted
+        assert not pallas_gather_refine_wanted(10_000, 2000, 96, 10)
+        monkeypatch.setenv("RAFT_TPU_PALLAS_REFINE", "always")
+        assert pallas_gather_refine_wanted(10_000, 2000, 96, 10)
+        # k past the merge budget / tiny candidate sets stay on XLA
+        assert not pallas_gather_refine_wanted(10_000, 2000, 96, 65)
+        assert not pallas_gather_refine_wanted(10_000, 100, 96, 10)
+        monkeypatch.setenv("RAFT_TPU_PALLAS_REFINE", "never")
+        assert not pallas_gather_refine_wanted(10_000, 2000, 96, 10)

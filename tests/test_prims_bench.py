@@ -37,3 +37,18 @@ def test_pq_scan_bench_rows(monkeypatch):
     impls = {r.impl for r in rows}
     assert impls == {"one_hot", "pallas_lut"}, impls
     assert all(r.ms > 0 and np.isfinite(r.throughput) for r in rows)
+
+
+def test_refine_bench_rows(monkeypatch):
+    """The refine microbench must emit an einsum row and, with the
+    interpret-mode force on, a pallas_gather row forced through the env
+    override (ISSUE 4 acceptance: the bench/prims refine row)."""
+    monkeypatch.setenv("RAFT_TPU_PALLAS_REFINE", "always")
+    rows = prims.bench_refine(grid=[(1500, 32, 32, 256, 8)], iters=1)
+    impls = {r.impl for r in rows}
+    assert impls == {"einsum_gather", "pallas_gather"}, impls
+    assert all(r.ms > 0 and np.isfinite(r.throughput) for r in rows)
+    assert all(r.params["gather_buffer_gib"] >= 0 for r in rows)
+    # the override must be restored, not leaked
+    import os
+    assert os.environ.get("RAFT_TPU_PALLAS_REFINE") == "always"

@@ -1,0 +1,193 @@
+"""Refine dispatch-tier tests: fused Pallas gather-refine vs the XLA
+einsum-gather path (interpret mode off-TPU), argument validation, and
+the obs dispatch contract (ISSUE 4 acceptance: parity across all four
+metrics × invalid-candidate patterns, atol-tiered by dtype)."""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from raft_tpu import obs
+from raft_tpu.neighbors import refine
+
+METRICS = ["sqeuclidean", "euclidean", "inner_product", "cosine"]
+
+
+@pytest.fixture()
+def corpus():
+    rng = np.random.default_rng(7)
+    n, d, m, C = 900, 48, 19, 300
+    x = rng.standard_normal((n, d)).astype(np.float32)
+    q = rng.standard_normal((m, d)).astype(np.float32)
+    cand = rng.integers(0, n, (m, C)).astype(np.int32)
+    return x, q, cand
+
+
+def _both_tiers(monkeypatch, x, q, cand, k, metric):
+    monkeypatch.setenv("RAFT_TPU_PALLAS_REFINE", "never")
+    d_x, i_x = refine.refine(jnp.asarray(x), jnp.asarray(q),
+                             jnp.asarray(cand), k, metric)
+    monkeypatch.setenv("RAFT_TPU_PALLAS_REFINE", "always")
+    d_p, i_p = refine.refine(jnp.asarray(x), jnp.asarray(q),
+                             jnp.asarray(cand), k, metric)
+    return (np.asarray(d_x), np.asarray(i_x),
+            np.asarray(d_p), np.asarray(i_p))
+
+
+class TestFusedParity:
+    @pytest.mark.parametrize("metric", METRICS)
+    def test_parity_clean_candidates(self, corpus, monkeypatch, metric):
+        x, q, cand = corpus
+        d_x, i_x, d_p, i_p = _both_tiers(monkeypatch, x, q, cand, 10,
+                                         metric)
+        np.testing.assert_allclose(d_p, d_x, rtol=2e-4, atol=2e-4)
+        np.testing.assert_array_equal(i_p, i_x)
+
+    @pytest.mark.parametrize("metric", METRICS)
+    def test_parity_invalid_patterns(self, corpus, monkeypatch, metric):
+        """All-(-1) rows, duplicate ids, and ragged (-1) tails must
+        survive both tiers identically — the kernel masks invalid ids
+        to ±inf exactly like the XLA path."""
+        x, q, cand = corpus
+        cand = cand.copy()
+        cand[0, :] = -1                    # fully invalid row
+        cand[1, 5:40] = cand[1, 4]         # duplicate ids
+        cand[2, -13:] = -1                 # ragged tail
+        cand[3, : 300 - 4] = -1            # fewer valid than k
+        d_x, i_x, d_p, i_p = _both_tiers(monkeypatch, x, q, cand, 10,
+                                         metric)
+        np.testing.assert_allclose(d_p, d_x, rtol=2e-4, atol=2e-4)
+        assert (i_p[0] == -1).all() and (i_x[0] == -1).all()
+        # duplicate ids rank as duplicates on both tiers
+        np.testing.assert_array_equal(i_p[1], i_x[1])
+        # the short row pads with -1 past its 4 valid candidates
+        assert (i_p[3][4:] == -1).all() and (i_x[3][4:] == -1).all()
+        np.testing.assert_array_equal(np.sort(i_p[2]), np.sort(i_x[2]))
+
+    def test_parity_bf16_dataset(self, corpus, monkeypatch):
+        """The recon-cache input shape: a bf16 dataset streams through
+        the row DMAs dtype-preserved; parity vs the XLA path on the
+        SAME bf16 rows, at the bf16 tolerance tier."""
+        x, q, cand = corpus
+        xb = jnp.asarray(x).astype(jnp.bfloat16)
+        monkeypatch.setenv("RAFT_TPU_PALLAS_REFINE", "never")
+        d_x, i_x = refine.refine(xb, jnp.asarray(q), jnp.asarray(cand), 10)
+        monkeypatch.setenv("RAFT_TPU_PALLAS_REFINE", "always")
+        d_p, i_p = refine.refine(xb, jnp.asarray(q), jnp.asarray(cand), 10)
+        np.testing.assert_allclose(np.asarray(d_p), np.asarray(d_x),
+                                   rtol=2e-2, atol=2e-2)
+        # Jaccard, not /k: duplicate candidate ids legitimately repeat
+        # in a top-k row, which shrinks the python set
+        overlap = np.mean([len(set(a) & set(b)) / len(set(a) | set(b))
+                           for a, b in zip(np.asarray(i_p),
+                                           np.asarray(i_x))])
+        assert overlap >= 0.9, overlap
+
+    def test_fused_declines_oversized_k(self, corpus, monkeypatch):
+        """k past the in-kernel merge budget must fall back to XLA, not
+        error: the dispatch gate (not the kernel) owns the bound."""
+        from raft_tpu.ops.pallas_kernels import GATHER_REFINE_MAX_K
+
+        x, q, cand = corpus
+        monkeypatch.setenv("RAFT_TPU_PALLAS_REFINE", "always")
+        reg = obs.MetricsRegistry()
+        obs.enable(registry=reg, hbm=False)
+        try:
+            refine.refine(jnp.asarray(x), jnp.asarray(q),
+                          jnp.asarray(cand), GATHER_REFINE_MAX_K + 1)
+        finally:
+            obs.disable()
+        c = reg.snapshot()["counters"]
+        assert c.get("refine.dispatch{impl=xla_gather}", 0) >= 1, c
+
+
+def test_pad_copy_guard():
+    """gather_refine_mem_ok: an unaligned dataset's PER-CALL pad copy
+    must be weighed against the [m, C, d] buffer the tier replaces —
+    a small re-rank against a huge d%128!=0 dataset stays on XLA."""
+    from raft_tpu.neighbors.ivf_common import gather_refine_mem_ok
+
+    assert gather_refine_mem_ok(10**6, 128, 4, m=10, C=256)  # aligned: free
+    # 512 MB pad copy vs a ~1 MB gather buffer → decline
+    assert not gather_refine_mem_ok(10**6, 96, 4, m=10, C=256)
+    # the oversampled regime: the 7.7 GB buffer dwarfs the copy → engage
+    assert gather_refine_mem_ok(10**6, 96, 4, m=10_000, C=2000)
+
+
+class TestDispatchContract:
+    def test_counters_and_span(self, corpus, monkeypatch):
+        x, q, cand = corpus
+        monkeypatch.setenv("RAFT_TPU_PALLAS_REFINE", "always")
+        reg = obs.MetricsRegistry()
+        obs.enable(registry=reg, hbm=False)
+        try:
+            refine.refine(jnp.asarray(x), jnp.asarray(q),
+                          jnp.asarray(cand), 10)
+        finally:
+            obs.disable()
+        snap = reg.snapshot()
+        assert snap["counters"].get(
+            "refine.dispatch{impl=pallas_gather}", 0) >= 1
+        # the fused scan runs under the established span contract
+        assert "span.refine.fused_scan" in snap["histograms"]
+
+    def test_host_tiers_count(self, corpus, monkeypatch):
+        x, q, cand = corpus
+        reg = obs.MetricsRegistry()
+        obs.enable(registry=reg, hbm=False)
+        try:
+            refine.refine_gathered(x, jnp.asarray(q), cand, 10)
+        finally:
+            obs.disable()
+        assert reg.snapshot()["counters"].get(
+            "refine.dispatch{impl=host_gather}", 0) >= 1
+
+
+class TestValidation:
+    """Satellite: oversized k / empty candidate axis fail with clear
+    expects() messages on every entry point — not an opaque
+    take_along_axis error from inside the jitted program."""
+
+    def test_oversized_k(self, corpus):
+        from raft_tpu.core.errors import LogicError
+
+        x, q, cand = corpus
+        with pytest.raises(LogicError, match="n_candidates"):
+            refine.refine(jnp.asarray(x), jnp.asarray(q),
+                          jnp.asarray(cand), cand.shape[1] + 1)
+        with pytest.raises(LogicError, match="n_candidates"):
+            refine.refine_gathered(x, jnp.asarray(q), cand,
+                                   cand.shape[1] + 1)
+
+    def test_empty_candidate_axis(self, corpus):
+        from raft_tpu.core.errors import LogicError
+
+        x, q, _ = corpus
+        empty = np.zeros((q.shape[0], 0), np.int32)
+        with pytest.raises(LogicError, match="non-empty"):
+            refine.refine(jnp.asarray(x), jnp.asarray(q),
+                          jnp.asarray(empty), 1)
+        with pytest.raises(LogicError, match="non-empty"):
+            refine.refine_gathered(x, jnp.asarray(q), empty, 1)
+
+    def test_row_mismatch_still_checked(self, corpus):
+        from raft_tpu.core.errors import LogicError
+
+        x, q, cand = corpus
+        with pytest.raises(LogicError, match="row mismatch"):
+            refine.refine(jnp.asarray(x), jnp.asarray(q[:5]),
+                          jnp.asarray(cand), 4)
+
+
+def test_dataset_dim_mismatch(corpus):
+    """Satellite follow-through: a wrong-dim re-rank base fails with a
+    clear expects() message on every entry point, not an opaque einsum
+    or Pallas block-shape error."""
+    from raft_tpu.core.errors import LogicError
+
+    x, q, cand = corpus
+    wrong = jnp.asarray(x[:, :17])
+    with pytest.raises(LogicError, match="feature-dim"):
+        refine.refine(wrong, jnp.asarray(q), jnp.asarray(cand), 5)
+    with pytest.raises(LogicError, match="feature-dim"):
+        refine.refine_gathered(np.asarray(wrong), jnp.asarray(q), cand, 5)

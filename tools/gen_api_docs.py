@@ -74,6 +74,31 @@ decode default, ~1e-2-relative key drift, candidate overlap ≥ 0.99 in
 practice; float8_e4m3 quantizes harder — use only with a refine pass
 behind it. The XLA paths quantize LUT entries, the `pallas_lut` kernel
 quantizes its codebook operand — same knob, numerically siblings.
+
+`SearchParams.refine="f32_regen"` + `search(..., dataset=...)` folds
+the reference's refinement_rate pattern into the call: the scan runs
+at `k·refine_ratio` candidates (through whichever tier above wins) and
+the exact re-rank routes through `neighbors.refine`'s dispatch tier —
+see that module's decision table.
+""",
+    "raft_tpu.neighbors.refine": """\
+### Refine-tier decision table
+
+`refine()` (and the `refine="f32_regen"` paths of `ivf_pq.search` /
+`ivf_flat.search`) picks the re-rank engine from dataset residency +
+shape (the obs counter `refine.dispatch{impl=...}` records the pick):
+
+| tier (`impl`) | selected when | gather structure | HBM transients |
+|---|---|---|---|
+| `pallas_gather` | device-resident f32/bf16 dataset, `k ≤ 64`, `k_cand ≥ 256`; auto on TPU for oversampled shapes (`k_cand ≥ 400` or a `[m, C, d]` buffer past 1 GB), forced with `RAFT_TPU_PALLAS_REFINE=always` (interpret mode off-TPU) | fused kernel (`ops.pallas_kernels.gather_refine_topk`): candidate ids HBM→SMEM, dataset rows streamed HBM→VMEM row-by-row, exact epilogue + running top-k on-chip | `[m, 128]` result tables only (plus a PER-CALL `[n, ceil(d/128)·128]` pad copy when `d % 128 ≠ 0` — `ivf_common.gather_refine_mem_ok` declines the tier when that copy exceeds the cap or the gather buffer it replaces) |
+| `xla_gather` | device dataset, any other shape | `dataset[cand]` gather + one batched einsum + `select_k` | the `[m, C, d]` f32 gather buffer (7.7 GB at batch 10000 × k_cand 2000 × d 96) |
+| `host_gather` (`refine_gathered`) | host/memmapped base (optionally SQ8 via `dequant=`) | host fancy-index of candidate rows, re-rank on device | `[m, C, d]` host rows + device copy |
+| `provider_regen` (`refine_provider`) | device-chunk provider (synthetic regen, deep-100m) | regenerate blocks on device, scatter candidate rows into one buffer | `[m·C, d]` device buffer (callers chunk queries) |
+
+All tiers share the metric semantics of the einsum path (l2 / sqrt-l2
+/ ip / cosine, invalid ids → ±inf, k ≤ n_candidates validated up
+front), so results cannot drift across tiers beyond dtype-tiered
+rounding.
 """,
 }
 
