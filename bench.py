@@ -34,15 +34,22 @@ Env: RAFT_TPU_BENCH_N / RAFT_TPU_BENCH_Q override dataset/query count
 RAFT_TPU_BENCH_LEGS comma-list restricts legs (deep100m,hard,gist);
 RAFT_TPU_BENCH_BUDGET_S total wall-clock budget.
 
-Observability (docs/observability.md): RAFT_TPU_BENCH_OBS=1 runs one
-diagnostic batch per measured row under raft_tpu.obs (sync + stage
-mode) and adds a per-stage latency breakdown ("stages": span seconds,
-incl. ivf_pq.search.{coarse_quantize,lut,scan} and refine) plus
-"peak_hbm_bytes" to each detail row; RAFT_TPU_BENCH_OBS_JSONL=path
-appends the captured metric series as JSON lines; RAFT_TPU_XPROF_DIR=
-path brackets one measured batch per row in jax.profiler.trace for
-offline XProf analysis. All of it is off by default and adds nothing to
-the timed QPS loop.
+Observability (docs/observability.md): RAFT_TPU_BENCH_OBS=1 runs a few
+diagnostic batches per measured row under raft_tpu.obs (sync + stage
+mode) and adds a per-stage latency breakdown ("stages": mean span
+seconds, incl. ivf_pq.search.{coarse_quantize,lut,scan} and refine),
+"peak_hbm_bytes", and p50/p99 search-latency quantiles
+("latency_p50_s"/"latency_p99_s") to each detail row;
+RAFT_TPU_BENCH_OBS_JSONL=path appends the captured metric series as
+JSON lines; RAFT_TPU_XPROF_DIR=path brackets one measured batch per row
+in jax.profiler.trace for offline XProf analysis. All of it is off by
+default and adds nothing to the timed QPS loop.
+
+Flight recorder: once the runner legs import raft_tpu, the flight
+recorder arms (dir RAFT_TPU_FLIGHT_DIR, default /tmp/raft_tpu_flight;
+periodic checkpoints via RAFT_TPU_FLIGHT_EVERY_S). The SIGTERM/SIGALRM
+partial-record path dumps it and stamps the dump path into "notes", so
+a killed run leaves a decomposable black box, not just QPS numbers.
 """
 
 import json
@@ -109,6 +116,38 @@ def emit():
     print(json.dumps(_payload()), flush=True)
 
 
+def _flight_dump(reason):
+    """Flight-recorder dump (docs/observability.md) — ONLY if raft_tpu
+    ever got imported this run: importing it from a signal handler
+    while the device plugin may be wedged would recreate the round-4
+    hang this file is structured to avoid. Returns the dump path or
+    None."""
+    if "raft_tpu" not in sys.modules:
+        return None
+    try:
+        from raft_tpu.obs import flight
+
+        return flight.dump_now(reason=reason)
+    except Exception:
+        return None
+
+
+def _install_flight():
+    """Arm the flight recorder once raft_tpu is being imported anyway
+    (the runner legs). signals=(): bench owns SIGTERM/SIGALRM via _die,
+    which dumps itself and stamps the path into the partial record."""
+    try:
+        from raft_tpu.obs import flight
+
+        flight.install(os.environ.get("RAFT_TPU_FLIGHT_DIR",
+                                      "/tmp/raft_tpu_flight"),
+                       signals=())
+        print("[bench] flight recorder armed "
+              f"(dir={flight.installed().dump_dir})", flush=True)
+    except Exception as e:
+        STATE["notes"].append(f"flight recorder unavailable: {e!r}")
+
+
 def _die(signum, frame):
     STATE["notes"].append(f"terminated by signal {signum} after "
                           f"{time.time() - STATE['t0']:.0f}s — "
@@ -122,6 +161,9 @@ def _die(signum, frame):
             child.wait(timeout=5)
         except Exception:
             child.kill()
+    dump = _flight_dump(f"signal {signum}")
+    if dump:
+        STATE["notes"].append(f"flight dump: {dump}")
     emit()
     os._exit(0)
 
@@ -427,6 +469,11 @@ def _row(dataset_name, r):
         row["stages"] = r.stage_breakdown
         row["stages_path"] = getattr(r, "stage_path", None)
         row["peak_hbm_bytes"] = getattr(r, "peak_hbm_bytes", None)
+    if getattr(r, "latency_quantiles", None) is not None:
+        # p50/p99 of the diagnostic batches (Histogram.quantile bucket
+        # interpolation) — tail estimate, not the timed QPS protocol
+        row["latency_p50_s"] = r.latency_quantiles.get("p50")
+        row["latency_p99_s"] = r.latency_quantiles.get("p99")
     return row
 
 
@@ -479,6 +526,8 @@ def main():
             emit()
         if "hard" in legs or "gist" in legs:
             from raft_tpu.bench import runner
+
+            _install_flight()
         if "hard" in legs:
             try:
                 runner.run_config(

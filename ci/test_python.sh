@@ -42,8 +42,17 @@ import __graft_entry__ as g
 fn, args = g.entry()
 jax.jit(fn).lower(*args)  # compile-check single chip
 print("entry() lowers OK")
-g.dryrun_multichip(8)
-print("dryrun_multichip(8) OK")
+comms = g.dryrun_multichip(8)
+# ISSUE 5: the dryrun must hand back nonzero comm counters for the
+# sharded-kNN (allgather) and distributed-kmeans (allreduce) legs,
+# with per-axis attribution on the 2-axis DCN×ICI mesh
+assert comms, "dryrun returned no comms snapshot"
+assert comms.get("comms.ops{axis=shard,op=allgather}", 0) > 0, comms
+assert comms.get("comms.ops{axis=shard,op=allreduce}", 0) > 0, comms
+assert comms.get("comms.bytes{axis=shard,op=allreduce}", 0) > 0, comms
+assert comms.get("comms.ops{axis=ici,op=allreduce}", 0) > 0, comms
+assert comms.get("comms.ops{axis=dcn,op=allreduce}", 0) > 0, comms
+print("dryrun_multichip(8) OK; comms section:", len(comms), "series")
 EOF
 
 echo "== bench smoke (tiny synthetic) =="
@@ -74,6 +83,88 @@ assert disp and all(r["value"] > 0 for r in disp), \
 print(f"observability smoke OK: {len(rows)} series, spans "
       f"{sorted(n for n in names if n.startswith('span.'))}, dispatch "
       f"impls {sorted(r['labels'].get('impl') for r in disp)}")
+EOF
+
+echo "== trace export round-trip (instrumented search -> Perfetto JSON) =="
+python - <<'EOF'
+import json
+import numpy as np
+import jax.numpy as jnp
+
+from raft_tpu import obs
+from raft_tpu.obs import trace
+from raft_tpu.obs.metrics import MetricsRegistry
+from raft_tpu.neighbors import ivf_pq
+
+rng = np.random.default_rng(0)
+x = jnp.asarray(rng.random((3000, 32), dtype=np.float32))
+idx = ivf_pq.build(x, ivf_pq.IndexParams(
+    n_lists=16, pq_dim=16, seed=0, cache_reconstruction="never"))
+obs.enable(sync=True, stages=True, registry=MetricsRegistry(),
+           events=True)
+try:
+    ivf_pq.search(idx, x[:64], 10,
+                  ivf_pq.SearchParams(n_probes=8, scan_mode="per_query"))
+finally:
+    obs.disable()
+n = trace.export_chrome("/tmp/raft_tpu_ci_trace.json")
+assert n >= 4, f"expected staged spans in the trace, got {n} events"
+with open("/tmp/raft_tpu_ci_trace.json") as f:
+    doc = json.load(f)
+names = {e["name"] for e in doc["traceEvents"] if e["ph"] == "X"}
+need = {"ivf_pq.search", "ivf_pq.search.scan", "ivf_pq.search.lut",
+        "ivf_pq.search.coarse_quantize"}
+assert need <= names, f"missing spans in trace: {sorted(need - names)}"
+assert all("ts" in e and "dur" in e and "tid" in e
+           for e in doc["traceEvents"] if e["ph"] == "X")
+print(f"trace round-trip OK: {n} events, spans {sorted(names)}")
+EOF
+python -m tools.obsdump /tmp/raft_tpu_ci_trace.json | grep -q "ivf_pq.search" \
+  || { echo "obsdump failed to render the trace"; exit 1; }
+echo "obsdump render OK"
+
+echo "== flight recorder smoke (simulated SIGTERM mid-run) =="
+python - <<'EOF'
+import json, os, signal, subprocess, sys, time
+
+DUMP_DIR = "/tmp/raft_tpu_ci_flight"
+subprocess.run(["rm", "-rf", DUMP_DIR])
+# child: an instrumented loop with the recorder armed; parent SIGTERMs
+# it mid-run and the dump must survive, parseable, with spans inside
+code = """
+import time
+from raft_tpu import obs
+from raft_tpu.obs import flight
+from raft_tpu.core import tracing
+
+# every_s=0: an inherited RAFT_TPU_FLIGHT_EVERY_S would add periodic
+# _latest.json checkpoints and make the dump selection ambiguous
+flight.install(%r, every_s=0)
+obs.enable(events=True, hbm=False)
+print("armed", flush=True)
+while True:
+    with tracing.span("ci.loop"):
+        time.sleep(0.01)
+""" % DUMP_DIR
+p = subprocess.Popen([sys.executable, "-c", code],
+                     stdout=subprocess.PIPE, text=True)
+assert p.stdout.readline().strip() == "armed"
+time.sleep(0.5)  # a few loop spans into the ring
+p.send_signal(signal.SIGTERM)
+p.wait(timeout=30)
+docs = []
+for f in sorted(os.listdir(DUMP_DIR)):
+    if f.startswith("flight_") and f.endswith(".json"):
+        with open(os.path.join(DUMP_DIR, f)) as fh:
+            docs.append((f, json.load(fh)))
+dumps = [f for f, d in docs if d["reason"].startswith("signal")]
+assert dumps, f"SIGTERM'd child left no signal dump: {[f for f, _ in docs]}"
+doc = dict(docs)[dumps[0]]
+assert any(e["name"] == "ci.loop" for e in doc["events"]), \
+    "flight dump lost the event ring"
+assert "span.ci.loop" in doc["metrics"]["histograms"]
+print(f"flight SIGTERM smoke OK: {sorted(dumps)[0]}, "
+      f"{len(doc['events'])} events, {len(doc['logs'])} log lines")
 EOF
 
 echo "== Pallas LUT-scan tier smoke (interpret mode, TPU-shaped dispatch) =="

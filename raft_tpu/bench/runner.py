@@ -68,6 +68,10 @@ class BenchResult:
     stage_breakdown: Optional[Dict[str, float]] = None
     stage_path: Optional[str] = None
     peak_hbm_bytes: Optional[int] = None
+    # p50/p99 of the diagnostic batches' end-to-end search latency
+    # (bucket-interpolated Histogram.quantile over OBS_REPS synced
+    # calls) — an estimate for tail triage, not the timed QPS protocol
+    latency_quantiles: Optional[Dict[str, float]] = None
     # True when the row was measured under the fenced LATENCY protocol
     # (reduced-batch legs): qps includes the per-call host round-trip
     fence_per_call: bool = False
@@ -171,17 +175,26 @@ ALGO_REGISTRY: Dict[str, Callable] = {
 # ---------------------------------------------------------------------------
 
 def _obs_capture(search_fn, queries, k, sp, batch_size, context):
-    """RAFT_TPU_BENCH_OBS=1: run ONE diagnostic batch under the
+    """RAFT_TPU_BENCH_OBS=1: run a few diagnostic batches under the
     observability layer (sync + stage mode → ivf_pq dispatches
     coarse_quantize/lut/scan as separate synced programs; refine and the
     other searches report whole-API spans) and return
-    (stage_seconds_by_span, peak_hbm_bytes). Runs AFTER the timed
-    measurement so the staged dispatch never pollutes QPS. With
-    RAFT_TPU_BENCH_OBS_JSONL set, the captured registry is appended to
-    that file, one JSON line per series, stamped with ``context``."""
+    (stage_seconds_by_span, path, peak_hbm_bytes, latency_quantiles).
+    Runs AFTER the timed measurement so the staged dispatch never
+    pollutes QPS. Stage values are PER-BATCH means over the reps; the
+    quantiles (p50/p99, ``Histogram.quantile`` bucket interpolation)
+    come from a ``bench.search_latency_s`` histogram of each rep's
+    end-to-end synced call. RAFT_TPU_BENCH_OBS_REPS overrides the rep
+    count (default 5). With RAFT_TPU_BENCH_OBS_JSONL set, the captured
+    registry is appended to that file, one JSON line per series,
+    stamped with ``context``."""
     from raft_tpu import obs
     from raft_tpu.obs import spans as _spans
 
+    try:
+        reps = max(1, int(os.environ.get("RAFT_TPU_BENCH_OBS_REPS", "5")))
+    except ValueError:
+        reps = 5
     reg = obs.MetricsRegistry()
     qb = queries[: min(batch_size, queries.shape[0])]
     prev = _spans._state()  # a RAFT_TPU_OBS=1 enable must survive this
@@ -189,15 +202,29 @@ def _obs_capture(search_fn, queries, k, sp, batch_size, context):
         # warm-up: the timed QPS loop ran the FUSED search, so the staged
         # programs are still uncompiled — the first staged call pays
         # trace+compile and would report seconds of "stage time". Burn it
-        # into a throwaway registry; measure the second call.
+        # into a throwaway registry; measure the later calls.
         obs.enable(sync=True, stages=True, registry=obs.MetricsRegistry())
         jax.block_until_ready(search_fn(qb, k, dict(sp)))
         obs.enable(sync=True, stages=True, registry=reg)
-        jax.block_until_ready(search_fn(qb, k, dict(sp)))
+        # denser-than-default buckets: the quantile estimate is bucket-
+        # interpolated, and the default decade buckets would clamp a
+        # handful of similar reps straight to min/max
+        lat = reg.histogram(
+            "bench.search_latency_s",
+            buckets=[1e-4, 2.5e-4, 5e-4, 1e-3, 2.5e-3, 5e-3, 1e-2,
+                     2.5e-2, 5e-2, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0,
+                     10.0, 30.0, 60.0])
+        for _ in range(reps):
+            t0 = time.perf_counter()
+            jax.block_until_ready(search_fn(qb, k, dict(sp)))
+            lat.observe(time.perf_counter() - t0)
     finally:
         _spans._restore(prev)
+    quantiles = {"p50": round(lat.quantile(0.5), 6),
+                 "p99": round(lat.quantile(0.99), 6),
+                 "samples": lat.count}
     snap = reg.snapshot()
-    stages = {name[len("span."):]: round(h["sum"], 6)
+    stages = {name[len("span."):]: round(h["mean"], 6)
               for name, h in snap["histograms"].items()
               if name.startswith("span.")}
     # which program the breakdown decomposed: ivf_pq with stage spans
@@ -210,7 +237,7 @@ def _obs_capture(search_fn, queries, k, sp, batch_size, context):
     jsonl = os.environ.get("RAFT_TPU_BENCH_OBS_JSONL")
     if jsonl:
         reg.dump_jsonl(jsonl, extra={"context": context})
-    return stages, path, (int(peak) if peak else None)
+    return stages, path, (int(peak) if peak else None), quantiles
 
 
 def _xprof_capture(search_fn, queries, k, sp, batch_size, xprof_dir):
@@ -363,10 +390,10 @@ def _run_one_index(index_cfg, algo, dsx, data, queries, k, batch_size,
         ids, dt, qps = _bench_search(search_fn, q_leg, k, sp, row_bs,
                                      fence_per_call=fenced)
         rec = ds_mod.recall(ids, data.groundtruth[: q_leg.shape[0]])
-        stages = stage_path = peak_hbm = None
+        stages = stage_path = peak_hbm = latency_q = None
         if _env_flag("RAFT_TPU_BENCH_OBS"):
             try:
-                stages, stage_path, peak_hbm = _obs_capture(
+                stages, stage_path, peak_hbm, latency_q = _obs_capture(
                     search_fn, q_leg, k, sp, row_bs,
                     context=f"{index_cfg.get('name', algo)} {sp}")
             except Exception as e:  # diagnostics must never cost a row
@@ -381,7 +408,8 @@ def _run_one_index(index_cfg, algo, dsx, data, queries, k, batch_size,
             build_s=build_s, search_s=dt, qps=qps, recall=rec,
             build_param=bp, search_param=dict(sp),
             stage_breakdown=stages, stage_path=stage_path,
-            peak_hbm_bytes=peak_hbm, fence_per_call=fenced,
+            peak_hbm_bytes=peak_hbm, latency_quantiles=latency_q,
+            fence_per_call=fenced,
         )
         results.append(row)
         if on_row is not None:
@@ -395,7 +423,10 @@ def _run_one_index(index_cfg, algo, dsx, data, queries, k, batch_size,
                                   for n, v in sorted(stages.items()))
                 hbm = (f"; peak_hbm={peak_hbm / 2**30:.2f}GiB"
                        if peak_hbm else "")
-                print(f"[bench]   stages: {parts}{hbm}")
+                lat = (f"; p50={latency_q['p50'] * 1e3:.1f}ms "
+                       f"p99={latency_q['p99'] * 1e3:.1f}ms"
+                       if latency_q else "")
+                print(f"[bench]   stages: {parts}{hbm}{lat}")
 
 
 def run_config_file(path: str, **kw) -> List[BenchResult]:

@@ -38,6 +38,11 @@ def fit(
     to the device count with zero weights. Returns replicated
     (centroids, inertia, n_iter).
     """
+    # deferred: parallel.ivf imports this module, so a top-level comms
+    # import would be circular
+    from raft_tpu.parallel.comms import Comms
+
+    comms = Comms(axis)
     n, d = x.shape
     k = params.n_clusters
     n_dev = mesh.shape[axis]
@@ -58,9 +63,11 @@ def fit(
                                          num_segments=k)
         local_counts = jax.ops.segment_sum(w_shard, labels, num_segments=k)
         local_inertia = jnp.sum(w_shard * d2)
-        sums = lax.psum(local_sums, axis)          # the reference's allreduce
-        counts = lax.psum(local_counts, axis)      # (core/comms.hpp:344)
-        inertia = lax.psum(local_inertia, axis)
+        # the reference's allreduce (core/comms.hpp:344), via the Comms
+        # facade so the merge traffic is counted per op × axis
+        sums = comms.allreduce(local_sums)
+        counts = comms.allreduce(local_counts)
+        inertia = comms.allreduce(local_inertia)
         new_c = jnp.where(counts[:, None] > 0,
                           sums / jnp.maximum(counts[:, None], 1e-12), centroids)
         return new_c, inertia

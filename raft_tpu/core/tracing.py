@@ -35,6 +35,16 @@ from raft_tpu.obs import spans as _spans
 from raft_tpu.obs.spans import span  # noqa: F401  (re-export: the stage timer)
 
 
+def annotate(name: str):
+    """Named-scope annotation for code that is ALREADY inside a trace
+    (shard_map/jit bodies — the collectives in ``parallel/comms.py``):
+    labels the lowered XLA ops so they group under ``name`` in
+    XProf/Perfetto op profiles. The host-side halves of :func:`traced`
+    (TraceAnnotation, recording spans) are meaningless there — a traced
+    body runs once at trace time — so this is just the metadata half."""
+    return jax.named_scope(name)
+
+
 def traced(name: Optional[str] = None) -> Callable:
     """Decorator: run the function under a named profiler scope
     (reference: RAFT_USING_NVTX / nvtx::range at API entry), plus a

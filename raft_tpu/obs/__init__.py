@@ -7,7 +7,12 @@ this package makes the same attribution available *in process*:
   with labels, ``snapshot()`` → dict, ``dump_jsonl`` sink;
 - :mod:`raft_tpu.obs.spans`   — ``span(name)`` stage timers (dotted
   nesting, optional device-time sync), recorded into the registry;
-- :mod:`raft_tpu.obs.hbm`     — ``device.memory_stats()`` telemetry;
+- :mod:`raft_tpu.obs.hbm`     — ``device.memory_stats()`` telemetry,
+  sampled per local device;
+- :mod:`raft_tpu.obs.trace`   — span-event ring buffer +
+  Chrome-trace/Perfetto export (``obs.enable(events=True)``);
+- :mod:`raft_tpu.obs.flight`  — flight recorder: crash-surviving dumps
+  of events + metrics + logs on signals/atexit/periodically;
 - :mod:`raft_tpu.obs.sanitize` — runtime sanitizer harness
   (``RAFT_TPU_SANITIZE=1``): rank-promotion/NaN config, transfer-guard
   scopes, and a jit-cache-miss counter with budget assertions.
@@ -24,6 +29,7 @@ from raft_tpu.obs.metrics import (  # noqa: F401
     MetricsRegistry,
     get_registry,
     load_jsonl,
+    quantile_from_state,
     set_registry,
 )
 from raft_tpu.obs.spans import (  # noqa: F401
@@ -35,10 +41,13 @@ from raft_tpu.obs.spans import (  # noqa: F401
     enabled,
     env_flag,
     env_tristate,
+    events_enabled,
     registry,
     span,
     stages_enabled,
     sync_enabled,
 )
 from raft_tpu.obs import hbm  # noqa: F401
+from raft_tpu.obs import trace  # noqa: F401
+from raft_tpu.obs import flight  # noqa: F401
 from raft_tpu.obs import sanitize  # noqa: F401

@@ -12,7 +12,10 @@ test mesh.
 (``hbm.bytes_in_use`` set-to-current, ``hbm.peak_bytes`` high-water) —
 the span timers call it at root-span exit when observability is on
 (nested-span exits skip it: the ``memory_stats()`` round-trip would
-land inside every ancestor span's timed region).
+land inside every ancestor span's timed region). By default it samples
+EVERY local device into per-device-labeled gauges
+(``hbm.bytes_in_use{device=0..n}``) so sharded runs see each chip, with
+device 0 mirrored into the unlabeled series for single-chip readers.
 """
 
 from __future__ import annotations
@@ -54,19 +57,64 @@ def bytes_limit(device: Optional[Any] = None,
     return int(v) if v else default
 
 
-def sample(registry=None, device: Optional[Any] = None) -> Dict[str, int]:
+def _local_devices() -> list:
+    try:
+        import jax
+
+        return list(jax.local_devices())
+    except Exception:
+        return []
+
+
+def _record(registry, stats: Dict[str, int], labels: Optional[Dict],
+            events, suffix: str) -> None:
+    if "bytes_in_use" in stats:
+        registry.gauge("hbm.bytes_in_use", labels).set(stats["bytes_in_use"])
+        if events is not None:
+            events.record_counter("hbm.bytes_in_use" + suffix,
+                                  stats["bytes_in_use"])
+    if "peak_bytes_in_use" in stats:
+        registry.gauge("hbm.peak_bytes", labels).max(
+            stats["peak_bytes_in_use"])
+        if events is not None:
+            events.record_counter("hbm.peak_bytes" + suffix,
+                                  stats["peak_bytes_in_use"])
+    if "bytes_limit" in stats:
+        registry.gauge("hbm.bytes_limit", labels).set(stats["bytes_limit"])
+
+
+def sample(registry=None, device: Optional[Any] = None,
+           events=None) -> Dict[str, int]:
     """Record current HBM gauges into ``registry`` (default: the global
-    one) and return the raw stats dict ({} when unavailable)."""
+    one) and return device 0's raw stats dict ({} when unavailable).
+
+    With ``device=None`` (the span-exit path) EVERY local device is
+    sampled into per-device-labeled gauges (``hbm.bytes_in_use{device=i}``
+    etc.) so sharded runs see each chip's HBM, and device 0 additionally
+    feeds the unlabeled series the bench's peak-HBM column reads. An
+    explicit ``device`` samples just that one into the unlabeled series.
+    Backends that report nothing (the CPU test mesh) degrade to ``{}``.
+    ``events`` (an :class:`raft_tpu.obs.trace.EventBuffer`) additionally
+    records one counter-track sample per gauge.
+    """
     if registry is None:
         from raft_tpu.obs import metrics as _metrics
 
         registry = _metrics.get_registry()
-    stats = device_memory_stats(device)
-    if stats:
-        if "bytes_in_use" in stats:
-            registry.gauge("hbm.bytes_in_use").set(stats["bytes_in_use"])
-        if "peak_bytes_in_use" in stats:
-            registry.gauge("hbm.peak_bytes").max(stats["peak_bytes_in_use"])
-        if "bytes_limit" in stats:
-            registry.gauge("hbm.bytes_limit").set(stats["bytes_limit"])
-    return stats
+    if device is not None:
+        stats = device_memory_stats(device)
+        if stats:
+            _record(registry, stats, None, events, "")
+        return stats
+    first: Dict[str, int] = {}
+    for i, dev in enumerate(_local_devices()):
+        stats = device_memory_stats(dev)
+        if i == 0:
+            first = stats
+        if not stats:
+            continue
+        _record(registry, stats, {"device": str(i)}, events,
+                "{device=%d}" % i)
+        if i == 0:  # unlabeled back-compat series mirrors device 0
+            _record(registry, stats, None, None, "")
+    return first

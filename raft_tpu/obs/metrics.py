@@ -59,7 +59,11 @@ class Counter:
         self.name = name
         self.labels = dict(labels or {})
         self._value = 0.0
-        self._lock = threading.Lock()
+        # RLock: the flight recorder's signal handler snapshots these
+        # structures ON the interrupted main thread — a plain Lock the
+        # interrupted frame already holds would deadlock the dying
+        # process (same for every lock on the snapshot path below)
+        self._lock = threading.RLock()
 
     def inc(self, value: float = 1.0) -> None:
         if value < 0:
@@ -81,7 +85,7 @@ class Gauge:
         self.name = name
         self.labels = dict(labels or {})
         self._value = 0.0
-        self._lock = threading.Lock()
+        self._lock = threading.RLock()  # signal-snapshot path, see Counter
 
     def set(self, value: float) -> None:
         with self._lock:
@@ -118,7 +122,7 @@ class Histogram:
         self._sum = 0.0
         self._min = float("inf")
         self._max = float("-inf")
-        self._lock = threading.Lock()
+        self._lock = threading.RLock()  # signal-snapshot path, see Counter
 
     def observe(self, value: float) -> None:
         value = float(value)
@@ -142,6 +146,15 @@ class Histogram:
     @property
     def sum(self) -> float:
         return self._sum
+
+    def quantile(self, q: float) -> Optional[float]:
+        """Bucket-interpolated quantile estimate (None when empty) —
+        Prometheus ``histogram_quantile`` semantics: linear
+        interpolation inside the bucket holding the q-th sample,
+        clamped to the observed min/max so coarse buckets never report
+        a value outside the data. p50/p99 of search latency in the
+        bench OBS rows and ``tools/obsdump.py`` come from here."""
+        return quantile_from_state(self.state(), q)
 
     def state(self) -> Dict[str, Any]:
         with self._lock:
@@ -167,7 +180,7 @@ class MetricsRegistry:
     """Thread-safe named-series registry (counters/gauges/histograms)."""
 
     def __init__(self) -> None:
-        self._lock = threading.Lock()
+        self._lock = threading.RLock()  # signal-snapshot path, see Counter
         self._counters: Dict[Tuple[str, tuple], Counter] = {}
         self._gauges: Dict[Tuple[str, tuple], Gauge] = {}
         self._histograms: Dict[Tuple[str, tuple], Histogram] = {}
@@ -257,6 +270,43 @@ class MetricsRegistry:
             self._counters.clear()
             self._gauges.clear()
             self._histograms.clear()
+
+
+def quantile_from_state(state: Dict[str, Any], q: float
+                        ) -> Optional[float]:
+    """Bucket-interpolated quantile from a ``Histogram.state()`` dict
+    (works on live states, JSONL rows, and flight-dump snapshots alike
+    — the buckets are cumulative counts keyed by upper bound)."""
+    count = state.get("count") or 0
+    if not count:
+        return None
+    lo_clamp = state.get("min")
+    hi_clamp = state.get("max")
+    entries = []
+    for key, cum in (state.get("buckets") or {}).items():
+        ub = float("inf") if key == "+inf" else float(key)
+        entries.append((ub, cum))
+    entries.sort()
+    if not entries:
+        return hi_clamp
+    rank = min(max(float(q), 0.0), 1.0) * count
+    prev_cum, lower = 0, 0.0
+    for ub, cum in entries:
+        in_bucket = cum - prev_cum
+        if cum >= rank and in_bucket > 0:
+            if ub == float("inf"):
+                est = hi_clamp if hi_clamp is not None else lower
+            else:
+                est = lower + (rank - prev_cum) / in_bucket * (ub - lower)
+            if lo_clamp is not None:
+                est = max(est, lo_clamp)
+            if hi_clamp is not None:
+                est = min(est, hi_clamp)
+            return float(est)
+        prev_cum = cum
+        if ub != float("inf"):
+            lower = ub
+    return float(hi_clamp) if hi_clamp is not None else None
 
 
 def load_jsonl(path: str) -> List[Dict[str, Any]]:

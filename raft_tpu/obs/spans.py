@@ -27,8 +27,9 @@ Semantics:
   stage-decomposed variant (``ivf_pq.search`` → ``search_staged``)
   route to it, trading fusion for per-stage attribution.
 
-Env: ``RAFT_TPU_OBS=1`` enables at import; ``RAFT_TPU_OBS_SYNC=1`` and
-``RAFT_TPU_OBS_STAGES=1`` add the respective modes.
+Env: ``RAFT_TPU_OBS=1`` enables at import; ``RAFT_TPU_OBS_SYNC=1``,
+``RAFT_TPU_OBS_STAGES=1`` and ``RAFT_TPU_OBS_EVENTS=1`` (timeline event
+recording into :mod:`raft_tpu.obs.trace`) add the respective modes.
 """
 
 from __future__ import annotations
@@ -44,6 +45,7 @@ _enabled = False
 _sync = False
 _stages = False
 _hbm_sample = True
+_events = False
 _registry: Optional[_metrics.MetricsRegistry] = None
 
 _tls = threading.local()
@@ -58,24 +60,29 @@ def _stack():
 
 def enable(sync: bool = False, stages: bool = False,
            registry: Optional[_metrics.MetricsRegistry] = None,
-           hbm: bool = True) -> None:
+           hbm: bool = True, events: bool = False) -> None:
     """Turn span recording on. ``sync`` blocks on attached arrays at span
     exit (device time); ``stages`` routes searches through their
     stage-decomposed variants; ``registry`` overrides the global sink;
-    ``hbm`` samples HBM gauges at root-span exit."""
-    global _enabled, _sync, _stages, _registry, _hbm_sample
+    ``hbm`` samples HBM gauges at root-span exit; ``events``
+    additionally appends one timeline event per span exit (plus HBM
+    counter samples) into the :mod:`raft_tpu.obs.trace` ring buffer for
+    Chrome-trace/Perfetto export."""
+    global _enabled, _sync, _stages, _registry, _hbm_sample, _events
     _sync = bool(sync)
     _stages = bool(stages)
     _registry = registry
     _hbm_sample = bool(hbm)
+    _events = bool(events)
     _enabled = True
 
 
 def disable() -> None:
-    global _enabled, _sync, _stages, _registry
+    global _enabled, _sync, _stages, _registry, _events
     _enabled = False
     _sync = False
     _stages = False
+    _events = False
     _registry = None
 
 
@@ -83,12 +90,12 @@ def _state():
     """Snapshot the enable state (for save/restore around a temporary
     enable — e.g. the bench's diagnostic capture must not wipe a
     RAFT_TPU_OBS=1 enable the user installed at import)."""
-    return (_enabled, _sync, _stages, _registry, _hbm_sample)
+    return (_enabled, _sync, _stages, _registry, _hbm_sample, _events)
 
 
 def _restore(state) -> None:
-    global _enabled, _sync, _stages, _registry, _hbm_sample
-    _enabled, _sync, _stages, _registry, _hbm_sample = state
+    global _enabled, _sync, _stages, _registry, _hbm_sample, _events
+    _enabled, _sync, _stages, _registry, _hbm_sample, _events = state
 
 
 def enabled() -> bool:
@@ -101,6 +108,10 @@ def sync_enabled() -> bool:
 
 def stages_enabled() -> bool:
     return _enabled and _stages
+
+
+def events_enabled() -> bool:
+    return _enabled and _events
 
 
 def registry() -> _metrics.MetricsRegistry:
@@ -188,21 +199,34 @@ class span:
             sp.attach(out)          # blocked on at exit in sync mode
 
     Arrays may also be passed at construction: ``span("scan", out)``.
+    ``labels`` (and :meth:`annotate`) attach key/values that ride into
+    the timeline event's ``args`` when event recording is on.
     """
 
-    __slots__ = ("name", "_arrays", "_t0", "_live")
+    __slots__ = ("name", "_arrays", "_t0", "_live", "_labels")
 
-    def __init__(self, name: str, *arrays: Any):
+    def __init__(self, name: str, *arrays: Any,
+                 labels: Optional[dict] = None):
         self.name = name
         self._arrays = list(arrays)
         self._t0 = 0.0
         self._live = False
+        self._labels = labels
 
     def attach(self, *arrays: Any) -> "span":
         """Register arrays (any pytrees) to block on at exit when sync
         mode is on. No-op (and free) when spans are disabled."""
         if self._live and _sync:
             self._arrays.extend(arrays)
+        return self
+
+    def annotate(self, **labels: Any) -> "span":
+        """Attach labels to this span's timeline event (event recording
+        only). No-op (and free) when spans/events are disabled."""
+        if self._live and _events:
+            if self._labels is None:
+                self._labels = {}
+            self._labels.update(labels)
         return self
 
     def __enter__(self) -> "span":
@@ -228,7 +252,17 @@ class span:
                     jax.block_until_ready(self._arrays)
                 dt = time.perf_counter() - self._t0
                 reg = registry()
-                reg.histogram("span." + ".".join(stack)).observe(dt)
+                dotted = ".".join(stack)
+                reg.histogram("span." + dotted).observe(dt)
+                events = None
+                if _events:
+                    from raft_tpu.obs import trace as _trace
+
+                    events = _trace.get_buffer()
+                    # wall-clock begin reconstructed from the monotonic
+                    # duration: one clock read per exit, none per enter
+                    events.record_span(dotted, time.time() - dt, dt,
+                                       args=self._labels)
                 # sample HBM only at ROOT-span exit: memory_stats() is a
                 # transport round-trip on tunnel-attached devices, and
                 # at a child-span exit every ancestor's clock is still
@@ -236,7 +270,7 @@ class span:
                 if _hbm_sample and len(stack) == 1:
                     from raft_tpu.obs import hbm as _hbm
 
-                    _hbm.sample(reg)
+                    _hbm.sample(reg, events=events)
         finally:
             stack.pop()
             self._live = False
@@ -246,4 +280,5 @@ class span:
 
 if env_flag("RAFT_TPU_OBS"):  # pragma: no cover - env-driven
     enable(sync=env_flag("RAFT_TPU_OBS_SYNC"),
-           stages=env_flag("RAFT_TPU_OBS_STAGES"))
+           stages=env_flag("RAFT_TPU_OBS_STAGES"),
+           events=env_flag("RAFT_TPU_OBS_EVENTS"))

@@ -19,18 +19,34 @@ TPU-native re-design of the reference's comms stack (SURVEY.md §5.8):
   exists for API parity.
 
 Reduction ops mirror ``op_t`` (core/comms.hpp:36): SUM, PROD, MIN, MAX.
+
+**Comms telemetry** (docs/observability.md): when observability is on
+(:func:`raft_tpu.obs.enable`), every collective counts one op and its
+per-rank payload bytes into ``comms.ops{op=...,axis=...}`` /
+``comms.bytes{op=...,axis=...}``, labeled by collective verb and axis
+name — a 2-axis DCN×ICI mesh attributes traffic per axis. Counting
+reads only STATIC shape/dtype at trace time (once per jit trace, the
+same per-dispatch-decision semantics as ``obs.count_dispatch``): zero
+host syncs, zero runtime cost in the compiled program, and a single
+flag check when observability is off. Each collective also lowers
+under a ``raft_tpu.comms.<verb>`` named scope (``core.tracing.annotate``)
+so profiler op timelines attribute ICI/DCN time to the verb.
 """
 
 from __future__ import annotations
 
 import enum
+import math
 from typing import Optional, Sequence, Union
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 from jax import lax
 
 from raft_tpu.core.compat import axis_size as _axis_size
+from raft_tpu.core.tracing import annotate as _annotate
+from raft_tpu.obs import spans as _obs
 
 
 class Op(enum.Enum):
@@ -57,6 +73,33 @@ _REDUCERS = {
 }
 
 
+def _axis_label(axis_name: Union[str, Sequence[str]]) -> str:
+    """Canonical label for one axis name or a multi-axis tuple
+    (``("dcn", "ici")`` → ``"dcn+ici"``)."""
+    if isinstance(axis_name, str):
+        return axis_name
+    return "+".join(str(a) for a in axis_name)
+
+
+def _payload_bytes(*arrays) -> int:
+    """Per-rank payload bytes from STATIC shape/dtype — works on
+    tracers (shapes are always concrete under shard_map), never touches
+    values, so counting introduces no host syncs (GL01-clean)."""
+    total = 0
+    for a in arrays:
+        shape = getattr(a, "shape", None)
+        if shape is None:  # python scalar payload
+            total += 8
+            continue
+        dtype = getattr(a, "dtype", None)
+        try:
+            itemsize = np.dtype(dtype).itemsize
+        except TypeError:
+            itemsize = 4
+        total += int(math.prod(shape)) * itemsize
+    return total
+
+
 class Comms:
     """Named-axis communicator (reference: ``comms_t``, core/comms.hpp:242).
 
@@ -79,45 +122,62 @@ class Comms:
         comms_t::comm_split, std_comms.hpp:145 — here: zero-cost renaming)."""
         return Comms(axis_name)
 
+    # -- telemetry ---------------------------------------------------------
+    def _count(self, op_name: str, *arrays) -> None:
+        """Count one collective + its per-rank payload bytes into
+        ``comms.ops`` / ``comms.bytes`` labeled ``{op=...,axis=...}``.
+        Runs at trace time from static shape/dtype only — once per jit
+        trace (the obs.count_dispatch semantics), zero host syncs, one
+        flag check when observability is off."""
+        if not _obs.enabled():
+            return
+        labels = {"op": op_name, "axis": _axis_label(self.axis_name)}
+        reg = _obs.registry()
+        reg.inc("comms.ops", 1.0, labels=labels)
+        reg.inc("comms.bytes", float(_payload_bytes(*arrays)), labels=labels)
+
     # -- collectives -------------------------------------------------------
-    def allreduce(self, x, op: Op = Op.SUM):
-        """reference: comms_t::allreduce (core/comms.hpp:344)."""
+    def _allreduce_raw(self, x, op: Op):
         if op == Op.PROD:
             return jnp.exp(lax.psum(jnp.log(x), self.axis_name))  # rarely used
         return _REDUCERS[op](x, self.axis_name)
 
+    def allreduce(self, x, op: Op = Op.SUM):
+        """reference: comms_t::allreduce (core/comms.hpp:344)."""
+        self._count("allreduce", x)
+        with _annotate("raft_tpu.comms.allreduce"):
+            return self._allreduce_raw(x, op)
+
     def reduce(self, x, root: int = 0, op: Op = Op.SUM):
         """reference: comms_t::reduce — XLA has no rooted reduce; allreduce
         and mask off non-roots (same wire cost on ICI)."""
-        full = self.allreduce(x, op)
-        rank = self.get_rank()
-        return jnp.where(rank == root, full, jnp.zeros_like(full))
+        self._count("reduce", x)
+        with _annotate("raft_tpu.comms.reduce"):
+            full = self._allreduce_raw(x, op)
+            rank = self.get_rank()
+            return jnp.where(rank == root, full, jnp.zeros_like(full))
 
     def bcast(self, x, root: int = 0):
         """reference: comms_t::bcast — select the root's shard and replicate."""
-        gathered = lax.all_gather(x, self.axis_name, axis=0)
-        return gathered[root]
+        self._count("bcast", x)
+        with _annotate("raft_tpu.comms.bcast"):
+            gathered = lax.all_gather(x, self.axis_name, axis=0)
+            return gathered[root]
 
     def allgather(self, x, axis: int = 0, tiled: bool = False):
         """reference: comms_t::allgather."""
-        return lax.all_gather(x, self.axis_name, axis=axis, tiled=tiled)
+        self._count("allgather", x)
+        with _annotate("raft_tpu.comms.allgather"):
+            return lax.all_gather(x, self.axis_name, axis=axis, tiled=tiled)
 
     def gather(self, x, root: int = 0, axis: int = 0):
         """reference: comms_t::gather — SPMD programs have no cheaper rooted
         gather; all ranks hold the result and root semantics are a no-op."""
-        return lax.all_gather(x, self.axis_name, axis=axis)
+        self._count("gather", x)
+        with _annotate("raft_tpu.comms.gather"):
+            return lax.all_gather(x, self.axis_name, axis=axis)
 
-    def allgatherv(self, x, count, compact: bool = True):
-        """Variable-length allgather (reference: comms_t::allgatherv,
-        core/comms.hpp:423-444). Ragged shard sizes are what real
-        sharded datasets produce; XLA collectives are statically shaped,
-        so each rank contributes a PADDED shard ``x [cap, ...]`` plus
-        its valid row ``count``. Returns ``(gathered [size·cap, ...],
-        counts [size])`` with every rank's valid rows stable-packed to
-        the front in rank order — ``jnp.sum(counts)`` rows are valid,
-        the tail is pad. ``compact=False`` skips the packing sort and
-        returns the raw padded concatenation (cheaper when the caller
-        masks instead of slicing)."""
+    def _allgatherv_impl(self, x, count, compact: bool):
         counts = lax.all_gather(count, self.axis_name)           # [size]
         g = lax.all_gather(x, self.axis_name, axis=0, tiled=True)
         if not compact:
@@ -130,34 +190,60 @@ class Comms:
         order = jnp.argsort(invalid, stable=True)  # valid first, rank order
         return jnp.take(g, order, axis=0), counts
 
+    def allgatherv(self, x, count, compact: bool = True):
+        """Variable-length allgather (reference: comms_t::allgatherv,
+        core/comms.hpp:423-444). Ragged shard sizes are what real
+        sharded datasets produce; XLA collectives are statically shaped,
+        so each rank contributes a PADDED shard ``x [cap, ...]`` plus
+        its valid row ``count``. Returns ``(gathered [size·cap, ...],
+        counts [size])`` with every rank's valid rows stable-packed to
+        the front in rank order — ``jnp.sum(counts)`` rows are valid,
+        the tail is pad. ``compact=False`` skips the packing sort and
+        returns the raw padded concatenation (cheaper when the caller
+        masks instead of slicing)."""
+        self._count("allgatherv", x, count)
+        with _annotate("raft_tpu.comms.allgatherv"):
+            return self._allgatherv_impl(x, count, compact)
+
     def gatherv(self, x, count, root: int = 0, compact: bool = True):
         """Variable-length gather (reference: comms_t::gatherv,
         core/comms.hpp:449-470) — rooted semantics are a no-op in SPMD
         (see :meth:`gather`); identical wire cost to allgatherv."""
-        return self.allgatherv(x, count, compact=compact)
+        self._count("gatherv", x, count)
+        with _annotate("raft_tpu.comms.gatherv"):
+            return self._allgatherv_impl(x, count, compact)
 
     def reducescatter(self, x, op: Op = Op.SUM, scatter_dimension: int = 0):
         """reference: comms_t::reducescatter."""
-        return lax.psum_scatter(x, self.axis_name,
-                                scatter_dimension=scatter_dimension, tiled=True)
+        self._count("reducescatter", x)
+        with _annotate("raft_tpu.comms.reducescatter"):
+            return lax.psum_scatter(x, self.axis_name,
+                                    scatter_dimension=scatter_dimension,
+                                    tiled=True)
 
     def alltoall(self, x, split_axis: int = 0, concat_axis: int = 0):
         """reference: std_comms nccl alltoall (device_multicast analog)."""
-        return lax.all_to_all(x, self.axis_name, split_axis=split_axis,
-                              concat_axis=concat_axis, tiled=True)
+        self._count("alltoall", x)
+        with _annotate("raft_tpu.comms.alltoall"):
+            return lax.all_to_all(x, self.axis_name, split_axis=split_axis,
+                                  concat_axis=concat_axis, tiled=True)
 
     def ppermute(self, x, perm):
         """Point-to-point ring/permute transfer — the structured replacement
         for comms_t::device_send/device_recv pairs (core/comms.hpp:505,531):
         SPMD programs express p2p as a permutation collective."""
-        return lax.ppermute(x, self.axis_name, perm=perm)
+        self._count("ppermute", x)
+        with _annotate("raft_tpu.comms.ppermute"):
+            return lax.ppermute(x, self.axis_name, perm=perm)
 
     def send_recv_ring(self, x, shift: int = 1):
         """Ring shift by ``shift`` (send to rank+shift, recv from rank-shift).
         Axis sizes are static at trace time, so the permutation is concrete."""
-        size = int(_axis_size(self.axis_name))
-        perm = [(i, (i + shift) % size) for i in range(size)]
-        return lax.ppermute(x, self.axis_name, perm=perm)
+        self._count("send_recv_ring", x)
+        with _annotate("raft_tpu.comms.send_recv_ring"):
+            size = int(_axis_size(self.axis_name))
+            perm = [(i, (i + shift) % size) for i in range(size)]
+            return lax.ppermute(x, self.axis_name, perm=perm)
 
     def sync_stream(self) -> Status:
         """reference: comms_t::sync_stream (core/comms.hpp:283-290) — XLA

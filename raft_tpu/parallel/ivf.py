@@ -51,6 +51,7 @@ from raft_tpu.matrix import select_k as _select_k
 from raft_tpu.neighbors import ivf_flat as _flat
 from raft_tpu.neighbors import ivf_pq as _pq
 from raft_tpu.neighbors import ivf_common as ic
+from raft_tpu.parallel.comms import Comms
 
 
 class ShardedIvfPq(flax.struct.PyTreeNode):
@@ -161,7 +162,7 @@ def _gather_trainset(x: jax.Array, mesh: Mesh, axis: str, t: int,
         owned = (local_idx >= 0) & (local_idx < shard_n)
         rows = x_shard[jnp.clip(local_idx, 0, shard_n - 1)]
         contrib = jnp.where(owned[:, None], rows, 0.0)
-        return lax.psum(contrib, axis)
+        return Comms(axis).allreduce(contrib)
 
     fn = shard_map(local, mesh=mesh, in_specs=(P(axis, None),),
                    out_specs=P(), check_vma=False)
@@ -172,9 +173,12 @@ def _merge_topk(vals: jax.Array, ids: jax.Array, axis: str, m: int, k: int,
                 n_dev: int, select_min: bool) -> Tuple[jax.Array, jax.Array]:
     """Cross-shard candidate merge: all-gather per-shard top-k over ICI,
     final select_k (reference: knn_merge_parts.cuh). Runs inside
-    shard_map; also the epilogue of parallel/knn.py's sharded search."""
-    all_v = lax.all_gather(vals, axis)          # [n_dev, m, k]
-    all_i = lax.all_gather(ids, axis)
+    shard_map; also the epilogue of parallel/knn.py's sharded search.
+    The gathers ride the Comms facade so merge traffic lands in the
+    ``comms.ops``/``comms.bytes`` counters per axis."""
+    comms = Comms(axis)
+    all_v = comms.allgather(vals)               # [n_dev, m, k]
+    all_i = comms.allgather(ids)
     flat_v = jnp.transpose(all_v, (1, 0, 2)).reshape(m, n_dev * k)
     flat_i = jnp.transpose(all_i, (1, 0, 2)).reshape(m, n_dev * k)
     return _select_k(flat_v, k, select_min=select_min, input_indices=flat_i)

@@ -22,6 +22,7 @@ from raft_tpu.core.errors import expects
 from raft_tpu.distance import DistanceType, SELECT_MIN, resolve_metric
 from raft_tpu.matrix import select_k as _select_k
 from raft_tpu.neighbors import brute_force
+from raft_tpu.parallel.comms import Comms
 
 
 def _pad_rows(x: jax.Array, multiple: int) -> Tuple[jax.Array, int]:
@@ -58,6 +59,7 @@ def sharded_knn(
     shard_size = padded.shape[0] // n_dev
     expects(k <= shard_size, "k=%d exceeds shard size %d", k, shard_size)
     pad_val = jnp.inf if select_min else -jnp.inf
+    comms = Comms(axis)  # counted collectives (comms.ops/comms.bytes)
 
     def local_search(ds_shard, q):
         rank = lax.axis_index(axis)
@@ -66,8 +68,8 @@ def sharded_knn(
         gids = ids.astype(jnp.int32) + rank.astype(jnp.int32) * shard_size
         vals = jnp.where(gids < n, vals, pad_val)  # mask padded rows
         # cross-shard merge: gather all candidates, select final top-k
-        all_vals = lax.all_gather(vals, axis)        # [n_dev, m, k]
-        all_ids = lax.all_gather(gids, axis)
+        all_vals = comms.allgather(vals)             # [n_dev, m, k]
+        all_ids = comms.allgather(gids)
         m = q.shape[0]
         flat_v = jnp.transpose(all_vals, (1, 0, 2)).reshape(m, n_dev * k)
         flat_i = jnp.transpose(all_ids, (1, 0, 2)).reshape(m, n_dev * k)

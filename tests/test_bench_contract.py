@@ -94,6 +94,39 @@ def test_sigterm_emits_record():
     assert any("signal" in n for n in payload["notes"])
 
 
+def test_sigterm_stamps_flight_dump_path(tmp_path):
+    """ISSUE 5 acceptance: a bench run killed by SIGTERM leaves a
+    flight dump whose path appears in the partial record's notes (the
+    recorder only arms once raft_tpu is imported — as the runner legs
+    do — so the child imports it before waiting)."""
+    code = (
+        "import sys, os; sys.path.insert(0, %r)\n"
+        "os.environ['RAFT_TPU_FLIGHT_DIR'] = %r\n"
+        "import bench, time, signal\n"
+        "import raft_tpu  # the runner legs would have imported it\n"
+        "bench._install_flight()\n"
+        "signal.signal(signal.SIGTERM, bench._die)\n"
+        "print('ready', flush=True)\n"
+        "time.sleep(60)\n" % (ROOT, str(tmp_path))
+    )
+    p = subprocess.Popen([sys.executable, "-c", code],
+                         stdout=subprocess.PIPE, text=True)
+    for line in p.stdout:
+        if line.strip() == "ready":
+            break
+    p.send_signal(signal.SIGTERM)
+    out, _ = p.communicate(timeout=60)
+    payload = json.loads(out.strip().splitlines()[-1])
+    stamped = [n for n in payload["notes"] if n.startswith("flight dump: ")]
+    assert stamped, payload["notes"]
+    dump_path = stamped[0][len("flight dump: "):]
+    assert os.path.dirname(dump_path) == str(tmp_path)
+    with open(dump_path) as f:
+        doc = json.load(f)
+    assert doc["reason"].startswith("signal")
+    assert "metrics" in doc and "events" in doc
+
+
 class TestGistConf:
     """GIST-960 leg wiring (ISSUE 4 satellite: BASELINE config 4 has
     recorded zero rows in five rounds — the conf now lives in

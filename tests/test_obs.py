@@ -230,6 +230,63 @@ class TestHbm:
         else:
             assert "hbm.bytes_in_use" not in gauges
 
+    def test_sample_covers_all_local_devices(self, monkeypatch):
+        # sharded runs must see EVERY chip's HBM, not just device 0 —
+        # fake a 2-device backend that reports allocator stats
+        class FakeDev:
+            def __init__(self, n):
+                self._n = n
+
+            def memory_stats(self):
+                return {"bytes_in_use": 100 * self._n,
+                        "peak_bytes_in_use": 200 * self._n,
+                        "bytes_limit": 1000}
+
+        monkeypatch.setattr(hbm, "_local_devices",
+                            lambda: [FakeDev(1), FakeDev(2)])
+        reg = MetricsRegistry()
+        stats = hbm.sample(reg)
+        assert stats["bytes_in_use"] == 100  # device 0's dict returned
+        gauges = reg.snapshot()["gauges"]
+        assert gauges["hbm.bytes_in_use{device=0}"] == 100
+        assert gauges["hbm.bytes_in_use{device=1}"] == 200
+        assert gauges["hbm.peak_bytes{device=1}"] == 400
+        # unlabeled back-compat series mirrors device 0 (bench peak col)
+        assert gauges["hbm.bytes_in_use"] == 100
+        assert gauges["hbm.peak_bytes"] == 200
+
+    def test_sample_mixed_reporting_devices(self, monkeypatch):
+        # a device mid-outage (stats -> {}) must not hide the others
+        class Dead:
+            def memory_stats(self):
+                raise RuntimeError("transport down")
+
+        class Live:
+            def memory_stats(self):
+                return {"bytes_in_use": 7}
+
+        monkeypatch.setattr(hbm, "_local_devices", lambda: [Dead(), Live()])
+        reg = MetricsRegistry()
+        stats = hbm.sample(reg)
+        assert stats == {}  # device 0 degraded
+        gauges = reg.snapshot()["gauges"]
+        assert "hbm.bytes_in_use{device=0}" not in gauges
+        assert gauges["hbm.bytes_in_use{device=1}"] == 7
+        assert "hbm.bytes_in_use" not in gauges  # unlabeled = device 0
+
+    def test_sample_records_counter_events(self, monkeypatch):
+        from raft_tpu.obs import trace
+
+        class Dev:
+            def memory_stats(self):
+                return {"bytes_in_use": 11, "peak_bytes_in_use": 13}
+
+        monkeypatch.setattr(hbm, "_local_devices", lambda: [Dev()])
+        buf = trace.EventBuffer()
+        hbm.sample(MetricsRegistry(), events=buf)
+        names = {e["name"] for e in buf.snapshot()}
+        assert "hbm.bytes_in_use{device=0}" in names
+
 
 class TestDeviceResourcesMetrics:
     def test_handle_hands_out_global_registry(self):
@@ -357,8 +414,55 @@ class TestStagedSearch:
 
 
 class TestNoOverheadWhenDisabled:
-    """ISSUE 1 acceptance: with observability disabled, the instrumented
-    search path adds no sync points and <2% wall-time overhead."""
+    """ISSUE 1 acceptance (extended by ISSUE 5 to the event recorder
+    and the instrumented collectives): with observability disabled, the
+    instrumented paths add no sync points, record no events, count no
+    comm traffic, and cost <2% wall time."""
+
+    def test_disabled_search_records_no_events(self, pq_index):
+        # ISSUE 5: the event-recording hook in span.__exit__ must stay
+        # behind the enable flag — a disabled search leaves the ring
+        # buffer untouched
+        from raft_tpu.obs import trace
+
+        idx, q = pq_index
+        assert not obs.enabled()
+        buf = trace.EventBuffer()
+        prev = trace.set_buffer(buf)
+        try:
+            ivf_pq.search(idx, q, 10,
+                          ivf_pq.SearchParams(n_probes=8,
+                                              scan_mode="per_query"))
+        finally:
+            trace.set_buffer(prev)
+        assert len(buf) == 0
+
+    def test_disabled_collectives_count_nothing(self):
+        # ISSUE 5: instrumented comms must be free when obs is off —
+        # no comms.* series appear anywhere, no events recorded
+        from jax.sharding import PartitionSpec as P
+
+        from raft_tpu.core.compat import shard_map
+        from raft_tpu.obs import trace
+        from raft_tpu.parallel import Comms, make_mesh
+
+        assert not obs.enabled()
+        mesh = make_mesh(axis_names=("shard",))
+        comms = Comms("shard")
+        buf = trace.EventBuffer()
+        prev = trace.set_buffer(buf)
+        try:
+            out = shard_map(
+                lambda v: comms.allgather(comms.allreduce(v)),
+                mesh=mesh, in_specs=(P("shard"),),
+                out_specs=P("shard", None), check_vma=False,
+            )(jnp.arange(8, dtype=jnp.float32))
+            jax.block_until_ready(out)
+        finally:
+            trace.set_buffer(prev)
+        counters = obs.get_registry().snapshot()["counters"]
+        assert not any(n.startswith("comms.") for n in counters), counters
+        assert len(buf) == 0
 
     def test_no_block_until_ready_from_span_code(self, monkeypatch,
                                                  pq_index):

@@ -1,0 +1,321 @@
+"""Event recording, Chrome-trace export, flight recorder, and the
+obsdump renderer (ISSUE 5 tentpole; see docs/observability.md)."""
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import threading
+import time
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from raft_tpu import obs
+from raft_tpu.core import tracing
+from raft_tpu.neighbors import ivf_pq
+from raft_tpu.obs import flight, trace
+from raft_tpu.obs.metrics import MetricsRegistry, quantile_from_state
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(autouse=True)
+def _obs_clean():
+    """Spans/registries/buffers are process-global — leave none behind."""
+    prev_buf = trace.set_buffer(trace.EventBuffer())
+    yield
+    obs.disable()
+    obs.get_registry().reset()
+    trace.set_buffer(prev_buf)
+    flight.uninstall()
+
+
+class TestEventBuffer:
+    def test_ring_evicts_oldest_and_counts_drops(self):
+        buf = trace.EventBuffer(capacity=4)
+        for i in range(7):
+            buf.record_span(f"s{i}", ts=float(i), dur=0.1)
+        assert len(buf) == 4
+        assert buf.dropped == 3
+        names = [e["name"] for e in buf.snapshot()]
+        assert names == ["s3", "s4", "s5", "s6"]  # oldest evicted
+        buf.clear()
+        assert len(buf) == 0 and buf.dropped == 0
+
+    def test_rejects_nonpositive_capacity(self):
+        with pytest.raises(ValueError):
+            trace.EventBuffer(capacity=0)
+
+    def test_thread_safety(self):
+        buf = trace.EventBuffer(capacity=10_000)
+
+        def work(tag):
+            for i in range(500):
+                buf.record_span(f"{tag}.{i}", ts=0.0, dur=0.0)
+
+        threads = [threading.Thread(target=work, args=(t,))
+                   for t in range(8)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert len(buf) == 4000
+
+    def test_counter_events(self):
+        buf = trace.EventBuffer()
+        buf.record_counter("hbm.bytes_in_use{device=0}", 123.0, ts=1.0)
+        (ev,) = buf.snapshot()
+        assert ev["ph"] == "C" and ev["value"] == 123.0 and ev["ts"] == 1.0
+
+
+class TestSpanEvents:
+    def test_spans_append_events_when_enabled(self):
+        buf = trace.get_buffer()
+        obs.enable(registry=MetricsRegistry(), hbm=False, events=True)
+        with tracing.span("search", labels={"leg": "hard"}):
+            with tracing.span("scan") as sp:
+                sp.annotate(probe=3)
+                time.sleep(0.002)
+        obs.disable()
+        events = buf.snapshot()
+        by_name = {e["name"]: e for e in events}
+        assert set(by_name) == {"search", "search.scan"}
+        scan = by_name["search.scan"]
+        assert scan["ph"] == "X"
+        assert scan["dur"] > 0 and scan["dur"] <= by_name["search"]["dur"]
+        assert scan["tid"] == threading.get_ident()
+        assert scan["args"] == {"probe": 3}
+        assert by_name["search"]["args"] == {"leg": "hard"}
+        # wall-clock begin ordering: outer starts before inner
+        assert by_name["search"]["ts"] <= scan["ts"] + 1e-6
+
+    def test_no_events_without_events_mode(self):
+        buf = trace.get_buffer()
+        obs.enable(registry=MetricsRegistry(), hbm=False)  # events OFF
+        with tracing.span("quiet"):
+            pass
+        obs.disable()
+        assert len(buf) == 0
+
+    def test_no_event_on_exception(self):
+        buf = trace.get_buffer()
+        obs.enable(registry=MetricsRegistry(), hbm=False, events=True)
+        with pytest.raises(RuntimeError):
+            with tracing.span("boom"):
+                raise RuntimeError("x")
+        obs.disable()
+        assert len(buf) == 0
+
+
+class TestChromeExport:
+    def _search_and_export(self, path):
+        rng = np.random.default_rng(0)
+        x = jnp.asarray(rng.random((2000, 32), dtype=np.float32))
+        idx = ivf_pq.build(x, ivf_pq.IndexParams(
+            n_lists=16, pq_dim=16, seed=0, cache_reconstruction="never"))
+        obs.enable(registry=MetricsRegistry(), hbm=False, events=True)
+        try:
+            ivf_pq.search(idx, x[:32], 5,
+                          ivf_pq.SearchParams(n_probes=4,
+                                              scan_mode="per_query"))
+        finally:
+            obs.disable()
+        return trace.export_chrome(str(path))
+
+    def test_schema_shape(self, tmp_path):
+        """Acceptance: the exported JSON is valid Chrome-trace schema
+        (loads in Perfetto): a traceEvents array of complete events with
+        name/ph/ts/dur/pid/tid, µs timestamps, per-thread metadata."""
+        out = tmp_path / "trace.json"
+        n = self._search_and_export(out)
+        assert n >= 1
+        with open(out) as f:
+            doc = json.load(f)
+        assert isinstance(doc["traceEvents"], list)
+        assert doc["displayTimeUnit"] == "ms"
+        phases = {e["ph"] for e in doc["traceEvents"]}
+        assert "X" in phases and "M" in phases
+        for e in doc["traceEvents"]:
+            assert isinstance(e["name"], str) and e["name"]
+            assert e["ph"] in ("X", "C", "M")
+            assert isinstance(e["pid"], int)
+            if e["ph"] == "X":
+                assert isinstance(e["ts"], float) and e["ts"] > 0
+                assert isinstance(e["dur"], float) and e["dur"] >= 0
+                assert isinstance(e["tid"], int)
+        names = {e["name"] for e in doc["traceEvents"] if e["ph"] == "X"}
+        assert "ivf_pq.search" in names, names
+        # one thread_name metadata track per tid seen
+        meta = [e for e in doc["traceEvents"]
+                if e["ph"] == "M" and e["name"] == "thread_name"]
+        assert meta and all(e["args"]["name"] for e in meta)
+
+    def test_merge_remaps_colliding_pids(self, tmp_path):
+        p1, p2 = tmp_path / "a.json", tmp_path / "b.json"
+        buf = trace.EventBuffer()
+        buf.record_span("w", ts=1.0, dur=0.5)
+        trace.export_chrome(str(p1), buf)
+        trace.export_chrome(str(p2), buf)  # same pid in both files
+        out = tmp_path / "merged.json"
+        doc = trace.merge([str(p1), str(p2)], out_path=str(out))
+        pids = {e["pid"] for e in doc["traceEvents"]}
+        assert len(pids) == 2, pids  # collision resolved
+        xs = [e for e in doc["traceEvents"] if e["ph"] == "X"]
+        assert len(xs) == 2
+        with open(out) as f:  # written file round-trips
+            assert json.load(f)["traceEvents"]
+
+    def test_obsdump_renders_tables(self, tmp_path):
+        """Acceptance: `python -m tools.obsdump <trace>` renders the
+        top-spans/comm-bytes/HBM tables from an instrumented search."""
+        out = tmp_path / "trace.json"
+        self._search_and_export(out)
+        p = subprocess.run(
+            [sys.executable, "-m", "tools.obsdump", str(out)],
+            capture_output=True, text=True, cwd=ROOT, timeout=120)
+        assert p.returncode == 0, p.stderr
+        assert "top spans by total time" in p.stdout
+        assert "ivf_pq.search" in p.stdout
+        assert "comm traffic by op x axis" in p.stdout
+        assert "HBM" in p.stdout
+
+
+class TestFlightRecorder:
+    def test_dump_contains_events_metrics_logs(self, tmp_path):
+        rec = flight.install(str(tmp_path), signals=(), use_atexit=False)
+        reg = MetricsRegistry()
+        obs.enable(registry=reg, hbm=False, events=True)
+        try:
+            with tracing.span("leg"):
+                pass
+            reg.inc("comms.ops", 2, labels={"op": "allreduce",
+                                            "axis": "shard"})
+            from raft_tpu.core import logging as _log
+
+            _log.warn("flight test line %d", 7)
+            path = rec.dump(reason="unit")
+        finally:
+            obs.disable()
+        with open(path) as f:
+            doc = json.load(f)
+        assert doc["schema"] == flight.SCHEMA
+        assert doc["reason"] == "unit"
+        assert doc["pid"] == os.getpid()
+        assert any(e["name"] == "leg" for e in doc["events"])
+        assert doc["metrics"]["counters"][
+            "comms.ops{axis=shard,op=allreduce}"] == 2.0
+        assert any("flight test line 7" in line for line in doc["logs"])
+        assert doc["uptime_s"] >= 0
+
+    def test_install_is_idempotent_and_dump_now_works(self, tmp_path):
+        rec = flight.install(str(tmp_path), signals=(), use_atexit=False)
+        assert flight.install("/elsewhere") is rec  # singleton wins
+        p = flight.dump_now(reason="now")
+        assert p and os.path.dirname(p) == str(tmp_path)
+        with open(p) as f:
+            assert json.load(f)["reason"] == "now"
+
+    def test_periodic_checkpoint(self, tmp_path):
+        rec = flight.FlightRecorder(str(tmp_path))
+        rec.start_periodic(0.05)
+        try:
+            latest = os.path.join(
+                str(tmp_path), f"flight_{os.getpid()}_latest.json")
+            deadline = time.time() + 5
+            while not os.path.exists(latest) and time.time() < deadline:
+                time.sleep(0.02)
+            assert os.path.exists(latest), "no periodic checkpoint in 5s"
+            with open(latest) as f:
+                assert json.load(f)["reason"] == "periodic"
+        finally:
+            rec.close()
+
+    def test_sigterm_leaves_parseable_dump_and_chains(self, tmp_path):
+        """Acceptance-shaped: a SIGTERM'd process leaves a parseable
+        flight_*.json, and the prior signal handler still runs (exit
+        path preserved)."""
+        code = (
+            "import sys, os, signal, time\n"
+            f"sys.path.insert(0, {ROOT!r})\n"
+            "def prior(num, frame):\n"
+            "    print('prior-handler', flush=True)\n"
+            "    os._exit(7)\n"
+            "signal.signal(signal.SIGTERM, prior)\n"
+            "from raft_tpu.obs import flight\n"
+            # every_s=0: an inherited RAFT_TPU_FLIGHT_EVERY_S would add
+            # periodic _latest.json dumps beside the signal one
+            f"flight.install({str(tmp_path)!r}, every_s=0)\n"
+            "print('armed', flush=True)\n"
+            "time.sleep(60)\n"
+        )
+        p = subprocess.Popen([sys.executable, "-c", code],
+                             stdout=subprocess.PIPE, text=True)
+        assert p.stdout.readline().strip() == "armed"
+        p.send_signal(signal.SIGTERM)
+        out, _ = p.communicate(timeout=30)
+        assert "prior-handler" in out  # chained to the previous handler
+        assert p.returncode == 7
+        docs = []
+        for name in sorted(os.listdir(tmp_path)):
+            if name.startswith("flight_") and name.endswith(".json"):
+                with open(os.path.join(str(tmp_path), name)) as f:
+                    docs.append(json.load(f))
+        signal_dumps = [d for d in docs
+                        if d["reason"].startswith("signal")]
+        assert signal_dumps, [d["reason"] for d in docs]
+        assert signal_dumps[0]["schema"] == flight.SCHEMA
+
+
+class TestQuantiles:
+    def test_histogram_quantile_interpolates(self):
+        h = obs.Histogram("lat", buckets=[0.01, 0.1, 1.0])
+        for v in (0.005, 0.02, 0.05, 0.5):
+            h.observe(v)
+        assert h.quantile(0.0) == pytest.approx(0.005)  # clamped to min
+        assert h.quantile(1.0) == pytest.approx(0.5)    # clamped to max
+        p50 = h.quantile(0.5)
+        assert 0.01 <= p50 <= 0.1  # rank 2 falls in the (0.01, 0.1] bucket
+        assert quantile_from_state(h.state(), 0.5) == pytest.approx(p50)
+
+    def test_quantile_empty_and_tail(self):
+        h = obs.Histogram("lat", buckets=[1.0])
+        assert h.quantile(0.5) is None
+        h.observe(5.0)  # lands in +inf bucket
+        assert h.quantile(0.99) == pytest.approx(5.0)
+
+    def test_quantile_from_jsonl_round_trip(self, tmp_path):
+        r = MetricsRegistry()
+        for v in (0.1, 0.2, 0.3, 4.0):
+            r.observe("lat", v)
+        path = str(tmp_path / "m.jsonl")
+        r.dump_jsonl(path)
+        (row,) = [x for x in obs.load_jsonl(path)
+                  if x["kind"] == "histogram"]
+        assert quantile_from_state(row, 0.99) == pytest.approx(4.0)
+
+
+class TestObsdumpFlight:
+    def test_renders_flight_dump_with_comms_and_hbm(self, tmp_path):
+        reg = MetricsRegistry()
+        reg.inc("comms.ops", 3, labels={"op": "allgather", "axis": "ici"})
+        reg.inc("comms.bytes", 4096,
+                labels={"op": "allgather", "axis": "ici"})
+        reg.gauge("hbm.bytes_in_use", {"device": "0"}).set(1 << 30)
+        reg.histogram("span.ivf_pq.search").observe(0.25)
+        rec = flight.install(str(tmp_path), signals=(), use_atexit=False)
+        obs.enable(registry=reg, hbm=False)
+        try:
+            path = rec.dump(reason="render")
+        finally:
+            obs.disable()
+        p = subprocess.run(
+            [sys.executable, "-m", "tools.obsdump", path, "--top", "5"],
+            capture_output=True, text=True, cwd=ROOT, timeout=120)
+        assert p.returncode == 0, p.stderr
+        assert "allgather" in p.stdout and "ici" in p.stdout
+        assert "4.0 KiB" in p.stdout
+        assert "ivf_pq.search" in p.stdout
+        assert "bytes_in_use" in p.stdout and "1.0 GiB" in p.stdout

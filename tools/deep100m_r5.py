@@ -20,8 +20,14 @@ sys.path.insert(0, "/root/repo")
 import numpy as np, jax, jax.numpy as jnp
 from raft_tpu.bench import dataset as dsm
 from raft_tpu.neighbors import ivf_pq, refine
+from raft_tpu.obs import flight
 
 ROOT = "/tmp/deep100m"
+# crash black box: SIGTERM (the watchdog's new grace kill) / SIGALRM /
+# atexit dump the span ring + registry + logs; RAFT_TPU_FLIGHT_EVERY_S
+# adds periodic checkpoints that even a SIGKILL can't erase
+_rec = flight.install(os.path.join(ROOT, "flight"))
+print(f"flight recorder armed (dir={_rec.dump_dir})", flush=True)
 IDX = os.path.join(ROOT, "pq.idx")
 GT10K = os.path.join(ROOT, "gt10k.npy")
 RES = os.path.join(ROOT, "results_r5.json")

@@ -19,7 +19,7 @@ MODULES = [
     "raft_tpu.core.bitset", "raft_tpu.core.interruptible",
     "raft_tpu.core.serialize",
     "raft_tpu.obs.metrics", "raft_tpu.obs.spans", "raft_tpu.obs.hbm",
-    "raft_tpu.obs.sanitize",
+    "raft_tpu.obs.trace", "raft_tpu.obs.flight", "raft_tpu.obs.sanitize",
     "raft_tpu.linalg.blas", "raft_tpu.linalg.solvers",
     "raft_tpu.linalg.eltwise", "raft_tpu.linalg.map_reduce",
     "raft_tpu.matrix.select_k", "raft_tpu.matrix.ops",

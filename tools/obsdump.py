@@ -1,0 +1,303 @@
+"""obsdump — render flight dumps, metric JSONL, and Chrome traces as tables.
+
+The converter between the observability layer's machine artifacts and
+the numbers a human needs during triage (the round-5 verdict: "QPS
+numbers nobody could decompose"). Input formats are sniffed:
+
+- ``flight_*.json``  — :mod:`raft_tpu.obs.flight` dumps (metrics
+  snapshot + event ring + logs),
+- ``*.jsonl``        — ``MetricsRegistry.dump_jsonl`` series files
+  (the ``RAFT_TPU_BENCH_OBS_JSONL`` sink),
+- Chrome-trace JSON  — :func:`raft_tpu.obs.trace.export_chrome` output
+  (or anything with a ``traceEvents`` array).
+
+Rendered tables: top spans by total time (count/total/mean/p50/p99),
+comm traffic by op × axis (``comms.ops``/``comms.bytes``), and HBM
+gauges (per-device when labeled). ``--merge`` merges multiple
+per-process Chrome traces into one Perfetto-loadable timeline.
+
+Usage::
+
+    python -m tools.obsdump flight_20260803-120000_123.json
+    python -m tools.obsdump trace_host0.json trace_host1.json --merge all.json
+    python -m tools.obsdump bench_obs.jsonl --top 30
+
+Stdlib + raft_tpu.obs only — runs device-free (no jax import needed to
+read a dump).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import re
+import sys
+from typing import Any, Dict, List, Optional, Tuple
+
+_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _REPO_ROOT)
+
+
+def _load_obs_module(name: str):
+    """Import an obs module WITHOUT jax: the package route
+    (``raft_tpu.obs.*``) runs ``raft_tpu/__init__`` which imports jax —
+    fine in a dev checkout, fatal on a jax-less triage host reading a
+    dump. The obs modules used here (metrics, trace) are stdlib-only,
+    so fall back to loading them straight from their files."""
+    try:
+        import importlib
+
+        return importlib.import_module(f"raft_tpu.obs.{name}")
+    except ImportError:
+        import importlib.util
+
+        path = os.path.join(_REPO_ROOT, "raft_tpu", "obs", f"{name}.py")
+        spec = importlib.util.spec_from_file_location(
+            f"_obsdump_{name}", path)
+        mod = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(mod)
+        return mod
+
+
+quantile_from_state = _load_obs_module("metrics").quantile_from_state
+
+_KEY_RE = re.compile(r"^(?P<name>[^{]+)(?:\{(?P<labels>.*)\})?$")
+
+
+def parse_key(key: str) -> Tuple[str, Dict[str, str]]:
+    """Split a rendered series key (``name{k=v,k2=v2}``) back into
+    (name, labels)."""
+    m = _KEY_RE.match(key)
+    if not m:
+        return key, {}
+    labels: Dict[str, str] = {}
+    if m.group("labels"):
+        for part in m.group("labels").split(","):
+            k, _, v = part.partition("=")
+            labels[k] = v
+    return m.group("name"), labels
+
+
+def _human_bytes(n: float) -> str:
+    for unit in ("B", "KiB", "MiB", "GiB", "TiB"):
+        if abs(n) < 1024 or unit == "TiB":
+            return f"{n:,.1f} {unit}" if unit != "B" else f"{int(n)} B"
+        n /= 1024
+    return f"{n:,.1f} TiB"
+
+
+def _ms(v: Optional[float]) -> str:
+    return "-" if v is None else f"{v * 1e3:,.2f}"
+
+
+def _table(headers: List[str], rows: List[List[str]]) -> str:
+    if not rows:
+        return "  (no data)\n"
+    widths = [max(len(h), *(len(r[i]) for r in rows))
+              for i, h in enumerate(headers)]
+    out = ["  " + "  ".join(h.ljust(w) for h, w in zip(headers, widths)),
+           "  " + "  ".join("-" * w for w in widths)]
+    for r in rows:
+        out.append("  " + "  ".join(c.ljust(w) for c, w in zip(r, widths)))
+    return "\n".join(out) + "\n"
+
+
+# ---------------------------------------------------------------------------
+# normalization: every input becomes {"counters": {key: v}, "gauges": ...,
+# "histograms": {key: state}} — the MetricsRegistry.snapshot() shape
+# ---------------------------------------------------------------------------
+
+def _render_key(name: str, labels: Dict[str, str]) -> str:
+    if not labels:
+        return name
+    inner = ",".join(f"{k}={v}" for k, v in sorted(labels.items()))
+    return f"{name}{{{inner}}}"
+
+
+def _from_jsonl(rows: List[Dict[str, Any]]) -> Dict[str, Any]:
+    snap: Dict[str, Any] = {"counters": {}, "gauges": {}, "histograms": {}}
+    for r in rows:
+        key = _render_key(r.get("name", "?"), r.get("labels") or {})
+        kind = r.get("kind")
+        if kind == "counter":
+            snap["counters"][key] = snap["counters"].get(key, 0.0) \
+                + r.get("value", 0.0)
+        elif kind == "gauge":
+            snap["gauges"][key] = r.get("value", 0.0)
+        elif kind == "histogram":
+            snap["histograms"][key] = {
+                k: r.get(k) for k in
+                ("count", "sum", "min", "max", "mean", "buckets")}
+    return snap
+
+
+def _from_trace_events(events: List[Dict[str, Any]]) -> Dict[str, Any]:
+    """Aggregate Chrome-trace events into the snapshot shape: X events
+    fold into pseudo-histogram states (count/sum/min/max — no buckets,
+    so p50/p99 render as '-'), C events into gauges (last value, plus a
+    .max companion for peaks)."""
+    spans: Dict[str, Dict[str, Any]] = {}
+    gauges: Dict[str, float] = {}
+    for e in events:
+        ph = e.get("ph")
+        if ph == "X":
+            dur = float(e.get("dur", 0.0)) / 1e6  # µs → s
+            st = spans.setdefault("span." + e.get("name", "?"), {
+                "count": 0, "sum": 0.0, "min": None, "max": None,
+                "mean": None, "buckets": {}})
+            st["count"] += 1
+            st["sum"] += dur
+            st["min"] = dur if st["min"] is None else min(st["min"], dur)
+            st["max"] = dur if st["max"] is None else max(st["max"], dur)
+            st["mean"] = st["sum"] / st["count"]
+        elif ph == "C":
+            v = float((e.get("args") or {}).get("value", 0.0))
+            name = e.get("name", "?")
+            gauges[name] = v
+            peak = gauges.get(name + ".seen_max")
+            gauges[name + ".seen_max"] = v if peak is None else max(peak, v)
+    return {"counters": {}, "gauges": gauges, "histograms": spans}
+
+
+def load_any(path: str) -> Tuple[str, Dict[str, Any], Dict[str, Any]]:
+    """Sniff + load one input file → (kind, snapshot, raw_doc)."""
+    with open(path) as f:
+        head = f.read(1)
+        f.seek(0)
+        if path.endswith(".jsonl") or (head == "{" and _looks_jsonl(f)):
+            rows = [json.loads(line) for line in f if line.strip()]
+            return "jsonl", _from_jsonl(rows), {"rows": rows}
+        doc = json.load(f)
+    if isinstance(doc, list) or "traceEvents" in doc:
+        events = doc if isinstance(doc, list) else doc.get("traceEvents", [])
+        return "trace", _from_trace_events(events), \
+            doc if isinstance(doc, dict) else {"traceEvents": doc}
+    if "metrics" in doc:  # flight dump: snapshot + its own event ring
+        snap = {k: dict(doc["metrics"].get(k, {}))
+                for k in ("counters", "gauges", "histograms")}
+        ev = _from_trace_events([
+            {**e, "dur": e.get("dur", 0.0) * 1e6,
+             "args": {"value": e.get("value", 0.0)}}
+            for e in doc.get("events", [])])
+        # span aggregates from the ring only fill holes the registry
+        # snapshot (authoritative: it has buckets) doesn't cover
+        for key, st in ev["histograms"].items():
+            snap["histograms"].setdefault(key, st)
+        return "flight", snap, doc
+    return "unknown", {"counters": {}, "gauges": {}, "histograms": {}}, doc
+
+
+def _looks_jsonl(f) -> bool:
+    pos = f.tell()
+    first = f.readline()
+    second = f.readline()
+    f.seek(pos)
+    if not second.strip():
+        return False
+    try:
+        json.loads(first)
+        json.loads(second)
+        return True
+    except ValueError:
+        return False
+
+
+# ---------------------------------------------------------------------------
+# tables
+# ---------------------------------------------------------------------------
+
+def spans_table(snap: Dict[str, Any], top: int) -> str:
+    rows = []
+    for key, st in snap["histograms"].items():
+        name, _ = parse_key(key)
+        if not name.startswith("span.") or not st.get("count"):
+            continue
+        rows.append((st["sum"], [
+            name[len("span."):],
+            str(st["count"]),
+            f"{st['sum']:.4f}",
+            _ms(st.get("mean")),
+            _ms(quantile_from_state(st, 0.5) if st.get("buckets") else None),
+            _ms(quantile_from_state(st, 0.99) if st.get("buckets") else None),
+        ]))
+    rows.sort(key=lambda r: -r[0])
+    return _table(["span", "count", "total_s", "mean_ms", "p50_ms",
+                   "p99_ms"], [r for _, r in rows[:top]])
+
+
+def comms_table(snap: Dict[str, Any]) -> str:
+    traffic: Dict[Tuple[str, str], Dict[str, float]] = {}
+    for key, v in snap["counters"].items():
+        name, labels = parse_key(key)
+        if name not in ("comms.ops", "comms.bytes"):
+            continue
+        slot = traffic.setdefault(
+            (labels.get("op", "?"), labels.get("axis", "?")),
+            {"ops": 0.0, "bytes": 0.0})
+        slot["ops" if name == "comms.ops" else "bytes"] += v
+    rows = [[op, axis, f"{int(t['ops'])}", _human_bytes(t["bytes"])]
+            for (op, axis), t in sorted(
+                traffic.items(), key=lambda kv: -kv[1]["bytes"])]
+    return _table(["collective", "axis", "ops", "payload"], rows)
+
+
+def hbm_table(snap: Dict[str, Any]) -> str:
+    rows = []
+    for key, v in sorted(snap["gauges"].items()):
+        name, labels = parse_key(key)
+        if not name.startswith("hbm.") or name.endswith(".seen_max"):
+            continue
+        rows.append([name[len("hbm."):], labels.get("device", "-"),
+                     _human_bytes(v)])
+    return _table(["gauge", "device", "value"], rows)
+
+
+def render(path: str, top: int) -> str:
+    kind, snap, raw = load_any(path)
+    out = [f"== {path} ({kind}) =="]
+    if kind == "flight":
+        out.append(f"  reason={raw.get('reason')} pid={raw.get('pid')} "
+                   f"host={raw.get('host')} time={raw.get('time')} "
+                   f"uptime={raw.get('uptime_s')}s "
+                   f"events={len(raw.get('events', []))} "
+                   f"(+{raw.get('dropped_events', 0)} dropped) "
+                   f"log_lines={len(raw.get('logs', []))}")
+    out.append("-- top spans by total time --")
+    out.append(spans_table(snap, top))
+    out.append("-- comm traffic by op x axis --")
+    out.append(comms_table(snap))
+    out.append("-- HBM --")
+    out.append(hbm_table(snap))
+    return "\n".join(out)
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="obsdump", description=__doc__.splitlines()[0])
+    ap.add_argument("paths", nargs="+",
+                    help="flight dump / metrics .jsonl / Chrome trace")
+    ap.add_argument("--top", type=int, default=20,
+                    help="rows in the span table (default 20)")
+    ap.add_argument("--merge", metavar="OUT",
+                    help="merge the inputs as Chrome traces into OUT "
+                         "(pid-remapped, Perfetto-loadable) instead of "
+                         "rendering tables")
+    args = ap.parse_args(argv)
+    if args.merge:
+        _trace = _load_obs_module("trace")
+        doc = _trace.merge(args.paths, out_path=args.merge)
+        print(f"merged {len(args.paths)} traces "
+              f"({len(doc['traceEvents'])} events) -> {args.merge}")
+        return 0
+    try:
+        for p in args.paths:
+            print(render(p, args.top))
+    except BrokenPipeError:  # downstream `| head` closed the pipe
+        devnull = os.open(os.devnull, os.O_WRONLY)
+        os.dup2(devnull, sys.stdout.fileno())
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -2,8 +2,13 @@
 # Stall watchdog for long tunnel-RPC jobs (they can wedge silently:
 # r5 measured an index upload parked at ~1 CPU tick/30 s). Restarts
 # the command when its CPU time stops advancing for STALL_MIN minutes.
+# Kills escalate SIGTERM -> ${WATCHDOG_GRACE_S:-30}s grace -> SIGKILL,
+# so the child's flight recorder / partial-record handlers get to flush
+# before the restart (the round-5 outage left NO dump because the
+# watchdog went straight to kill -9).
 # Usage: run_watchdog.sh LOGFILE MAX_RESTARTS STALL_MIN CMD...
 LOG=$1; MAXR=$2; STALL_MIN=$3; shift 3
+GRACE=${WATCHDOG_GRACE_S:-30}
 for attempt in $(seq 0 "$MAXR"); do
   "$@" >> "$LOG" 2>&1 &
   PID=$!
@@ -20,8 +25,23 @@ for attempt in $(seq 0 "$MAXR"); do
     if [ "$cpu" = "$last_cpu" ]; then idle=$((idle+1)); else idle=0; fi
     last_cpu=$cpu
     if [ $idle -ge "$STALL_MIN" ]; then
-      echo "[watchdog] stalled ${STALL_MIN}m — killing $PID" >> "$LOG"
-      kill -9 $PID 2>/dev/null
+      echo "[watchdog] stalled ${STALL_MIN}m — SIGTERM $PID (grace ${GRACE}s)" >> "$LOG"
+      kill -TERM $PID 2>/dev/null
+      waited=0
+      while kill -0 $PID 2>/dev/null && [ $waited -lt "$GRACE" ]; do
+        # an exited-but-unreaped child is done flushing — stop waiting
+        state=$(awk '{print $3}' /proc/$PID/stat 2>/dev/null || echo "")
+        [ "$state" = "Z" ] && break
+        sleep 1; waited=$((waited+1))
+      done
+      # kill -0 also succeeds on a zombie (exited, flushed, unreaped):
+      # re-check the state so the log never claims a SIGKILL cut off a
+      # dump that actually completed
+      state=$(awk '{print $3}' /proc/$PID/stat 2>/dev/null || echo "")
+      if [ -n "$state" ] && [ "$state" != "Z" ]; then
+        echo "[watchdog] no exit after ${GRACE}s grace — SIGKILL $PID" >> "$LOG"
+        kill -9 $PID 2>/dev/null
+      fi
       break
     fi
   done

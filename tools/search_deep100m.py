@@ -6,8 +6,11 @@ sys.path.insert(0, "/root/repo")
 import numpy as np, jax, jax.numpy as jnp
 from raft_tpu.bench import dataset as dsm
 from raft_tpu.neighbors import ivf_pq, refine
+from raft_tpu.obs import flight
 
 ROOT = "/tmp/deep100m"
+_rec = flight.install(os.path.join(ROOT, "flight"))
+print(f"flight recorder armed (dir={_rec.dump_dir})", flush=True)
 NQ = 10_000
 gt = np.load(os.path.join(ROOT, "gt.npy"))
 base_i8 = dsm.bin_memmap(os.path.join(ROOT, "base_i8.fbin"), np.int8)
