@@ -22,16 +22,21 @@ echo "== graftlint static analysis (blocking; CPU-only, no device) =="
 # cache-bust-proof by construction: a pure-stdlib AST pass over the
 # tree — no XLA compile cache, no pytest cache, no device backend, so
 # it cannot go stale or flake with the environment. Zero unsuppressed
-# findings is the gate (tools/graftlint, docs/developer_guide.md).
-python -m tools.graftlint raft_tpu
+# findings is the gate (tools/graftlint, docs/developer_guide.md);
+# covers GL01–GL05 plus the SPMD/DMA pass GL06–GL10. The JSON report
+# is the CI artifact (per-finding rule/path/line).
+python -m tools.graftlint raft_tpu --report /tmp/graftlint_report.json
+echo "graftlint report artifact: /tmp/graftlint_report.json"
 
 echo "== raft_tpu unit+integration tests (8-device CPU mesh) =="
 python -m pytest tests/ -q "$@"
 
 echo "== sanitizer-mode subset (RAFT_TPU_SANITIZE=1: rank-promotion raise"
-echo "   + debug_nans + transfer guards + recompile budgets) =="
+echo "   + debug_nans + transfer guards + recompile budgets + the"
+echo "   collective-schedule checker over the parallel/distributed suites) =="
 RAFT_TPU_SANITIZE=1 python -m pytest \
     tests/test_sanitize.py tests/test_graftlint.py tests/test_core.py \
+    tests/test_parallel.py tests/test_parallel_ivf.py \
     -q -p no:cacheprovider
 
 echo "== driver contract: entry() compiles, dryrun_multichip(8) executes =="

@@ -22,6 +22,19 @@ compute-sanitizer (RAFT ci/test.sh) :
   wraps its steady-state calls in ``recompile_budget(0)`` and an
   unexpected retrace fails loudly with the count, instead of costing
   seconds per call in production three PRs later.
+- :func:`assert_uniform_collective_schedule` /
+  :func:`collective_schedule` — the collective-schedule checker, the
+  runtime complement of graftlint's SPMD pass (GL06–GL10): traces a
+  program on the 8-device CPU mesh, derives each device's sequence of
+  collectives, and raises :class:`CollectiveScheduleDivergence` when
+  the schedules can differ across devices (a collective issued in only
+  some branches of an ``axis_index``-gated ``lax.cond``/``switch`` —
+  exactly the class the AST pass cannot prove absent, and the class
+  that deadlocks a real v5e mesh while CPU tests stay green).
+- :func:`record_comms_schedule` — records the trace-time sequence of
+  comms-facade calls (verb, axis, payload bytes) per traced program,
+  so tests can assert WHAT schedule a distributed entry point commits
+  every device to.
 
 Everything here is import-cheap: jax is only imported when a guard is
 actually used, and the monitoring listener is installed once on first
@@ -132,3 +145,160 @@ def apply_sanitize_config() -> None:
 def sanitize_enabled() -> bool:
     """True when the suite runs in ``RAFT_TPU_SANITIZE=1`` mode."""
     return env_flag("RAFT_TPU_SANITIZE")
+
+
+# ---------------------------------------------------------------------------
+# collective-schedule checker — the runtime half of graftlint GL06–GL10
+# ---------------------------------------------------------------------------
+
+class CollectiveScheduleDivergence(RuntimeError):
+    """A traced program's collective schedule can differ across devices
+    (a collective appears in only some branches of conditional control
+    flow) — the SPMD deadlock/corruption class on a real mesh."""
+
+
+# Collective primitive base names; version-tolerant prefix matching
+# (psum lowers as psum/psum2/psum_invariant depending on jax version).
+# Longest-first so psum_scatter is not swallowed by psum. axis_index is
+# deliberately absent: it carries no payload and cannot deadlock.
+_COLLECTIVE_BASES = (
+    "reduce_scatter", "psum_scatter", "all_gather", "all_to_all",
+    "ppermute", "pshuffle", "pgather", "pmax", "pmin", "pmean", "psum",
+)
+
+
+def _collective_base(prim_name: str):
+    for base in _COLLECTIVE_BASES:
+        if prim_name.startswith(base):
+            return base
+    return None
+
+
+def _eqn_axes(params) -> tuple:
+    axes = params.get("axes", params.get("axis_name"))
+    if axes is None:
+        return ()
+    if not isinstance(axes, (tuple, list)):
+        axes = (axes,)
+    return tuple(str(a) for a in axes)
+
+
+def _jaxpr_like(v):
+    """Yield raw jaxprs found in an eqn-param value (Jaxpr, ClosedJaxpr,
+    or containers of them)."""
+    if hasattr(v, "eqns"):
+        yield v
+    elif hasattr(v, "jaxpr") and hasattr(getattr(v, "jaxpr"), "eqns"):
+        yield v.jaxpr
+    elif isinstance(v, (tuple, list)):
+        for item in v:
+            yield from _jaxpr_like(item)
+
+
+def _render_schedule(sched) -> str:
+    if not sched:
+        return "(no collectives)"
+    return ", ".join(
+        f"{e[0]}@{','.join(e[1])}{list(e[2])}" if len(e) == 3
+        else f"{e[0]}[{_render_schedule(e[1])}]" for e in sched)
+
+
+def _jaxpr_schedule(jaxpr) -> tuple:
+    """Depth-first collective schedule of one jaxpr. ``cond``/``switch``
+    branches must commit to IDENTICAL schedules — a device-dependent
+    predicate then cannot change what any device executes, which is the
+    across-devices uniformity the checker asserts. Loop bodies
+    (while/scan) are wrapped as nested entries: their schedule is
+    uniform per iteration; trip counts driven by collective-reduced
+    values are uniform by construction."""
+    sched = []
+    for eqn in jaxpr.eqns:
+        name = eqn.primitive.name
+        base = _collective_base(name)
+        if base is not None:
+            shapes = tuple(str(getattr(v, "aval", v)) for v in eqn.invars)
+            sched.append((base, _eqn_axes(eqn.params), shapes))
+            continue
+        branches = eqn.params.get("branches") if eqn.params else None
+        if branches is not None:
+            scheds = [_jaxpr_schedule(b) for bb in branches
+                      for b in _jaxpr_like(bb)]
+            if any(s != scheds[0] for s in scheds[1:]):
+                detail = "\n".join(
+                    f"  branch {i}: {_render_schedule(s)}"
+                    for i, s in enumerate(scheds))
+                raise CollectiveScheduleDivergence(
+                    f"collective schedule diverges across {name} "
+                    f"branches — devices taking different branches "
+                    f"would disagree on which collectives run "
+                    f"(deadlock/zero-fill on a real mesh):\n{detail}")
+            if scheds:
+                sched.extend(scheds[0])
+            continue
+        for sub in _jaxpr_like(list((eqn.params or {}).values())):
+            inner = _jaxpr_schedule(sub)
+            if not inner:
+                continue
+            if name in ("while", "scan"):
+                sched.append((name, inner))
+            else:
+                sched.extend(inner)
+    return tuple(sched)
+
+
+def collective_schedule(fn, *args, **kwargs) -> tuple:
+    """Trace ``fn(*args, **kwargs)`` (no execution) and return its
+    device-uniform collective schedule as a tuple of
+    ``(verb, axes, input_avals)`` entries (loops nest as
+    ``("while"|"scan", inner)``). Raises
+    :class:`CollectiveScheduleDivergence` when conditional branches
+    commit different devices to different schedules."""
+    import jax
+
+    closed = jax.make_jaxpr(fn)(*args, **kwargs)
+    return _jaxpr_schedule(closed.jaxpr)
+
+
+def assert_uniform_collective_schedule(fn, *args, **kwargs) -> tuple:
+    """Alias of :func:`collective_schedule` named for its assertion:
+    use in tests to gate distributed entry points in the
+    ``RAFT_TPU_SANITIZE=1`` lane."""
+    return collective_schedule(fn, *args, **kwargs)
+
+
+# -- comms-facade schedule recorder -----------------------------------------
+
+_comms_schedule: Optional[list] = None
+
+
+def comms_schedule_recording() -> bool:
+    """True while a :func:`record_comms_schedule` scope is active (one
+    module-global read — the facade's fast-path guard)."""
+    return _comms_schedule is not None
+
+
+def note_collective(verb: str, axis: str, nbytes: int) -> None:
+    """Hook called by ``parallel.comms.Comms`` at trace time, once per
+    collective per traced program (the same per-trace semantics as the
+    ``comms.ops`` counters)."""
+    rec = _comms_schedule
+    if rec is not None:
+        rec.append((verb, axis, int(nbytes)))
+
+
+@contextlib.contextmanager
+def record_comms_schedule() -> Iterator[list]:
+    """Record the trace-time sequence of comms-facade calls —
+    ``(verb, axis, payload_bytes)`` per collective, in program order.
+    Under SPMD every device executes the one traced program, so this IS
+    each device's schedule; pair with
+    :func:`assert_uniform_collective_schedule` to also rule out
+    conditionally-divergent collectives the recorder (which sees both
+    branches at trace time) cannot distinguish."""
+    global _comms_schedule
+    prev = _comms_schedule
+    _comms_schedule = rec = []
+    try:
+        yield rec
+    finally:
+        _comms_schedule = prev

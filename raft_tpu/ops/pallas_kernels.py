@@ -892,7 +892,7 @@ def _gather_refine_kernel(q_ref, cand_ref, cand_hbm, data_hbm,
         vals_ref[:] = jnp.full_like(vals_ref, jnp.inf)
         ids_ref[:] = jnp.full_like(ids_ref, -1)
 
-    # 1. candidate ids HBM→SMEM
+    # 1. candidate ids HBM→SMEM (start/wait paired inline — GL08)
     cp = pltpu.make_async_copy(
         cand_hbm.at[pl.ds(i * bq, bq), pl.ds(jc * bc, bc)],
         ids_smem, sem_ids)
@@ -902,7 +902,10 @@ def _gather_refine_kernel(q_ref, cand_ref, cand_hbm, data_hbm,
     # 2. candidate rows HBM→VMEM, NBUF in flight. The wait recomputes
     # the identical copy descriptor (the documented double-buffer
     # idiom); a slot is always waited before its next start so two
-    # copies never share a live semaphore.
+    # copies never share a live semaphore — the graftlint GL08 lifetime
+    # contract (the linter verifies the factory's starts all have
+    # waits; the t/t+NBUF slot rotation below is the hand-managed part
+    # it cannot prove, hence this invariant comment).
     def row_copy(t):
         qq = t // bc
         rr = jax.lax.rem(t, bc)

@@ -46,6 +46,7 @@ from jax import lax
 
 from raft_tpu.core.compat import axis_size as _axis_size
 from raft_tpu.core.tracing import annotate as _annotate
+from raft_tpu.obs import sanitize as _sanitize
 from raft_tpu.obs import spans as _obs
 
 
@@ -128,7 +129,12 @@ class Comms:
         ``comms.ops`` / ``comms.bytes`` labeled ``{op=...,axis=...}``.
         Runs at trace time from static shape/dtype only — once per jit
         trace (the obs.count_dispatch semantics), zero host syncs, one
-        flag check when observability is off."""
+        flag check when observability is off. The sanitize lane's
+        collective-schedule recorder taps the same per-trace event."""
+        if _sanitize.comms_schedule_recording():
+            _sanitize.note_collective(op_name,
+                                      _axis_label(self.axis_name),
+                                      _payload_bytes(*arrays))
         if not _obs.enabled():
             return
         labels = {"op": op_name, "axis": _axis_label(self.axis_name)}

@@ -36,7 +36,6 @@ import flax.struct
 import jax
 import jax.numpy as jnp
 import numpy as np
-from jax import lax
 
 from raft_tpu.core.compat import shard_map
 from jax.sharding import Mesh, PartitionSpec as P
@@ -152,9 +151,10 @@ def _gather_trainset(x: jax.Array, mesh: Mesh, axis: str, t: int,
     rather than per-shard (which would overweight short shards)."""
     n_dev = mesh.shape[axis]
     total = n_dev * t
+    comms = Comms(axis)
 
     def local(x_shard):
-        rank = lax.axis_index(axis)
+        rank = comms.get_rank()
         shard_n = x_shard.shape[0]
         key = jax.random.PRNGKey(seed)  # identical on every shard
         gidx = jax.random.randint(key, (total,), 0, n_real)
@@ -162,7 +162,7 @@ def _gather_trainset(x: jax.Array, mesh: Mesh, axis: str, t: int,
         owned = (local_idx >= 0) & (local_idx < shard_n)
         rows = x_shard[jnp.clip(local_idx, 0, shard_n - 1)]
         contrib = jnp.where(owned[:, None], rows, 0.0)
-        return Comms(axis).allreduce(contrib)
+        return comms.allreduce(contrib)
 
     fn = shard_map(local, mesh=mesh, in_specs=(P(axis, None),),
                    out_specs=P(), check_vma=False)
@@ -239,10 +239,11 @@ def build_ivf_pq(params: _pq.IndexParams, dataset: jax.Array, mesh: Mesh,
     avg = max(1, shard_n // params.n_lists)
     L = max(8, -(-int(avg * params.list_size_cap_factor) // 8) * 8)
     n_lists = params.n_lists
+    comms = Comms(axis)
 
     def encode_pack(x_blk, centers, centers_rot, rotation, codebooks):
         xs = x_blk
-        rank = lax.axis_index(axis)
+        rank = comms.get_rank()
         gid = rank * shard_n + jnp.arange(shard_n, dtype=jnp.int32)
         _, labels = fused_l2_nn_argmin(xs, centers)
         labels = jnp.where(gid < n_real, labels, n_lists)  # drop pad rows
@@ -335,9 +336,10 @@ def build_ivf_flat(params: _flat.IndexParams, dataset: jax.Array, mesh: Mesh,
 
     avg = max(1, shard_n // n_lists)
     L = max(8, -(-int(avg * params.list_size_cap_factor) // 8) * 8)
+    comms = Comms(axis)
 
     def assign_pack(x_blk, centers):
-        rank = lax.axis_index(axis)
+        rank = comms.get_rank()
         gid = rank * shard_n + jnp.arange(shard_n, dtype=jnp.int32)
         _, labels = fused_l2_nn_argmin(x_blk, centers)
         labels = jnp.where(gid < n_real, labels, n_lists)

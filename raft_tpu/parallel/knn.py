@@ -14,7 +14,6 @@ from typing import Tuple
 
 import jax
 import jax.numpy as jnp
-from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from raft_tpu.core.compat import shard_map
 
@@ -62,7 +61,7 @@ def sharded_knn(
     comms = Comms(axis)  # counted collectives (comms.ops/comms.bytes)
 
     def local_search(ds_shard, q):
-        rank = lax.axis_index(axis)
+        rank = comms.get_rank()
         idx = brute_force.build(ds_shard, metric=mt)
         vals, ids = brute_force.knn(idx, q, k)
         gids = ids.astype(jnp.int32) + rank.astype(jnp.int32) * shard_size

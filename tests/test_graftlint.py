@@ -253,6 +253,271 @@ def test_gl05_fires_on_lane_tiling_memory_space_and_gather():
 
 
 # ---------------------------------------------------------------------------
+# GL06 — collective scope / axis consistency
+# ---------------------------------------------------------------------------
+
+GL06_BAD = """
+from jax import lax
+from jax.sharding import Mesh, PartitionSpec as P
+from raft_tpu.core.compat import shard_map
+from raft_tpu.parallel.comms import Comms
+
+
+def merge(x):
+    comms = Comms("shards")          # typo: the mesh binds "shard"
+    return comms.allreduce(x)
+
+
+def helper(x, axis="shard"):
+    return lax.psum(x, axis)         # right axis, never shard_mapped
+
+
+def run(x, mesh, axis="shard"):
+    fn = shard_map(lambda v: v, mesh=mesh, in_specs=(P(axis),),
+                   out_specs=P(axis), check_vma=False)
+    return fn(x)
+"""
+
+GL06_GOOD = """
+from jax.sharding import PartitionSpec as P
+from raft_tpu.core.compat import shard_map
+from raft_tpu.parallel.comms import Comms
+
+
+def merge(vals, axis):
+    comms = Comms(axis)              # axis-generic helper: undecidable
+    return comms.allgather(vals)
+
+
+def run(x, mesh, axis="shard"):
+    comms = Comms(axis)
+
+    def local(v):
+        return comms.allreduce(merge(v, axis))
+
+    fn = shard_map(local, mesh=mesh, in_specs=(P(axis),),
+                   out_specs=P(), check_vma=False)
+    return fn(x)
+"""
+
+
+def test_gl06_fires_on_unbound_axis_and_unwrapped_collective():
+    findings = [f for f in lint(GL06_BAD) if f.rule == "GL06"]
+    msgs = " | ".join(f.message for f in findings)
+    assert len(findings) == 3
+    assert "not bound by any mesh/axis declaration" in msgs
+    assert "never wrapped in (or called from) shard_map" in msgs
+
+
+def test_gl06_quiet_on_wrapped_and_axis_generic_code():
+    assert not [f for f in lint(GL06_GOOD) if f.rule == "GL06"]
+
+
+# ---------------------------------------------------------------------------
+# GL07 — static ppermute perms
+# ---------------------------------------------------------------------------
+
+GL07_BAD = """
+from jax import lax
+
+
+def bad_src(x):
+    return lax.ppermute(x, "shard", perm=[(0, 1), (0, 2), (1, 0)])
+
+
+def bad_dup(x):
+    return lax.ppermute(x, "shard", perm=[(0, 1), (1, 1), (2, 0)])
+
+
+def bad_drop(x):
+    # shift without wraparound: rank 0 silently receives zeros
+    return lax.ppermute(x, "shard", perm=[(0, 1), (1, 2), (2, 3)])
+
+
+def ring_exchange(x, comms):
+    perm = [(0, 1), (1, 0), (2, 3), (3, 2)]  # two 2-cycles, no ring
+    return comms.ppermute(x, perm)
+"""
+
+GL07_GOOD = """
+from jax import lax
+
+
+def ring_step(x, comms):
+    return comms.ppermute(x, [(0, 1), (1, 2), (2, 3), (3, 0)])
+
+
+def dynamic(x, comms, size):
+    perm = [(i, (i + 1) % size) for i in range(size)]  # not static
+    return comms.ppermute(x, perm)
+"""
+
+
+def test_gl07_fires_on_non_permutations_and_open_rings():
+    findings = [f for f in lint(GL07_BAD) if f.rule == "GL07"]
+    msgs = " | ".join(f.message for f in findings)
+    assert len(findings) == 4
+    assert "duplicate source" in msgs
+    assert "not injective" in msgs
+    assert "ZERO-FILLS" in msgs
+    assert "single cycle" in msgs
+
+
+def test_gl07_quiet_on_closed_ring_and_dynamic_perms():
+    assert not [f for f in lint(GL07_GOOD) if f.rule == "GL07"]
+
+
+# ---------------------------------------------------------------------------
+# GL08 — Pallas DMA lifetime
+# ---------------------------------------------------------------------------
+
+GL08_BAD = """
+from jax.experimental.pallas import tpu as pltpu
+
+
+def leak_kernel(hbm_ref, o_ref, sem):
+    cp = pltpu.make_async_copy(hbm_ref, o_ref, sem)
+    cp.start()
+    o_ref[:] = o_ref[:] * 2.0
+
+
+def race_kernel(hbm_ref, o_ref, sem):
+    for i in range(4):
+        cp = pltpu.make_async_copy(hbm_ref.at[i], o_ref, sem)
+        cp.start()
+    cp.wait()
+
+
+def branch_kernel(hbm_ref, o_ref, sem, flag):
+    cp = pltpu.make_async_copy(hbm_ref, o_ref, sem)
+    cp.start()
+    if flag:
+        cp.wait()
+
+
+def shared_sem_kernel(a_hbm, b_hbm, o_ref, sem):
+    c1 = pltpu.make_async_copy(a_hbm, o_ref.at[0], sem)
+    c2 = pltpu.make_async_copy(b_hbm, o_ref.at[1], sem)
+    c1.start()
+    c2.start()
+    c1.wait()
+    c2.wait()
+"""
+
+# the gather_refine factory/queue idiom (NBUF copies in flight, waits
+# in the fori_loop body) must stay quiet
+GL08_GOOD = """
+import jax
+from jax.experimental.pallas import tpu as pltpu
+
+
+def good_kernel(ids_hbm, data_hbm, o_ref, ids_smem, rows, sem_ids, sems):
+    cp = pltpu.make_async_copy(ids_hbm, ids_smem, sem_ids)
+    cp.start()
+    cp.wait()
+
+    def row_copy(t):
+        return pltpu.make_async_copy(
+            data_hbm.at[t], rows.at[t], sems.at[t % 4])
+
+    for t in range(4):
+        row_copy(t).start()
+
+    def stream(t, carry):
+        row_copy(t).wait()
+        return carry
+
+    jax.lax.fori_loop(0, 16, stream, 0)
+    o_ref[:] = rows[:]
+"""
+
+
+def test_gl08_fires_on_every_lifetime_violation():
+    findings = [f for f in lint(GL08_BAD) if f.rule == "GL08"]
+    msgs = " | ".join(f.message for f in findings)
+    assert len(findings) == 4
+    assert "never waited" in msgs
+    assert "restarted" in msgs
+    assert "all control paths" in msgs
+    assert "SAME semaphore" in msgs
+
+
+def test_gl08_quiet_on_the_queue_idiom():
+    assert not [f for f in lint(GL08_GOOD) if f.rule == "GL08"]
+
+
+# ---------------------------------------------------------------------------
+# GL09 — shard_map contract
+# ---------------------------------------------------------------------------
+
+GL09_BAD = """
+from jax.sharding import Mesh, PartitionSpec as P
+from raft_tpu.core.compat import shard_map
+
+
+def local(a, b):
+    return a + b
+
+
+def run(x, y, mesh, axis="shard"):
+    fn = shard_map(local, mesh=mesh,
+                   in_specs=(P(axis, None), P(axis), P()),
+                   out_specs=P("replicas"))
+    return fn(x, y)
+"""
+
+
+def test_gl09_fires_on_arity_and_axis_mismatch():
+    findings = [f for f in lint(GL09_BAD) if f.rule == "GL09"]
+    msgs = " | ".join(f.message for f in findings)
+    assert len(findings) == 2
+    assert "3 entries" in msgs and "2 positional" in msgs
+    assert "'replicas'" in msgs
+    src_ok = GL09_BAD.replace(", P()),", "),") \
+                     .replace('P("replicas")', "P(axis)")
+    assert not [f for f in lint(src_ok) if f.rule == "GL09"]
+    # a bare P(...) in_specs is a valid pytree PREFIX broadcast over
+    # every argument — never an arity finding
+    src_prefix = GL09_BAD.replace("(P(axis, None), P(axis), P()),",
+                                  "P(axis, None),") \
+                         .replace('P("replicas")', "P(axis)")
+    assert not [f for f in lint(src_prefix) if f.rule == "GL09"]
+
+
+# ---------------------------------------------------------------------------
+# GL10 — facade bypass
+# ---------------------------------------------------------------------------
+
+GL10_BAD = """
+from jax import lax
+
+
+def merge(vals, axis_name):
+    s = lax.psum(vals, axis_name)
+    m = lax.pmax(vals, axis_name)
+    return s + m
+"""
+
+
+def test_gl10_fires_outside_the_facade_module():
+    findings = [f for f in lint(GL10_BAD, path="raft_tpu/parallel/fake.py")
+                if f.rule == "GL10"]
+    assert len(findings) == 2
+    assert all("bypasses" in f.message for f in findings)
+    # the facade itself and non-raft_tpu paths are exempt
+    assert not [f for f in lint(GL10_BAD,
+                                path="raft_tpu/parallel/comms.py")
+                if f.rule == "GL10"]
+    assert not [f for f in lint(GL10_BAD, path="tools/fake.py")
+                if f.rule == "GL10"]
+    # axis_index carries no payload: not a bypass
+    src = GL10_BAD.replace("lax.psum", "lax.axis_index") \
+                  .replace("lax.pmax", "lax.axis_index")
+    assert not [f for f in lint(src, path="raft_tpu/parallel/fake.py")
+                if f.rule == "GL10"]
+
+
+# ---------------------------------------------------------------------------
 # suppressions
 # ---------------------------------------------------------------------------
 
@@ -286,6 +551,42 @@ def g(x):
     assert len(findings) == 1  # only g's — f is scope-suppressed
 
 
+def test_fn_suppression_anchors_to_decorated_def():
+    """Regression: disable-fn above a decorator stack (or trailing on a
+    decorator line) applies to the function it documents, not to the
+    decorator expression."""
+    src = """
+import functools
+import jax
+import numpy as np
+
+
+# graftlint: disable-fn=GL01
+@functools.partial(jax.jit, static_argnames=("k",))
+def f(x, k):
+    return np.asarray(x)
+
+
+@jax.jit  # graftlint: disable-fn=GL01
+def g(x):
+    return np.asarray(x)
+
+
+@jax.jit
+def h(x):
+    return np.asarray(x)
+"""
+    findings = [f for f in lint(src) if f.rule == "GL01"]
+    assert len(findings) == 1
+    assert "(h)" in findings[0].message
+    # a TRAILING comment on the statement above the stack must NOT leak
+    # into the next function
+    src2 = src.replace(
+        "# graftlint: disable-fn=GL01\n@functools",
+        "y = 1  # graftlint: disable-fn=GL01\n@functools")
+    assert len([f for f in lint(src2) if f.rule == "GL01"]) == 2
+
+
 def test_disable_all():
     src = """
 import os
@@ -304,6 +605,19 @@ def test_every_rule_has_a_suppressible_finding():
                  "    v = x.item()  # graftlint: disable=GL01"),
         "GL04": (GL04_BAD, "def build(dataset):",
                  "def build(dataset):  # graftlint: disable=GL04"),
+        "GL07": (GL07_BAD,
+                 '    return lax.ppermute(x, "shard", '
+                 "perm=[(0, 1), (1, 1), (2, 0)])",
+                 '    return lax.ppermute(x, "shard", '
+                 "perm=[(0, 1), (1, 1), (2, 0)])"
+                 "  # graftlint: disable=GL07"),
+        "GL08": (GL08_BAD,
+                 "    cp.start()\n    o_ref[:] = o_ref[:] * 2.0",
+                 "    cp.start()  # graftlint: disable=GL08\n"
+                 "    o_ref[:] = o_ref[:] * 2.0"),
+        "GL10": (GL10_BAD, "    s = lax.psum(vals, axis_name)",
+                 "    s = lax.psum(vals, axis_name)"
+                 "  # graftlint: disable=GL10"),
     }
     for rule, (src, old, new) in cases.items():
         before = [f for f in lint(src) if f.rule == rule]
@@ -345,3 +659,85 @@ def test_cli_json_and_exit_codes(tmp_path):
         capture_output=True, text=True, cwd=root, env=env)
     assert p.returncode == 0, p.stdout + p.stderr
     assert "clean" in p.stdout
+
+
+def test_cli_report_artifact(tmp_path):
+    """--report writes the CI JSON artifact beside the normal output."""
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    bad = tmp_path / "neighbors"
+    bad.mkdir()
+    (bad / "mod.py").write_text(GL04_BAD)
+    report = tmp_path / "report.json"
+    env = dict(os.environ, PYTHONPATH=root)
+    p = subprocess.run(
+        [sys.executable, "-m", "tools.graftlint", str(bad),
+         "--report", str(report)],
+        capture_output=True, text=True, cwd=root, env=env)
+    assert p.returncode == 1
+    doc = json.loads(report.read_text())
+    assert doc["count"] == 2
+    assert {f["rule"] for f in doc["findings"]} == {"GL04"}
+    assert set(doc["rules"]) >= {"GL01", "GL06", "GL10"}
+
+
+def _git(repo, *args):
+    p = subprocess.run(
+        ["git", "-c", "user.email=ci@test", "-c", "user.name=ci",
+         *args], capture_output=True, text=True, cwd=repo)
+    assert p.returncode == 0, (args, p.stdout, p.stderr)
+    return p.stdout
+
+
+def test_cli_changed_lints_only_modified_files(tmp_path):
+    """--changed scopes the run to files modified vs merge-base(HEAD,
+    main): pre-existing findings elsewhere in the tree don't block."""
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    repo = tmp_path / "repo"
+    pkg = repo / "raft_tpu" / "neighbors"
+    pkg.mkdir(parents=True)
+    (pkg / "legacy.py").write_text(GL04_BAD)   # bad, but NOT changed
+    (pkg / "mod.py").write_text("def helper(x):\n    return x\n")
+    _git(repo, "init", "-q")
+    _git(repo, "checkout", "-q", "-b", "main")
+    _git(repo, "add", "-A")
+    _git(repo, "commit", "-q", "-m", "seed")
+    _git(repo, "checkout", "-q", "-b", "feature")
+
+    env = dict(os.environ, PYTHONPATH=root)
+
+    def run_changed(*extra):
+        return subprocess.run(
+            [sys.executable, "-m", "tools.graftlint", "--changed",
+             *extra], capture_output=True, text=True, cwd=repo, env=env)
+
+    # clean edit on the feature branch → exit 0 despite legacy.py
+    (pkg / "mod.py").write_text("def helper(x):\n    return x + 1\n")
+    _git(repo, "commit", "-aqm", "clean edit")
+    p = run_changed()
+    assert p.returncode == 0, p.stdout + p.stderr
+    assert "1 file(s) in scope" in p.stdout
+
+    # bad UNCOMMITTED edit → exit 1, findings only in mod.py
+    (pkg / "mod.py").write_text(GL04_BAD)
+    p = run_changed("--format", "json")
+    assert p.returncode == 1
+    payload = json.loads(p.stdout)
+    assert payload and all("mod.py" in f["path"] for f in payload)
+
+    # a brand-new UNTRACKED bad file is in scope even when the CLI runs
+    # from a subdirectory (git ls-files --others is cwd-relative)
+    (pkg / "mod.py").write_text("def helper(x):\n    return x + 1\n")
+    (pkg / "fresh.py").write_text(GL04_BAD)
+    p = subprocess.run(
+        [sys.executable, "-m", "tools.graftlint", "--changed",
+         os.path.join(str(repo), "raft_tpu"), "--format", "json"],
+        capture_output=True, text=True, cwd=str(pkg), env=env)
+    assert p.returncode == 1, p.stdout + p.stderr
+    assert all("fresh.py" in f["path"] for f in json.loads(p.stdout))
+
+    # full run (no --changed) still sees legacy.py
+    p = subprocess.run(
+        [sys.executable, "-m", "tools.graftlint", "raft_tpu", "--format",
+         "json"], capture_output=True, text=True, cwd=repo, env=env)
+    assert p.returncode == 1
+    assert any("legacy.py" in f["path"] for f in json.loads(p.stdout))

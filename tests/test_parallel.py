@@ -224,6 +224,43 @@ class TestCommsTelemetry:
         assert c.get("comms.bytes{axis=shard,op=allreduce}", 0) > 0, c
 
 
+class TestCollectiveSchedule:
+    """Distributed entry points gated by the collective-schedule
+    checker (raft_tpu.obs.sanitize): the schedule each traced program
+    commits every device to must be conditional-free-or-uniform, and
+    must contain the collectives the telemetry attributes."""
+
+    def _flat(self, sched):
+        for e in sched:
+            if len(e) == 2:  # ("while"|"scan", inner)
+                yield from self._flat(e[1])
+            else:
+                yield e
+
+    def test_sharded_knn_schedule_uniform(self, mesh, rng):
+        from raft_tpu.obs import sanitize
+
+        x = jnp.asarray(rng.random((64, 8), dtype=np.float32))
+        q = jnp.asarray(rng.random((4, 8), dtype=np.float32))
+        sched = sanitize.assert_uniform_collective_schedule(
+            lambda: sharded_knn(x, q, 3, mesh))
+        verbs = [e[0] for e in self._flat(sched)]
+        assert verbs.count("all_gather") == 2, verbs  # vals + ids merge
+
+    def test_distributed_kmeans_schedule_uniform(self, mesh, rng):
+        from raft_tpu.cluster import KMeansParams
+        from raft_tpu.cluster import distributed as dkm
+        from raft_tpu.obs import sanitize
+
+        x = jnp.asarray(rng.random((64, 8), dtype=np.float32))
+        sched = sanitize.assert_uniform_collective_schedule(
+            lambda: dkm.fit(KMeansParams(n_clusters=4, max_iter=2,
+                                         seed=0), x, mesh))
+        verbs = [e[0] for e in self._flat(sched)]
+        # sums + counts + inertia psums per Lloyd iteration
+        assert verbs.count("psum") >= 3, verbs
+
+
 class TestShardedKnn:
     def test_sharded_matches_naive(self, mesh, rng):
         x = rng.random((803, 16), dtype=np.float32)  # non-divisible by 8

@@ -143,3 +143,26 @@ class TestShardedIvfFlat:
         _, ids = search_ivf_flat(ivf_flat.SearchParams(n_probes=16), sharded,
                                  jnp.asarray(queries[:16]), k, mesh)
         assert recall(np.asarray(ids), gt) >= 0.999
+
+
+class TestCollectiveSchedule:
+    """Sharded IVF search programs under the collective-schedule checker
+    (raft_tpu.obs.sanitize) — the merge's cross-shard gathers must form
+    one device-uniform schedule, with the facade recorder attributing
+    the same verbs the comms counters see."""
+
+    def test_sharded_ivf_flat_search_schedule(self, mesh, data):
+        from raft_tpu.obs import sanitize
+
+        dataset, queries = data
+        params = ivf_flat.IndexParams(n_lists=16, kmeans_n_iters=2)
+        sharded = build_ivf_flat(params, jnp.asarray(dataset[:512]), mesh)
+        sp = ivf_flat.SearchParams(n_probes=8)
+        q = jnp.asarray(queries[:8])
+        with sanitize.record_comms_schedule() as rec:
+            sched = sanitize.assert_uniform_collective_schedule(
+                lambda: search_ivf_flat(sp, sharded, q, 5, mesh))
+        verbs = [e[0] for e in sched if len(e) == 3]
+        assert verbs.count("all_gather") == 2, verbs  # vals + ids merge
+        assert [v for v, _, _ in rec] == ["allgather", "allgather"], rec
+        assert all(a == "shard" for _, a, _ in rec)

@@ -128,6 +128,79 @@ def test_filtered_knn_steady_state(warm_filtered_knn):
     assert ids.shape == (16, 10)
 
 
+class TestCollectiveSchedule:
+    """The runtime half of the SPMD correctness pass (graftlint
+    GL06–GL10): per traced program, every device's collective schedule
+    must be identical — a collective gated on ``axis_index`` deadlocks
+    (or silently zero-fills) a real mesh while single-device tests stay
+    green. Runs on the 8-device CPU mesh."""
+
+    @pytest.fixture(scope="class")
+    def mesh(self):
+        from raft_tpu.parallel import make_mesh
+
+        return make_mesh(axis_names=("shard",))
+
+    def test_axis_gated_psum_is_caught(self, mesh):
+        from jax import lax
+        from jax.sharding import PartitionSpec as P
+        from raft_tpu.core.compat import shard_map
+
+        def prog(x):
+            def local(v):
+                rank = lax.axis_index("shard")
+                return lax.cond(rank == 0,
+                                lambda u: lax.psum(u, "shard"),
+                                lambda u: u, v)
+            return shard_map(local, mesh=mesh, in_specs=(P("shard"),),
+                             out_specs=P("shard"), check_vma=False)(x)
+
+        with pytest.raises(sanitize.CollectiveScheduleDivergence) as e:
+            sanitize.assert_uniform_collective_schedule(
+                prog, jnp.ones((8, 4), jnp.float32))
+        assert "diverges" in str(e.value)
+
+    def test_uniform_branches_pass(self, mesh):
+        # both branches committing to the SAME schedule is safe: every
+        # device executes a psum regardless of the predicate
+        from jax import lax
+        from jax.sharding import PartitionSpec as P
+        from raft_tpu.core.compat import shard_map
+
+        def prog(x):
+            def local(v):
+                rank = lax.axis_index("shard")
+                return lax.cond(rank == 0,
+                                lambda u: lax.psum(u, "shard"),
+                                lambda u: lax.psum(u * 2.0, "shard"), v)
+            return shard_map(local, mesh=mesh, in_specs=(P("shard"),),
+                             out_specs=P("shard"), check_vma=False)(x)
+
+        sched = sanitize.collective_schedule(
+            prog, jnp.ones((8, 4), jnp.float32))
+        assert [e[0] for e in sched] == ["psum"]
+
+    def test_comms_schedule_recorder(self, mesh):
+        from jax.sharding import PartitionSpec as P
+        from raft_tpu.core.compat import shard_map
+        from raft_tpu.parallel import Comms
+
+        comms = Comms("shard")
+
+        def body(v):
+            return comms.send_recv_ring(comms.allreduce(v))
+
+        fn = shard_map(body, mesh=mesh, in_specs=(P("shard"),),
+                       out_specs=P("shard"), check_vma=False)
+        with sanitize.record_comms_schedule() as rec:
+            jax.block_until_ready(jax.jit(fn)(jnp.ones((8,))))
+        assert [(v, a) for v, a, _ in rec] == \
+            [("allreduce", "shard"), ("send_recv_ring", "shard")]
+        assert all(b > 0 for _, _, b in rec)
+        # recording is scoped: outside the context nothing records
+        assert not sanitize.comms_schedule_recording()
+
+
 def test_recompile_budget_fires():
     """The budget context itself: a fresh shape inside a 0-budget scope
     must raise RecompileBudgetExceeded."""

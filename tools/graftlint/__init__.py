@@ -37,6 +37,14 @@ GL05  Pallas TPU kernel constraints: a ``pl.BlockSpec`` whose trailing
       ``jnp.take``/``take_along_axis``/``lax.gather`` inside a kernel
       body (Mosaic has no lane-axis gather — use a one-hot matmul).
 
+SPMD / DMA rules (GL06–GL10, :mod:`tools.graftlint.spmd`) — the
+distributed-correctness pass: collective axis/scope consistency (GL06),
+static ``ppermute`` perm bijectivity (GL07), Pallas DMA start/wait
+lifetime (GL08), the ``shard_map`` in_specs/axis contract (GL09), and
+raw-``lax``-collective bypass of the Comms telemetry facade (GL10).
+The runtime complement — the collective-schedule checker for divergence
+the AST cannot see — lives in :mod:`raft_tpu.obs.sanitize`.
+
 Suppression
 -----------
 
@@ -68,6 +76,16 @@ RULES: Dict[str, str] = {
     "GL04": "public entry point missing traced/span observability wrapper",
     "GL05": "Pallas kernel constraint (lane tiling / memory_space / "
             "lane gather)",
+    "GL06": "collective axis not bound / collective outside shard_map "
+            "scope",
+    "GL07": "static ppermute perm is not a permutation (duplicate or "
+            "dropped destinations; open ring)",
+    "GL08": "Pallas DMA lifetime (missing wait / slot reuse / shared "
+            "semaphore)",
+    "GL09": "shard_map contract (in_specs arity / unknown P() axis "
+            "names)",
+    "GL10": "raw lax collective outside parallel/comms.py (bypasses "
+            "comms telemetry)",
 }
 
 # GL02: string literals that mark an env read as *flag* parsing (vs a
@@ -124,11 +142,15 @@ def _parse_rules(spec: str) -> Set[str]:
 
 
 def _suppressions(source: str) -> Tuple[Dict[int, Set[str]],
-                                        Dict[int, Set[str]]]:
-    """(line → rules disabled on that line, line → rules disabled for the
-    function whose ``def`` sits on that line)."""
+                                        Dict[int, Set[str]],
+                                        Set[int]]:
+    """(line → rules disabled on that line, line → rules disabled for
+    the function that line belongs to, lines whose disable-fn comment is
+    standalone — i.e. the whole line is the comment, so it may document
+    the decorator stack / ``def`` directly below it)."""
     lines: Dict[int, Set[str]] = {}
     fn_lines: Dict[int, Set[str]] = {}
+    fn_standalone: Set[int] = set()
     try:
         toks = tokenize.generate_tokens(io.StringIO(source).readline)
         for tok in toks:
@@ -138,6 +160,8 @@ def _suppressions(source: str) -> Tuple[Dict[int, Set[str]],
             if m:
                 fn_lines.setdefault(tok.start[0], set()).update(
                     _parse_rules(m.group(1)))
+                if not tok.line[:tok.start[1]].strip():
+                    fn_standalone.add(tok.start[0])
                 continue
             m = _SUPPRESS_RE.search(tok.string)
             if m:
@@ -145,7 +169,7 @@ def _suppressions(source: str) -> Tuple[Dict[int, Set[str]],
                     _parse_rules(m.group(1)))
     except (tokenize.TokenError, IndentationError):
         pass
-    return lines, fn_lines
+    return lines, fn_lines, fn_standalone
 
 
 class _Parents(ast.NodeVisitor):
@@ -547,19 +571,28 @@ def lint_source(source: str, path: str = "<string>",
     except SyntaxError as e:
         return [Finding(path, e.lineno or 0, e.offset or 0, "GL00",
                         f"syntax error: {e.msg}")]
-    suppress, suppress_fn = _suppressions(source)
+    suppress, suppress_fn, suppress_fn_standalone = _suppressions(source)
     parents = _Parents(tree)
     findings: List[Finding] = []
 
-    # function-scoped suppression: (line range, rules) per def whose
-    # signature line carries a disable-fn comment
+    # function-scoped suppression: (line range, rules) per function
+    # whose signature carries a disable-fn comment. The comment anchors
+    # to the function it documents: trailing on the def line, trailing
+    # on any decorator line, or standalone on the line directly above
+    # the decorator stack (standalone-only there, so a trailing comment
+    # on the previous statement never leaks into the next function).
     fn_ranges: List[Tuple[int, int, Set[str]]] = []
     if suppress_fn:
         for node in ast.walk(tree):
             if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
-                for line in range(node.lineno,
-                                  (node.body[0].lineno if node.body
-                                   else node.lineno) + 1):
+                dec_start = min([d.lineno for d in node.decorator_list]
+                                + [node.lineno])
+                candidates = list(range(dec_start,
+                                        (node.body[0].lineno if node.body
+                                         else node.lineno) + 1))
+                if dec_start - 1 in suppress_fn_standalone:
+                    candidates.insert(0, dec_start - 1)
+                for line in candidates:
                     if line in suppress_fn:
                         fn_ranges.append((node.lineno,
                                           node.end_lineno or node.lineno,
@@ -588,6 +621,9 @@ def lint_source(source: str, path: str = "<string>",
     _check_gl02(tree, parents, add)
     _check_gl04(tree, path, add)
     _check_gl05(tree, fns, add)
+    from tools.graftlint import spmd  # deferred: spmd imports helpers
+
+    spmd.check(tree, parents, path, add)
     findings.sort(key=lambda f: (f.path, f.line, f.col, f.rule))
     return findings
 
@@ -615,6 +651,56 @@ def lint_paths(paths: Iterable[str],
     return findings
 
 
+def changed_files(cwd: Optional[str] = None) -> List[str]:
+    """Absolute paths of ``.py`` files modified vs ``git merge-base
+    HEAD main`` (committed, staged, unstaged, and untracked) — the fast
+    pre-commit scope for ``--changed``. All git listing runs from the
+    repo ROOT (``ls-files --others`` is cwd-relative and cwd-limited
+    otherwise, which would silently drop untracked files when invoked
+    from a subdirectory); ``-z`` output keeps paths with spaces whole."""
+    import subprocess
+
+    def run(*cmd: str, at: Optional[str] = cwd):
+        return subprocess.run(cmd, capture_output=True, text=True, cwd=at)
+
+    root = run("git", "rev-parse", "--show-toplevel").stdout.strip()
+    base = None
+    for ref in ("main", "origin/main", "master"):
+        p = run("git", "merge-base", "HEAD", ref)
+        if p.returncode == 0 and p.stdout.strip():
+            base = p.stdout.strip()
+            break
+    if not root or base is None:
+        raise RuntimeError(
+            "graftlint --changed: cannot resolve `git merge-base HEAD "
+            "main` (not a git checkout, or no main/master ref)")
+    names = set(run("git", "diff", "--name-only", "-z", base,
+                    at=root).stdout.split("\0"))
+    names |= set(run("git", "ls-files", "--others", "--exclude-standard",
+                     "-z", at=root).stdout.split("\0"))
+    out = []
+    for f in sorted(names):
+        if not f.endswith(".py"):
+            continue
+        full = os.path.join(root, f)
+        if os.path.exists(full):
+            out.append(full)
+    return out
+
+
+def _scope_filter(files: Sequence[str], paths: Sequence[str]) -> List[str]:
+    """Keep only files that a full run over ``paths`` would lint."""
+    scopes = [os.path.abspath(p) for p in paths]
+    out = []
+    for f in files:
+        af = os.path.abspath(f)
+        for s in scopes:
+            if af == s or af.startswith(s + os.sep):
+                out.append(f)
+                break
+    return out
+
+
 def main(argv: Optional[Sequence[str]] = None) -> int:
     import argparse
 
@@ -626,6 +712,13 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
     ap.add_argument("--format", choices=("human", "json"), default="human")
     ap.add_argument("--select", default=None,
                     help="comma-separated rule ids to run (default: all)")
+    ap.add_argument("--changed", action="store_true",
+                    help="lint only files modified vs `git merge-base "
+                         "HEAD main` (within the given paths) — the "
+                         "fast pre-commit run; same reporter/exit codes")
+    ap.add_argument("--report", metavar="PATH", default=None,
+                    help="also write a JSON report (findings + rule "
+                         "table) to PATH — the CI artifact")
     ap.add_argument("--list-rules", action="store_true")
     args = ap.parse_args(argv)
 
@@ -643,7 +736,24 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
             print(f"graftlint: unknown rule(s): {sorted(unknown)}",
                   file=sys.stderr)
             return 2
-    findings = lint_paths(args.paths or ["raft_tpu"], select=select)
+    paths = args.paths or ["raft_tpu"]
+    if args.changed:
+        try:
+            targets = _scope_filter(changed_files(), paths)
+        except RuntimeError as e:
+            print(str(e), file=sys.stderr)
+            return 2
+        if args.format == "human":
+            print(f"graftlint: --changed → {len(targets)} file(s) in "
+                  f"scope")
+        findings = lint_paths(targets, select=select)
+    else:
+        findings = lint_paths(paths, select=select)
+    if args.report:
+        with open(args.report, "w", encoding="utf-8") as fh:
+            json.dump({"rules": RULES, "count": len(findings),
+                       "findings": [f.as_dict() for f in findings]},
+                      fh, indent=2)
     if args.format == "json":
         print(json.dumps([f.as_dict() for f in findings], indent=2))
     else:
