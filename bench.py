@@ -386,8 +386,28 @@ def _probe_cause(head: str, stderr) -> str:
     return head + (f"; stderr tail: {tail}" if tail else "; no stderr")
 
 
+def _load_robust(modname):
+    """Load raft_tpu/robust/<modname>.py STANDALONE — without importing
+    the raft_tpu package (module doc: no raft_tpu/jax imports before
+    the probe; a wedged device plugin can block the package import in C
+    code). faults/retry are stdlib-only by contract exactly so this
+    file-level load works."""
+    import importlib.util
+
+    key = f"_bench_robust_{modname}"
+    if key in sys.modules:
+        return sys.modules[key]
+    path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "raft_tpu", "robust", f"{modname}.py")
+    spec = importlib.util.spec_from_file_location(key, path)
+    mod = importlib.util.module_from_spec(spec)
+    sys.modules[key] = mod
+    spec.loader.exec_module(mod)
+    return mod
+
+
 def _device_backend_ok(timeout_s: float = 150.0, attempts: int = 2,
-                       backoff_s: float = 15.0) -> bool:
+                       backoff_s=None) -> bool:
     """Probe the device backend in a KILLABLE subprocess. A wedged
     remote-device plugin blocks `import jax` in C code where SIGALRM
     never reaches the Python handler — probing in-process would turn a
@@ -397,36 +417,71 @@ def _device_backend_ok(timeout_s: float = 150.0, attempts: int = 2,
 
     A SINGLE flaky probe must not kill a whole leg either (BENCH_r05
     lost the hard/gist legs to one probe subprocess timeout during a
-    transient tunnel hiccup): retry once after a short backoff before
-    declaring the device dead. On failure the cause (returncode +
-    stderr tail) AND the attempt count are stashed in
-    STATE['probe_error'] for the caller's partial-record note."""
+    transient tunnel hiccup): retries ride robust.retry's policy —
+    exponential backoff + jitter (base RAFT_TPU_BENCH_PROBE_BACKOFF_S,
+    default 15 s) instead of the old hand-rolled retry-once. On failure
+    the cause (returncode + stderr tail), the attempt count, AND the
+    final retry-policy state are stashed in STATE['probe_error'] for
+    the caller's partial-record note. The probe is the
+    ``probe.backend`` fault point (docs/developer_guide.md
+    "Robustness"), so probe-failure handling is CI-testable."""
     import subprocess
 
-    cause = "no diagnostics captured"
-    for attempt in range(1, attempts + 1):
+    retry = _load_robust("retry")
+    faults = _load_robust("faults")
+    if backoff_s is None:
+        try:
+            backoff_s = float(os.environ.get(
+                "RAFT_TPU_BENCH_PROBE_BACKOFF_S", "15"))
+        except ValueError:
+            backoff_s = 15.0
+
+    class _ProbeFailed(Exception):
+        transient = True  # robust.retry's explicit retryable opt-in
+
+    def probe_once():
+        faults.faultpoint("probe.backend")
         try:
             p = subprocess.run(
                 [sys.executable, "-c",
                  "import jax; jax.devices(); print('ok')"],
                 capture_output=True, text=True, timeout=timeout_s)
-            if p.returncode == 0 and "ok" in p.stdout:
-                STATE.pop("probe_error", None)
-                return True
-            cause = _probe_cause(
-                f"probe subprocess rc={p.returncode}", p.stderr)
         except subprocess.TimeoutExpired as e:
-            cause = _probe_cause(
+            raise _ProbeFailed(_probe_cause(
                 f"probe subprocess timed out after {timeout_s:.0f}s",
-                e.stderr)
-        except Exception as e:
-            cause = f"probe failed to launch: {e!r}"
-        if attempt < attempts:
-            print(f"[bench] device probe attempt {attempt}/{attempts} "
-                  f"failed ({cause.splitlines()[0]}) — retrying in "
-                  f"{backoff_s:.0f}s")
-            time.sleep(backoff_s)
-    STATE["probe_error"] = f"{cause} (after {attempts} probe attempts)"
+                e.stderr)) from None
+        if p.returncode == 0 and "ok" in p.stdout:
+            return True
+        raise _ProbeFailed(_probe_cause(
+            f"probe subprocess rc={p.returncode}", p.stderr))
+
+    policy = retry.RetryPolicy(max_attempts=attempts,
+                               base_delay_s=backoff_s,
+                               max_delay_s=max(60.0, 4 * backoff_s),
+                               jitter=0.25)
+    stats = {}
+
+    def sleep_and_say(delay):
+        head = stats["errors"][-1].splitlines()[0] if stats["errors"] \
+            else "unknown"
+        print(f"[bench] device probe attempt {stats['attempts']}/"
+              f"{attempts} failed ({head}) — retrying in {delay:.1f}s",
+              flush=True)
+        time.sleep(delay)
+
+    try:
+        retry.retry_call(probe_once, site="probe.backend", policy=policy,
+                         stats=stats, sleep=sleep_and_say)
+        STATE.pop("probe_error", None)
+        return True
+    except retry.RetryExhausted as e:
+        cause = str(e.last)
+    except Exception as e:
+        cause = f"probe failed to launch: {e!r}"
+    STATE["probe_error"] = (
+        f"{cause} (after {stats.get('attempts', 1)} probe attempts; "
+        f"retry {stats.get('outcome') or 'fatal'}, "
+        f"{stats.get('policy') or 'no policy'})")
     return False
 
 

@@ -64,6 +64,131 @@ echo "== bench smoke (tiny synthetic) =="
 RAFT_TPU_BENCH_N=20000 RAFT_TPU_BENCH_Q=500 \
 RAFT_TPU_BENCH_ALGOS=ivf_flat python bench.py
 
+echo "== chaos lane (fault-injected OOM / SIGTERM / probe failure;"
+echo "   docs/developer_guide.md 'Robustness') =="
+python - <<'EOF'
+# 1. injected RESOURCE_EXHAUSTED during an oversampled search: the
+#    degradation ladder must complete the request, record its path in
+#    degrade.steps, and return results identical to the undegraded
+#    search (batch splitting is exact per query).
+import numpy as np
+import jax.numpy as jnp
+
+from raft_tpu import obs
+from raft_tpu.obs.metrics import MetricsRegistry
+from raft_tpu.robust import faults
+from raft_tpu.neighbors import ivf_pq
+
+rng = np.random.default_rng(0)
+x = jnp.asarray(rng.random((3000, 32), dtype=np.float32))
+idx = ivf_pq.build(x, ivf_pq.IndexParams(
+    n_lists=16, pq_dim=16, seed=0, cache_reconstruction="never"))
+sp = ivf_pq.SearchParams(n_probes=8, scan_mode="per_query")
+d_ref, i_ref = ivf_pq.search(idx, x[:64], 40, sp)
+reg = MetricsRegistry()
+obs.enable(registry=reg, hbm=False)
+faults.install_plan({"faults": [
+    {"site": "ivf_pq.search", "kind": "oom", "times": 1}]})
+try:
+    d_dg, i_dg = ivf_pq.search_resilient(idx, x[:64], 40, sp)
+finally:
+    faults.clear_plan()
+    obs.disable()
+np.testing.assert_array_equal(np.asarray(i_dg), np.asarray(i_ref))
+snap = reg.snapshot()
+step = snap["counters"].get(
+    "degrade.steps{from=native,reason=resource_exhausted,"
+    "site=ivf_pq.search,to=halve_batch}", 0)
+assert step >= 1, snap["counters"]
+assert snap["counters"].get("faults.fired{kind=oom,site=ivf_pq.search}",
+                            0) >= 1, snap["counters"]
+print("chaos OOM OK: ladder completed via halve_batch, results match, "
+      "degrade.steps + faults.fired recorded")
+EOF
+python - <<'EOF'
+# 2. injected SIGTERM mid-build_chunked, then resume=True: the resumed
+#    index must be sha-identical to an uninterrupted build and the
+#    resume.* counters must record the replay.
+import hashlib, json, os, shutil, subprocess, sys, tempfile
+import numpy as np
+
+work = tempfile.mkdtemp(prefix="raft_chaos_")
+data = os.path.join(work, "data.npy")
+np.save(data, np.random.default_rng(7).random((4000, 32),
+                                              dtype=np.float32))
+ck = os.path.join(work, "ckpt")
+child = """
+import os, numpy as np
+os.environ["JAX_PLATFORMS"] = "cpu"
+from raft_tpu.robust import faults
+from raft_tpu.neighbors import ivf_pq
+faults.install_plan({"faults": [{"site": "build.chunk_encode",
+                                 "kind": "sigterm", "after": 3}]})
+x = np.load(%r, mmap_mode="r")
+ivf_pq.build_chunked(x, ivf_pq.IndexParams(n_lists=16, pq_dim=16, seed=0,
+                                           cache_reconstruction="never"),
+                     chunk_rows=500, checkpoint_dir=%r)
+raise SystemExit("UNREACHABLE: the injected SIGTERM did not fire")
+""" % (data, ck)
+p = subprocess.run([sys.executable, "-c", child], capture_output=True,
+                   text=True)
+assert p.returncode != 0, "child survived the injected SIGTERM"
+man = json.load(open(os.path.join(ck, "manifest.json")))
+assert man["phase"] == "encode" and 0 < man["chunks_done"] < man["n_chunks"], man
+
+from raft_tpu import obs
+from raft_tpu.obs.metrics import MetricsRegistry
+from raft_tpu.neighbors import ivf_pq
+
+x = np.load(data, mmap_mode="r")
+params = ivf_pq.IndexParams(n_lists=16, pq_dim=16, seed=0,
+                            cache_reconstruction="never")
+reg = MetricsRegistry()
+obs.enable(registry=reg, hbm=False)
+try:
+    resumed = ivf_pq.build_chunked(x, params, chunk_rows=500,
+                                   checkpoint_dir=ck, resume=True)
+finally:
+    obs.disable()
+clean = ivf_pq.build_chunked(x, params, chunk_rows=500)
+
+def sha(idx):
+    h = hashlib.sha256()
+    for name in ("centers", "centers_rot", "rotation", "codebooks",
+                 "packed_codes", "packed_ids", "packed_norms",
+                 "list_sizes"):
+        h.update(np.ascontiguousarray(
+            np.asarray(getattr(idx, name))).tobytes())
+    return h.hexdigest()
+assert sha(resumed) == sha(clean), \
+    "resumed index differs from an uninterrupted build"
+c = reg.snapshot()["counters"]
+assert c.get("resume.attempts{site=ivf_pq.build_chunked}", 0) >= 1, c
+assert c.get("resume.chunks_replayed{site=ivf_pq.build_chunked}",
+             0) == man["chunks_done"], c
+shutil.rmtree(work)
+print(f"chaos SIGTERM OK: died at chunk {man['chunks_done']}, resumed "
+      "sha-identical, resume.* counters recorded")
+EOF
+# 3. injected probe failure: bench.py's robust.retry-backed backend
+#    probe must absorb one injected failure and still produce rows.
+RAFT_TPU_FAULT_PLAN_JSON='{"faults": [{"site": "probe.backend", "kind": "error", "times": 1}]}' \
+RAFT_TPU_BENCH_PROBE_BACKOFF_S=0.2 \
+RAFT_TPU_BENCH_N=20000 RAFT_TPU_BENCH_Q=500 \
+RAFT_TPU_BENCH_ALGOS=ivf_flat RAFT_TPU_BENCH_LEGS=hard \
+python bench.py | tee /tmp/raft_tpu_chaos_probe.out
+grep -q "device probe attempt 1/2 failed" /tmp/raft_tpu_chaos_probe.out \
+  || { echo "chaos probe: injected failure did not hit the retry path"; exit 1; }
+python - <<'EOF'
+import json
+rows = [json.loads(ln) for ln in open("/tmp/raft_tpu_chaos_probe.out")
+        if ln.startswith("{")]
+assert rows and rows[-1]["detail"], \
+    "chaos probe: no bench rows after the retried probe"
+print("chaos probe OK: retry recovered, "
+      f"{len(rows[-1]['detail'])} rows measured")
+EOF
+
 echo "== observability smoke (RAFT_TPU_BENCH_OBS=1, instrumented ivf_pq) =="
 rm -f /tmp/raft_tpu_obs_smoke.jsonl
 RAFT_TPU_BENCH_N=20000 RAFT_TPU_BENCH_Q=500 \

@@ -43,6 +43,7 @@ from raft_tpu.cluster import kmeans_balanced
 from raft_tpu.cluster.kmeans_balanced import KMeansBalancedParams
 from raft_tpu.distance.types import DistanceType, resolve_metric
 from raft_tpu.matrix import select_k as _select_k
+from raft_tpu.robust import faults as _faults
 from raft_tpu.utils.precision import get_precision
 
 _SERIAL_VERSION = 1
@@ -622,6 +623,7 @@ def search(index: IvfFlatIndex, queries: jax.Array, k: int,
         params = SearchParams()
     expects(queries.ndim == 2 and queries.shape[1] == index.dim,
             "queries must be [m, %d]", index.dim)
+    _faults.faultpoint("ivf_flat.search")
     if params.refine != "none":
         return _route_refined(index, queries, k, params, filter_bitset,
                               dataset)
@@ -663,6 +665,29 @@ def search(index: IvfFlatIndex, queries: jax.Array, k: int,
     return _search_impl(index, queries, k, n_probes,
                         _fit_query_tile(params.query_tile, n_probes, index),
                         filter_bits=filter_bitset)
+
+
+@traced("raft_tpu.ivf_flat.search_resilient")
+def search_resilient(index: IvfFlatIndex, queries: jax.Array, k: int,
+                     params: Optional[SearchParams] = None,
+                     filter_bitset: Optional[jax.Array] = None,
+                     dataset=None) -> Tuple[jax.Array, jax.Array]:
+    """:func:`search` behind the standard degradation ladder
+    (:mod:`raft_tpu.robust.degrade`, same wiring as
+    ``ivf_pq.search_resilient`` minus the LUT rung — IVF-Flat has no
+    LUT to quantize): RESOURCE_EXHAUSTED walks halve-batch → decline
+    fused tier → host gather (then keeps halving), counted in
+    ``degrade.steps{site=ivf_flat.search,...}``."""
+    from raft_tpu.robust import degrade as _dg
+
+    if params is None:
+        params = SearchParams()
+    queries = jnp.asarray(queries)
+    return _dg.run_with_degradation(
+        _dg.batched_search_call(search, index, queries, k, filter_bitset),
+        {"params": params, "dataset": dataset},
+        _dg.standard_search_ladder(queries.shape[0], has_lut=False),
+        site="ivf_flat.search")
 
 
 def _fit_query_tile(want: int, n_probes: int, index: IvfFlatIndex) -> int:

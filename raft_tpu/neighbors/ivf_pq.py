@@ -33,6 +33,7 @@ Supported metrics: sqeuclidean / euclidean / inner_product / cosine
 from __future__ import annotations
 
 import dataclasses
+import os
 from functools import partial
 from typing import Optional, Tuple
 
@@ -46,6 +47,9 @@ from raft_tpu.core.errors import expects
 from raft_tpu.core.tracing import traced, span
 from raft_tpu.core import serialize as ser
 from raft_tpu.obs import spans as _obs_spans
+from raft_tpu.robust import degrade as _degrade
+from raft_tpu.robust import faults as _faults
+from raft_tpu.robust import retry as _retry
 from raft_tpu.cluster import kmeans_balanced
 from raft_tpu.cluster.kmeans_balanced import KMeansBalancedParams
 from raft_tpu.distance.types import DistanceType, resolve_metric
@@ -743,11 +747,21 @@ def build(dataset: jax.Array, params: Optional[IndexParams] = None) -> IvfPqInde
     return index
 
 
+def _count_resume(name: str, value: float = 1.0) -> None:
+    """``resume.*{site=ivf_pq.build_chunked}`` counters — recorded only
+    when obs is on (the count_dispatch convention)."""
+    if _obs_spans.enabled():
+        _obs_spans.registry().inc(name, value,
+                                  labels={"site": "ivf_pq.build_chunked"})
+
+
 @traced("raft_tpu.ivf_pq.build_chunked")
 def build_chunked(dataset, params: Optional[IndexParams] = None,  # graftlint: disable-fn=GL01 (streaming memmap build syncs per chunk by design)
                   chunk_rows: int = 1 << 18,
                   max_train_rows: int = 1 << 21,
-                  progress: bool = False) -> IvfPqIndex:
+                  progress: bool = False,
+                  checkpoint_dir: Optional[str] = None,
+                  resume=False) -> IvfPqIndex:
     """Build from a host array/memmap in O(chunk) device + host working
     memory — the billion-scale path (reference: the bench harness's
     memmapped BinFile + subset datasets, cpp/bench/ann/src/common/
@@ -758,6 +772,20 @@ def build_chunked(dataset, params: Optional[IndexParams] = None,  # graftlint: d
     RSS stays bounded by ``chunk_rows`` plus the packed index itself.
     ``progress`` prints phase/chunk timings (hour-scale 10⁸-row builds
     are opaque without them).
+
+    **Checkpointed resumable builds** (docs/developer_guide.md
+    "Robustness"): with ``checkpoint_dir=`` the build writes a durable
+    manifest (atomic tmp+fsync+rename), the trained quantizer state,
+    the label pass, and one encoded-list shard per completed chunk.
+    ``resume=True`` verifies the manifest's dataset/params fingerprints
+    (a mismatch, truncated manifest, or missing shard refuses with a
+    clear error) and continues from the last complete chunk — quantizers
+    and labels are *loaded*, completed chunks replay from their shards,
+    so the resumed index is bit-identical to an uninterrupted build.
+    ``resume="auto"`` resumes when a manifest exists and starts fresh
+    otherwise. Host reads / device transfers retry under
+    :data:`raft_tpu.robust.retry.IO_POLICY`; an encode chunk that hits
+    RESOURCE_EXHAUSTED is halved (``degrade.steps`` counts the walk).
     """
     import time as _time
 
@@ -773,7 +801,37 @@ def build_chunked(dataset, params: Optional[IndexParams] = None,  # graftlint: d
     expects(params.codebook_kind in ("per_subspace", "per_cluster"),
             "codebook_kind must be per_subspace or per_cluster")
     expects(4 <= params.pq_bits <= 8, "pq_bits must be in [4, 8]")
+    expects(resume in (False, True, "auto"),
+            "resume must be False, True, or 'auto' (got %r)", resume)
+    expects(not resume or checkpoint_dir is not None,
+            "resume=%r needs checkpoint_dir= (there is no manifest to "
+            "resume from without one)", resume)
     n, dim = dataset.shape
+
+    # checkpoint bootstrap: fingerprint the inputs, load + validate the
+    # manifest when resuming (robust.checkpoint owns the refusal cases)
+    ck = manifest = None
+    base_manifest = {}
+    if checkpoint_dir is not None:
+        from raft_tpu.robust import checkpoint as _ckpt
+
+        ck = _ckpt.BuildCheckpoint(checkpoint_dir)
+        ds_sha = _ckpt.dataset_fingerprint(dataset)
+        p_sha = _ckpt.params_fingerprint(
+            {**dataclasses.asdict(params), "chunk_rows": chunk_rows,
+             "max_train_rows": max_train_rows})
+        base_manifest = {"dataset_sha": ds_sha, "params_sha": p_sha,
+                         "n": int(n), "dim": int(dim),
+                         "chunk_rows": int(chunk_rows),
+                         "n_chunks": -(-n // chunk_rows)}
+        if resume is True or (resume == "auto"
+                              and os.path.exists(ck.manifest_path)):
+            manifest = ck.load_manifest()
+            ck.validate_manifest(manifest, ds_sha, p_sha)
+            _count_resume("resume.attempts")
+            _say(f"resuming from {ck.manifest_path} "
+                 f"(phase {manifest.get('phase')}, "
+                 f"{manifest.get('chunks_done', 0)} chunks done)")
     spherical = mt in (DistanceType.InnerProduct, DistanceType.CosineExpanded)
     normalize = mt == DistanceType.CosineExpanded
 
@@ -789,102 +847,195 @@ def build_chunked(dataset, params: Optional[IndexParams] = None,  # graftlint: d
                 jnp.sum(x * x, -1, keepdims=True), 1e-12))
         return x
 
+    def read_chunk(a, b):
+        """One host read + device transfer under the shared IO retry
+        policy (tunnel hiccups and flaky memmap reads recover instead of
+        killing an hour-scale build)."""
+        def _do():
+            _faults.faultpoint("build.chunk_read")
+            return to_device(dataset[a:b])
+        return _retry.retry_call(_do, site="build.chunk_read",
+                                 policy=_retry.IO_POLICY)
+
     pq_dim = params.pq_dim or _default_pq_dim(dim)
     pq_len = -(-dim // pq_dim)
     rot_dim = pq_dim * pq_len
     K = 1 << params.pq_bits
     key = jax.random.PRNGKey(params.seed)
 
-    # 1. quantizers on a bounded random subsample (sorted: memmap locality)
-    n_train = min(n, max_train_rows,
-                  max(params.n_lists * 4, 4 * K,
-                      int(n * params.kmeans_trainset_fraction)))
-    rng = np.random.default_rng(params.seed)
-    tr_idx = np.sort(rng.choice(n, n_train, replace=False))
-    _say(f"sampling {n_train} train rows")
-    if hasattr(dataset, "sample_rows"):  # device-chunk provider
-        trainset = to_device(dataset.sample_rows(tr_idx))
-    else:
-        trainset = to_device(dataset[tr_idx])
-    _say("training quantizers (coarse kmeans + rotation + codebooks)")
     km = KMeansBalancedParams(n_iters=params.kmeans_n_iters,
                               metric="cosine" if spherical else "l2",
                               seed=params.seed)
-    with span("train"):
-        centers, rotation, centers_rot, codebooks = _train_quantizers(
-            trainset, params, dim, pq_dim, pq_len, K, key, km)
-        jax.block_until_ready(codebooks)
-    del trainset
+    if manifest is not None:
+        # any manifest phase implies trained quantizers on disk (the
+        # first manifest write happens after the train checkpoint);
+        # loading raises a clear error when the state file is missing
+        _say("resume: loading quantizer state from checkpoint")
+        q = ck.load_arrays("quantizers")
+        centers = jnp.asarray(q["centers"])
+        rotation = jnp.asarray(q["rotation"])
+        centers_rot = jnp.asarray(q["centers_rot"])
+        codebooks = jnp.asarray(q["codebooks"])
+    else:
+        # 1. quantizers on a bounded random subsample (sorted: memmap
+        # locality)
+        n_train = min(n, max_train_rows,
+                      max(params.n_lists * 4, 4 * K,
+                          int(n * params.kmeans_trainset_fraction)))
+        rng = np.random.default_rng(params.seed)
+        tr_idx = np.sort(rng.choice(n, n_train, replace=False))
+        _say(f"sampling {n_train} train rows")
+        if hasattr(dataset, "sample_rows"):  # device-chunk provider
+            trainset = to_device(dataset.sample_rows(tr_idx))
+        else:
+            trainset = _retry.retry_call(
+                lambda: to_device(dataset[tr_idx]),
+                site="build.train_sample", policy=_retry.IO_POLICY)
+        _say("training quantizers (coarse kmeans + rotation + codebooks)")
+        with span("train"):
+            centers, rotation, centers_rot, codebooks = _train_quantizers(
+                trainset, params, dim, pq_dim, pq_len, K, key, km)
+            jax.block_until_ready(codebooks)
+        del trainset
+        if ck is not None:
+            # kmeans centroid state + rotation + codebooks: the state a
+            # resume must NOT retrain (f32 round-trips bit-exact, so a
+            # resumed encode is identical to an uninterrupted one)
+            ck.save_arrays("quantizers",
+                           centers=np.asarray(centers),
+                           rotation=np.asarray(rotation),
+                           centers_rot=np.asarray(centers_rot),
+                           codebooks=np.asarray(codebooks))
+            ck.write_manifest({**base_manifest, "phase": "label"})
     _say("quantizers trained; label pass")
 
-    # 2. streaming label pass → histogram → list capacity
+    # 2. streaming label pass → histogram → list capacity (loaded from
+    # the checkpoint when the resume manifest says the pass completed)
     from raft_tpu.neighbors.ivf_flat import _fit_list_size
 
     from raft_tpu.core.interruptible import cancellation_point
 
     avg = max(1, n // params.n_lists)
-    with span("label"):
-        if params.spill:
-            # top-2 labels, then cap+spill (see IndexParams.spill): L is
-            # the cap itself, not the skewed max load
-            from raft_tpu.neighbors import ivf_common as ic
-            from raft_tpu.neighbors.ivf_flat import _lane_round
+    have_labels = (manifest is not None
+                   and manifest.get("phase") in ("encode", "done"))
+    if have_labels:
+        _say("resume: loading label pass from checkpoint")
+        labels = np.asarray(ck.load_arrays("labels")["labels"], np.int32)
+        expects(labels.shape[0] == n,
+                "resume label checkpoint holds %d rows, dataset has %d",
+                labels.shape[0], n)
+        L = int(manifest["L"])
+        counts = np.bincount(labels[labels < params.n_lists],
+                             minlength=params.n_lists)
+    else:
+        with span("label"):
+            if params.spill:
+                # top-2 labels, then cap+spill (see IndexParams.spill):
+                # L is the cap itself, not the skewed max load
+                from raft_tpu.neighbors import ivf_common as ic
+                from raft_tpu.neighbors.ivf_flat import _lane_round
 
-            NC = min(ic.SPILL_DEPTH, params.n_lists)
-            lk = np.empty((n, NC), np.int32)
-            for a in range(0, n, chunk_rows):
-                cancellation_point()
-                b = min(n, a + chunk_rows)
-                lk[a:b] = np.asarray(
-                    kmeans_balanced.predict_topk(centers,
-                                                 to_device(dataset[a:b]),
-                                                 NC, km))
-                if a % (8 * chunk_rows) == 0:
-                    _say(f"labeled {b}/{n}")
-            L = _lane_round(int(avg * params.list_size_cap_factor))
-            _say("spilling assignments")
-            labels = np.asarray(ic.spill_assignments(
-                jnp.asarray(lk[:, 0]), jnp.asarray(lk[:, 1]),
-                params.n_lists, L,
-                *[jnp.asarray(lk[:, c]) for c in range(2, lk.shape[1])]))
-            del lk
-            _say("spill done; encode pass")
-            n_spill_drop = int((labels >= params.n_lists).sum())
-            if n_spill_drop:
-                from raft_tpu.core import logging as _log
-                _log.warn("ivf_pq chunked build: %d rows overflowed both "
-                          "choices at cap %d", n_spill_drop, L)
-            counts = np.bincount(labels[labels < params.n_lists],
-                                 minlength=params.n_lists)
-        else:
-            labels = np.empty(n, np.int32)
-            for a in range(0, n, chunk_rows):
-                cancellation_point()  # chunk seams are cancellation points
-                b = min(n, a + chunk_rows)
-                labels[a:b] = np.asarray(
-                    kmeans_balanced.predict(centers,
-                                            to_device(dataset[a:b]), km))
-            counts = np.bincount(labels, minlength=params.n_lists)
-            L = _fit_list_size(counts, avg, params.list_size_cap_factor)
+                NC = min(ic.SPILL_DEPTH, params.n_lists)
+                lk = np.empty((n, NC), np.int32)
+                for a in range(0, n, chunk_rows):
+                    cancellation_point()
+                    b = min(n, a + chunk_rows)
+                    lk[a:b] = np.asarray(
+                        kmeans_balanced.predict_topk(centers,
+                                                     read_chunk(a, b),
+                                                     NC, km))
+                    if a % (8 * chunk_rows) == 0:
+                        _say(f"labeled {b}/{n}")
+                L = _lane_round(int(avg * params.list_size_cap_factor))
+                _say("spilling assignments")
+                labels = np.asarray(ic.spill_assignments(
+                    jnp.asarray(lk[:, 0]), jnp.asarray(lk[:, 1]),
+                    params.n_lists, L,
+                    *[jnp.asarray(lk[:, c]) for c in range(2, lk.shape[1])]))
+                del lk
+                _say("spill done; encode pass")
+                n_spill_drop = int((labels >= params.n_lists).sum())
+                if n_spill_drop:
+                    from raft_tpu.core import logging as _log
+                    _log.warn("ivf_pq chunked build: %d rows overflowed both "
+                              "choices at cap %d", n_spill_drop, L)
+                counts = np.bincount(labels[labels < params.n_lists],
+                                     minlength=params.n_lists)
+            else:
+                labels = np.empty(n, np.int32)
+                for a in range(0, n, chunk_rows):
+                    cancellation_point()  # chunk seams are cancellation points
+                    b = min(n, a + chunk_rows)
+                    labels[a:b] = np.asarray(
+                        kmeans_balanced.predict(centers,
+                                                read_chunk(a, b), km))
+                counts = np.bincount(labels, minlength=params.n_lists)
+                L = _fit_list_size(counts, avg, params.list_size_cap_factor)
+        if ck is not None:
+            ck.save_arrays("labels", labels=labels)
+            ck.write_manifest({**base_manifest, "phase": "encode",
+                               "L": int(L), "chunks_done": 0})
     nbytes = packed_nbytes(pq_dim, params.pq_bits)
 
     # 3. streaming encode + pack into the preallocated index
+    def encode_range(lo, hi):
+        """Encode dataset[lo:hi) → host (packed codes, norms). A chunk
+        that hits RESOURCE_EXHAUSTED is halved and retried (each row's
+        encode is independent, so splitting changes nothing but the
+        peak working set) — the build entry point's degradation rung."""
+        try:
+            xb = read_chunk(lo, hi)
+            lb = jnp.asarray(labels[lo:hi])
+            codes, norms = _encode_with_norms(xb @ rotation.T, centers_rot,
+                                              lb, codebooks,
+                                              params.codebook_kind)
+            return (pack_bits_np(np.asarray(codes), params.pq_bits),
+                    np.asarray(norms))
+        except Exception as e:
+            if not _degrade.is_resource_exhausted(e) or hi - lo <= 1024:
+                raise
+            _degrade.note_step("ivf_pq.build_chunked", "chunk",
+                               "half_chunk", "resource_exhausted")
+            from raft_tpu.core import logging as _log
+
+            _log.warn("ivf_pq chunked build: RESOURCE_EXHAUSTED encoding "
+                      "rows [%d, %d) — halving the chunk", lo, hi)
+            mid = (lo + hi) // 2
+            c1, n1 = encode_range(lo, mid)
+            c2, n2 = encode_range(mid, hi)
+            return np.concatenate([c1, c2]), np.concatenate([n1, n2])
+
+    chunks_done = int(manifest.get("chunks_done", 0)) if have_labels else 0
     packed = np.zeros((params.n_lists, L, nbytes), np.uint8)
     ids = np.full((params.n_lists, L), -1, np.int32)
     pnorm = np.zeros((params.n_lists, L), np.float32)
     cursor = np.zeros(params.n_lists, np.int64)  # next free slot per list
     dropped = 0
     with span("encode_pack"):
-        for a in range(0, n, chunk_rows):
-            cancellation_point()
+        for ci, a in enumerate(range(0, n, chunk_rows)):
             b = min(n, a + chunk_rows)
-            xb = to_device(dataset[a:b])
-            lb = jnp.asarray(labels[a:b])
-            codes, norms = _encode_with_norms(xb @ rotation.T, centers_rot,
-                                              lb, codebooks,
-                                              params.codebook_kind)
-            codes_h = pack_bits_np(np.asarray(codes), params.pq_bits)
-            norms_h = np.asarray(norms)
+            if ci < chunks_done:
+                # completed before the interruption: replay the encoded
+                # shard (no device work) so the pack below is identical
+                shard = ck.load_shard(ci)
+                codes_h = np.asarray(shard["codes"], np.uint8)
+                norms_h = np.asarray(shard["norms"], np.float32)
+                expects(codes_h.shape[0] == b - a,
+                        "resume shard %d holds %d rows, expected %d — "
+                        "corrupt checkpoint; refusing to resume",
+                        ci, codes_h.shape[0], b - a)
+                _count_resume("resume.chunks_replayed")
+            else:
+                cancellation_point()
+                _faults.faultpoint("build.chunk_encode")
+                codes_h, norms_h = encode_range(a, b)
+                if ck is not None:
+                    # shard first, then the manifest that records it —
+                    # a death between the two re-encodes one chunk, it
+                    # never trusts a missing shard
+                    ck.save_shard(ci, codes=codes_h, norms=norms_h)
+                    ck.write_manifest({**base_manifest, "phase": "encode",
+                                       "L": int(L), "chunks_done": ci + 1})
             lb_h = labels[a:b]
             order, sorted_l, slot = _stable_slots(lb_h, params.n_lists,
                                                   cursor)
@@ -900,6 +1051,9 @@ def build_chunked(dataset, params: Optional[IndexParams] = None,  # graftlint: d
                     :params.n_lists], L)
             if a % (8 * chunk_rows) == 0:
                 _say(f"encoded {b}/{n}")
+    if ck is not None:
+        ck.write_manifest({**base_manifest, "phase": "done", "L": int(L),
+                           "chunks_done": -(-n // chunk_rows)})
     if dropped:
         from raft_tpu.core import logging as _log
         _log.warn("ivf_pq chunked build: dropped %d overflow vectors", dropped)
@@ -1571,6 +1725,7 @@ def search(index: IvfPqIndex, queries: jax.Array, k: int,
         params = SearchParams()
     expects(queries.ndim == 2 and queries.shape[1] == index.dim,
             "queries must be [m, %d]", index.dim)
+    _faults.faultpoint("ivf_pq.search")
     if params.refine != "none":
         return _route_refined(index, queries, k, params, filter_bitset,
                               dataset)
@@ -1633,8 +1788,9 @@ def search(index: IvfPqIndex, queries: jax.Array, k: int,
                 _warn_lut_fallback()
                 select_impl = "approx"
         if want_lut:
-            mem_ok = ic.lut_scan_mem_ok(n_seg, seg, index.rot_dim,
-                                        pairs, _pk.LUT_SCAN_BINS)
+            mem_ok = (ic.lut_scan_mem_ok(n_seg, seg, index.rot_dim,
+                                         pairs, _pk.LUT_SCAN_BINS)
+                      and not _faults.forced("ivf_pq.scan.mem_guard"))
             kernel_ok = mem_ok and _pk.pallas_lut_scan_wanted(
                 index.pq_dim, index.pq_book_size, index.pq_len,
                 packed_nbytes(index.pq_dim, index.pq_bits),
@@ -1649,9 +1805,18 @@ def search(index: IvfPqIndex, queries: jax.Array, k: int,
                         lut_dtype=params.lut_dtype)
                     _sp.attach(out)
                 return out
-            _count_lut_fallback(
-                "per_cluster" if index.codebook_kind != "per_subspace"
-                else "mem_guard" if not mem_ok else "kernel_ineligible")
+            reason = ("per_cluster" if index.codebook_kind != "per_subspace"
+                      else "mem_guard" if not mem_ok else "kernel_ineligible")
+            _count_lut_fallback(reason)
+            if reason == "mem_guard":
+                # the static half of the degradation policy: a guard
+                # declining the fused tier before it OOMs records the
+                # same degrade.steps move the reactive ladder would
+                # (explicit pallas requests land on approx, see below)
+                to_impl = ("approx" if params.scan_select == "pallas"
+                           else select_impl)
+                _degrade.note_step("ivf_pq.search", "pallas_lut",
+                                   f"grouped_{to_impl}", "mem_guard")
             if params.scan_select == "pallas":
                 # an EXPLICIT pallas request that the kernel can't serve
                 # (per_cluster codebooks, unsupported layout, off-TPU, or
@@ -1686,6 +1851,32 @@ def search(index: IvfPqIndex, queries: jax.Array, k: int,
     return _search_impl(index, queries, k, n_probes,
                         _fit_query_tile(params.query_tile, n_probes, index),
                         filter_bits=filter_bitset, lut_dtype=params.lut_dtype)
+
+
+@traced("raft_tpu.ivf_pq.search_resilient")
+def search_resilient(index: IvfPqIndex, queries: jax.Array, k: int,
+                     params: Optional[SearchParams] = None,
+                     filter_bitset: Optional[jax.Array] = None,
+                     dataset=None) -> Tuple[jax.Array, jax.Array]:
+    """:func:`search` behind the standard degradation ladder
+    (:mod:`raft_tpu.robust.degrade`): a ``RESOURCE_EXHAUSTED`` walks
+    halve-batch → bf16 LUT → decline fused tier → host gather (then
+    keeps halving) instead of crashing the request, recording every
+    move in ``degrade.steps{site=ivf_pq.search,from=,to=,reason=}``.
+    Results are the degraded configuration's results — batch splitting
+    is exact (each query's math is independent); the bf16-LUT and
+    declined-tier rungs trade the documented precision/speed margins.
+    Serving loops should call this; offline sweeps that prefer a crash
+    to a silently degraded number keep calling :func:`search`."""
+    if params is None:
+        params = SearchParams()
+    queries = jnp.asarray(queries)
+    return _degrade.run_with_degradation(
+        _degrade.batched_search_call(search, index, queries, k,
+                                     filter_bitset),
+        {"params": params, "dataset": dataset},
+        _degrade.standard_search_ladder(queries.shape[0], has_lut=True),
+        site="ivf_pq.search")
 
 
 # ---------------------------------------------------------------------------

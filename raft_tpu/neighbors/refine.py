@@ -38,6 +38,8 @@ from raft_tpu.core.tracing import traced, span
 from raft_tpu.distance.types import DistanceType, resolve_metric
 from raft_tpu.matrix import select_k as _select_k
 from raft_tpu.obs import spans as _obs_spans
+from raft_tpu.robust import degrade as _degrade
+from raft_tpu.robust import faults as _faults
 from raft_tpu.utils.precision import get_precision
 
 
@@ -136,10 +138,18 @@ def _fused_refine_wanted(dataset, queries, candidates, k: int) -> bool:
         return False
     if dataset.dtype not in (jnp.float32, jnp.bfloat16):
         return False
-    if not ic.gather_refine_mem_ok(dataset.shape[0], dataset.shape[1],
-                                   dataset.dtype.itemsize,
-                                   m=candidates.shape[0],
-                                   C=candidates.shape[1]):
+    mem_ok = ic.gather_refine_mem_ok(dataset.shape[0], dataset.shape[1],
+                                     dataset.dtype.itemsize,
+                                     m=candidates.shape[0],
+                                     C=candidates.shape[1])
+    if _faults.forced("refine.mem_guard"):  # CI-testable decline path
+        mem_ok = False
+    if not mem_ok:
+        # the static half of the degradation policy (robust.degrade):
+        # the guard's pre-emptive tier decline counts the same
+        # degrade.steps move a reactive OOM walk would
+        _degrade.note_step("refine", "pallas_gather", "xla_gather",
+                           "mem_guard")
         return False
     return _pk.pallas_gather_refine_wanted(
         candidates.shape[0], candidates.shape[1], dataset.shape[1], k,

@@ -61,6 +61,22 @@ class _LogTail(logging.Handler):
             pass
 
 
+def _watchdog_kill_info() -> Optional[Dict[str, Any]]:
+    """Parse the stall-kill sidecar ``tools/run_watchdog.sh`` exports
+    via the ``WATCHDOG_KILL_INFO`` env var (a JSON file path the
+    watchdog writes just before SIGTERM). None when unset, absent, or
+    unparseable — a broken sidecar must never cost the dump."""
+    path = os.environ.get("WATCHDOG_KILL_INFO", "")  # path value
+    if not path or not os.path.exists(path):
+        return None
+    try:
+        with open(path) as f:
+            info = json.load(f)
+        return info if isinstance(info, dict) else {"raw": info}
+    except Exception:
+        return None
+
+
 def _resolve_signals(signals: Sequence) -> List[int]:
     out = []
     for s in signals:
@@ -99,7 +115,7 @@ class FlightRecorder:
             metrics = _spans.registry().snapshot()
         except Exception:  # a half-swapped registry must not lose the dump
             metrics = _metrics.get_registry().snapshot()
-        return {
+        out = {
             "schema": SCHEMA,
             "reason": reason,
             "pid": os.getpid(),
@@ -112,6 +128,14 @@ class FlightRecorder:
             "dropped_events": buf.dropped,
             "logs": list(self._log_tail.lines),
         }
+        watchdog = _watchdog_kill_info()
+        if watchdog is not None:
+            # why an external supervisor killed us (tools/run_watchdog.sh
+            # writes its stall-kill reason + elapsed time to the file
+            # named by WATCHDOG_KILL_INFO just before SIGTERM) — the
+            # dump then says WHY it was killed, not just that it was
+            out["watchdog"] = watchdog
+        return out
 
     def dump(self, reason: str = "manual",
              path: Optional[str] = None) -> str:
@@ -126,9 +150,24 @@ class FlightRecorder:
         body = self.payload(reason)
         with self._dump_lock:
             tmp = f"{path}.tmp.{os.getpid()}"
-            with open(tmp, "w") as f:
-                json.dump(body, f)
-            os.replace(tmp, path)
+            try:
+                with open(tmp, "w") as f:
+                    json.dump(body, f)
+                    # fsync BEFORE the rename: without it a power loss /
+                    # SIGKILL after the (atomic) rename but before the
+                    # data reaches disk can leave a zero-byte "latest"
+                    # dump — the rename must never outrun its contents
+                    f.flush()
+                    os.fsync(f.fileno())
+                os.replace(tmp, path)
+            except BaseException:
+                # never leave tmp litter; the dump path either exposes a
+                # complete file or nothing
+                try:
+                    os.unlink(tmp)
+                except OSError:
+                    pass
+                raise
         return path
 
     # -- signal / atexit / periodic hooks -----------------------------------

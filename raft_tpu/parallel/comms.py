@@ -48,6 +48,7 @@ from raft_tpu.core.compat import axis_size as _axis_size
 from raft_tpu.core.tracing import annotate as _annotate
 from raft_tpu.obs import sanitize as _sanitize
 from raft_tpu.obs import spans as _obs
+from raft_tpu.robust import faults as _faults
 
 
 class Op(enum.Enum):
@@ -130,7 +131,14 @@ class Comms:
         Runs at trace time from static shape/dtype only — once per jit
         trace (the obs.count_dispatch semantics), zero host syncs, one
         flag check when observability is off. The sanitize lane's
-        collective-schedule recorder taps the same per-trace event."""
+        collective-schedule recorder taps the same per-trace event.
+
+        Every collective is also a named fault point
+        (``comms.<verb>``, robust.faults): a fault plan can fail a
+        collective *at trace time* — aborting the trace exactly where a
+        wedged ICI link would abort the program — so distributed
+        failure handling is CI-testable without breaking hardware."""
+        _faults.faultpoint(f"comms.{op_name}")
         if _sanitize.comms_schedule_recording():
             _sanitize.note_collective(op_name,
                                       _axis_label(self.axis_name),

@@ -1,0 +1,242 @@
+"""Graceful-degradation controller — a declared ladder instead of a crash.
+
+A ``RESOURCE_EXHAUSTED`` from a search/build entry point is almost
+never fatal to the *request* — it is fatal to the *configuration*:
+the batch was too wide, the LUT too precise, the fused tier's
+transients too big, the re-rank base resident where it need not be.
+Production ANN services degrade through exactly those knobs instead of
+500ing. This module formalizes that walk:
+
+- :func:`is_resource_exhausted` classifies real XLA/PJRT OOMs and the
+  fault harness's :class:`~raft_tpu.robust.faults.
+  InjectedResourceExhausted` identically (so the ladder is CI-testable);
+- a :class:`Ladder` declares ordered :class:`Step` rungs; each
+  RESOURCE_EXHAUSTED advances one rung (``halve_batch → bf16_lut →
+  decline_fused → host_gather → halve_batch…``, see
+  :func:`standard_search_ladder`);
+- :func:`run_with_degradation` drives a callable through the ladder and
+  counts every move: ``degrade.steps{site=,from=,to=,reason=}``, plus
+  ``degrade.recovered{site=}`` / ``degrade.exhausted{site=}``.
+
+It also owns :func:`note_step` — the *pre-emptive* half of the same
+policy: the scattered ``*_mem_ok`` guards (LUT-scan, fused
+gather-refine) that decline a tier before OOMing now record their
+decline through the same ``degrade.steps`` counter, so "what ran
+degraded and why" is one query over one metric family, whether the
+degradation was reactive (caught OOM) or static (guard decline).
+
+Entry-point wiring lives with the entry points:
+``ivf_pq.search_resilient`` / ``ivf_flat.search_resilient`` wrap their
+``search`` with :func:`standard_search_ladder`; ``ivf_pq.build_chunked``
+halves an OOMing encode chunk via :func:`run_with_degradation`.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import sys
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+# one classifier for "is this an OOM": retry uses it to refuse blind
+# re-execution, degrade uses it to trigger the ladder — shared so the
+# two policies can never disagree about the same exception
+from raft_tpu.robust.retry import is_resource_exhausted  # noqa: F401
+
+__all__ = [
+    "is_resource_exhausted", "Step", "Ladder", "DegradationExhausted",
+    "run_with_degradation", "standard_search_ladder", "note_step",
+    "batched_search_call",
+]
+
+@dataclasses.dataclass
+class Step:
+    """One rung: ``apply(knobs) -> new knobs`` or ``None`` when the rung
+    does not apply to the current knobs (already taken / not
+    applicable). ``repeatable`` rungs may fire again on later failures
+    (the terminal keep-halving rung); others are consumed once."""
+
+    name: str
+    apply: Callable[[Dict[str, Any]], Optional[Dict[str, Any]]]
+    repeatable: bool = False
+
+
+class Ladder:
+    """Ordered degradation rungs with a cursor: each failure advances to
+    the first applicable rung at or after the cursor."""
+
+    def __init__(self, steps: List[Step]):
+        self.steps = list(steps)
+        self._cursor = 0
+
+    def advance(self, knobs: Dict[str, Any]
+                ) -> Optional[Tuple[Step, Dict[str, Any]]]:
+        for i in range(self._cursor, len(self.steps)):
+            step = self.steps[i]
+            new = step.apply(dict(knobs))
+            if new is not None:
+                self._cursor = i if step.repeatable else i + 1
+                return step, new
+        return None
+
+
+class DegradationExhausted(RuntimeError):
+    """Every rung was walked and the call still hit RESOURCE_EXHAUSTED.
+    ``__cause__`` is the final OOM; ``path`` the rung names taken."""
+
+    def __init__(self, site: str, path: List[str], last: BaseException):
+        super().__init__(
+            f"degradation ladder exhausted at {site!r} "
+            f"(path: {' -> '.join(path) or 'none applicable'}): {last!r}")
+        self.site = site
+        self.path = path
+        self.last = last
+
+
+def _count(name: str, labels: Dict[str, str]) -> None:
+    spans = sys.modules.get("raft_tpu.obs.spans")
+    if spans is not None and spans.enabled():
+        spans.registry().inc(name, labels=labels)
+
+
+def note_step(site: str, frm: str, to: str, reason: str) -> None:
+    """Record one degradation move into
+    ``degrade.steps{site=,from=,to=,reason=}`` outside the reactive
+    ladder: a guard's pre-emptive tier decline (``*_mem_ok`` and
+    friends) or a caller-managed shrink (the chunked build halving an
+    OOMing chunk) — one observable degradation policy either way."""
+    _count("degrade.steps",
+           {"site": site, "from": frm, "to": to, "reason": reason})
+
+
+def run_with_degradation(call: Callable[[Dict[str, Any]], Any],
+                         knobs: Dict[str, Any],
+                         ladder: Ladder,
+                         site: str) -> Any:
+    """Run ``call(knobs)``; on RESOURCE_EXHAUSTED advance ``ladder`` one
+    rung and retry with the degraded knobs. Non-OOM exceptions propagate
+    unchanged. Raises :class:`DegradationExhausted` when no rung is
+    left."""
+    state = "native"
+    path: List[str] = []
+    while True:
+        try:
+            out = call(knobs)
+        except Exception as e:
+            if not is_resource_exhausted(e):
+                raise
+            advanced = ladder.advance(knobs)
+            if advanced is None:
+                _count("degrade.exhausted", {"site": site})
+                raise DegradationExhausted(site, path, e) from e
+            step, knobs = advanced
+            _count("degrade.steps", {"site": site, "from": state,
+                                     "to": step.name,
+                                     "reason": "resource_exhausted"})
+            from raft_tpu.core import logging as _log
+
+            _log.warn("%s: RESOURCE_EXHAUSTED — degrading %s -> %s",
+                      site, state, step.name)
+            state = step.name
+            path.append(step.name)
+            continue
+        if path:
+            _count("degrade.recovered", {"site": site})
+        return out
+
+
+def batched_search_call(search_fn, index, queries, k: int,
+                        filter_bitset) -> Callable[[Dict[str, Any]], Any]:
+    """Build the ladder ``call(knobs)`` for a search entry point (the
+    shared body of ``ivf_pq.search_resilient`` /
+    ``ivf_flat.search_resilient``): honors the knobs the standard
+    ladder mutates — ``params``, ``dataset``, and ``max_batch``
+    (splitting the query batch and concatenating per-axis results when
+    a halve-batch rung has fired; each query's math is independent, so
+    splitting is exact)."""
+    import jax.numpy as jnp
+
+    B = queries.shape[0]
+
+    def call(knobs: Dict[str, Any]):
+        p = knobs["params"]
+        ds = knobs.get("dataset")
+        mb = knobs.get("max_batch")
+        if not mb or mb >= B:
+            return search_fn(index, queries, k, p, filter_bitset, ds)
+        outs = [search_fn(index, queries[a:a + mb], k, p, filter_bitset,
+                          ds)
+                for a in range(0, B, mb)]
+        return (jnp.concatenate([o[0] for o in outs], axis=0),
+                jnp.concatenate([o[1] for o in outs], axis=0))
+
+    return call
+
+
+# ---------------------------------------------------------------------------
+# the standard search ladder (ISSUE 7: halve query batch → bf16 LUT →
+# decline fused tier → host gather; then keep halving)
+# ---------------------------------------------------------------------------
+
+def _halve_batch(total: int):
+    def apply(knobs):
+        cur = knobs.get("max_batch") or total
+        if cur <= 1:
+            return None
+        knobs["max_batch"] = max(1, cur // 2)
+        return knobs
+    return apply
+
+
+def _bf16_lut(knobs):
+    params = knobs["params"]
+    if getattr(params, "lut_dtype", None) != "float32":
+        return None
+    knobs["params"] = dataclasses.replace(params, lut_dtype="bfloat16")
+    return knobs
+
+
+def _decline_fused(knobs):
+    """Route off the fused/grouped tiers: pallas → approx select first,
+    then the grouped scan → the tile-bounded per_query path (whose
+    working set _fit_query_tile caps at ~1 GB)."""
+    params = knobs["params"]
+    if getattr(params, "scan_select", None) == "pallas":
+        knobs["params"] = dataclasses.replace(params, scan_select="approx")
+        return knobs
+    if getattr(params, "scan_mode", None) != "per_query":
+        knobs["params"] = dataclasses.replace(params, scan_mode="per_query")
+        return knobs
+    return None
+
+
+def _host_gather(knobs):
+    """Move the re-rank base off the device: the refined path then
+    routes through refine_gathered (host gather of candidate rows) and
+    the dataset's HBM residency is reclaimed."""
+    params = knobs["params"]
+    dataset = knobs.get("dataset")
+    if getattr(params, "refine", "none") == "none" or dataset is None:
+        return None
+    import jax
+    import numpy as np
+
+    if not isinstance(dataset, jax.Array):
+        return None  # already host-side
+    knobs["dataset"] = np.asarray(dataset)
+    return knobs
+
+
+def standard_search_ladder(batch: int, has_lut: bool = False) -> Ladder:
+    """The declared search ladder. ``batch`` is the incoming query
+    count; ``has_lut`` adds the bf16-LUT rung (IVF-PQ only — IVF-Flat
+    has no LUT to quantize). The terminal rung keeps halving the batch
+    down to 1 so a pathological shape still completes, just slowly."""
+    steps = [Step("halve_batch", _halve_batch(batch))]
+    if has_lut:
+        steps.append(Step("bf16_lut", _bf16_lut))
+    # repeatable: declining the fused tier is two moves (pallas select →
+    # approx, then the grouped scan → the tile-bounded per_query path)
+    steps.append(Step("decline_fused", _decline_fused, repeatable=True))
+    steps.append(Step("host_gather", _host_gather))
+    steps.append(Step("halve_batch", _halve_batch(batch), repeatable=True))
+    return Ladder(steps)

@@ -269,6 +269,68 @@ class TestFlightRecorder:
         assert signal_dumps[0]["schema"] == flight.SCHEMA
 
 
+class TestFlightDumpDurability:
+    """ISSUE 7 satellite: the dump path must never expose a partial
+    file — fsync BEFORE the atomic rename, and a failed write leaves
+    neither the target nor tmp litter."""
+
+    def test_fsync_happens_before_rename(self, tmp_path, monkeypatch):
+        rec = flight.FlightRecorder(str(tmp_path))
+        order = []
+        real_fsync, real_replace = os.fsync, os.replace
+        monkeypatch.setattr(
+            os, "fsync", lambda fd: (order.append("fsync"),
+                                     real_fsync(fd))[1])
+        monkeypatch.setattr(
+            os, "replace",
+            lambda a, b: (order.append("rename"), real_replace(a, b))[1])
+        path = rec.dump(reason="durability")
+        assert os.path.exists(path)
+        assert "fsync" in order and "rename" in order
+        assert order.index("fsync") < order.index("rename"), order
+        rec.close()
+
+    def test_failed_dump_exposes_nothing(self, tmp_path, monkeypatch):
+        rec = flight.FlightRecorder(str(tmp_path))
+        def boom(*a, **k):
+            raise OSError("disk full")
+
+        monkeypatch.setattr(json, "dump", boom)
+        with pytest.raises(OSError):
+            rec.dump(reason="boom")
+        leftovers = [f for f in os.listdir(str(tmp_path))
+                     if f.startswith("flight_")]
+        assert not leftovers, leftovers  # no final file, no tmp litter
+        rec.close()
+
+    def test_watchdog_kill_info_rides_the_dump(self, tmp_path,
+                                               monkeypatch):
+        info = tmp_path / "kill.json"
+        info.write_text(json.dumps({"reason": "stall", "stalled_min": 5,
+                                    "elapsed_s": 301, "attempt": 0}))
+        monkeypatch.setenv("WATCHDOG_KILL_INFO", str(info))
+        rec = flight.FlightRecorder(str(tmp_path))
+        with open(rec.dump(reason="killed")) as f:
+            doc = json.load(f)
+        assert doc["watchdog"] == {"reason": "stall", "stalled_min": 5,
+                                   "elapsed_s": 301, "attempt": 0}
+        rec.close()
+
+    def test_watchdog_sidecar_absent_or_broken_is_ignored(
+            self, tmp_path, monkeypatch):
+        monkeypatch.setenv("WATCHDOG_KILL_INFO",
+                           str(tmp_path / "nope.json"))
+        rec = flight.FlightRecorder(str(tmp_path))
+        with open(rec.dump(reason="x")) as f:
+            assert "watchdog" not in json.load(f)
+        broken = tmp_path / "broken.json"
+        broken.write_text("{truncated")
+        monkeypatch.setenv("WATCHDOG_KILL_INFO", str(broken))
+        with open(rec.dump(reason="y")) as f:
+            assert "watchdog" not in json.load(f)
+        rec.close()
+
+
 class TestQuantiles:
     def test_histogram_quantile_interpolates(self):
         h = obs.Histogram("lat", buckets=[0.01, 0.1, 1.0])

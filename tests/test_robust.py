@@ -1,0 +1,475 @@
+"""raft_tpu.robust — fault injection, retry policy, degradation ladder
+(ISSUE 7 tentpole; docs/developer_guide.md "Robustness")."""
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from raft_tpu import obs
+from raft_tpu.obs.metrics import MetricsRegistry
+from raft_tpu.robust import degrade, faults, retry
+
+
+@pytest.fixture(autouse=True)
+def _clean_plan():
+    """Fault plans are process-global — leave none behind."""
+    faults.clear_plan()
+    yield
+    faults.clear_plan()
+    obs.disable()
+
+
+def _counters(reg):
+    return reg.snapshot()["counters"]
+
+
+# ---------------------------------------------------------------------------
+# faults
+# ---------------------------------------------------------------------------
+
+class TestFaults:
+    def test_no_plan_is_a_noop(self):
+        assert faults.faultpoint("anything") is None
+        assert faults.fires() == {}
+
+    def test_error_kind_raises_transient(self):
+        faults.install_plan({"faults": [{"site": "s", "kind": "error"}]})
+        with pytest.raises(faults.FaultInjected) as ei:
+            faults.faultpoint("s")
+        assert ei.value.transient is True
+        assert ei.value.site == "s"
+
+    def test_oom_kind_matches_resource_exhausted(self):
+        faults.install_plan({"faults": [{"site": "s", "kind": "oom"}]})
+        with pytest.raises(faults.InjectedResourceExhausted) as ei:
+            faults.faultpoint("s")
+        assert degrade.is_resource_exhausted(ei.value)
+        assert ei.value.transient is False  # never blind-retried
+        assert not retry.default_retryable(ei.value)
+
+    def test_after_and_times_semantics(self):
+        faults.install_plan({"faults": [
+            {"site": "s", "kind": "error", "after": 3, "times": 2}]})
+        assert faults.faultpoint("s") is None  # hit 1
+        assert faults.faultpoint("s") is None  # hit 2
+        for _ in range(2):                     # hits 3, 4 fire
+            with pytest.raises(faults.FaultInjected):
+                faults.faultpoint("s")
+        assert faults.faultpoint("s") is None  # times cap reached
+        assert faults.fires() == {"s": 2}
+
+    def test_probability_is_deterministic_by_seed(self):
+        spec = {"seed": 42, "faults": [
+            {"site": "s", "kind": "nan", "p": 0.5, "times": 0}]}
+        runs = []
+        for _ in range(2):
+            faults.install_plan(dict(spec))
+            runs.append([faults.faultpoint("s") for _ in range(20)])
+        assert runs[0] == runs[1]
+        assert "nan" in runs[0] and None in runs[0]  # both outcomes occur
+
+    def test_unknown_kind_rejected(self):
+        with pytest.raises(ValueError, match="unknown fault kind"):
+            faults.install_plan({"faults": [{"site": "s",
+                                             "kind": "explode"}]})
+
+    def test_corrupt_nan_poisons_floats(self):
+        faults.install_plan({"faults": [{"site": "s", "kind": "nan"}]})
+        out = faults.corrupt("s", np.ones((3,), np.float32))
+        assert np.isnan(out).all()
+        assert np.array_equal(faults.corrupt("s", np.ones(3)),
+                              np.ones(3))  # times=1 consumed
+
+    def test_forced(self):
+        faults.install_plan({"faults": [{"site": "g", "kind": "force"}]})
+        assert faults.forced("g") is True
+        assert faults.forced("g") is False  # consumed
+        assert faults.forced("other") is False
+
+    def test_env_inline_plan_arms_lazily(self, monkeypatch):
+        monkeypatch.setenv("RAFT_TPU_FAULT_PLAN_JSON",
+                           '{"faults": [{"site": "e", "kind": "force"}]}')
+        monkeypatch.setattr(faults, "_plan", None)
+        monkeypatch.setattr(faults, "_env_checked", False)
+        assert faults.forced("e") is True
+        faults.clear_plan()
+
+    def test_fired_counter(self):
+        reg = MetricsRegistry()
+        obs.enable(registry=reg, hbm=False)
+        faults.install_plan({"faults": [{"site": "c", "kind": "force"}]})
+        assert faults.forced("c")
+        assert _counters(reg)["faults.fired{kind=force,site=c}"] == 1.0
+
+
+# ---------------------------------------------------------------------------
+# retry
+# ---------------------------------------------------------------------------
+
+class _Flaky:
+    def __init__(self, fail_times, exc_factory):
+        self.calls = 0
+        self.fail_times = fail_times
+        self.exc_factory = exc_factory
+
+    def __call__(self):
+        self.calls += 1
+        if self.calls <= self.fail_times:
+            raise self.exc_factory()
+        return "ok"
+
+
+class TestRetry:
+    def test_first_try_success_never_sleeps(self):
+        slept = []
+        st = {}
+        out = retry.retry_call(lambda: 7, site="s", stats=st,
+                               sleep=slept.append)
+        assert out == 7 and st["attempts"] == 1 and not slept
+        assert st["outcome"] == "ok"
+
+    def test_transient_then_success_recovers(self):
+        reg = MetricsRegistry()
+        obs.enable(registry=reg, hbm=False)
+        fn = _Flaky(2, lambda: OSError("read hiccup"))
+        slept = []
+        st = {}
+        policy = retry.RetryPolicy(max_attempts=3, base_delay_s=0.1,
+                                   multiplier=2.0, jitter=0.0)
+        assert retry.retry_call(fn, site="io", policy=policy, stats=st,
+                                sleep=slept.append) == "ok"
+        assert st["attempts"] == 3 and st["outcome"] == "recovered"
+        assert slept == [0.1, 0.2]  # exponential, jitter off
+        c = _counters(reg)
+        assert c["retry.attempts{site=io}"] == 3.0
+        assert c["retry.recovered{site=io}"] == 1.0
+
+    def test_exhausted_raises_with_cause_and_counter(self):
+        reg = MetricsRegistry()
+        obs.enable(registry=reg, hbm=False)
+        fn = _Flaky(99, lambda: TimeoutError("still down"))
+        policy = retry.RetryPolicy(max_attempts=2, base_delay_s=0.0)
+        with pytest.raises(retry.RetryExhausted) as ei:
+            retry.retry_call(fn, site="s", policy=policy,
+                             sleep=lambda d: None)
+        assert ei.value.attempts == 2
+        assert isinstance(ei.value.last, TimeoutError)
+        assert _counters(reg)["retry.exhausted{site=s}"] == 1.0
+
+    def test_non_retryable_propagates_unwrapped(self):
+        st = {}
+        with pytest.raises(ValueError):
+            retry.retry_call(_Flaky(9, lambda: ValueError("logic bug")),
+                             site="s", stats=st, sleep=lambda d: None)
+        assert st["attempts"] == 1 and st["outcome"] == "fatal"
+
+    def test_oom_is_never_retried(self):
+        fn = _Flaky(9, lambda: RuntimeError(
+            "RESOURCE_EXHAUSTED: Out of memory allocating 7 bytes"))
+        with pytest.raises(RuntimeError, match="RESOURCE_EXHAUSTED"):
+            retry.retry_call(fn, site="s", sleep=lambda d: None)
+        assert fn.calls == 1
+
+    def test_jitter_bounds(self):
+        slept = []
+        fn = _Flaky(1, lambda: OSError("x"))
+        policy = retry.RetryPolicy(max_attempts=2, base_delay_s=1.0,
+                                   jitter=0.5)
+        retry.retry_call(fn, site="s", policy=policy, sleep=slept.append)
+        assert len(slept) == 1 and 0.5 <= slept[0] <= 1.5
+
+    def test_deadline_budget_stops_early(self, monkeypatch):
+        clock = [0.0]
+        monkeypatch.setattr(retry.time, "monotonic", lambda: clock[0])
+        fn = _Flaky(9, lambda: OSError("x"))
+        policy = retry.RetryPolicy(max_attempts=10, base_delay_s=5.0,
+                                   jitter=0.0, deadline_s=4.0)
+        with pytest.raises(retry.RetryExhausted) as ei:
+            retry.retry_call(fn, site="s", policy=policy,
+                             sleep=lambda d: None)
+        assert ei.value.attempts == 1  # a 5s backoff can't fit 4s budget
+
+    def test_injected_fault_is_retryable(self):
+        faults.install_plan({"faults": [
+            {"site": "r", "kind": "error", "times": 1}]})
+
+        def body():
+            faults.faultpoint("r")
+            return "done"
+
+        st = {}
+        assert retry.retry_call(body, site="r", stats=st,
+                                sleep=lambda d: None) == "done"
+        assert st["outcome"] == "recovered"
+
+    def test_decorator(self):
+        calls = []
+
+        @retry.retrying("deco", retry.RetryPolicy(max_attempts=2,
+                                                  base_delay_s=0.0))
+        def fn():
+            calls.append(1)
+            if len(calls) < 2:
+                raise OSError("x")
+            return len(calls)
+
+        assert fn() == 2
+
+    def test_policy_describe_mentions_knobs(self):
+        s = retry.RetryPolicy(base_delay_s=15.0, jitter=0.25).describe()
+        assert "15" in s and "25%" in s
+
+
+# ---------------------------------------------------------------------------
+# degrade
+# ---------------------------------------------------------------------------
+
+def _oom():
+    raise RuntimeError("RESOURCE_EXHAUSTED: out of memory")
+
+
+class TestDegrade:
+    def test_classifier(self):
+        assert degrade.is_resource_exhausted(
+            RuntimeError("RESOURCE_EXHAUSTED: ..."))
+        assert degrade.is_resource_exhausted(
+            RuntimeError("Resource exhausted: Out of memory"))
+        assert not degrade.is_resource_exhausted(ValueError("nope"))
+
+    def test_ladder_walk_records_path_and_recovers(self):
+        reg = MetricsRegistry()
+        obs.enable(registry=reg, hbm=False)
+        fails = [2]
+
+        def call(knobs):
+            if fails[0]:
+                fails[0] -= 1
+                _oom()
+            return knobs
+
+        ladder = degrade.Ladder([
+            degrade.Step("a", lambda kn: {**kn, "a": 1}),
+            degrade.Step("b", lambda kn: {**kn, "b": 1}),
+        ])
+        out = degrade.run_with_degradation(call, {}, ladder, site="t")
+        assert out == {"a": 1, "b": 1}
+        c = _counters(reg)
+        assert c["degrade.steps{from=native,reason=resource_exhausted,"
+                 "site=t,to=a}"] == 1.0
+        assert c["degrade.steps{from=a,reason=resource_exhausted,"
+                 "site=t,to=b}"] == 1.0
+        assert c["degrade.recovered{site=t}"] == 1.0
+
+    def test_exhausted_ladder_raises_with_path(self):
+        reg = MetricsRegistry()
+        obs.enable(registry=reg, hbm=False)
+        ladder = degrade.Ladder([degrade.Step("only",
+                                              lambda kn: {**kn, "x": 1})])
+        with pytest.raises(degrade.DegradationExhausted) as ei:
+            degrade.run_with_degradation(lambda kn: _oom(), {}, ladder,
+                                         site="t")
+        assert ei.value.path == ["only"]
+        assert degrade.is_resource_exhausted(ei.value.last)
+        assert _counters(reg)["degrade.exhausted{site=t}"] == 1.0
+
+    def test_non_oom_propagates(self):
+        ladder = degrade.Ladder([degrade.Step("a", lambda kn: kn)])
+
+        def call(knobs):
+            raise ValueError("logic bug")
+
+        with pytest.raises(ValueError):
+            degrade.run_with_degradation(call, {}, ladder, site="t")
+
+    def test_repeatable_terminal_rung(self):
+        fails = [3]
+
+        def call(knobs):
+            if fails[0]:
+                fails[0] -= 1
+                _oom()
+            return knobs
+
+        ladder = degrade.Ladder([
+            degrade.Step("halve", degrade._halve_batch(8),
+                         repeatable=True)])
+        out = degrade.run_with_degradation(call, {}, ladder, site="t")
+        assert out["max_batch"] == 1  # 8 → 4 → 2 → 1
+
+    def test_standard_ladder_order(self):
+        from raft_tpu.neighbors import ivf_pq
+
+        ladder = degrade.standard_search_ladder(64, has_lut=True)
+        knobs = {"params": ivf_pq.SearchParams(scan_select="pallas"),
+                 "dataset": jnp.ones((8, 4))}
+        names = []
+        for _ in range(6):
+            adv = ladder.advance(knobs)
+            if adv is None:
+                break
+            step, knobs = adv
+            names.append(step.name)
+        # pallas→approx then →per_query are two decline_fused moves;
+        # host_gather skipped (refine off); terminal halving repeats
+        assert names[:2] == ["halve_batch", "bf16_lut"]
+        assert names[2:4] == ["decline_fused", "decline_fused"]
+        assert set(names[4:]) == {"halve_batch"}
+        assert knobs["params"].scan_select == "approx"
+        assert knobs["params"].scan_mode == "per_query"
+        assert knobs["params"].lut_dtype == "bfloat16"
+
+    def test_host_gather_rung_moves_dataset(self):
+        from raft_tpu.neighbors import ivf_pq
+
+        params = ivf_pq.SearchParams(refine="f32_regen")
+        knobs = {"params": params, "dataset": jnp.ones((8, 4))}
+        out = degrade._host_gather(dict(knobs))
+        assert isinstance(out["dataset"], np.ndarray)
+        # already host-side → rung not applicable
+        assert degrade._host_gather(dict(out)) is None
+
+
+# ---------------------------------------------------------------------------
+# entry-point wiring: search_resilient, mem-guard declines, comms
+# ---------------------------------------------------------------------------
+
+@pytest.fixture(scope="module")
+def pq_index():
+    from raft_tpu.neighbors import ivf_pq
+
+    rng = np.random.default_rng(0)
+    x = jnp.asarray(rng.random((2000, 32), dtype=np.float32))
+    idx = ivf_pq.build(x, ivf_pq.IndexParams(
+        n_lists=16, pq_dim=16, seed=0, cache_reconstruction="never"))
+    return idx, x
+
+
+class TestSearchResilient:
+    def test_injected_oom_completes_with_identical_results(self, pq_index):
+        from raft_tpu.neighbors import ivf_pq
+
+        idx, x = pq_index
+        sp = ivf_pq.SearchParams(n_probes=8, scan_mode="per_query")
+        d0, i0 = ivf_pq.search(idx, x[:64], 10, sp)
+        reg = MetricsRegistry()
+        obs.enable(registry=reg, hbm=False)
+        faults.install_plan({"faults": [
+            {"site": "ivf_pq.search", "kind": "oom", "times": 1}]})
+        d1, i1 = ivf_pq.search_resilient(idx, x[:64], 10, sp)
+        np.testing.assert_array_equal(np.asarray(i1), np.asarray(i0))
+        np.testing.assert_allclose(np.asarray(d1), np.asarray(d0),
+                                   rtol=1e-6, atol=1e-6)
+        c = _counters(reg)
+        assert c["degrade.steps{from=native,reason=resource_exhausted,"
+                 "site=ivf_pq.search,to=halve_batch}"] == 1.0
+        assert c["degrade.recovered{site=ivf_pq.search}"] == 1.0
+
+    def test_two_injected_ooms_walk_two_rungs(self, pq_index):
+        from raft_tpu.neighbors import ivf_pq
+
+        idx, x = pq_index
+        reg = MetricsRegistry()
+        obs.enable(registry=reg, hbm=False)
+        faults.install_plan({"faults": [
+            {"site": "ivf_pq.search", "kind": "oom", "times": 2}]})
+        sp = ivf_pq.SearchParams(n_probes=8, scan_mode="per_query")
+        d1, i1 = ivf_pq.search_resilient(idx, x[:32], 10, sp)
+        assert i1.shape == (32, 10)
+        c = _counters(reg)
+        assert c["degrade.steps{from=halve_batch,"
+                 "reason=resource_exhausted,site=ivf_pq.search,"
+                 "to=bf16_lut}"] == 1.0
+
+    def test_no_fault_means_no_counters(self, pq_index):
+        from raft_tpu.neighbors import ivf_pq
+
+        idx, x = pq_index
+        reg = MetricsRegistry()
+        obs.enable(registry=reg, hbm=False)
+        ivf_pq.search_resilient(idx, x[:16], 5)
+        assert not [k for k in _counters(reg) if k.startswith("degrade.")]
+
+    def test_ivf_flat_resilient(self):
+        from raft_tpu.neighbors import ivf_flat
+
+        rng = np.random.default_rng(1)
+        x = jnp.asarray(rng.random((1500, 16), dtype=np.float32))
+        idx = ivf_flat.build(x, ivf_flat.IndexParams(n_lists=8, seed=0))
+        sp = ivf_flat.SearchParams(n_probes=4, scan_mode="per_query")
+        d0, i0 = ivf_flat.search(idx, x[:48], 10, sp)
+        reg = MetricsRegistry()
+        obs.enable(registry=reg, hbm=False)
+        faults.install_plan({"faults": [
+            {"site": "ivf_flat.search", "kind": "oom", "times": 1}]})
+        d1, i1 = ivf_flat.search_resilient(idx, x[:48], 10, sp)
+        np.testing.assert_array_equal(np.asarray(i1), np.asarray(i0))
+        assert _counters(reg)[
+            "degrade.steps{from=native,reason=resource_exhausted,"
+            "site=ivf_flat.search,to=halve_batch}"] == 1.0
+
+
+class TestMemGuardDeclines:
+    def test_refine_forced_decline_counts_degrade_step(self):
+        from raft_tpu.neighbors import refine as rf
+
+        rng = np.random.default_rng(0)
+        x = jnp.asarray(rng.random((500, 24), dtype=np.float32))
+        q = jnp.asarray(rng.random((8, 24), dtype=np.float32))
+        cand = jnp.asarray(rng.integers(0, 500, (8, 32)).astype(np.int32))
+        reg = MetricsRegistry()
+        obs.enable(registry=reg, hbm=False)
+        faults.install_plan({"faults": [
+            {"site": "refine.mem_guard", "kind": "force", "times": 1}]})
+        rf.refine(x, q, cand, 5)
+        c = _counters(reg)
+        assert c["degrade.steps{from=pallas_gather,reason=mem_guard,"
+                 "site=refine,to=xla_gather}"] == 1.0
+        assert c["refine.dispatch{impl=xla_gather}"] >= 1.0
+
+    def test_lut_scan_forced_mem_guard_decline(self, pq_index):
+        from raft_tpu.neighbors import ivf_pq
+
+        idx, x = pq_index
+        reg = MetricsRegistry()
+        obs.enable(registry=reg, hbm=False)
+        faults.install_plan({"faults": [
+            {"site": "ivf_pq.scan.mem_guard", "kind": "force",
+             "times": 1}]})
+        # an explicit pallas request forces the grouped path through
+        # the mem guard; the forced decline must land on approx with
+        # both the fallback reason and the degrade step recorded
+        ivf_pq.search(idx, x[:64], 10, ivf_pq.SearchParams(
+            n_probes=8, scan_select="pallas"))
+        c = _counters(reg)
+        assert c["ivf_pq.scan.fallback{reason=mem_guard}"] == 1.0
+        assert c["degrade.steps{from=pallas_lut,reason=mem_guard,"
+                 "site=ivf_pq.search,to=grouped_approx}"] == 1.0
+
+
+class TestCommsFaultpoint:
+    def test_collective_fault_fires_at_trace_time(self):
+        from raft_tpu.parallel import comms as cm
+
+        faults.install_plan({"faults": [
+            {"site": "comms.allreduce", "kind": "error"}]})
+        with pytest.raises(faults.FaultInjected, match="comms.allreduce"):
+            cm.Comms("shard").allreduce(jnp.ones((4,)))
+
+    def test_build_chunk_read_fault_is_retried(self):
+        from raft_tpu.neighbors import ivf_pq
+
+        rng = np.random.default_rng(2)
+        x = rng.random((1200, 16), dtype=np.float32)
+        reg = MetricsRegistry()
+        obs.enable(registry=reg, hbm=False)
+        faults.install_plan({"faults": [
+            {"site": "build.chunk_read", "kind": "error", "times": 1}]})
+        idx = ivf_pq.build_chunked(
+            x, ivf_pq.IndexParams(n_lists=8, pq_dim=8, seed=0,
+                                  cache_reconstruction="never"),
+            chunk_rows=400)
+        assert idx.size > 0
+        c = _counters(reg)
+        assert c["retry.recovered{site=build.chunk_read}"] == 1.0
+        assert c["retry.attempts{site=build.chunk_read}"] >= 2.0

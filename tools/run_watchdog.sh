@@ -6,13 +6,23 @@
 # so the child's flight recorder / partial-record handlers get to flush
 # before the restart (the round-5 outage left NO dump because the
 # watchdog went straight to kill -9).
+# Before the SIGTERM the kill reason + elapsed time are written to a
+# JSON sidecar whose path the child inherits as WATCHDOG_KILL_INFO —
+# the flight recorder folds it into the dump, so killed-run dumps say
+# WHY they were killed (stall, minutes idle, seconds elapsed, attempt).
 # Usage: run_watchdog.sh LOGFILE MAX_RESTARTS STALL_MIN CMD...
 LOG=$1; MAXR=$2; STALL_MIN=$3; shift 3
 GRACE=${WATCHDOG_GRACE_S:-30}
+KILL_INFO="${LOG%.log}.watchdog_kill.json"
+export WATCHDOG_KILL_INFO="$KILL_INFO"
 for attempt in $(seq 0 "$MAXR"); do
+  # a stale sidecar from an earlier stalled attempt must not mislabel
+  # this attempt's death
+  rm -f "$KILL_INFO"
   "$@" >> "$LOG" 2>&1 &
   PID=$!
-  echo "[watchdog] attempt $attempt pid $PID" >> "$LOG"
+  START=$(date +%s)
+  echo "[watchdog] attempt $attempt pid $PID (kill info -> $KILL_INFO)" >> "$LOG"
   last_cpu=-1; idle=0
   while kill -0 $PID 2>/dev/null; do
     # a finished child stays a kill-0-able ZOMBIE until reaped: bail to
@@ -25,7 +35,14 @@ for attempt in $(seq 0 "$MAXR"); do
     if [ "$cpu" = "$last_cpu" ]; then idle=$((idle+1)); else idle=0; fi
     last_cpu=$cpu
     if [ $idle -ge "$STALL_MIN" ]; then
-      echo "[watchdog] stalled ${STALL_MIN}m — SIGTERM $PID (grace ${GRACE}s)" >> "$LOG"
+      ELAPSED=$(( $(date +%s) - START ))
+      # sidecar first, then the kill: the child's SIGTERM flight dump
+      # reads it via the inherited WATCHDOG_KILL_INFO env (tmp+mv so a
+      # concurrent reader never sees a partial file)
+      printf '{"reason": "stall", "stalled_min": %s, "elapsed_s": %s, "attempt": %s}\n' \
+        "$STALL_MIN" "$ELAPSED" "$attempt" > "$KILL_INFO.tmp" \
+        && mv "$KILL_INFO.tmp" "$KILL_INFO"
+      echo "[watchdog] stalled ${STALL_MIN}m after ${ELAPSED}s — SIGTERM $PID (grace ${GRACE}s)" >> "$LOG"
       kill -TERM $PID 2>/dev/null
       waited=0
       while kill -0 $PID 2>/dev/null && [ $waited -lt "$GRACE" ]; do
