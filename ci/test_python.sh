@@ -37,6 +37,7 @@ echo "   collective-schedule checker over the parallel/distributed suites) =="
 RAFT_TPU_SANITIZE=1 python -m pytest \
     tests/test_sanitize.py tests/test_graftlint.py tests/test_core.py \
     tests/test_parallel.py tests/test_parallel_ivf.py \
+    tests/test_ring_topk.py \
     -q -p no:cacheprovider
 
 echo "== driver contract: entry() compiles, dryrun_multichip(8) executes =="
@@ -57,7 +58,60 @@ assert comms.get("comms.ops{axis=shard,op=allreduce}", 0) > 0, comms
 assert comms.get("comms.bytes{axis=shard,op=allreduce}", 0) > 0, comms
 assert comms.get("comms.ops{axis=ici,op=allreduce}", 0) > 0, comms
 assert comms.get("comms.ops{axis=dcn,op=allreduce}", 0) > 0, comms
-print("dryrun_multichip(8) OK; comms section:", len(comms), "series")
+# ISSUE 8: the ring merge tier must run (7 counted hops per merge on
+# the 8-device mesh) and its merge-phase bytes must beat the allgather
+# tier's by >= 2x at n_dev=8 on the scaling legs (rows self-stamped)
+assert comms.get("comms.ops{axis=shard,op=ring_topk}", 0) > 0, comms
+rows = comms.get("scaling")
+assert rows, "dryrun returned no MULTICHIP_SCALING rows"
+assert {r["n_dev"] for r in rows} == {2, 4, 8}, rows
+assert all(r["measured_at"] and r["git_commit"] for r in rows), rows
+for leg in ("strong", "weak"):
+    by = {r["merge"]: r["merge_bytes"] for r in rows
+          if r["leg"] == leg and r["n_dev"] == 8}
+    assert 2 * by["ring"] <= by["allgather"], (leg, by)
+print("dryrun_multichip(8) OK; comms section:", len(comms) - 1,
+      "series;", len(rows), "scaling rows")
+EOF
+
+echo "== ring top-k exchange kernel smoke (interpret mode, 8-dev mesh) =="
+python - <<'EOF'
+# the ACTUAL Pallas ring kernel (remote DMAs interpreted) vs the
+# ppermute fallback the CPU dryrun uses: identical results by schedule
+import numpy as np
+import jax, jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from raft_tpu.core.compat import shard_map
+from raft_tpu.ops import pallas_kernels as pk
+from raft_tpu.parallel import make_mesh, merge_topk
+
+mesh = make_mesh(axis_names=("shard",))
+m, k, n_dev = 40, 8, 8
+rng = np.random.default_rng(0)
+vals = np.sort(rng.random((n_dev, m, k)).astype(np.float32), axis=-1)
+ids = rng.integers(0, 10_000, (n_dev, m, k)).astype(np.int32)
+
+def kernel_body(v, i):
+    return pk.ring_topk_merge(v[0], i[0], k, "shard", n_dev,
+                              select_min=True, interpret=True)
+
+def fallback_body(v, i):
+    return merge_topk(v[0], i[0], "shard", m, k, n_dev, True,
+                      tier="ring", impl="ring_ppermute")
+
+outs = {}
+for name, body in (("kernel", kernel_body), ("fallback", fallback_body)):
+    f = shard_map(body, mesh=mesh,
+                  in_specs=(P("shard", None, None), P("shard", None, None)),
+                  out_specs=(P("shard", None), P("shard", None)),
+                  check_vma=False)
+    gv, gi = f(jnp.asarray(vals), jnp.asarray(ids))
+    outs[name] = (np.asarray(gv)[:m], np.asarray(gi)[:m])
+np.testing.assert_array_equal(outs["kernel"][1], outs["fallback"][1])
+np.testing.assert_allclose(outs["kernel"][0], outs["fallback"][0])
+print("ring kernel smoke OK: interpret-mode remote-DMA ring == ppermute "
+      "fallback on the 8-device mesh")
 EOF
 
 echo "== bench smoke (tiny synthetic) =="

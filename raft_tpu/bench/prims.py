@@ -339,6 +339,74 @@ def bench_refine(grid=None, iters: int = 3) -> List[PrimResult]:
     return rows
 
 
+def measure_merge_tier(mesh, x, q, k: int, tier: str, iters: int = 3):
+    """Measure ONE cross-shard merge tier through sharded kNN on
+    ``mesh``: returns ``(median ms per call, merge-phase comms bytes)``.
+    The single harness behind both the prims `ring_merge` rows and the
+    dryrun's MULTICHIP scaling rows — byte-model or dispatch changes
+    land in one place. Jits once so timed calls hit the cache (a bare
+    ``sharded_knn`` call rebuilds its shard_map closure and re-traces
+    every call — that would time the tracer), and enables a private
+    registry only around the tracing call so the per-trace comms
+    counters attribute exactly one merge."""
+    from raft_tpu import obs
+    from raft_tpu.obs import spans as _spans
+    from raft_tpu.obs.metrics import MetricsRegistry
+    from raft_tpu.parallel import sharded_knn
+
+    op = "ring_topk" if tier == "ring" else "allgather"
+    fn = jax.jit(lambda xx, qq: sharded_knn(xx, qq, k, mesh, merge=tier))
+    reg = MetricsRegistry()
+    prev = _spans._state()  # a RAFT_TPU_OBS=1 enable must survive this
+    try:
+        obs.enable(registry=reg, hbm=False)
+        jax.block_until_ready(fn(x, q))
+    finally:
+        _spans._restore(prev)
+    c = reg.snapshot()["counters"]
+    merge_bytes = sum(
+        v for key, v in c.items()
+        if key.startswith("comms.bytes{") and f"op={op}" in key)
+    ms = _time(lambda: fn(x, q)[0], iters=iters, warmup=1)
+    return ms, int(merge_bytes)
+
+
+def bench_ring_merge(grid=None, iters: int = 3) -> List[PrimResult]:
+    """Allgather-and-select vs the ring top-k exchange behind sharded
+    search (``parallel.merge``) — the measurement grounding the merge
+    tier's dispatch and the MULTICHIP scaling rows. Each row runs
+    sharded kNN over the full local mesh with the merge tier forced,
+    and decomposes the merge's interconnect cost from the PR-5
+    ``comms.bytes`` counters (allgather: the materialized table; ring:
+    n_dev−1 surviving-block hops). Off-TPU the ring rides the ppermute
+    fallback — identical schedule and identical counted bytes, wall
+    time is CPU-mesh-shaped."""
+    from raft_tpu.parallel import make_mesh
+
+    n_dev = len(jax.devices())
+    if n_dev < 2:
+        return [PrimResult("ring_merge", "skipped", 0.0, 0.0, "queries/s",
+                           {"reason": f"{n_dev} device(s): no mesh axis "
+                                      "to merge across"})]
+    if grid is None:
+        # (n, d, m, k)
+        grid = [(32_768, 64, 1024, 10), (32_768, 64, 1024, 64)]
+    mesh = make_mesh()
+    rows: List[PrimResult] = []
+    rng = np.random.default_rng(0)
+    for n, d, m, k in grid:
+        x = jnp.asarray(rng.random((n, d), dtype=np.float32))
+        q = jnp.asarray(rng.random((m, d), dtype=np.float32))
+        for tier in ("allgather", "ring"):
+            ms, merge_bytes = measure_merge_tier(mesh, x, q, k, tier,
+                                                 iters=iters)
+            rows.append(PrimResult(
+                "ring_merge", tier, ms, m * 1e3 / ms, "queries/s",
+                {"n": n, "d": d, "m": m, "k": k, "n_dev": n_dev,
+                 "merge_bytes": merge_bytes}))
+    return rows
+
+
 BENCHES: Dict[str, Callable[[], List[PrimResult]]] = {
     "select_k": bench_select_k,
     "fused_l2_nn": bench_fused_l2_nn,
@@ -347,6 +415,7 @@ BENCHES: Dict[str, Callable[[], List[PrimResult]]] = {
     "ivf_scan": bench_ivf_scan,
     "pq_scan": bench_pq_scan,
     "refine": bench_refine,
+    "ring_merge": bench_ring_merge,
 }
 
 

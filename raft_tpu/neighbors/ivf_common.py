@@ -51,6 +51,36 @@ import jax.numpy as jnp
 SEGMENT_SIZE = 128
 
 
+def sharded_dispatch(index, mesh, cls_name: str):
+    """The pod-scale dispatch gate shared by the IVF search entries:
+    returns the ``raft_tpu.parallel.ivf`` module when ``(index, mesh)``
+    route to the sharded tier, ``None`` for the single-chip path.
+
+    A sharded index can only exist if ``parallel.ivf`` is already
+    imported, so the gate checks ``sys.modules`` first — plain
+    single-chip searches never pay the parallel-subtree import.
+    Validates the pairing (a sharded index without its mesh, or a
+    ``mesh=`` with a single-chip index, is a caller error); per-entry
+    capability checks (filters, refine) stay with the caller."""
+    import sys
+
+    from raft_tpu.core.errors import expects as _expects
+
+    if mesh is None and "raft_tpu.parallel.ivf" not in sys.modules:
+        return None
+    from raft_tpu.parallel import ivf as _divf
+
+    cls = getattr(_divf, cls_name)
+    if mesh is None and not isinstance(index, cls):
+        return None
+    _expects(isinstance(index, cls),
+             "mesh= dispatch needs a parallel.%s index (got %s)",
+             cls_name, type(index).__name__)
+    _expects(mesh is not None,
+             "a %s index needs search(..., mesh=...)", cls_name)
+    return _divf
+
+
 def n_segments(pairs: int, n_lists: int, seg: int) -> int:
     """Static upper bound on the segment count: every list owns
     ``ceil(load/seg)`` segments, and ``sum ceil(load/seg) <=

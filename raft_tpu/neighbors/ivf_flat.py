@@ -606,10 +606,12 @@ def _route_refined(index: IvfFlatIndex, queries: jax.Array, k: int,
 
 
 @traced("raft_tpu.ivf_flat.search")
-def search(index: IvfFlatIndex, queries: jax.Array, k: int,
+def search(index, queries: jax.Array, k: int,
            params: Optional[SearchParams] = None,
            filter_bitset: Optional[jax.Array] = None,
-           dataset=None) -> Tuple[jax.Array, jax.Array]:
+           dataset=None, *, mesh=None,
+           mesh_axis: str = "shard",
+           merge: str = "auto") -> Tuple[jax.Array, jax.Array]:
     """Search the index (reference: ivf_flat::search, ivf_flat-inl.cuh:452;
     filtered overload ivf_flat-inl.cuh search_with_filtering).
 
@@ -618,9 +620,23 @@ def search(index: IvfFlatIndex, queries: jax.Array, k: int,
     ``filter_bitset``: optional packed bitset over dataset rows (see
     neighbors.sample_filter) — cleared bits are excluded.
     ``params.refine="f32_regen"`` + ``dataset`` re-ranks an oversampled
-    scan exactly (see SearchParams.refine)."""
+    scan exactly (see SearchParams.refine).
+
+    **Pod-scale dispatch**: handed a ``parallel.ShardedIvfFlat`` (plus
+    its ``mesh``), routes to the sharded search tier with the
+    cross-shard merge picked by ``merge`` (auto | allgather | ring, see
+    ``parallel.merge``)."""
     if params is None:
         params = SearchParams()
+    from raft_tpu.neighbors import ivf_common as ic
+
+    _divf = ic.sharded_dispatch(index, mesh, "ShardedIvfFlat")
+    if _divf is not None:
+        expects(filter_bitset is None and params.refine == "none",
+                "sharded IVF-Flat search supports neither filter "
+                "bitsets nor refine yet")
+        return _divf.search_ivf_flat(params, index, queries, k, mesh,
+                                     axis=mesh_axis, merge=merge)
     expects(queries.ndim == 2 and queries.shape[1] == index.dim,
             "queries must be [m, %d]", index.dim)
     _faults.faultpoint("ivf_flat.search")

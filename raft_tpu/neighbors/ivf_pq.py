@@ -1707,10 +1707,12 @@ def _warn_lut_fallback() -> None:
 
 
 @traced("raft_tpu.ivf_pq.search")
-def search(index: IvfPqIndex, queries: jax.Array, k: int,
+def search(index, queries: jax.Array, k: int,
            params: Optional[SearchParams] = None,
            filter_bitset: Optional[jax.Array] = None,
-           dataset=None) -> Tuple[jax.Array, jax.Array]:
+           dataset=None, *, mesh=None,
+           mesh_axis: str = "shard",
+           merge: str = "auto") -> Tuple[jax.Array, jax.Array]:
     """Search (reference: ivf_pq::search, ivf_pq-inl.cuh:478; filtered
     overload search_with_filtering). Distances are PQ-approximate (as the
     reference's) unless ``params.refine="f32_regen"``, which scans
@@ -1720,9 +1722,26 @@ def search(index: IvfPqIndex, queries: jax.Array, k: int,
     provider → on-device regen). Standalone re-ranking stays available
     as neighbors.refine.
     ``filter_bitset``: optional packed bitset over dataset rows (see
-    neighbors.sample_filter) — cleared bits are excluded."""
+    neighbors.sample_filter) — cleared bits are excluded.
+
+    **Pod-scale dispatch**: handed a ``parallel.ShardedIvfPq`` (plus its
+    ``mesh``), the same entry routes to the sharded search tier —
+    per-shard scan (+ per-shard fused refine when
+    ``params.refine="f32_regen"`` and ``dataset`` is given) and the
+    cross-shard merge tier picked by ``merge`` (auto | allgather |
+    ring, see ``parallel.merge``). Filter bitsets are single-chip-only
+    for now."""
     if params is None:
         params = SearchParams()
+    from raft_tpu.neighbors import ivf_common as ic
+
+    _divf = ic.sharded_dispatch(index, mesh, "ShardedIvfPq")
+    if _divf is not None:
+        expects(filter_bitset is None,
+                "sharded search does not support filter bitsets yet")
+        return _divf.search_ivf_pq(params, index, queries, k, mesh,
+                                   axis=mesh_axis, dataset=dataset,
+                                   merge=merge)
     expects(queries.ndim == 2 and queries.shape[1] == index.dim,
             "queries must be [m, %d]", index.dim)
     _faults.faultpoint("ivf_pq.search")

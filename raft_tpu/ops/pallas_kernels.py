@@ -840,6 +840,37 @@ def pallas_lut_scan_wanted(S: int, K: int, P: int, nb: int, Wb: int,
     return True if force == "on" else _on_tpu()
 
 
+def _extract_topk_block(comb_v: jax.Array, comb_i: jax.Array, k: int,
+                        kpad: int) -> Tuple[jax.Array, jax.Array]:
+    """k-round extraction merge of a combined candidate block: reduce
+    ``comb_v``/``comb_i`` [rows, C] (minimized keys, +inf = empty slot)
+    to the ascending top-k in a [rows, kpad] lane tile, ids resolved
+    gather-free via the argmin one-hot (Mosaic has no general gather).
+    The in-kernel merge shared by the fused gather-refine and the ring
+    top-k exchange — k is static, so the loop unrolls to k VPU rounds."""
+    rows = comb_v.shape[0]
+    out_cols = jax.lax.broadcasted_iota(jnp.int32, (rows, kpad), 1)
+    # sentinel init anchored on the candidate block rather than two bare
+    # jnp.full broadcasts: XLA CPU's sharding propagation aborts on a
+    # pair of broadcasted-constant stores in a discharged (interpret)
+    # kernel that also issued a remote DMA — the predicate is constant-
+    # false, so the values are identical
+    out_v = jnp.where(out_cols < 0, comb_v[:, :kpad], jnp.inf)
+    out_i = jnp.where(out_cols < 0, comb_i[:, :kpad], -1)
+    imax = jnp.iinfo(jnp.int32).max
+    for j in range(k):  # static unroll (see _select_k_kernel)
+        mn = jnp.min(comb_v, axis=1)
+        am = jnp.argmin(comb_v, axis=1)
+        onehot = jax.lax.broadcasted_iota(
+            jnp.int32, comb_v.shape, 1) == am[:, None]
+        picked = jnp.min(jnp.where(onehot, comb_i, imax), axis=1)
+        picked = jnp.where(jnp.isinf(mn), -1, picked)
+        out_v = jnp.where(out_cols == j, mn[:, None], out_v)
+        out_i = jnp.where(out_cols == j, picked[:, None], out_i)
+        comb_v = jnp.where(onehot, jnp.inf, comb_v)
+    return out_v, out_i
+
+
 # ---------------------------------------------------------------------------
 # fused gather-refine: per-query candidate rows streamed HBM→VMEM by id,
 # exact distance epilogue + running top-k on-chip — the [m, C, d] gather
@@ -954,20 +985,7 @@ def _gather_refine_kernel(q_ref, cand_ref, cand_hbm, data_hbm,
     kpad = vals_ref.shape[1]
     comb_v = jnp.concatenate([vals_ref[:], key], axis=1)
     comb_i = jnp.concatenate([ids_ref[:], gid], axis=1)
-    out_v = jnp.full((bq, kpad), jnp.inf, jnp.float32)
-    out_i = jnp.full((bq, kpad), -1, jnp.int32)
-    out_cols = jax.lax.broadcasted_iota(jnp.int32, (bq, kpad), 1)
-    imax = jnp.iinfo(jnp.int32).max
-    for j in range(k):  # static unroll (see _select_k_kernel)
-        mn = jnp.min(comb_v, axis=1)
-        am = jnp.argmin(comb_v, axis=1)
-        onehot = jax.lax.broadcasted_iota(
-            jnp.int32, comb_v.shape, 1) == am[:, None]
-        picked = jnp.min(jnp.where(onehot, comb_i, imax), axis=1)
-        picked = jnp.where(jnp.isinf(mn), -1, picked)
-        out_v = jnp.where(out_cols == j, mn[:, None], out_v)
-        out_i = jnp.where(out_cols == j, picked[:, None], out_i)
-        comb_v = jnp.where(onehot, jnp.inf, comb_v)
+    out_v, out_i = _extract_topk_block(comb_v, comb_i, k, kpad)
     vals_ref[:] = out_v
     ids_ref[:] = out_i
 
@@ -1122,3 +1140,222 @@ def select_k_pallas(scores: jax.Array, k: int, select_min: bool = True,
         interpret=interpret,
     )(s, nvalid)
     return vals[:m, :k], idx[:m, :k]
+
+
+# ---------------------------------------------------------------------------
+# ring top-k exchange: reduce-scatter-of-top-k across a mesh axis — each
+# device streams only its surviving [mc, k] block to its ring neighbor via
+# async remote DMA; the [n_dev, m, k] allgather buffer never exists
+# ---------------------------------------------------------------------------
+
+# In-kernel merge budget (k extraction rounds per hop — the same bound the
+# gather-refine merge carries).
+RING_TOPK_MAX_K = 64
+# VMEM working set: recv slots (double-buffered) + running/local blocks
+# for vals+ids, all [mc, 128] lane tiles.
+_RING_VMEM_BUDGET = 12 * 1024 * 1024
+
+
+def ring_chunk_rows(m: int, n_dev: int) -> int:
+    """Query rows per ring chunk: ceil(m / n_dev) padded to a sublane
+    tile. Shared by the kernel, the ppermute fallback, and the comms
+    byte accounting so all three agree on the per-hop block shape."""
+    mc = -(-m // n_dev)
+    return max(_SUBLANES, -(-mc // _SUBLANES) * _SUBLANES)
+
+
+def ring_topk_kernel_ok(m: int, k: int, n_dev: int) -> bool:
+    """Kernel-tier eligibility: merge budget (k extraction rounds per
+    hop) and the VMEM working set of the double-buffered exchange.
+    Multi-axis meshes are the caller's problem — the kernel addresses
+    ring neighbors by LOGICAL device id, so the exchange axis must be
+    the whole mesh (the ppermute fallback serves sub-axis rings)."""
+    if k > RING_TOPK_MAX_K or n_dev < 2:
+        return False
+    mc = ring_chunk_rows(m, n_dev)
+    vmem = (2 * mc * _LANES * 8      # recv slots (vals+ids, double buffer)
+            + 2 * mc * _LANES * 8    # running + local staging blocks
+            + 2 * mc * 3 * _LANES * 8)  # extraction transients
+    return vmem <= _RING_VMEM_BUDGET
+
+
+def _ring_topk_kernel(vals_hbm, ids_hbm, out_v_ref, out_i_ref,
+                      buf_v, buf_i, run_v, run_i, loc_v, loc_i,
+                      send_sems, recv_sems, cap_sems, copy_sems, *,
+                      k: int, n_dev: int, mc: int, axis_name: str,
+                      flow_control: bool):
+    """One device's program of the ring reduce-scatter-of-top-k.
+
+    The local [n_dev·mc, kpad] candidate table lives in HBM; chunk ``c``
+    (rows [c·mc, (c+1)·mc)) is query chunk ``c``'s local top-k. Chunk
+    ``c``'s partial starts at device ``(c+1) mod n_dev`` and travels the
+    ring for ``n_dev−1`` hops, merged against each host device's local
+    chunk on the way, landing fully merged at its owner ``c``. Per hop:
+
+    1. the running block (vals + ids) streams to the right neighbor's
+       recv slot via async remote DMA, and the owning chunk's local
+       block starts its HBM→VMEM copies in the same breath — the local
+       gather rides under the remote transfer instead of after it;
+    2. recv slots are double-buffered (slot = s mod 2), so the LEFT
+       neighbor — which may run a hop ahead — can land hop s+1's block
+       in slot (s+1)%2 while this device still merges slot s%2;
+    3. once both transfers land, the k-round extraction merge
+       (``_extract_topk_block``, the gather-refine merge) reduces
+       incoming ++ local to the surviving top-k — the only bytes hop
+       s+1 ever ships. The send wait stays ahead of the merge by
+       necessity: the merge overwrites the running block the send
+       reads.
+
+    ``flow_control``: on real hardware a capacity semaphore guards slot
+    reuse (the right neighbor confirms it consumed slot s%2 before the
+    step-s+2 send restarts it) and a neighbor barrier aligns kernel
+    entry; interpret mode executes remote copies synchronously and
+    implements neither remote signal, so both are compiled out there.
+    """
+    my = jax.lax.axis_index(axis_name)
+    right = jax.lax.rem(my + 1, n_dev)
+    left = jax.lax.rem(my + n_dev - 1, n_dev)
+
+    if flow_control:
+        barrier = pltpu.get_barrier_semaphore()
+        pltpu.semaphore_signal(barrier, inc=1, device_id=left,
+                               device_id_type=pltpu.DeviceIdType.LOGICAL)
+        pltpu.semaphore_signal(barrier, inc=1, device_id=right,
+                               device_id_type=pltpu.DeviceIdType.LOGICAL)
+        pltpu.semaphore_wait(barrier, 2)
+
+    def chunk_copy(hbm, dst, c, which):
+        return pltpu.make_async_copy(
+            hbm.at[pl.ds(c * mc, mc)], dst, copy_sems.at[which])
+
+    # init: this device starts chunk (my−1)'s journey with its local block
+    c0 = jax.lax.rem(my + n_dev - 1, n_dev)
+    chunk_copy(vals_hbm, run_v, c0, 0).start()
+    chunk_copy(ids_hbm, run_i, c0, 1).start()
+    chunk_copy(vals_hbm, run_v, c0, 0).wait()
+    chunk_copy(ids_hbm, run_i, c0, 1).wait()
+
+    def ring_send(src, dst, slot, which):
+        return pltpu.make_async_remote_copy(
+            src_ref=src, dst_ref=dst,
+            send_sem=send_sems.at[slot, which],
+            recv_sem=recv_sems.at[slot, which],
+            device_id=right,
+            device_id_type=pltpu.DeviceIdType.LOGICAL)
+
+    for s in range(n_dev - 1):  # static unroll: n_dev−1 hops
+        slot = s % 2
+        if flow_control and s >= 2:
+            # right neighbor consumed slot s−2 → safe to restart it
+            pltpu.semaphore_wait(cap_sems.at[slot], 1)
+        ring_send(run_v, buf_v.at[slot], slot, 0).start()
+        ring_send(run_i, buf_i.at[slot], slot, 1).start()
+        # the incoming partial is chunk (my − s − 2)'s: start its local
+        # block's HBM→VMEM copies NOW so they overlap the remote
+        # transfer (loc_* was last read by the previous hop's merge,
+        # which completed before this send started)
+        c = jax.lax.rem(my + 2 * n_dev - s - 2, n_dev)
+        chunk_copy(vals_hbm, loc_v, c, 0).start()
+        chunk_copy(ids_hbm, loc_i, c, 1).start()
+        # wait = send_sem (running block reusable) + recv_sem (this hop's
+        # incoming partial landed in MY slot — SPMD symmetry)
+        ring_send(run_v, buf_v.at[slot], slot, 0).wait()
+        ring_send(run_i, buf_i.at[slot], slot, 1).wait()
+        chunk_copy(vals_hbm, loc_v, c, 0).wait()
+        chunk_copy(ids_hbm, loc_i, c, 1).wait()
+        comb_v = jnp.concatenate([buf_v[slot], loc_v[:]], axis=1)
+        comb_i = jnp.concatenate([buf_i[slot], loc_i[:]], axis=1)
+        mv, mi = _extract_topk_block(comb_v, comb_i, k, run_v.shape[1])
+        run_v[:] = mv
+        run_i[:] = mi
+        if flow_control and s + 2 <= n_dev - 2:
+            # tell the left neighbor its slot is free for step s+2
+            pltpu.semaphore_signal(cap_sems.at[slot], inc=1, device_id=left,
+                                   device_id_type=pltpu.DeviceIdType.LOGICAL)
+    out_v_ref[:] = run_v[:]
+    out_i_ref[:] = run_i[:]
+
+
+def ring_topk_merge(vals: jax.Array, ids: jax.Array, k: int,
+                    axis_name: str, n_dev: int, select_min: bool = True,
+                    interpret: bool = False
+                    ) -> Tuple[jax.Array, jax.Array]:
+    """Ring reduce-scatter-of-top-k over a mesh axis — the Pallas merge
+    tier replacing allgather-and-select (reference: knn_merge_parts.cuh
+    merged over NCCL in raft-dask; here the merge IS the transport).
+
+    Must be called inside ``shard_map`` over ``axis_name`` (a 1-D mesh:
+    neighbors are addressed by logical device id — see
+    :func:`ring_topk_kernel_ok`). ``vals``/``ids`` [m, k'] (k' ≥ k) are
+    this device's local top-k table, ids -1 invalid, invalid keys at the
+    select sentinel (+inf for ``select_min``, −inf otherwise). Returns
+    this device's owned query chunk ([mc, k] — rows
+    [rank·mc, (rank+1)·mc) of the padded query axis): callers emit
+    ``P(axis)`` out_specs and slice the assembled [n_dev·mc, k] back to
+    [m, k]. The allgather buffer is gone: per hop only the surviving
+    [mc, k] block crosses the interconnect, counted per hop as
+    ``comms.ops/bytes{op=ring_topk}`` by the dispatching merge tier.
+    """
+    m, kin = vals.shape
+    if k > kin:
+        raise ValueError(f"k={k} > candidate width {kin}")
+    if k > RING_TOPK_MAX_K:
+        raise ValueError(
+            f"k={k} > {RING_TOPK_MAX_K} (the in-kernel merge is k "
+            "extraction rounds per hop — gate with ring_topk_kernel_ok)")
+    mc = ring_chunk_rows(m, n_dev)
+    m_pad = mc * n_dev
+    kpad = _LANES
+    keys = vals.astype(jnp.float32)
+    if not select_min:
+        keys = -keys  # uniform ascending selection; −inf pads → +inf
+    keys = _pad_to(keys, m_pad, 0, jnp.inf) if m_pad > m else keys
+    keys = _pad_to(keys, kpad, 1, jnp.inf)
+    idp = ids.astype(jnp.int32)
+    idp = _pad_to(idp, m_pad, 0, -1) if m_pad > m else idp
+    idp = _pad_to(idp, kpad, 1, -1)
+    # invalid slots must carry the internal sentinel regardless of the
+    # caller's pad value convention
+    keys = jnp.where(idp < 0, jnp.inf, keys)
+
+    kwargs = {}
+    if not interpret:
+        # the neighbor barrier needs a collective id (real hardware only)
+        kwargs["compiler_params"] = pltpu.TPUCompilerParams(
+            collective_id=1)
+    out_v, out_i = pl.pallas_call(
+        functools.partial(_ring_topk_kernel, k=k, n_dev=n_dev, mc=mc,
+                          axis_name=axis_name,
+                          flow_control=not interpret),
+        in_specs=[
+            pl.BlockSpec(memory_space=pltpu.ANY),
+            pl.BlockSpec(memory_space=pltpu.ANY),
+        ],
+        out_specs=[
+            pl.BlockSpec((mc, kpad), lambda: (0, 0)),
+            pl.BlockSpec((mc, kpad), lambda: (0, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((mc, kpad), jnp.float32),
+            jax.ShapeDtypeStruct((mc, kpad), jnp.int32),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((2, mc, kpad), jnp.float32),   # recv slots (vals)
+            pltpu.VMEM((2, mc, kpad), jnp.int32),     # recv slots (ids)
+            pltpu.VMEM((mc, kpad), jnp.float32),      # running block
+            pltpu.VMEM((mc, kpad), jnp.int32),
+            pltpu.VMEM((mc, kpad), jnp.float32),      # local chunk staging
+            pltpu.VMEM((mc, kpad), jnp.int32),
+            pltpu.SemaphoreType.DMA((2, 2)),          # send, per slot×array
+            pltpu.SemaphoreType.DMA((2, 2)),          # recv
+            pltpu.SemaphoreType.REGULAR((2,)),        # slot capacity
+            pltpu.SemaphoreType.DMA((2,)),            # local chunk copies
+        ],
+        interpret=interpret,
+        **kwargs,
+    )(keys, idp)
+    res_v = out_v[:, :k]
+    res_i = out_i[:, :k]
+    if not select_min:
+        res_v = jnp.where(jnp.isinf(res_v), -jnp.inf, -res_v)
+    return res_v, res_i

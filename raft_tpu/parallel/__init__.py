@@ -11,6 +11,14 @@ from raft_tpu.parallel.mesh import (  # noqa: F401
     make_mesh,
     replicate,
     shard_rows,
+    submesh,
+)
+from raft_tpu.parallel.merge import (  # noqa: F401
+    MERGE_TIERS,
+    merge_out_spec,
+    merge_tier,
+    merge_topk,
+    merged_rows,
 )
 from raft_tpu.parallel.knn import replicated_knn, sharded_knn  # noqa: F401
 from raft_tpu.parallel.ivf import (  # noqa: F401

@@ -22,15 +22,27 @@ Reduction ops mirror ``op_t`` (core/comms.hpp:36): SUM, PROD, MIN, MAX.
 
 **Comms telemetry** (docs/observability.md): when observability is on
 (:func:`raft_tpu.obs.enable`), every collective counts one op and its
-per-rank payload bytes into ``comms.ops{op=...,axis=...}`` /
+per-rank bytes into ``comms.ops{op=...,axis=...}`` /
 ``comms.bytes{op=...,axis=...}``, labeled by collective verb and axis
-name — a 2-axis DCN×ICI mesh attributes traffic per axis. Counting
-reads only STATIC shape/dtype at trace time (once per jit trace, the
-same per-dispatch-decision semantics as ``obs.count_dispatch``): zero
-host syncs, zero runtime cost in the compiled program, and a single
-flag check when observability is off. Each collective also lowers
-under a ``raft_tpu.comms.<verb>`` named scope (``core.tracing.annotate``)
-so profiler op timelines attribute ICI/DCN time to the verb.
+name — a 2-axis DCN×ICI mesh attributes traffic per axis. The byte
+model charges what each rank actually moves over the interconnect:
+fixed-size-result collectives (allreduce, reducescatter, alltoall,
+ppermute, send_recv_ring) count their per-rank payload; gather-family
+collectives (allgather, gather, bcast, allgatherv, gatherv) count
+``axis_size × payload`` — the materialized gathered table every rank
+assembles over ICI, the O(n_dev·m·k) cost the ring top-k exchange
+exists to avoid; the ring exchange itself (``ring_topk``) counts one op
+and one surviving-block payload PER HOP (n_dev−1 hops per merge),
+whether the hops ride :meth:`Comms.ring_topk_hop` (ppermute fallback)
+or the Pallas kernel's in-kernel remote DMAs (attributed via
+:meth:`Comms.count_ring_topk` — no collective escapes telemetry,
+GL10). Counting reads only STATIC shape/dtype at trace time (once per
+jit trace, the same per-dispatch-decision semantics as
+``obs.count_dispatch``): zero host syncs, zero runtime cost in the
+compiled program, and a single flag check when observability is off.
+Each collective also lowers under a ``raft_tpu.comms.<verb>`` named
+scope (``core.tracing.annotate``) so profiler op timelines attribute
+ICI/DCN time to the verb.
 """
 
 from __future__ import annotations
@@ -81,6 +93,13 @@ def _axis_label(axis_name: Union[str, Sequence[str]]) -> str:
     if isinstance(axis_name, str):
         return axis_name
     return "+".join(str(a) for a in axis_name)
+
+
+# Collectives whose RESULT (and interconnect traffic) grows with the
+# axis: each rank materializes the size×payload gathered table, so the
+# byte model scales their payload by the static axis size.
+_GATHER_FAMILY = frozenset(
+    {"allgather", "gather", "bcast", "allgatherv", "gatherv"})
 
 
 def _payload_bytes(*arrays) -> int:
@@ -139,16 +158,24 @@ class Comms:
         wedged ICI link would abort the program — so distributed
         failure handling is CI-testable without breaking hardware."""
         _faults.faultpoint(f"comms.{op_name}")
-        if _sanitize.comms_schedule_recording():
+        recording = _sanitize.comms_schedule_recording()
+        counting = _obs.enabled()
+        if not (recording or counting):
+            return
+        nbytes = _payload_bytes(*arrays)
+        if op_name in _GATHER_FAMILY:
+            # the materialized gathered table (axis size is static at
+            # trace time — same int() the ring perms rely on)
+            nbytes *= int(_axis_size(self.axis_name))
+        if recording:
             _sanitize.note_collective(op_name,
-                                      _axis_label(self.axis_name),
-                                      _payload_bytes(*arrays))
-        if not _obs.enabled():
+                                      _axis_label(self.axis_name), nbytes)
+        if not counting:
             return
         labels = {"op": op_name, "axis": _axis_label(self.axis_name)}
         reg = _obs.registry()
         reg.inc("comms.ops", 1.0, labels=labels)
-        reg.inc("comms.bytes", float(_payload_bytes(*arrays)), labels=labels)
+        reg.inc("comms.bytes", float(nbytes), labels=labels)
 
     # -- collectives -------------------------------------------------------
     def _allreduce_raw(self, x, op: Op):
@@ -258,6 +285,31 @@ class Comms:
             size = int(_axis_size(self.axis_name))
             perm = [(i, (i + shift) % size) for i in range(size)]
             return lax.ppermute(x, self.axis_name, perm=perm)
+
+    def ring_topk_hop(self, vals, ids, shift: int = 1):
+        """One hop of the ring top-k exchange: the surviving
+        ``(vals, ids)`` block moves to rank+``shift`` (recv from
+        rank−``shift``). The CPU-mesh / sub-axis fallback of the Pallas
+        ``ring_topk_merge`` kernel (``ops/pallas_kernels``) — identical
+        schedule, counted identically: one ``comms.ops{op=ring_topk}``
+        and one surviving-block ``comms.bytes`` per hop."""
+        self._count("ring_topk", vals, ids)
+        with _annotate("raft_tpu.comms.ring_topk"):
+            size = int(_axis_size(self.axis_name))
+            perm = [(i, (i + shift) % size) for i in range(size)]
+            return (lax.ppermute(vals, self.axis_name, perm=perm),
+                    lax.ppermute(ids, self.axis_name, perm=perm))
+
+    def count_ring_topk(self, n_hops: int, *arrays) -> None:
+        """Attribute the Pallas ring kernel's in-kernel exchange to the
+        comms telemetry: ``n_hops`` ops and ``n_hops`` surviving-block
+        payloads under ``op=ring_topk``, at trace time. The kernel's
+        remote DMAs never pass through ``lax``, so without this call
+        they would escape ``comms.ops``/``comms.bytes`` — the GL10
+        "no collective escapes telemetry" invariant. ``arrays`` carry
+        only static shape/dtype (``jax.ShapeDtypeStruct`` works)."""
+        for _ in range(int(n_hops)):
+            self._count("ring_topk", *arrays)
 
     def sync_stream(self) -> Status:
         """reference: comms_t::sync_stream (core/comms.hpp:283-290) — XLA

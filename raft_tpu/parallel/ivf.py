@@ -29,6 +29,7 @@ TPU-native structure — everything is ``shard_map`` over one mesh axis:
 
 from __future__ import annotations
 
+import dataclasses
 from functools import partial
 from typing import Optional, Tuple
 
@@ -46,11 +47,12 @@ from raft_tpu.cluster import distributed as dkm
 from raft_tpu.distance import SELECT_MIN
 from raft_tpu.distance.fused_l2_nn import fused_l2_nn_argmin
 from raft_tpu.distance.types import DistanceType, resolve_metric
-from raft_tpu.matrix import select_k as _select_k
 from raft_tpu.neighbors import ivf_flat as _flat
 from raft_tpu.neighbors import ivf_pq as _pq
 from raft_tpu.neighbors import ivf_common as ic
+from raft_tpu.parallel import merge as _merge
 from raft_tpu.parallel.comms import Comms
+from raft_tpu.robust import faults as _faults
 
 
 class ShardedIvfPq(flax.struct.PyTreeNode):
@@ -68,6 +70,11 @@ class ShardedIvfPq(flax.struct.PyTreeNode):
     metric: str = flax.struct.field(pytree_node=False, default="sqeuclidean")
     pq_bits: int = flax.struct.field(pytree_node=False, default=8)
     pq_dim: int = flax.struct.field(pytree_node=False, default=0)
+    # rows per shard of the (padded) BUILD dataset — the global ids baked
+    # into packed_ids are rank·shard_rows + local, so the refined search
+    # can validate a caller-passed dataset against the build geometry
+    # (0 = unknown, for indexes assembled by hand)
+    shard_rows: int = flax.struct.field(pytree_node=False, default=0)
 
     @property
     def n_shards(self) -> int:
@@ -169,19 +176,11 @@ def _gather_trainset(x: jax.Array, mesh: Mesh, axis: str, t: int,
     return fn(x)
 
 
-def _merge_topk(vals: jax.Array, ids: jax.Array, axis: str, m: int, k: int,
-                n_dev: int, select_min: bool) -> Tuple[jax.Array, jax.Array]:
-    """Cross-shard candidate merge: all-gather per-shard top-k over ICI,
-    final select_k (reference: knn_merge_parts.cuh). Runs inside
-    shard_map; also the epilogue of parallel/knn.py's sharded search.
-    The gathers ride the Comms facade so merge traffic lands in the
-    ``comms.ops``/``comms.bytes`` counters per axis."""
-    comms = Comms(axis)
-    all_v = comms.allgather(vals)               # [n_dev, m, k]
-    all_i = comms.allgather(ids)
-    flat_v = jnp.transpose(all_v, (1, 0, 2)).reshape(m, n_dev * k)
-    flat_i = jnp.transpose(all_i, (1, 0, 2)).reshape(m, n_dev * k)
-    return _select_k(flat_v, k, select_min=select_min, input_indices=flat_i)
+# Cross-shard candidate merges route through parallel/merge.py — the
+# one dispatch point shared with parallel/knn.py (allgather-and-select
+# vs the ring reduce-scatter-of-top-k tier; reference:
+# knn_merge_parts.cuh). All merge traffic rides the Comms facade so it
+# lands in the ``comms.ops``/``comms.bytes`` counters per axis.
 
 
 def build_ivf_pq(params: _pq.IndexParams, dataset: jax.Array, mesh: Mesh,
@@ -272,47 +271,118 @@ def build_ivf_pq(params: _pq.IndexParams, dataset: jax.Array, mesh: Mesh,
         centers=centers, centers_rot=centers_rot, rotation=rotation,
         codebooks=codebooks, packed_codes=pcodes, packed_ids=pids,
         packed_norms=pnorms, list_sizes=sizes, metric=mt.value,
-        pq_bits=params.pq_bits, pq_dim=pq_dim)
+        pq_bits=params.pq_bits, pq_dim=pq_dim, shard_rows=shard_n)
 
 
 def search_ivf_pq(params: _pq.SearchParams, index: ShardedIvfPq,
                   queries: jax.Array, k: int, mesh: Mesh,
-                  axis: str = "shard") -> Tuple[jax.Array, jax.Array]:
-    """Sharded IVF-PQ search: per-shard list scan + all-gather top-k merge
-    (reference: per-worker search + knn_merge_parts.cuh). Queries are
-    replicated; returns replicated (distances [m, k], global ids [m, k])."""
+                  axis: str = "shard", dataset=None,
+                  merge: str = "auto") -> Tuple[jax.Array, jax.Array]:
+    """Sharded IVF-PQ search: per-shard list scan + cross-shard top-k
+    merge (reference: per-worker search + knn_merge_parts.cuh). Queries
+    are replicated; returns (distances [m, k], global ids [m, k]) —
+    replicated under the allgather merge tier, query-sharded under the
+    ring tier (``merge`` = auto | allgather | ring, see
+    ``parallel.merge``).
+
+    With ``params.refine="f32_regen"`` and ``dataset`` (the build
+    dataset, row-sharded over the mesh) this is the end-to-end fused
+    pipeline per shard: the oversampled scan rides whatever tier
+    ``ivf_pq.search`` picks (incl. the Pallas LUT-scan kernel), the
+    exact re-rank rides the gather-refine dispatch tier against the
+    shard's own rows, and only each shard's k refined survivors enter
+    the merge — BASELINE config 5's shape (sharded IVF-PQ, SIFT-1B on
+    v5e-64) end to end."""
     mt = resolve_metric(index.metric)
     select_min = SELECT_MIN[mt]
     n_probes = min(params.n_probes, index.n_lists)
     q = jnp.asarray(queries, jnp.float32)
+    # same entry contract as the single-chip search: validate queries
+    # up front (not deep inside shard_map) and expose the PR-7 fault
+    # point so chaos plans cover the sharded tier too
+    expects(q.ndim == 2 and q.shape[1] == index.dim,
+            "queries must be [m, %d]", index.dim)
+    _faults.faultpoint("ivf_pq.search")
     m = q.shape[0]
     n_dev = index.n_shards
     expects(n_dev == mesh.shape[axis],
             "index sharded over %d devices, mesh axis has %d",
             n_dev, mesh.shape[axis])
+    tier, impl = _merge.merge_tier(
+        n_dev, m, k, explicit=merge,
+        whole_mesh=n_dev == mesh.devices.size)
+    comms = Comms(axis)
+
+    refined = params.refine != "none"
+    if refined:
+        from raft_tpu.neighbors import refine as _refine
+
+        expects(dataset is not None,
+                "refine=%r needs search(..., dataset=...): the sharded "
+                "rows to re-rank against (the build dataset)",
+                params.refine)
+        xd = jnp.asarray(dataset, jnp.float32)
+        expects(xd.ndim == 2 and xd.shape[1] == index.dim,
+                "refine dataset shape %s does not match the index dim %d",
+                tuple(xd.shape), index.dim)
+        if mt == DistanceType.CosineExpanded:
+            xd = xd / jnp.sqrt(
+                jnp.maximum(jnp.sum(xd * xd, -1, keepdims=True), 1e-12))
+        xd, _ = _pad_shard(xd, n_dev)
+        shard_n = xd.shape[0] // n_dev
+        # the gid → local-row remap below is only correct against the
+        # BUILD dataset's shard geometry — a row-count mismatch would
+        # refine against the wrong vectors silently (JAX clamps
+        # out-of-range gathers)
+        expects(index.shard_rows == 0 or shard_n == index.shard_rows,
+                "refine dataset has %d rows/shard but the index was "
+                "built with %d — pass the build dataset",
+                shard_n, index.shard_rows)
+        k_cand = max(k, int(round(k * params.refine_ratio)))
+        scan_params = dataclasses.replace(params, refine="none")
 
     def local_search(codes, ids, norms, sizes, q,
-                     centers, centers_rot, rotation, codebooks):
+                     centers, centers_rot, rotation, codebooks, *ds):
         local = _pq.IvfPqIndex(
             centers=centers, centers_rot=centers_rot, rotation=rotation,
             codebooks=codebooks, packed_codes=codes[0], packed_ids=ids[0],
             packed_norms=norms[0], list_sizes=sizes[0], metric=index.metric,
             pq_bits=index.pq_bits, pq_dim_static=index.pq_dim)
-        vals, gids = _pq._search_impl(local, q, k, n_probes,
-                                      params.query_tile,
-                                      lut_dtype=params.lut_dtype)
-        return _merge_topk(vals, gids, axis, m, k, n_dev, select_min)
+        if refined:
+            # per-shard fused pipeline: oversampled scan through the
+            # full single-chip dispatch stack (LUT-scan tier included),
+            # exact re-rank against this shard's own rows (ids are
+            # global with the shard offset baked in at build)
+            _, i0 = _pq.search(local, q, k_cand, scan_params)
+            rank = comms.get_rank()
+            li = jnp.where(i0 >= 0, i0 - rank * shard_n, -1)
+            vals, lids = _refine.refine(ds[0], q, li, k,
+                                        metric=index.metric)
+            gids = jnp.where(lids >= 0, lids + rank * shard_n, -1)
+        else:
+            vals, gids = _pq._search_impl(local, q, k, n_probes,
+                                          params.query_tile,
+                                          lut_dtype=params.lut_dtype)
+        return _merge.merge_topk(vals, gids, axis, m, k, n_dev,
+                                 select_min, tier=tier, impl=impl)
 
+    in_specs = [P(axis, None, None, None), P(axis, None, None),
+                P(axis, None, None), P(axis, None), P(),
+                P(), P(), P(), P()]
+    operands = [index.packed_codes, index.packed_ids, index.packed_norms,
+                index.list_sizes, q, index.centers, index.centers_rot,
+                index.rotation, index.codebooks]
+    if refined:
+        in_specs.append(P(axis, None))
+        operands.append(xd)
+    out_spec = _merge.merge_out_spec(tier, axis)
     fn = shard_map(
         local_search, mesh=mesh,
-        in_specs=(P(axis, None, None, None), P(axis, None, None),
-                  P(axis, None, None), P(axis, None), P(),
-                  P(), P(), P(), P()),
-        out_specs=(P(), P()),
+        in_specs=tuple(in_specs),
+        out_specs=(out_spec, out_spec),
         check_vma=False)
-    return fn(index.packed_codes, index.packed_ids, index.packed_norms,
-              index.list_sizes, q, index.centers, index.centers_rot,
-              index.rotation, index.codebooks)
+    rv, ri = fn(*operands)
+    return rv[:m], ri[:m]
 
 
 def build_ivf_flat(params: _flat.IndexParams, dataset: jax.Array, mesh: Mesh,
@@ -364,17 +434,25 @@ def build_ivf_flat(params: _flat.IndexParams, dataset: jax.Array, mesh: Mesh,
 
 def search_ivf_flat(params: _flat.SearchParams, index: ShardedIvfFlat,
                     queries: jax.Array, k: int, mesh: Mesh,
-                    axis: str = "shard") -> Tuple[jax.Array, jax.Array]:
-    """Sharded IVF-Flat search (per-shard scan + all-gather merge)."""
+                    axis: str = "shard",
+                    merge: str = "auto") -> Tuple[jax.Array, jax.Array]:
+    """Sharded IVF-Flat search: per-shard scan + cross-shard merge
+    through the shared tier (``merge`` = auto | allgather | ring)."""
     mt = resolve_metric(index.metric)
     select_min = SELECT_MIN[mt]
     n_probes = min(params.n_probes, index.n_lists)
     q = jnp.asarray(queries, jnp.float32)
+    expects(q.ndim == 2 and q.shape[1] == index.dim,
+            "queries must be [m, %d]", index.dim)
+    _faults.faultpoint("ivf_flat.search")
     m = q.shape[0]
     n_dev = index.packed_data.shape[0]
     expects(n_dev == mesh.shape[axis],
             "index sharded over %d devices, mesh axis has %d",
             n_dev, mesh.shape[axis])
+    tier, impl = _merge.merge_tier(
+        n_dev, m, k, explicit=merge,
+        whole_mesh=n_dev == mesh.devices.size)
 
     def local_search(data, ids, norms, sizes, q, centers):
         local = _flat.IvfFlatIndex(
@@ -382,13 +460,16 @@ def search_ivf_flat(params: _flat.SearchParams, index: ShardedIvfFlat,
             packed_norms=norms[0], list_sizes=sizes[0], metric=index.metric)
         vals, gids = _flat._search_impl(local, q, k, n_probes,
                                         params.query_tile)
-        return _merge_topk(vals, gids, axis, m, k, n_dev, select_min)
+        return _merge.merge_topk(vals, gids, axis, m, k, n_dev,
+                                 select_min, tier=tier, impl=impl)
 
+    out_spec = _merge.merge_out_spec(tier, axis)
     fn = shard_map(
         local_search, mesh=mesh,
         in_specs=(P(axis, None, None, None), P(axis, None, None),
                   P(axis, None, None), P(axis, None), P(), P()),
-        out_specs=(P(), P()),
+        out_specs=(out_spec, out_spec),
         check_vma=False)
-    return fn(index.packed_data, index.packed_ids, index.packed_norms,
-              index.list_sizes, q, index.centers)
+    rv, ri = fn(index.packed_data, index.packed_ids, index.packed_norms,
+                index.list_sizes, q, index.centers)
+    return rv[:m], ri[:m]

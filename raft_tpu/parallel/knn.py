@@ -19,8 +19,8 @@ from raft_tpu.core.compat import shard_map
 
 from raft_tpu.core.errors import expects
 from raft_tpu.distance import DistanceType, SELECT_MIN, resolve_metric
-from raft_tpu.matrix import select_k as _select_k
 from raft_tpu.neighbors import brute_force
+from raft_tpu.parallel import merge as _merge
 from raft_tpu.parallel.comms import Comms
 
 
@@ -39,26 +39,34 @@ def sharded_knn(
     mesh: Mesh,
     axis: str = "shard",
     metric="sqeuclidean",
+    merge: str = "auto",
 ) -> Tuple[jax.Array, jax.Array]:
     """Exact kNN over an index sharded across a mesh axis.
 
-    Each device scans its local shard (tiled brute force on the MXU), takes
-    a local top-k, all-gathers the [n_dev, m, k] candidates over ICI, and
-    merges with a final select_k — the reference's sharded-index pattern
-    (per-shard select + ``knn_merge_parts``, knn_brute_force.cuh:276)
-    as one SPMD program.
+    Each device scans its local shard (tiled brute force on the MXU),
+    takes a local top-k, and the per-shard candidates merge through the
+    shared cross-shard merge tier (``parallel.merge``) — allgather-and-
+    select or the ring reduce-scatter-of-top-k exchange, picked by
+    ``merge`` ("auto" defers to ``RAFT_TPU_RING_TOPK``) — the
+    reference's sharded-index pattern (per-shard select +
+    ``knn_merge_parts``, knn_brute_force.cuh:276) as one SPMD program.
 
-    Returns replicated (distances [m, k], global indices [m, k]).
+    Returns (distances [m, k], global indices [m, k]) — replicated
+    under the allgather tier, query-sharded under the ring tier.
     """
     mt = resolve_metric(metric)
     select_min = SELECT_MIN[mt]
     n_dev = mesh.shape[axis]
     n = dataset.shape[0]
+    m = queries.shape[0]
     padded, _ = _pad_rows(dataset, n_dev)
     shard_size = padded.shape[0] // n_dev
     expects(k <= shard_size, "k=%d exceeds shard size %d", k, shard_size)
     pad_val = jnp.inf if select_min else -jnp.inf
     comms = Comms(axis)  # counted collectives (comms.ops/comms.bytes)
+    tier, impl = _merge.merge_tier(
+        n_dev, m, k, explicit=merge,
+        whole_mesh=n_dev == mesh.devices.size)
 
     def local_search(ds_shard, q):
         rank = comms.get_rank()
@@ -66,21 +74,19 @@ def sharded_knn(
         vals, ids = brute_force.knn(idx, q, k)
         gids = ids.astype(jnp.int32) + rank.astype(jnp.int32) * shard_size
         vals = jnp.where(gids < n, vals, pad_val)  # mask padded rows
-        # cross-shard merge: gather all candidates, select final top-k
-        all_vals = comms.allgather(vals)             # [n_dev, m, k]
-        all_ids = comms.allgather(gids)
-        m = q.shape[0]
-        flat_v = jnp.transpose(all_vals, (1, 0, 2)).reshape(m, n_dev * k)
-        flat_i = jnp.transpose(all_ids, (1, 0, 2)).reshape(m, n_dev * k)
-        return _select_k(flat_v, k, select_min=select_min, input_indices=flat_i)
+        gids = jnp.where(gids < n, gids, -1)
+        return _merge.merge_topk(vals, gids, axis, m, k, n_dev,
+                                 select_min, tier=tier, impl=impl)
 
+    out_spec = _merge.merge_out_spec(tier, axis)
     fn = shard_map(
         local_search, mesh=mesh,
         in_specs=(P(axis, None), P()),
-        out_specs=(P(), P()),
+        out_specs=(out_spec, out_spec),
         check_vma=False,
     )
-    return fn(padded, queries)
+    rv, ri = fn(padded, queries)
+    return rv[:m], ri[:m]
 
 
 def replicated_knn(

@@ -1,0 +1,195 @@
+"""Cross-shard top-k merge tiers — the single dispatch point behind every
+distributed candidate merge (sharded kNN and both sharded IVF searches).
+
+Two tiers return the same global top-k, with very different traffic
+(reference: ``knn_merge_parts.cuh`` merged over NCCL in raft-dask):
+
+- **allgather**: every device gathers the full ``[n_dev, m, k]``
+  candidate tables over ICI and selects locally — O(n_dev·m·k) bytes
+  materialized per device, the original merge. Result is replicated.
+- **ring**: reduce-scatter-of-top-k. The query axis splits into n_dev
+  chunks; each chunk's partial top-k travels the ring for n_dev−1 hops,
+  merged against each device's local candidates on the way, landing
+  fully merged at its owner — only the surviving ``[m/n_dev, k]`` block
+  ever crosses a link, O(m·k) bytes per device total. Result is
+  query-sharded (``P(axis)`` out-specs; callers slice the assembled
+  array back to ``[m, k]``). On TPU the hops are the Pallas
+  ``ring_topk_merge`` kernel's async remote DMAs; elsewhere (the
+  8-device CPU CI mesh) and on sub-axis rings of a multi-axis mesh an
+  identical-schedule ``ppermute`` fallback keeps semantics and
+  ``comms.ops/bytes{op=ring_topk}`` accounting bit-for-bit comparable.
+
+``RAFT_TPU_RING_TOPK`` (auto | on | off, :func:`raft_tpu.obs.env_tristate`)
+picks the tier; explicit ``merge=`` arguments on the search entries
+override. Every decision lands in ``parallel.merge.dispatch{impl=...}``.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from raft_tpu.core.errors import expects
+from raft_tpu.matrix import select_k as _select_k
+from raft_tpu.obs import spans as _obs_spans
+from raft_tpu.ops import pallas_kernels as _pk
+from raft_tpu.parallel.comms import Comms
+
+MERGE_TIERS = ("allgather", "ring")
+
+
+def ring_auto_wanted(m: int, k: int, n_dev: int) -> bool:
+    """Auto-mode shape gate: take the ring only where it actually wins.
+    The ring ships (n_dev−1) sublane-padded ``[mc, k]`` blocks over
+    n_dev−1 SERIAL hops vs the allgather's one collective of
+    n_dev·[m, k]; for tiny query batches the mc=8 row padding makes the
+    ring ship MORE bytes and the hop chain is pure added latency.
+    Require the ring's counted bytes to be ≤ half the allgather's (the
+    same ≥2× bar the scaling CI asserts) before auto prefers it."""
+    mc = _pk.ring_chunk_rows(m, n_dev)
+    return 2 * (n_dev - 1) * mc <= n_dev * m
+
+
+def merge_tier(n_dev: int, m: int, k: int,
+               explicit: Optional[str] = None,
+               whole_mesh: bool = True) -> Tuple[str, str]:
+    """Pick the merge tier + implementation for one sharded search call.
+
+    ``explicit`` (a search entry's ``merge=`` argument, "auto" = defer)
+    overrides the ``RAFT_TPU_RING_TOPK`` tri-state; auto mode takes the
+    ring tier on TPU when the kernel can serve the shape AND the shape
+    is bandwidth-bound enough to win (:func:`ring_auto_wanted` —
+    small/latency-bound batches keep the single allgather). The kernel
+    addresses neighbors by logical device id, so it needs the exchange
+    axis to be the ``whole_mesh``; sub-axis rings and non-TPU backends
+    ride the ppermute fallback. Returns ``(tier, impl)`` with impl ∈
+    {allgather, ring_kernel, ring_ppermute}; counted per decision under
+    ``parallel.merge.dispatch{impl=...}``."""
+    force = _obs_spans.env_tristate("RAFT_TPU_RING_TOPK")
+    kernel_ok = (_pk._on_tpu() and whole_mesh
+                 and _pk.ring_topk_kernel_ok(m, k, n_dev))
+    if explicit is not None and explicit != "auto":
+        expects(explicit in MERGE_TIERS,
+                "unknown merge tier %r (supported: %s)", explicit,
+                "/".join(MERGE_TIERS))
+        tier = explicit
+    elif force == "off":
+        tier = "allgather"
+    elif force == "on":
+        tier = "ring"
+    else:
+        tier = ("ring" if kernel_ok and ring_auto_wanted(m, k, n_dev)
+                else "allgather")
+        if _pk._on_tpu() and tier == "allgather" and n_dev > 1:
+            _obs_spans.count_fallback(
+                "parallel.merge",
+                "latency_bound" if kernel_ok else "kernel_ineligible")
+    impl = "allgather"
+    if tier == "ring":
+        impl = "ring_kernel" if kernel_ok else "ring_ppermute"
+    _obs_spans.count_dispatch("parallel.merge", impl)
+    return tier, impl
+
+
+def merge_out_spec(tier: str, axis: str) -> P:
+    """shard_map out-spec for one merged output: the allgather tier
+    replicates, the ring tier leaves results query-sharded."""
+    return P() if tier == "allgather" else P(axis, None)
+
+
+def merged_rows(tier: str, m: int, n_dev: int) -> int:
+    """Global row count of the assembled merge result (the ring tier
+    pads the query axis to n_dev chunks of sublane-tiled rows; pad rows
+    sit at the END, so callers slice ``[:m]``)."""
+    if tier == "allgather":
+        return m
+    return _pk.ring_chunk_rows(m, n_dev) * n_dev
+
+
+def _merge_allgather(vals, ids, comms, m: int, k: int, n_dev: int,
+                     select_min: bool):
+    """All-gather the per-shard tables, select locally (the original
+    merge; reference: knn_merge_parts.cuh)."""
+    all_v = comms.allgather(vals)               # [n_dev, m, k]
+    all_i = comms.allgather(ids)
+    flat_v = jnp.transpose(all_v, (1, 0, 2)).reshape(m, n_dev * k)
+    flat_i = jnp.transpose(all_i, (1, 0, 2)).reshape(m, n_dev * k)
+    return _select_k(flat_v, k, select_min=select_min, input_indices=flat_i)
+
+
+def _ring_merge_fallback(vals, ids, comms, axis, m: int, k: int,
+                         n_dev: int, select_min: bool):
+    """The ppermute ring — the kernel's schedule, collective by
+    collective: device ``i`` launches chunk ``(i−1) mod n_dev``'s
+    partial, ships its running block right each hop, and merges the
+    incoming partial with its local block for that chunk; after
+    n_dev−1 hops device ``i`` owns chunk ``i`` fully merged."""
+    mc = _pk.ring_chunk_rows(m, n_dev)
+    m_pad = mc * n_dev
+    big = jnp.inf if select_min else -jnp.inf
+    v = vals.astype(jnp.float32)
+    i = ids.astype(jnp.int32)
+    if m_pad > m:
+        v = jnp.pad(v, ((0, m_pad - m), (0, 0)), constant_values=big)
+        i = jnp.pad(i, ((0, m_pad - m), (0, 0)), constant_values=-1)
+    v = jnp.where(i < 0, big, v)  # uniform invalid sentinel (kernel parity)
+    v3 = v.reshape(n_dev, mc, k)
+    i3 = i.reshape(n_dev, mc, k)
+    rank = comms.get_rank()
+    c0 = jax.lax.rem(rank + n_dev - 1, n_dev)
+    run_v = jax.lax.dynamic_index_in_dim(v3, c0, 0, keepdims=False)
+    run_i = jax.lax.dynamic_index_in_dim(i3, c0, 0, keepdims=False)
+    for s in range(n_dev - 1):
+        run_v, run_i = comms.ring_topk_hop(run_v, run_i)
+        c = jax.lax.rem(rank + 2 * n_dev - s - 2, n_dev)
+        loc_v = jax.lax.dynamic_index_in_dim(v3, c, 0, keepdims=False)
+        loc_i = jax.lax.dynamic_index_in_dim(i3, c, 0, keepdims=False)
+        cat_v = jnp.concatenate([run_v, loc_v], axis=1)
+        cat_i = jnp.concatenate([run_i, loc_i], axis=1)
+        run_v, run_i = _select_k(cat_v, k, select_min=select_min,
+                                 input_indices=cat_i)
+    return run_v, run_i
+
+
+def merge_topk(vals: jax.Array, ids: jax.Array, axis: str, m: int, k: int,
+               n_dev: int, select_min: bool, tier: str = "allgather",
+               impl: Optional[str] = None, interpret: bool = False
+               ) -> Tuple[jax.Array, jax.Array]:
+    """Cross-shard candidate merge — runs INSIDE ``shard_map`` over
+    ``axis``. ``vals``/``ids`` [m, k] are this shard's local top-k
+    (global ids, -1 invalid, invalid keys at the select sentinel).
+
+    The allgather tier returns the replicated [m, k] result; the ring
+    tier returns this device's owned query chunk (pair with
+    :func:`merge_out_spec` / :func:`merged_rows`). All traffic rides
+    the ``Comms`` facade — allgather merges count the materialized
+    table, ring merges count n_dev−1 surviving-block hops under
+    ``op=ring_topk`` — so the two tiers' merge-phase bytes are directly
+    comparable in ``comms.bytes`` (the dryrun's scaling assertion)."""
+    expects(tier in MERGE_TIERS, "unknown merge tier %r", tier)
+    expects(vals.shape == (m, k) and ids.shape == (m, k),
+            "merge_topk expects [m, k] local tables (got %s/%s for "
+            "m=%d k=%d)", vals.shape, ids.shape, m, k)
+    comms = Comms(axis)
+    if tier == "allgather":
+        return _merge_allgather(vals, ids, comms, m, k, n_dev, select_min)
+    if impl == "ring_kernel":
+        mc = _pk.ring_chunk_rows(m, n_dev)
+        # the kernel's remote DMAs bypass lax: attribute its hop traffic
+        # through the facade at trace time (GL10's telemetry invariant).
+        # Counted at the LOGICAL [mc, k] block — the facade-wide
+        # convention (every verb counts shape × itemsize): physically
+        # the kernel ships lane-padded [mc, 128] buffers, exactly as
+        # XLA's tiled layout pads the allgather tier's [m, k] tables,
+        # so the tier-vs-tier comparison stays like-for-like
+        comms.count_ring_topk(
+            n_dev - 1,
+            jax.ShapeDtypeStruct((mc, k), jnp.float32),
+            jax.ShapeDtypeStruct((mc, k), jnp.int32))
+        return _pk.ring_topk_merge(vals, ids, k, axis, n_dev, select_min,
+                                   interpret=interpret)
+    return _ring_merge_fallback(vals, ids, comms, axis, m, k, n_dev,
+                                select_min)

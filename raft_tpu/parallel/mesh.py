@@ -44,6 +44,18 @@ def make_hybrid_mesh(ici_shape: Sequence[int], dcn_shape: Sequence[int],
     return Mesh(devices, tuple(axis_names))
 
 
+def submesh(mesh: Mesh, n_dev: int, axis_names: Sequence[str] = ("shard",)
+            ) -> Mesh:
+    """A 1-D mesh over the first ``n_dev`` devices of ``mesh`` — the
+    scaling-study helper (weak/strong legs at n_dev ∈ {2, 4, 8} reuse
+    one device pool instead of re-enumerating the platform)."""
+    flat = list(np.asarray(mesh.devices).reshape(-1))
+    if n_dev > len(flat):
+        raise ValueError(f"submesh of {n_dev} devices from a "
+                         f"{len(flat)}-device mesh")
+    return make_mesh(axis_names=axis_names, devices=flat[:n_dev])
+
+
 def shard_rows(x: jax.Array, mesh: Mesh, axis: str = "shard") -> jax.Array:
     """Place a [n, …] array row-sharded over ``axis`` (replicated on the
     rest). Pads implicitly via XLA if n is not divisible."""

@@ -139,11 +139,13 @@ class TestCommsTelemetry:
 
     def test_byte_totals_per_collective(self, mesh, reg):
         comms = Comms("shard")
-        # [16, 2] f32 per shard → 128 payload bytes for every verb
+        # [16, 2] f32 per shard = 128 payload bytes; fixed-size-result
+        # verbs count the payload, the gather family counts the
+        # size×payload table it materializes over the interconnect
         x = jnp.ones((N_DEV * 16, 2), jnp.float32)
 
         def body(v):
-            g = comms.allgather(v)                       # 128 B
+            g = comms.allgather(v)                       # 8 × 128 B
             r = comms.reducescatter(
                 comms.alltoall(v) + v, Op.SUM)           # 128 B each
             s = comms.send_recv_ring(v)                  # 128 B
@@ -152,10 +154,11 @@ class TestCommsTelemetry:
         shard_map(body, mesh=mesh, in_specs=(P("shard"),),
                   out_specs=P("shard"), check_vma=False)(x)
         c = self._counters(reg)
-        for verb in ("allgather", "alltoall", "reducescatter",
-                     "send_recv_ring"):
+        for verb, want in (("allgather", N_DEV * 128.0),
+                           ("alltoall", 128.0), ("reducescatter", 128.0),
+                           ("send_recv_ring", 128.0)):
             assert c[f"comms.ops{{axis=shard,op={verb}}}"] == 1.0, (verb, c)
-            assert c[f"comms.bytes{{axis=shard,op={verb}}}"] == 128.0, \
+            assert c[f"comms.bytes{{axis=shard,op={verb}}}"] == want, \
                 (verb, c)
 
     def test_allgatherv_counts_payload_plus_count(self, mesh, reg):
@@ -168,8 +171,9 @@ class TestCommsTelemetry:
                   out_specs=(P(None), P(None)), check_vma=False)(x, counts)
         c = self._counters(reg)
         assert c["comms.ops{axis=shard,op=allgatherv}"] == 1.0
-        # [4, 2] f32 rows + one i32 count = 32 + 4
-        assert c["comms.bytes{axis=shard,op=allgatherv}"] == 36.0
+        # gather family counts the materialized table:
+        # 8 × ([4, 2] f32 rows + one i32 count) = 8 × 36
+        assert c["comms.bytes{axis=shard,op=allgatherv}"] == N_DEV * 36.0
 
     def test_counted_once_per_trace_not_per_execution(self, mesh, reg):
         comms = Comms("shard")

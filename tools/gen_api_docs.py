@@ -43,6 +43,7 @@ MODULES = [
     "raft_tpu.stats.descriptive", "raft_tpu.stats.metrics",
     "raft_tpu.spectral.partition", "raft_tpu.solver.lap",
     "raft_tpu.parallel.mesh", "raft_tpu.parallel.comms",
+    "raft_tpu.parallel.merge",
     "raft_tpu.parallel.knn", "raft_tpu.parallel.ivf",
     "raft_tpu.ops.pallas_kernels", "raft_tpu.native",
     "raft_tpu.bench.dataset", "raft_tpu.bench.runner",
@@ -54,6 +55,23 @@ MODULES = [
 # Hand-authored notes appended after a module's generated listing —
 # survive regeneration because they live HERE, not in the output file.
 NOTES = {
+    "raft_tpu.parallel.merge": """\
+### Cross-shard merge-tier decision table
+
+Every sharded search's candidate merge routes through `merge_topk`
+(the obs counter `parallel.merge.dispatch{impl=...}` records the pick;
+`merge="auto"|"allgather"|"ring"` on the search entries overrides the
+`RAFT_TPU_RING_TOPK` tri-state):
+
+| tier (`impl`) | selected when | transport | merge-phase bytes/rank |
+|---|---|---|---|
+| `allgather` | auto off-TPU, small/latency-bound shapes, or forced | one `all_gather` of the `[n_dev, m, k]` tables + local select; result replicated | O(n_dev·m·k) — the materialized table (`comms.bytes{op=allgather}`) |
+| `ring_kernel` | TPU + whole-mesh 1-D axis + `k ≤ 64` + VMEM guard (`ops.pallas_kernels.ring_topk_kernel_ok`) | Pallas `ring_topk_merge`: n_dev−1 async-remote-DMA hops, each shipping only the surviving `[m/n_dev, k]` block, k-round extraction merge on-chip; result query-sharded | O(m·k) total (per-hop `comms.bytes{op=ring_topk}`, attributed via `Comms.count_ring_topk`) |
+| `ring_ppermute` | ring tier forced/auto off-TPU or on a sub-axis of a multi-axis mesh | `Comms.ring_topk_hop` ppermute hops — the kernel's schedule, identical results and identical counted bytes | O(m·k) total (per-hop `comms.bytes{op=ring_topk}`) |
+
+See docs/developer_guide.md "The cross-shard merge tier" for the full
+latency/bandwidth trade and docs/observability.md for the byte model.
+""",
     "raft_tpu.neighbors.ivf_pq": """\
 ### IVF-PQ scan-tier decision table
 
