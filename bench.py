@@ -38,12 +38,18 @@ Observability (docs/observability.md): RAFT_TPU_BENCH_OBS=1 runs a few
 diagnostic batches per measured row under raft_tpu.obs (sync + stage
 mode) and adds a per-stage latency breakdown ("stages": mean span
 seconds, incl. ivf_pq.search.{coarse_quantize,lut,scan} and refine),
-"peak_hbm_bytes", and p50/p99 search-latency quantiles
-("latency_p50_s"/"latency_p99_s") to each detail row;
+"peak_hbm_bytes", p50/p99 search-latency quantiles
+("latency_p50_s"/"latency_p99_s"/"latency_reps"), and roofline cost
+columns ("flops"/"bytes_accessed"/"arith_intensity"/"bound"/
+"achieved_bw_frac" — obs.prof's XLA cost-model attribution of the
+row's compiled search program) to each detail row;
 RAFT_TPU_BENCH_OBS_JSONL=path appends the captured metric series as
 JSON lines; RAFT_TPU_XPROF_DIR=path brackets one measured batch per row
-in jax.profiler.trace for offline XProf analysis. All of it is off by
-default and adds nothing to the timed QPS loop.
+in a programmatic obs.prof.capture for offline XProf analysis. Every
+runner row also self-stamps environment provenance ("env": jax/jaxlib/
+libtpu versions, device kind/count, mesh shape) so tools/benchdiff.py
+can refuse cross-environment comparisons. All of it is off by default
+and adds nothing to the timed QPS loop.
 
 Flight recorder: once the runner legs import raft_tpu, the flight
 recorder arms (dir RAFT_TPU_FLIGHT_DIR, default /tmp/raft_tpu_flight;
@@ -526,9 +532,22 @@ def _row(dataset_name, r):
         row["peak_hbm_bytes"] = getattr(r, "peak_hbm_bytes", None)
     if getattr(r, "latency_quantiles", None) is not None:
         # p50/p99 of the diagnostic batches (Histogram.quantile bucket
-        # interpolation) — tail estimate, not the timed QPS protocol
+        # interpolation) — tail estimate, not the timed QPS protocol;
+        # "samples" is the rep count benchdiff's noise model reads
         row["latency_p50_s"] = r.latency_quantiles.get("p50")
         row["latency_p99_s"] = r.latency_quantiles.get("p99")
+        row["latency_reps"] = r.latency_quantiles.get("samples")
+    if getattr(r, "cost", None) is not None:
+        # roofline cost attribution (obs.prof): XLA cost model of the
+        # row's compiled search program + memory/compute bound vs the
+        # device peak table + achieved bandwidth fraction at the
+        # diagnostic p50 — the "is this near the hardware limit" column
+        row.update(r.cost)
+    if getattr(r, "env", None) is not None:
+        # environment provenance: benchdiff refuses cross-environment
+        # comparisons (different chip / jax / device count) instead of
+        # reporting phantom regressions
+        row["env"] = r.env
     return row
 
 

@@ -248,8 +248,11 @@ rm -f /tmp/raft_tpu_obs_smoke.jsonl
 RAFT_TPU_BENCH_N=20000 RAFT_TPU_BENCH_Q=500 \
 RAFT_TPU_BENCH_ALGOS=ivf_pq RAFT_TPU_BENCH_LEGS=hard \
 RAFT_TPU_BENCH_OBS=1 \
-RAFT_TPU_BENCH_OBS_JSONL=/tmp/raft_tpu_obs_smoke.jsonl python bench.py
+RAFT_TPU_BENCH_OBS_JSONL=/tmp/raft_tpu_obs_smoke.jsonl python bench.py \
+  | tee /tmp/raft_tpu_obs_bench.out
 python - <<'EOF'
+import json
+
 from raft_tpu.obs import load_jsonl
 
 rows = load_jsonl("/tmp/raft_tpu_obs_smoke.jsonl")
@@ -264,10 +267,94 @@ assert all(r["sum"] > 0 for r in rows
 disp = [r for r in rows if r["name"] == "ivf_pq.scan.dispatch"]
 assert disp and all(r["value"] > 0 for r in disp), \
     f"ivf_pq.scan.dispatch counter missing: {sorted(names)}"
+# the prof.* roofline gauges must have landed in the captured series
+prof = [r for r in rows if r["name"].startswith("prof.")]
+assert {"prof.flops", "prof.bytes", "prof.bound"} <= \
+    {r["name"] for r in prof}, sorted(names)
+# ISSUE 9 acceptance: the smoke record's rows carry non-null cost
+# columns + environment provenance (saved as the gate's record)
+recs = [json.loads(ln) for ln in open("/tmp/raft_tpu_obs_bench.out")
+        if ln.startswith("{")]
+record = recs[-1]
+assert record["detail"], "obs smoke produced no rows"
+for r in record["detail"]:
+    assert r.get("flops") and r.get("bytes_accessed"), r
+    assert r.get("bound") in ("memory", "compute"), r
+    assert r.get("env", {}).get("jax"), r
+with open("/tmp/raft_tpu_obs_bench.json", "w") as f:
+    json.dump(record, f, indent=1)
 print(f"observability smoke OK: {len(rows)} series, spans "
       f"{sorted(n for n in names if n.startswith('span.'))}, dispatch "
-      f"impls {sorted(r['labels'].get('impl') for r in disp)}")
+      f"impls {sorted(r['labels'].get('impl') for r in disp)}; "
+      f"{len(record['detail'])} rows with cost columns "
+      f"(bound={sorted({r['bound'] for r in record['detail']})})")
 EOF
+
+echo "== benchdiff regression gate (ISSUE 9: unchanged record passes,"
+echo "   faults-sleep-injected slowdown trips the gate) =="
+# gate 1: the smoke record vs itself — an unchanged record must pass
+python -m tools.benchdiff /tmp/raft_tpu_obs_bench.json \
+    /tmp/raft_tpu_obs_bench.json \
+    --md /tmp/raft_tpu_benchdiff_scoreboard.md \
+    --json /tmp/raft_tpu_benchdiff_verdict.json
+# gate 2 (self-test): re-measure one CPU-shaped leg clean and with a
+# PR-7 fault-plan "sleep" injected at ivf_flat.search — the injected
+# ≥20% qps regression must exit non-zero through the CLI gate
+python - <<'EOF'
+import json
+import subprocess
+import sys
+
+from raft_tpu.bench import runner
+from raft_tpu.robust import faults
+
+cfg = {
+    "dataset": {"name": "gate-smoke", "n": 20_000, "dim": 32,
+                "n_queries": 500, "metric": "sqeuclidean"},
+    "k": 10, "batch_size": 10_000,
+    "index": [{"name": "ivf_flat.n64", "algo": "ivf_flat",
+               "build_param": {"n_lists": 64},
+               "search_params": [{"n_probes": 8}]}],
+}
+
+def measure():
+    rows = runner.run_config(json.loads(json.dumps(cfg)), verbose=False)
+    return {"detail": [
+        {"dataset": r.dataset, "algo": r.algo, "index": r.index_name,
+         "qps": r.qps, "recall": r.recall, "batch_size": r.batch_size,
+         "search_param": r.search_param, "env": r.env} for r in rows]}
+
+base = measure()
+plan = faults.install_plan({"faults": [{"site": "ivf_flat.search",
+                                        "kind": "sleep", "sleep_s": 0.3,
+                                        "times": 0}]})
+try:
+    slow = measure()
+finally:
+    faults.clear_plan()
+assert plan.fires().get("ivf_flat.search", 0) > 0, \
+    "sleep fault never fired — the self-test measured nothing"
+b, s = base["detail"][0]["qps"], slow["detail"][0]["qps"]
+assert s < 0.8 * b, f"injected sleep only moved qps {b:.0f}->{s:.0f}"
+json.dump(base, open("/tmp/raft_tpu_gate_base.json", "w"))
+json.dump(slow, open("/tmp/raft_tpu_gate_slow.json", "w"))
+for args, want in ((["/tmp/raft_tpu_gate_base.json"] * 2, 0),
+                   (["/tmp/raft_tpu_gate_base.json",
+                     "/tmp/raft_tpu_gate_slow.json"], 1)):
+    p = subprocess.run([sys.executable, "-m", "tools.benchdiff"] + args,
+                       capture_output=True, text=True)
+    assert p.returncode == want, (args, want, p.returncode, p.stdout)
+print(f"benchdiff gate OK: unchanged record passed; injected sleep "
+      f"({b:,.0f} -> {s:,.0f} qps) tripped exit 1")
+EOF
+# informational: drift vs the committed baseline (never gates — CPU
+# qps is machine-load-dependent across hosts; the env-stamp refusal
+# and join are what this exercises)
+python -m tools.benchdiff cpu_smoke /tmp/raft_tpu_obs_bench.json \
+    --report-only --allow-env-mismatch | tail -5
+python -m tools.obsdump /tmp/raft_tpu_benchdiff_verdict.json \
+  | grep -q "Verdict" || { echo "obsdump failed on the verdict"; exit 1; }
+echo "benchdiff scoreboard artifact: /tmp/raft_tpu_benchdiff_scoreboard.md"
 
 echo "== trace export round-trip (instrumented search -> Perfetto JSON) =="
 python - <<'EOF'
@@ -412,5 +499,18 @@ assert "span.refine.fused_scan" in snap["histograms"], \
 print("gather-refine smoke OK: fused tier parity + dispatch counter "
       "+ span recorded")
 EOF
+
+echo "== CI artifacts =="
+# one directory a CI system (or a human triaging a red run) picks up
+# whole: the graftlint findings, the obs-smoke bench record (with cost
+# columns + env provenance), and the benchdiff scoreboard + verdict
+ARTIFACTS="${RAFT_TPU_CI_ARTIFACTS:-/tmp/raft_tpu_ci_artifacts}"
+mkdir -p "$ARTIFACTS"
+cp /tmp/graftlint_report.json \
+   /tmp/raft_tpu_obs_bench.json \
+   /tmp/raft_tpu_benchdiff_scoreboard.md \
+   /tmp/raft_tpu_benchdiff_verdict.json "$ARTIFACTS"/
+ls -l "$ARTIFACTS"
+echo "CI artifacts under $ARTIFACTS"
 
 echo "CI: all green"

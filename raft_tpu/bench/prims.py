@@ -441,19 +441,52 @@ def export_csv(rows: List[PrimResult], path: str) -> None:
                         f"{r.throughput:.1f}", r.unit, _json.dumps(r.params)])
 
 
+def export_json(rows: List[PrimResult], path: str) -> None:
+    """Self-describing record: rows + the same environment-provenance
+    stamp bench rows carry (``runner.environment_stamp``), so prim
+    measurements from different chips/jax builds are never compared as
+    if they were the same machine."""
+    import json as _json
+    import time as _time
+
+    from raft_tpu.bench.runner import environment_stamp
+
+    doc = {
+        "schema": "raft_tpu.prims/1",
+        "measured_at": _time.strftime("%Y-%m-%dT%H:%M:%SZ",
+                                      _time.gmtime()),
+        "env": environment_stamp(),
+        "rows": [{"bench": r.bench, "impl": r.impl, "ms": r.ms,
+                  "throughput": r.throughput, "unit": r.unit,
+                  "params": r.params} for r in rows],
+    }
+    with open(path, "w") as f:
+        _json.dump(doc, f, indent=1)
+
+
 def main(argv: Optional[List[str]] = None) -> None:
     import argparse
 
     ap = argparse.ArgumentParser(description="raft_tpu prim micro-benchmarks")
     ap.add_argument("benches", nargs="*", default=["all"])
     ap.add_argument("--csv", default=None)
+    ap.add_argument("--json", default=None,
+                    help="write rows + environment-provenance stamp "
+                         "as one JSON record")
     args = ap.parse_args(argv)
+    from raft_tpu.bench.runner import environment_stamp
+
+    env = environment_stamp()
+    print(f"[prims] env: jax={env.get('jax')} backend={env.get('backend')} "
+          f"{env.get('device_kind')} x{env.get('device_count')}")
     rows = run(args.benches or ["all"])
     for r in rows:
         print(f"{r.bench:14s} {r.impl:14s} {r.ms:10.3f} ms "
               f"{r.throughput:14,.0f} {r.unit:12s} {r.params}")
     if args.csv:
         export_csv(rows, args.csv)
+    if args.json:
+        export_json(rows, args.json)
 
 
 if __name__ == "__main__":

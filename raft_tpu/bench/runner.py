@@ -75,6 +75,17 @@ class BenchResult:
     # True when the row was measured under the fenced LATENCY protocol
     # (reduced-batch legs): qps includes the per-call host round-trip
     fence_per_call: bool = False
+    # roofline cost attribution (RAFT_TPU_BENCH_OBS=1, obs.prof): the
+    # XLA cost model of the row's whole compiled search program —
+    # flops / bytes_accessed / arith_intensity / memory-vs-compute
+    # bound vs the device peak table, plus achieved_bw_frac from the
+    # diagnostic batches' p50 latency. None when the search closure
+    # can't be traced end-to-end (host-gather paths)
+    cost: Optional[Dict[str, Any]] = None
+    # environment provenance (jax/jaxlib/libtpu versions, device kind
+    # and count, mesh shape) — benchdiff refuses cross-environment
+    # comparisons instead of reporting phantom regressions
+    env: Optional[Dict[str, Any]] = None
 
 
 # ---------------------------------------------------------------------------
@@ -174,6 +185,62 @@ ALGO_REGISTRY: Dict[str, Callable] = {
 # driver
 # ---------------------------------------------------------------------------
 
+_ENV_STAMP: Optional[Dict[str, Any]] = None
+
+
+def environment_stamp() -> Dict[str, Any]:
+    """Environment provenance for bench rows (cached per process):
+    jax/jaxlib/libtpu versions, backend, device kind/count, local
+    device count, and the (flat single-process) mesh shape. Two
+    records whose stamps differ are measuring different hardware or
+    different compilers — ``tools/benchdiff.py`` refuses to compare
+    them instead of reporting phantom regressions. Every field
+    degrades to None rather than raising (the stamp must never cost a
+    row)."""
+    global _ENV_STAMP
+    if _ENV_STAMP is not None:
+        return _ENV_STAMP
+    env: Dict[str, Any] = {}
+    try:
+        env["jax"] = jax.__version__
+    except Exception:
+        env["jax"] = None
+    try:
+        import jaxlib
+
+        env["jaxlib"] = jaxlib.__version__
+    except Exception:
+        env["jaxlib"] = None
+    libtpu = None
+    try:  # libtpu ships under several distribution names
+        import importlib.metadata as _md
+
+        for dist in ("libtpu", "libtpu-nightly"):
+            try:
+                libtpu = _md.version(dist)
+                break
+            except Exception:
+                continue
+    except Exception:
+        pass
+    env["libtpu"] = libtpu
+    try:
+        env["backend"] = jax.default_backend()
+        devs = jax.devices()
+        env["device_kind"] = getattr(devs[0], "device_kind", None)
+        env["device_count"] = len(devs)
+        env["local_device_count"] = jax.local_device_count()
+        env["process_count"] = getattr(jax, "process_count", lambda: 1)()
+        # flat single-process mesh; multichip records stamp their own
+        env["mesh_shape"] = [len(devs)]
+    except Exception:
+        env.setdefault("backend", None)
+        env.setdefault("device_kind", None)
+        env.setdefault("device_count", None)
+    _ENV_STAMP = env
+    return env
+
+
 def _obs_capture(search_fn, queries, k, sp, batch_size, context):
     """RAFT_TPU_BENCH_OBS=1: run a few diagnostic batches under the
     observability layer (sync + stage mode → ivf_pq dispatches
@@ -223,6 +290,26 @@ def _obs_capture(search_fn, queries, k, sp, batch_size, context):
     quantiles = {"p50": round(lat.quantile(0.5), 6),
                  "p99": round(lat.quantile(0.99), 6),
                  "samples": lat.count}
+    # roofline cost attribution (obs.prof): trace+compile the SAME
+    # search closure the timed loop dispatched as one whole program and
+    # read XLA's cost model — flops, bytes accessed, arithmetic
+    # intensity, memory-vs-compute bound vs the device peak table.
+    # elapsed = the diagnostic p50, so achieved_bw_frac compares the
+    # row's realized bandwidth against the chip ceiling. analyze_jit
+    # returns None (row kept, columns null) when the closure can't
+    # trace end-to-end — e.g. host-gather refine paths.
+    cost_row = None
+    try:
+        from raft_tpu.obs import prof as _prof
+
+        cost = _prof.analyze_jit(lambda q: search_fn(q, k, dict(sp)), qb,
+                                 elapsed_s=quantiles["p50"])
+        if cost is not None:
+            _prof.record(cost, registry=reg, program=context)
+            cost_row = cost.as_row()
+    except Exception as e:  # diagnostics must never cost a row
+        print(f"[bench] prof capture failed ({e!r}) — "
+              "row kept without cost columns")
     snap = reg.snapshot()
     stages = {name[len("span."):]: round(h["mean"], 6)
               for name, h in snap["histograms"].items()
@@ -237,17 +324,27 @@ def _obs_capture(search_fn, queries, k, sp, batch_size, context):
     jsonl = os.environ.get("RAFT_TPU_BENCH_OBS_JSONL")
     if jsonl:
         reg.dump_jsonl(jsonl, extra={"context": context})
-    return stages, path, (int(peak) if peak else None), quantiles
+    return stages, path, (int(peak) if peak else None), quantiles, cost_row
 
 
 def _xprof_capture(search_fn, queries, k, sp, batch_size, xprof_dir):
-    """RAFT_TPU_XPROF_DIR: bracket one measured batch in
-    ``jax.profiler.trace`` for offline XProf/Perfetto analysis."""
+    """RAFT_TPU_XPROF_DIR: bracket one measured batch in a programmatic
+    profiler capture (``obs.prof.capture`` — the start/stop
+    generalization of the old inline ``jax.profiler.trace`` block) for
+    offline XProf/Perfetto analysis."""
+    from raft_tpu.obs import prof as _prof
+
     qb = queries[: min(batch_size, queries.shape[0])]
-    with jax.profiler.trace(xprof_dir):
+    cap = _prof.capture(xprof_dir).start()
+    try:
         out = search_fn(qb, k, dict(sp))
         jax.block_until_ready(out)
-    print(f"[bench] xprof capture written under {xprof_dir}")
+    finally:
+        cap.stop()
+    if cap.error is not None:
+        print(f"[bench] xprof capture unavailable ({cap.error!r})")
+    else:
+        print(f"[bench] xprof capture written under {xprof_dir}")
 
 
 def _bench_search(search_fn, queries, k, sp, batch_size, iters=5,
@@ -390,12 +487,13 @@ def _run_one_index(index_cfg, algo, dsx, data, queries, k, batch_size,
         ids, dt, qps = _bench_search(search_fn, q_leg, k, sp, row_bs,
                                      fence_per_call=fenced)
         rec = ds_mod.recall(ids, data.groundtruth[: q_leg.shape[0]])
-        stages = stage_path = peak_hbm = latency_q = None
+        stages = stage_path = peak_hbm = latency_q = cost_row = None
         if _env_flag("RAFT_TPU_BENCH_OBS"):
             try:
-                stages, stage_path, peak_hbm, latency_q = _obs_capture(
-                    search_fn, q_leg, k, sp, row_bs,
-                    context=f"{index_cfg.get('name', algo)} {sp}")
+                stages, stage_path, peak_hbm, latency_q, cost_row = \
+                    _obs_capture(
+                        search_fn, q_leg, k, sp, row_bs,
+                        context=f"{index_cfg.get('name', algo)} {sp}")
             except Exception as e:  # diagnostics must never cost a row
                 print(f"[bench] obs capture failed ({e!r}) — "
                       "row kept without stage breakdown")
@@ -409,7 +507,8 @@ def _run_one_index(index_cfg, algo, dsx, data, queries, k, batch_size,
             build_param=bp, search_param=dict(sp),
             stage_breakdown=stages, stage_path=stage_path,
             peak_hbm_bytes=peak_hbm, latency_quantiles=latency_q,
-            fence_per_call=fenced,
+            fence_per_call=fenced, cost=cost_row,
+            env=environment_stamp(),
         )
         results.append(row)
         if on_row is not None:
@@ -427,6 +526,13 @@ def _run_one_index(index_cfg, algo, dsx, data, queries, k, batch_size,
                        f"p99={latency_q['p99'] * 1e3:.1f}ms"
                        if latency_q else "")
                 print(f"[bench]   stages: {parts}{hbm}{lat}")
+            if cost_row and cost_row.get("flops") is not None \
+                    and cost_row.get("bytes_accessed") is not None:
+                bw = cost_row.get("achieved_bw_frac")
+                bw_s = f" bw_frac={bw:.3f}" if bw is not None else ""
+                print(f"[bench]   roofline: flops={cost_row['flops']:.3g} "
+                      f"bytes={cost_row['bytes_accessed']:.3g} "
+                      f"bound={cost_row['bound']}{bw_s}")
 
 
 def run_config_file(path: str, **kw) -> List[BenchResult]:

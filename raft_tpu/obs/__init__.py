@@ -9,6 +9,10 @@ this package makes the same attribution available *in process*:
   nesting, optional device-time sync), recorded into the registry;
 - :mod:`raft_tpu.obs.hbm`     — ``device.memory_stats()`` telemetry,
   sampled per local device;
+- :mod:`raft_tpu.obs.prof`    — compiled-program cost attribution
+  (``Compiled.cost_analysis``), roofline memory-/compute-bound
+  classing against a device peak table, and a programmatic
+  ``jax.profiler`` start/stop bracket;
 - :mod:`raft_tpu.obs.trace`   — span-event ring buffer +
   Chrome-trace/Perfetto export (``obs.enable(events=True)``);
 - :mod:`raft_tpu.obs.flight`  — flight recorder: crash-surviving dumps
@@ -48,6 +52,7 @@ from raft_tpu.obs.spans import (  # noqa: F401
     sync_enabled,
 )
 from raft_tpu.obs import hbm  # noqa: F401
+from raft_tpu.obs import prof  # noqa: F401
 from raft_tpu.obs import trace  # noqa: F401
 from raft_tpu.obs import flight  # noqa: F401
 from raft_tpu.obs import sanitize  # noqa: F401

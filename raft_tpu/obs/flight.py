@@ -77,6 +77,32 @@ def _watchdog_kill_info() -> Optional[Dict[str, Any]]:
         return None
 
 
+def _robust_state() -> Dict[str, Any]:
+    """Active fault plan (rules + live hit/fire counts) and the recent
+    degrade-ladder moves, via ``sys.modules`` — the robust package is
+    never imported FROM a dump path (``bench.py`` spec-loads
+    ``faults``/``retry`` standalone before jax; importing the package
+    route from a signal handler could re-enter a wedged import). {}
+    when nothing robust is loaded or armed — and any failure stays
+    silent: folding extras must never cost the dump."""
+    out: Dict[str, Any] = {}
+    try:
+        faults_mod = sys.modules.get("raft_tpu.robust.faults")
+        if faults_mod is not None:
+            plan = faults_mod.active_plan()
+            if plan is not None:
+                out["fault_plan"] = plan.describe()
+                out["fault_fires"] = plan.fires()
+        degrade_mod = sys.modules.get("raft_tpu.robust.degrade")
+        if degrade_mod is not None:
+            steps = degrade_mod.recent_steps()
+            if steps:
+                out["degrade_recent"] = steps
+    except Exception:
+        return {}
+    return out
+
+
 def _resolve_signals(signals: Sequence) -> List[int]:
     out = []
     for s in signals:
@@ -135,6 +161,14 @@ class FlightRecorder:
             # named by WATCHDOG_KILL_INFO just before SIGTERM) — the
             # dump then says WHY it was killed, not just that it was
             out["watchdog"] = watchdog
+        robust = _robust_state()
+        if robust:
+            # the robust↔obs cross-link: what the chaos lane had
+            # injected (active fault plan + live fire counts) and how
+            # far the run had degraded (recent ladder moves), so a
+            # killed chaos-lane run's dump says what was IN FLIGHT,
+            # not just what died
+            out["robust"] = robust
         return out
 
     def dump(self, reason: str = "manual",

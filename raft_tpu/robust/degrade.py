@@ -35,6 +35,8 @@ from __future__ import annotations
 
 import dataclasses
 import sys
+import time
+from collections import deque
 from typing import Any, Callable, Dict, List, Optional, Tuple
 
 # one classifier for "is this an OOM": retry uses it to refuse blind
@@ -45,8 +47,32 @@ from raft_tpu.robust.retry import is_resource_exhausted  # noqa: F401
 __all__ = [
     "is_resource_exhausted", "Step", "Ladder", "DegradationExhausted",
     "run_with_degradation", "standard_search_ladder", "note_step",
-    "batched_search_call",
+    "batched_search_call", "recent_steps", "clear_recent",
 ]
+
+# Bounded ring of the most recent ladder moves (reactive OOM rungs AND
+# note_step guard declines), kept regardless of whether obs recording
+# is on — the flight recorder folds it into every dump, so a killed
+# run's black box says how far it had degraded. Deque appends are
+# atomic under the GIL; no lock needed on this path.
+_RECENT_MAX = 64
+_recent: deque = deque(maxlen=_RECENT_MAX)
+
+
+def _note_recent(site: str, frm: str, to: str, reason: str) -> None:
+    _recent.append({"ts": round(time.time(), 3), "site": site,
+                    "from": frm, "to": to, "reason": reason})
+
+
+def recent_steps() -> List[Dict[str, Any]]:
+    """The last ≤64 degradation moves (oldest first) — what
+    :mod:`raft_tpu.obs.flight` embeds as ``robust.degrade_recent``."""
+    return list(_recent)
+
+
+def clear_recent() -> None:
+    """Reset the ring (tests)."""
+    _recent.clear()
 
 @dataclasses.dataclass
 class Step:
@@ -106,6 +132,7 @@ def note_step(site: str, frm: str, to: str, reason: str) -> None:
     OOMing chunk) — one observable degradation policy either way."""
     _count("degrade.steps",
            {"site": site, "from": frm, "to": to, "reason": reason})
+    _note_recent(site, frm, to, reason)
 
 
 def run_with_degradation(call: Callable[[Dict[str, Any]], Any],
@@ -132,6 +159,7 @@ def run_with_degradation(call: Callable[[Dict[str, Any]], Any],
             _count("degrade.steps", {"site": site, "from": state,
                                      "to": step.name,
                                      "reason": "resource_exhausted"})
+            _note_recent(site, state, step.name, "resource_exhausted")
             from raft_tpu.core import logging as _log
 
             _log.warn("%s: RESOURCE_EXHAUSTED — degrading %s -> %s",

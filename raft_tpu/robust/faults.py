@@ -127,7 +127,11 @@ class FaultPlan:
         if not isinstance(spec, dict) or "faults" not in spec:
             raise ValueError(
                 "fault plan must be a JSON object with a 'faults' list")
-        self._lock = threading.Lock()
+        # RLock: the flight recorder's signal handler snapshots the plan
+        # (describe()/fires()) ON the interrupted main thread — a plain
+        # Lock held by an interrupted check() would deadlock the dying
+        # process (same rule as the metrics registry's snapshot path)
+        self._lock = threading.RLock()
         self._rng = random.Random(int(spec.get("seed", 0)))
         self._by_site: Dict[str, List[_Rule]] = {}
         for entry in spec["faults"]:
@@ -162,6 +166,17 @@ class FaultPlan:
             return {site: sum(r.fired for r in rules)
                     for site, rules in self._by_site.items()
                     if any(r.fired for r in rules)}
+
+    def describe(self) -> List[Dict[str, Any]]:
+        """One dict per rule (site/kind/after/p/times + live hit/fire
+        counts) — what the flight recorder folds into a dump so a
+        killed chaos-lane run says what was injected, not just what
+        died."""
+        with self._lock:
+            return [{"site": r.site, "kind": r.kind, "after": r.after,
+                     "p": r.p, "times": r.times, "hits": r.hits,
+                     "fired": r.fired}
+                    for rules in self._by_site.values() for r in rules]
 
 
 _plan: Optional[FaultPlan] = None

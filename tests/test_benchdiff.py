@@ -1,0 +1,307 @@
+"""benchdiff (tools/benchdiff.py): record loading, the join, the
+noise-aware thresholds, environment-provenance refusal, rendering,
+exit codes — and the gate's self-test: a slowdown injected through the
+PR-7 ``faults`` ``sleep`` kind must trip it (ISSUE 9 acceptance)."""
+
+import copy
+import json
+import subprocess
+import sys
+
+import pytest
+
+from tools import benchdiff
+
+
+ENV = {"jax": "0.4.37", "jaxlib": "0.4.36", "libtpu": None,
+       "backend": "cpu", "device_kind": "cpu", "device_count": 8,
+       "mesh_shape": [8]}
+
+
+def _row(qps=1000.0, recall=0.99, index="ivf_flat.n1024",
+         sp=None, p50=0.010, p99=0.011, reps=5, env=ENV, **extra):
+    r = {"dataset": "sift-hard", "algo": "ivf_flat", "index": index,
+         "qps": qps, "recall": recall, "batch_size": 10_000,
+         "search_param": sp if sp is not None else {"n_probes": 32},
+         "latency_p50_s": p50, "latency_p99_s": p99,
+         "latency_reps": reps}
+    if env is not None:
+        r["env"] = dict(env)
+    r.update(extra)
+    return r
+
+
+def _record(rows, path=None, tmp_path=None, name="r.json"):
+    doc = {"detail": rows}
+    if tmp_path is not None:
+        path = tmp_path / name
+        path.write_text(json.dumps(doc))
+        return str(path)
+    return {"path": "<mem>", "rows": rows, "meta": {}}
+
+
+class TestLoading:
+    def test_payload_driver_wrap_and_bare_list(self, tmp_path):
+        rows = [_row()]
+        shapes = {
+            "payload.json": {"detail": rows},
+            "wrapped.json": {"parsed": {"detail": rows}, "rc": 0},
+            "bare.json": rows,
+        }
+        for name, doc in shapes.items():
+            p = tmp_path / name
+            p.write_text(json.dumps(doc))
+            rec = benchdiff.load_record(str(p))
+            assert len(rec["rows"]) == 1, name
+
+    def test_rowless_record_raises(self, tmp_path):
+        p = tmp_path / "x.json"
+        p.write_text(json.dumps({"metric": "x"}))
+        with pytest.raises(ValueError):
+            benchdiff.load_record(str(p))
+
+    def test_baseline_name_resolution(self, tmp_path):
+        with pytest.raises(FileNotFoundError):
+            benchdiff.resolve_record_path("no-such-baseline-xyz")
+        # the committed baseline resolves by bare name
+        assert benchdiff.resolve_record_path("cpu_smoke").endswith(
+            "baselines/cpu_smoke.json")
+
+    def test_row_key_joins_on_identity_not_measurement(self):
+        a = _row(qps=1.0, recall=0.5)
+        b = _row(qps=9.0, recall=0.9)
+        assert benchdiff.row_key(a) == benchdiff.row_key(b)
+        assert benchdiff.row_key(_row(sp={"n_probes": 64})) != \
+            benchdiff.row_key(a)
+
+
+class TestCompare:
+    def test_identical_records_pass(self):
+        doc = benchdiff.diff_records(_record([_row()]), _record([_row()]))
+        assert doc["verdict"] == "pass"
+        assert doc["rows"][0]["status"] == "ok"
+
+    def test_qps_regression_trips(self):
+        doc = benchdiff.diff_records(
+            _record([_row(qps=1000)]), _record([_row(qps=700)]))
+        assert doc["verdict"] == "regression"
+        assert "qps" in doc["rows"][0]["reasons"][0]
+
+    def test_twenty_percent_drop_always_trips_despite_noise(self):
+        # rep spread at the clamp (noise 1.0) still cannot widen the
+        # threshold past the cap — the acceptance bar's 20 % regression
+        # must trip no matter how noisy the reps were
+        base = _row(qps=1000, p50=0.01, p99=0.05)
+        new = _row(qps=799, p50=0.01, p99=0.05)
+        doc = benchdiff.diff_records(_record([base]), _record([new]))
+        assert doc["verdict"] == "regression"
+
+    def test_noise_widens_threshold_below_cap(self):
+        # 12 % drop: trips at tight noise, tolerated under wide spread
+        tight = benchdiff.diff_records(
+            _record([_row(qps=1000, p99=0.0101)]),
+            _record([_row(qps=880, p99=0.0101)]))
+        assert tight["verdict"] == "regression"
+        wide = benchdiff.diff_records(
+            _record([_row(qps=1000, p99=0.0108)]),
+            _record([_row(qps=880, p99=0.0108)]))
+        assert wide["verdict"] == "pass"
+        assert wide["rows"][0]["qps_threshold"] > \
+            tight["rows"][0]["qps_threshold"]
+
+    def test_explicit_floor_wins_over_the_cap(self):
+        # --qps-drop 0.30 must tolerate a 25 % drop even though the
+        # (noise-widening) cap sits at 0.18
+        doc = benchdiff.diff_records(
+            _record([_row(qps=1000)]), _record([_row(qps=750)]),
+            thresholds={"qps_drop": 0.30})
+        assert doc["verdict"] == "pass"
+        assert doc["rows"][0]["qps_threshold"] == pytest.approx(0.30)
+
+    def test_recall_regression_trips(self):
+        doc = benchdiff.diff_records(
+            _record([_row(recall=0.95)]), _record([_row(recall=0.90)]))
+        assert doc["verdict"] == "regression"
+        assert any("recall" in r for r in doc["rows"][0]["reasons"])
+
+    def test_p99_rise_flags(self):
+        doc = benchdiff.diff_records(
+            _record([_row(p99=0.011)]), _record([_row(p99=0.030)]))
+        assert doc["rows"][0]["status"] == "regression"
+        assert any("p99" in r for r in doc["rows"][0]["reasons"])
+
+    def test_improvement_is_flagged_not_gated(self):
+        doc = benchdiff.diff_records(
+            _record([_row(qps=1000)]), _record([_row(qps=1500)]))
+        assert doc["verdict"] == "pass"
+        assert doc["rows"][0]["status"] == "improved"
+
+    def test_single_rep_rows_fall_back_to_floor(self):
+        base = _row(qps=1000, reps=1, p99=0.05)
+        assert benchdiff.row_noise(base) is None
+        doc = benchdiff.diff_records(
+            _record([base]), _record([_row(qps=880, reps=1, p99=0.05)]))
+        assert doc["verdict"] == "regression"  # floor 10 % < 12 % drop
+
+    def test_unmatched_rows_counted_not_gated(self):
+        doc = benchdiff.diff_records(
+            _record([_row(), _row(index="only-in-base")]),
+            _record([_row(), _row(index="only-in-new")]))
+        assert doc["verdict"] == "pass"
+        assert doc["counts"]["base_only"] == 1
+        assert doc["counts"]["new_only"] == 1
+
+    def test_zero_join_refuses(self):
+        doc = benchdiff.diff_records(
+            _record([_row(index="a")]), _record([_row(index="b")]))
+        assert doc["verdict"] == "refused"
+
+
+class TestEnvProvenance:
+    def test_mismatch_refuses_with_named_keys(self):
+        other = dict(ENV, device_kind="TPU v5e", device_count=4)
+        doc = benchdiff.diff_records(
+            _record([_row()]), _record([_row(env=other)]))
+        assert doc["verdict"] == "refused"
+        assert "device_kind" in doc["refusal"]
+        assert set(doc["env"]["mismatched_keys"]) == {"device_kind",
+                                                      "device_count"}
+
+    def test_mismatch_override(self):
+        other = dict(ENV, jax="9.9.9")
+        doc = benchdiff.diff_records(
+            _record([_row()]), _record([_row(env=other)]),
+            allow_env_mismatch=True)
+        assert doc["verdict"] == "pass"
+
+    def test_pre_provenance_records_compare_as_unknown(self):
+        doc = benchdiff.diff_records(
+            _record([_row(env=None)]), _record([_row()]))
+        assert doc["env"]["status"] == "unknown"
+        assert doc["verdict"] == "pass"
+
+
+class TestRenderAndCli:
+    def test_markdown_scoreboard(self):
+        doc = benchdiff.diff_records(
+            _record([_row(qps=1000)]), _record([_row(qps=700)]))
+        md = benchdiff.render_markdown(doc)
+        assert "REGRESSION" in md and "ivf_flat.n1024" in md
+        assert "Environment: identical" in md
+
+    def test_cli_exit_codes_and_artifacts(self, tmp_path):
+        base = _record([_row(qps=1000)], tmp_path=tmp_path, name="b.json")
+        slow = _record([_row(qps=600)], tmp_path=tmp_path, name="s.json")
+        out_md = tmp_path / "score.md"
+        out_json = tmp_path / "verdict.json"
+        rc = benchdiff.main([base, base])
+        assert rc == 0
+        rc = benchdiff.main([base, slow, "--md", str(out_md),
+                             "--json", str(out_json)])
+        assert rc == 1
+        assert "REGRESSION" in out_md.read_text()
+        verdict = json.loads(out_json.read_text())
+        assert verdict["schema"] == benchdiff.SCHEMA
+        assert verdict["verdict"] == "regression"
+        assert benchdiff.main([base, slow, "--report-only"]) == 0
+
+    def test_cli_env_mismatch_exit_2(self, tmp_path):
+        base = _record([_row()], tmp_path=tmp_path, name="b.json")
+        rows = [_row(env=dict(ENV, jaxlib="0.0.1"))]
+        other = _record(rows, tmp_path=tmp_path, name="o.json")
+        assert benchdiff.main([base, other]) == 2
+        assert benchdiff.main([base, other, "--allow-env-mismatch"]) == 0
+
+    def test_cli_missing_file_exit_2(self):
+        assert benchdiff.main(["/no/such.json", "/no/such2.json"]) == 2
+
+    def test_obsdump_renders_verdict_json(self, tmp_path, capsys):
+        from tools import obsdump
+
+        doc = benchdiff.diff_records(
+            _record([_row(qps=1000)]), _record([_row(qps=700)]))
+        p = tmp_path / "verdict.json"
+        p.write_text(json.dumps(doc))
+        out = obsdump.render(str(p), top=20)
+        assert "benchdiff" in out and "REGRESSION" in out
+
+
+class TestCommittedBaseline:
+    def test_cpu_smoke_baseline_loads_and_self_compares_clean(self):
+        path = benchdiff.resolve_record_path("cpu_smoke")
+        rec = benchdiff.load_record(path)
+        assert rec["rows"], "committed baseline has no rows"
+        env = benchdiff.record_env(rec)
+        assert env and env["backend"] == "cpu"
+        # acceptance: rows carry the roofline columns
+        assert all(r.get("flops") and r.get("bytes_accessed")
+                   and r.get("bound") in ("memory", "compute")
+                   for r in rec["rows"])
+        doc = benchdiff.diff_records(rec, rec)
+        assert doc["verdict"] == "pass"
+        assert doc["counts"]["regressions"] == 0
+
+
+@pytest.mark.slow
+class TestSleepInjectedSelfTest:
+    """The gate's reason to exist: a slowdown injected through the PR-7
+    fault harness (``sleep`` kind at the ``ivf_flat.search`` fault
+    point) must show up as a qps regression and trip the exit code.
+    Marked slow (two live bench measurements); the CI gate re-runs the
+    same scenario end-to-end in ``ci/test_python.sh``, and the full
+    pytest lane there includes slow tests."""
+
+    @pytest.fixture(scope="class")
+    def records(self, tmp_path_factory):
+        from raft_tpu.bench import runner
+        from raft_tpu.robust import faults
+
+        cfg = {
+            "dataset": {"name": "gate-smoke", "n": 1200, "dim": 16,
+                        "n_queries": 64, "metric": "sqeuclidean"},
+            "k": 8, "batch_size": 10_000,
+            "index": [{"name": "ivf_flat.n8", "algo": "ivf_flat",
+                       "build_param": {"n_lists": 8},
+                       "search_params": [{"n_probes": 4}]}],
+        }
+
+        def measure():
+            rows = runner.run_config(copy.deepcopy(cfg), verbose=False)
+            return {"detail": [
+                {"dataset": r.dataset, "algo": r.algo,
+                 "index": r.index_name, "qps": r.qps,
+                 "recall": r.recall, "batch_size": r.batch_size,
+                 "search_param": r.search_param, "env": r.env}
+                for r in rows]}
+
+        base = measure()
+        faults.install_plan({"faults": [
+            {"site": "ivf_flat.search", "kind": "sleep",
+             "sleep_s": 0.05, "times": 0}]})
+        try:
+            slow = measure()
+        finally:
+            faults.clear_plan()
+        d = tmp_path_factory.mktemp("gate")
+        pb, ps = d / "base.json", d / "slow.json"
+        pb.write_text(json.dumps(base))
+        ps.write_text(json.dumps(slow))
+        return str(pb), str(ps), base, slow
+
+    def test_injected_sleep_is_a_real_slowdown(self, records):
+        _, _, base, slow = records
+        b, s = base["detail"][0]["qps"], slow["detail"][0]["qps"]
+        assert s < 0.8 * b, (b, s)  # ≥20 % regression, the gate's bar
+
+    def test_gate_trips_on_injected_slowdown(self, records):
+        pb, ps, _, _ = records
+        assert benchdiff.main([pb, pb]) == 0   # unchanged record passes
+        assert benchdiff.main([pb, ps]) == 1   # injected slowdown trips
+
+    def test_gate_trips_from_the_cli_entry(self, records):
+        pb, ps, _, _ = records
+        p = subprocess.run(
+            [sys.executable, "-m", "tools.benchdiff", pb, ps],
+            capture_output=True, text=True)
+        assert p.returncode == 1, p.stdout + p.stderr
+        assert "REGRESSION" in p.stdout

@@ -131,6 +131,61 @@ class TestMetricsRegistry:
             obs.set_registry(prev)
 
 
+class TestHistogramQuantileEdges:
+    """Edge cases of ``Histogram.quantile`` / ``quantile_from_state`` —
+    the values benchdiff's noise model reads off the recorded bench
+    reps, so the degenerate shapes (empty, single sample, single
+    bucket) must degrade predictably instead of interpolating junk."""
+
+    def test_empty_histogram_returns_none(self):
+        h = MetricsRegistry().histogram("h")
+        assert h.quantile(0.5) is None
+        assert obs.quantile_from_state(h.state(), 0.5) is None
+
+    def test_single_sample_every_q_is_the_sample(self):
+        h = MetricsRegistry().histogram("h", buckets=[1.0, 10.0])
+        h.observe(3.5)
+        for q in (0.0, 0.25, 0.5, 0.99, 1.0):
+            # min/max clamping pins every quantile to the one value
+            assert h.quantile(q) == pytest.approx(3.5)
+
+    def test_single_bucket_histogram_clamps_to_observed_range(self):
+        h = MetricsRegistry().histogram("h", buckets=[100.0])
+        for v in (1.0, 2.0, 3.0, 4.0):
+            h.observe(v)
+        # one giant bucket: interpolation alone would sweep [0, 100];
+        # the min/max clamps keep estimates inside the data
+        assert 1.0 <= h.quantile(0.5) <= 4.0
+        assert h.quantile(0.0) == pytest.approx(1.0)
+        assert h.quantile(1.0) == pytest.approx(4.0)
+
+    def test_q_clamping_outside_unit_interval(self):
+        h = MetricsRegistry().histogram("h", buckets=[1.0, 2.0, 4.0])
+        for v in (0.5, 1.5, 3.0):
+            h.observe(v)
+        # q outside [0, 1] clamps to the endpoints rather than raising
+        assert h.quantile(-0.5) == h.quantile(0.0)
+        assert h.quantile(2.0) == h.quantile(1.0)
+        assert h.quantile(0.0) == pytest.approx(0.5)
+        assert h.quantile(1.0) == pytest.approx(3.0)
+
+    def test_all_samples_in_overflow_bucket(self):
+        h = MetricsRegistry().histogram("h", buckets=[1.0])
+        h.observe(5.0)
+        h.observe(7.0)
+        # +inf bucket has no upper bound to interpolate against: the
+        # estimate falls back to the observed max
+        assert h.quantile(0.99) == pytest.approx(7.0)
+        assert h.quantile(0.5) == pytest.approx(7.0)
+
+    def test_state_without_buckets_degrades(self):
+        # hand-built state (a flight dump from a foreign process might
+        # carry a truncated histogram): no buckets → max fallback
+        st = {"count": 3, "sum": 6.0, "min": 1.0, "max": 3.0,
+              "mean": 2.0, "buckets": {}}
+        assert obs.quantile_from_state(st, 0.5) == 3.0
+
+
 class TestSpans:
     def test_nested_spans_dot_join(self):
         reg = MetricsRegistry()

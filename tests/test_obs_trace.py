@@ -330,6 +330,51 @@ class TestFlightDumpDurability:
             assert "watchdog" not in json.load(f)
         rec.close()
 
+    def test_robust_state_rides_the_dump(self, tmp_path):
+        # the robust↔obs cross-link (ISSUE 9): a dump taken while a
+        # fault plan is armed and the degradation ladder has moved
+        # says WHAT was injected and how far the run had degraded
+        from raft_tpu.robust import degrade, faults
+
+        faults.install_plan({"faults": [
+            {"site": "ivf_pq.search", "kind": "sleep",
+             "sleep_s": 0.0, "times": 2}]})
+        degrade.clear_recent()
+        try:
+            assert faults.faultpoint("ivf_pq.search") == "sleep"
+            degrade.note_step("ivf_pq.search", "native", "halve_batch",
+                              "resource_exhausted")
+            rec = flight.FlightRecorder(str(tmp_path))
+            with open(rec.dump(reason="chaos")) as f:
+                doc = json.load(f)
+            rec.close()
+        finally:
+            faults.clear_plan()
+            degrade.clear_recent()
+        robust = doc["robust"]
+        (rule,) = robust["fault_plan"]
+        assert rule["site"] == "ivf_pq.search"
+        assert rule["kind"] == "sleep"
+        assert rule["fired"] == 1
+        assert robust["fault_fires"] == {"ivf_pq.search": 1}
+        (step,) = robust["degrade_recent"]
+        assert step["site"] == "ivf_pq.search"
+        assert step["from"] == "native"
+        assert step["to"] == "halve_batch"
+        assert step["reason"] == "resource_exhausted"
+        assert step["ts"] > 0
+
+    def test_no_robust_section_when_nothing_armed(self, tmp_path):
+        from raft_tpu.robust import degrade, faults
+
+        faults.clear_plan()
+        degrade.clear_recent()
+        rec = flight.FlightRecorder(str(tmp_path))
+        with open(rec.dump(reason="calm")) as f:
+            doc = json.load(f)
+        rec.close()
+        assert "robust" not in doc
+
 
 class TestQuantiles:
     def test_histogram_quantile_interpolates(self):
@@ -381,3 +426,36 @@ class TestObsdumpFlight:
         assert "4.0 KiB" in p.stdout
         assert "ivf_pq.search" in p.stdout
         assert "bytes_in_use" in p.stdout and "1.0 GiB" in p.stdout
+
+    def test_renders_prof_roofline_and_robust_sections(self, tmp_path):
+        from raft_tpu.obs import prof
+        from raft_tpu.robust import degrade, faults
+        from tools import obsdump
+
+        reg = MetricsRegistry()
+        cost = prof.ProgramCost(
+            flops=2e9, bytes_accessed=1e9, arithmetic_intensity=2.0,
+            bound="memory", peak_flops=1e12, peak_bw=1e11, ridge=10.0,
+        ).attribute_elapsed(0.05)
+        prof.record(cost, registry=reg, program="ivf_pq.n1024 b10000")
+        faults.install_plan({"faults": [
+            {"site": "ivf_flat.search", "kind": "sleep",
+             "sleep_s": 0.5, "times": 0}]})
+        degrade.clear_recent()
+        degrade.note_step("s", "native", "halve_batch", "mem_guard")
+        rec = flight.FlightRecorder(str(tmp_path))
+        obs.enable(registry=reg, hbm=False)
+        try:
+            path = rec.dump(reason="prof-render")
+        finally:
+            obs.disable()
+            rec.close()
+            faults.clear_plan()
+            degrade.clear_recent()
+        out = obsdump.render(path, top=10)
+        assert "cost / roofline attribution" in out
+        assert "ivf_pq.n1024 b10000" in out
+        assert "memory" in out
+        assert "2e+09" in out or "2.000e+09" in out or "2e+9" in out
+        assert "ivf_flat.search:sleep" in out
+        assert "native->halve_batch [mem_guard]" in out

@@ -1,0 +1,422 @@
+"""benchdiff — join two bench records and gate on perf regressions.
+
+The TPU-native counterpart of the reference's benchmark-comparison
+harness (``raft-ann-bench`` data_export + plot comparing run
+directories): five ``BENCH_r*.json`` records accumulated over PRs 1-8
+with nothing consuming them meant regressions between PRs were
+invisible. This tool makes the records load-bearing:
+
+- **join** two records (or a record vs a committed baseline under
+  ``raft_tpu/bench/baselines/``) by
+  ``(dataset, algo, index, search_param, batch_size)``;
+- **compare** Δqps / Δrecall / Δp99 with noise-aware thresholds — the
+  relative qps threshold widens with the row's own recorded rep
+  spread (``(p99-p50)/p50`` over the ``latency_reps`` diagnostic
+  reps), floored at ``--qps-drop``, with the noise widening capped at
+  ``--qps-drop-cap`` so at default flags a ≥20 % regression always
+  trips (an explicitly raised floor wins over the cap);
+- **refuse cross-environment comparisons**: rows self-stamp
+  jax/jaxlib/libtpu versions, device kind/count and mesh shape
+  (``bench/runner.environment_stamp``); if the two records' stamps
+  disagree the verdict is *refused* (exit 2), not a phantom
+  regression — override with ``--allow-env-mismatch``;
+- **render** a markdown scoreboard (``--md``) + a JSON verdict
+  (``--json``, schema ``raft_tpu.benchdiff/1``) and **exit non-zero on
+  regression** — the CI gate every future perf PR records its claims
+  through.
+
+Input formats are sniffed: a driver-wrapped ``BENCH_r*.json``
+(``{"parsed": {...}}``), a raw bench payload (``{"detail": [...]}``),
+or a bare row list. A BASE/NEW argument that is not a file resolves as
+a baseline name (``raft_tpu/bench/baselines/<name>.json``).
+
+Usage::
+
+    python -m tools.benchdiff BENCH_r05.json BENCH_r06.json
+    python -m tools.benchdiff cpu_smoke /tmp/bench_new.json --md score.md
+    python -m tools.benchdiff base.json new.json --json verdict.json
+
+Stdlib-only — runs device-free (no jax import needed to diff records).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+from typing import Any, Dict, List, Optional, Tuple
+
+_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+BASELINE_DIR = os.path.join(_REPO_ROOT, "raft_tpu", "bench", "baselines")
+
+SCHEMA = "raft_tpu.benchdiff/1"
+
+# environment-stamp keys that must agree for two records to be
+# comparable (hostnames and wall-clock stamps deliberately excluded)
+ENV_COMPARE_KEYS = ("jax", "jaxlib", "libtpu", "backend", "device_kind",
+                    "device_count", "mesh_shape")
+
+DEFAULTS = {
+    "qps_drop": 0.10,       # relative qps-drop floor
+    "qps_drop_cap": 0.18,   # noise widening cap (< 0.20: the acceptance
+                            # bar's 20 % regression must always trip)
+    "recall_drop": 0.01,    # absolute recall drop
+    "p99_rise": 0.50,       # relative p99 rise (tails are noisy)
+    "noise_factor": 2.0,    # threshold = noise_factor × rep spread
+}
+
+
+# ---------------------------------------------------------------------------
+# record loading
+# ---------------------------------------------------------------------------
+
+def resolve_record_path(name_or_path: str) -> str:
+    """A real file wins; otherwise try it as a committed baseline name
+    (``raft_tpu/bench/baselines/<name>.json``)."""
+    if os.path.exists(name_or_path):
+        return name_or_path
+    base = os.path.join(BASELINE_DIR, name_or_path)
+    for cand in (base, base + ".json"):
+        if os.path.exists(cand):
+            return cand
+    raise FileNotFoundError(
+        f"{name_or_path!r} is neither a file nor a baseline under "
+        f"{BASELINE_DIR}")
+
+
+def load_record(path: str) -> Dict[str, Any]:
+    """Load one bench record → ``{"path", "rows", "meta"}``. Accepts
+    the driver wrap (``{"parsed": payload}``), a raw payload
+    (``{"detail": rows}``), or a bare row list."""
+    with open(path) as f:
+        doc = json.load(f)
+    meta: Dict[str, Any] = {}
+    if isinstance(doc, dict) and isinstance(doc.get("parsed"), dict):
+        doc = doc["parsed"]
+    if isinstance(doc, dict):
+        rows = doc.get("detail", doc.get("rows"))
+        meta = {k: doc.get(k) for k in
+                ("metric", "value", "total_bench_s", "notes")
+                if k in doc}
+    else:
+        rows = doc
+    if not isinstance(rows, list):
+        raise ValueError(f"{path}: no 'detail' row list found")
+    return {"path": path, "rows": [r for r in rows if isinstance(r, dict)],
+            "meta": meta}
+
+
+def row_key(r: Dict[str, Any]) -> Tuple:
+    """The join key: (dataset, algo, index, search_param, batch_size)."""
+    return (r.get("dataset"), r.get("algo"), r.get("index"),
+            json.dumps(r.get("search_param") or {}, sort_keys=True),
+            r.get("batch_size"))
+
+
+def record_env(record: Dict[str, Any]) -> Optional[Dict[str, Any]]:
+    """The record's environment stamp: the first row-level ``env``
+    (all rows of one run share one stamp). None for pre-provenance
+    records."""
+    for r in record["rows"]:
+        env = r.get("env")
+        if isinstance(env, dict):
+            return env
+    return None
+
+
+def env_compatibility(base: Dict[str, Any], new: Dict[str, Any]
+                      ) -> Dict[str, Any]:
+    """Compare the two records' environment stamps over
+    :data:`ENV_COMPARE_KEYS`. status: ``ok`` (stamps agree),
+    ``mismatch`` (at least one key differs — comparison refused by
+    default), ``unknown`` (a side has no stamp: pre-provenance record,
+    compared with a warning)."""
+    e_base, e_new = record_env(base), record_env(new)
+    out: Dict[str, Any] = {"base": e_base, "new": e_new,
+                           "mismatched_keys": []}
+    if e_base is None or e_new is None:
+        out["status"] = "unknown"
+        return out
+    for k in ENV_COMPARE_KEYS:
+        if e_base.get(k) != e_new.get(k):
+            out["mismatched_keys"].append(k)
+    out["status"] = "mismatch" if out["mismatched_keys"] else "ok"
+    return out
+
+
+# ---------------------------------------------------------------------------
+# the noise model + comparison
+# ---------------------------------------------------------------------------
+
+def row_noise(r: Dict[str, Any]) -> Optional[float]:
+    """Relative rep spread of one row's diagnostic latency reps:
+    ``(p99 - p50) / p50``, clamped to [0, 1]. None when the row has no
+    quantiles (no-OBS run) or a single rep (spread is meaningless)."""
+    p50, p99 = r.get("latency_p50_s"), r.get("latency_p99_s")
+    reps = r.get("latency_reps")
+    if not p50 or not p99 or p50 <= 0:
+        return None
+    if reps is not None and reps < 2:
+        return None
+    return max(0.0, min(1.0, (p99 - p50) / p50))
+
+
+def pair_noise(base_row: Dict[str, Any], new_row: Dict[str, Any]
+               ) -> Optional[float]:
+    noises = [n for n in (row_noise(base_row), row_noise(new_row))
+              if n is not None]
+    return max(noises) if noises else None
+
+
+def compare_pair(base_row: Dict[str, Any], new_row: Dict[str, Any],
+                 thresholds: Dict[str, float]) -> Dict[str, Any]:
+    """Compare one joined row pair; returns the verdict-row dict."""
+    noise = pair_noise(base_row, new_row)
+    # the cap bounds the NOISE widening only — an explicitly raised
+    # --qps-drop floor must win over it, or the flag silently does
+    # nothing past the cap
+    thr_qps = max(thresholds["qps_drop"],
+                  min(thresholds["qps_drop_cap"],
+                      thresholds["noise_factor"] * (noise or 0.0)))
+    out: Dict[str, Any] = {
+        "dataset": base_row.get("dataset"), "algo": base_row.get("algo"),
+        "index": base_row.get("index"),
+        "search_param": base_row.get("search_param"),
+        "batch_size": base_row.get("batch_size"),
+        "base_qps": base_row.get("qps"), "new_qps": new_row.get("qps"),
+        "base_recall": base_row.get("recall"),
+        "new_recall": new_row.get("recall"),
+        "noise": noise, "qps_threshold": round(thr_qps, 4),
+        "reasons": [],
+    }
+    regress, improve = [], []
+    b_qps, n_qps = base_row.get("qps"), new_row.get("qps")
+    if b_qps and n_qps is not None and b_qps > 0:
+        d = (n_qps - b_qps) / b_qps
+        out["dqps_rel"] = round(d, 4)
+        if -d > thr_qps:
+            regress.append(f"qps {d * 100:+.1f}% (thr -{thr_qps * 100:.0f}%)")
+        elif d > thr_qps:
+            improve.append(f"qps {d * 100:+.1f}%")
+    b_rec, n_rec = base_row.get("recall"), new_row.get("recall")
+    if b_rec is not None and n_rec is not None:
+        d = n_rec - b_rec
+        out["drecall"] = round(d, 4)
+        if -d > thresholds["recall_drop"]:
+            regress.append(
+                f"recall {d:+.4f} (thr -{thresholds['recall_drop']})")
+        elif d > thresholds["recall_drop"]:
+            improve.append(f"recall {d:+.4f}")
+    b_p99, n_p99 = base_row.get("latency_p99_s"), new_row.get("latency_p99_s")
+    if b_p99 and n_p99 and b_p99 > 0:
+        d = (n_p99 - b_p99) / b_p99
+        out["dp99_rel"] = round(d, 4)
+        # widen from the BASE row's spread only: the new row's spread
+        # contains the very tail regression being tested — folding it
+        # in would let a p99 blowup mask itself
+        thr_p99 = max(thresholds["p99_rise"],
+                      thresholds["noise_factor"]
+                      * (row_noise(base_row) or 0.0))
+        if d > thr_p99:
+            regress.append(
+                f"p99 {d * 100:+.1f}% (thr +{thr_p99 * 100:.0f}%)")
+    if regress:
+        out["status"] = "regression"
+        out["reasons"] = regress
+    elif improve:
+        out["status"] = "improved"
+        out["reasons"] = improve
+    else:
+        out["status"] = "ok"
+    return out
+
+
+def diff_records(base: Dict[str, Any], new: Dict[str, Any],
+                 thresholds: Optional[Dict[str, float]] = None,
+                 allow_env_mismatch: bool = False) -> Dict[str, Any]:
+    """The full comparison → the JSON verdict document (schema
+    ``raft_tpu.benchdiff/1``). ``verdict``: ``pass`` / ``regression``
+    / ``refused`` (env mismatch and not overridden, or nothing
+    joinable)."""
+    thr = dict(DEFAULTS)
+    thr.update(thresholds or {})
+    env = env_compatibility(base, new)
+    doc: Dict[str, Any] = {
+        "schema": SCHEMA,
+        "base": base["path"], "new": new["path"],
+        "env": env, "thresholds": thr, "rows": [],
+    }
+    base_by = {row_key(r): r for r in base["rows"]}
+    new_by = {row_key(r): r for r in new["rows"]}
+    if env["status"] == "mismatch" and not allow_env_mismatch:
+        doc["verdict"] = "refused"
+        doc["refusal"] = (
+            "environment mismatch on "
+            + ", ".join(f"{k} ({env['base'].get(k)!r} vs "
+                        f"{env['new'].get(k)!r})"
+                        for k in env["mismatched_keys"])
+            + " — comparing these records would report phantom "
+              "regressions; re-measure in one environment or pass "
+              "--allow-env-mismatch")
+        return doc
+    shared = [k for k in base_by if k in new_by]
+    rows = [compare_pair(base_by[k], new_by[k], thr) for k in shared]
+    rows.sort(key=lambda r: ({"regression": 0, "improved": 1,
+                              "ok": 2}.get(r["status"], 3),
+                             str(r["index"])))
+    doc["rows"] = rows
+    counts = {
+        "compared": len(rows),
+        "regressions": sum(r["status"] == "regression" for r in rows),
+        "improvements": sum(r["status"] == "improved" for r in rows),
+        "base_only": len(base_by) - len(shared),
+        "new_only": len(new_by) - len(shared),
+    }
+    doc["counts"] = counts
+    if not rows:
+        doc["verdict"] = "refused"
+        doc["refusal"] = ("no joinable rows — the records share no "
+                          "(dataset, algo, index, search_param, "
+                          "batch_size) key; a gate over zero rows "
+                          "would always pass")
+    else:
+        doc["verdict"] = ("regression" if counts["regressions"]
+                          else "pass")
+    return doc
+
+
+# ---------------------------------------------------------------------------
+# rendering
+# ---------------------------------------------------------------------------
+
+def _fmt(v: Optional[float], spec: str = "{:,.1f}") -> str:
+    return "-" if v is None else spec.format(v)
+
+
+def render_markdown(doc: Dict[str, Any]) -> str:
+    """The scoreboard: one markdown table + header/env/verdict lines
+    (also what ``tools/obsdump.py`` renders for a verdict JSON)."""
+    lines = [f"# benchdiff — {os.path.basename(doc['base'])} → "
+             f"{os.path.basename(doc['new'])}", ""]
+    env = doc.get("env", {})
+    status = env.get("status", "unknown")
+    if status == "ok":
+        e = env.get("base") or {}
+        lines.append(f"Environment: identical ({e.get('backend')}, "
+                     f"{e.get('device_kind')} ×{e.get('device_count')}, "
+                     f"jax {e.get('jax')})")
+    elif status == "mismatch":
+        lines.append("Environment: **MISMATCH** on "
+                     + ", ".join(env.get("mismatched_keys", [])))
+    else:
+        lines.append("Environment: unknown (a record predates "
+                     "provenance stamping) — deltas are advisory")
+    lines.append("")
+    if doc.get("verdict") == "refused":
+        lines += [f"**Verdict: REFUSED** — {doc.get('refusal')}", ""]
+        return "\n".join(lines)
+    lines += [
+        "| dataset | index | search_param | batch | qps base → new "
+        "| Δqps | thr | recall base → new | Δp99 | status |",
+        "|---|---|---|---|---|---|---|---|---|---|",
+    ]
+    for r in doc.get("rows", []):
+        sp = json.dumps(r.get("search_param") or {}, sort_keys=True)
+        if len(sp) > 48:
+            sp = sp[:45] + "..."
+        status_cell = {"regression": "**REGRESSION**",
+                       "improved": "improved"}.get(r["status"], "ok")
+        if r.get("reasons"):
+            status_cell += " (" + "; ".join(r["reasons"]) + ")"
+        lines.append(
+            f"| {r.get('dataset')} | {r.get('index')} | `{sp}` "
+            f"| {r.get('batch_size') or '-'} "
+            f"| {_fmt(r.get('base_qps'))} → {_fmt(r.get('new_qps'))} "
+            f"| {_fmt(100 * r['dqps_rel'], '{:+.1f}%') if r.get('dqps_rel') is not None else '-'} "
+            f"| {_fmt(100 * r['qps_threshold'], '{:.0f}%')} "
+            f"| {_fmt(r.get('base_recall'), '{:.4f}')} → "
+            f"{_fmt(r.get('new_recall'), '{:.4f}')} "
+            f"| {_fmt(100 * r['dp99_rel'], '{:+.1f}%') if r.get('dp99_rel') is not None else '-'} "
+            f"| {status_cell} |")
+    c = doc.get("counts", {})
+    lines += ["",
+              f"Compared {c.get('compared', 0)} rows — "
+              f"{c.get('regressions', 0)} regressions, "
+              f"{c.get('improvements', 0)} improvements "
+              f"({c.get('base_only', 0)} base-only, "
+              f"{c.get('new_only', 0)} new-only rows unmatched).",
+              "", f"**Verdict: {doc.get('verdict', '?').upper()}**", ""]
+    return "\n".join(lines)
+
+
+# ---------------------------------------------------------------------------
+# CLI
+# ---------------------------------------------------------------------------
+
+def main(argv: Optional[List[str]] = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="benchdiff", description=__doc__.splitlines()[0])
+    ap.add_argument("base", help="base record (path or baseline name "
+                                 "under raft_tpu/bench/baselines/)")
+    ap.add_argument("new", help="new record (path or baseline name)")
+    ap.add_argument("--json", metavar="OUT",
+                    help="write the JSON verdict here")
+    ap.add_argument("--md", metavar="OUT",
+                    help="write the markdown scoreboard here "
+                         "(always printed to stdout too)")
+    ap.add_argument("--qps-drop", type=float, default=DEFAULTS["qps_drop"],
+                    help="relative qps-drop threshold floor "
+                         "(default %(default)s)")
+    ap.add_argument("--qps-drop-cap", type=float,
+                    default=DEFAULTS["qps_drop_cap"],
+                    help="cap on the noise-widened qps threshold "
+                         "(default %(default)s)")
+    ap.add_argument("--recall-drop", type=float,
+                    default=DEFAULTS["recall_drop"],
+                    help="absolute recall-drop threshold "
+                         "(default %(default)s)")
+    ap.add_argument("--p99-rise", type=float, default=DEFAULTS["p99_rise"],
+                    help="relative p99-rise threshold "
+                         "(default %(default)s)")
+    ap.add_argument("--noise-factor", type=float,
+                    default=DEFAULTS["noise_factor"],
+                    help="threshold widening per unit of recorded rep "
+                         "spread (default %(default)s)")
+    ap.add_argument("--allow-env-mismatch", action="store_true",
+                    help="compare despite differing environment stamps")
+    ap.add_argument("--report-only", action="store_true",
+                    help="never gate: exit 0 on regressions/refusals "
+                         "(informational committed-baseline diffs)")
+    args = ap.parse_args(argv)
+    try:
+        base = load_record(resolve_record_path(args.base))
+        new = load_record(resolve_record_path(args.new))
+    except (OSError, ValueError) as e:
+        print(f"benchdiff: {e}", file=sys.stderr)
+        return 2
+    doc = diff_records(
+        base, new,
+        thresholds={"qps_drop": args.qps_drop,
+                    "qps_drop_cap": args.qps_drop_cap,
+                    "recall_drop": args.recall_drop,
+                    "p99_rise": args.p99_rise,
+                    "noise_factor": args.noise_factor},
+        allow_env_mismatch=args.allow_env_mismatch)
+    md = render_markdown(doc)
+    print(md)
+    if args.md:
+        with open(args.md, "w") as f:
+            f.write(md)
+    if args.json:
+        with open(args.json, "w") as f:
+            json.dump(doc, f, indent=1)
+    if args.report_only:
+        return 0
+    if doc["verdict"] == "refused":
+        return 2
+    return 1 if doc["verdict"] == "regression" else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -19,6 +19,7 @@ MODULES = [
     "raft_tpu.core.bitset", "raft_tpu.core.interruptible",
     "raft_tpu.core.serialize",
     "raft_tpu.obs.metrics", "raft_tpu.obs.spans", "raft_tpu.obs.hbm",
+    "raft_tpu.obs.prof",
     "raft_tpu.obs.trace", "raft_tpu.obs.flight", "raft_tpu.obs.sanitize",
     "raft_tpu.robust.faults", "raft_tpu.robust.retry",
     "raft_tpu.robust.degrade", "raft_tpu.robust.checkpoint",
@@ -49,12 +50,38 @@ MODULES = [
     "raft_tpu.bench.dataset", "raft_tpu.bench.runner",
     "raft_tpu.bench.ingest", "raft_tpu.bench.plot",
     "raft_tpu.bench.prims",
+    "tools.benchdiff",
 ]
 
 
 # Hand-authored notes appended after a module's generated listing —
 # survive regeneration because they live HERE, not in the output file.
 NOTES = {
+    "raft_tpu.obs.prof": """\
+### Device peak table (roofline ceilings)
+
+| kind | peak flops (dense bf16) | HBM bandwidth | ridge (flops/B) |
+|---|---|---|---|
+| v4 | 275 TF/s | 1228 GB/s | ~224 |
+| v5e | 197 TF/s | 819 GB/s | ~241 |
+| v5p | 459 TF/s | 2765 GB/s | ~166 |
+| cpu | 50 GF/s (PLACEHOLDER) | 20 GB/s (PLACEHOLDER) | 2.5 |
+
+Unknown device kinds degrade to the CPU placeholder; the roofline
+classification still runs, its ceiling is just not calibrated. The
+flops/bytes inputs are XLA's *static* cost model for the compiled
+program (algorithmic flops, estimated post-fusion HBM traffic) —
+achieved fractions compare a measured wall time against these
+ceilings. See docs/observability.md "Cost attribution & regression
+gate".
+""",
+    "tools.benchdiff": """\
+The regression-gate CLI: exit 0 pass / 1 regression / 2 refused
+(environment mismatch or nothing joinable). Committed baselines live
+under `raft_tpu/bench/baselines/` and resolve by bare name. See
+docs/observability.md "Cost attribution & regression gate" for the
+noise model and CI wiring.
+""",
     "raft_tpu.parallel.merge": """\
 ### Cross-shard merge-tier decision table
 

@@ -9,18 +9,24 @@ numbers nobody could decompose"). Input formats are sniffed:
 - ``*.jsonl``        — ``MetricsRegistry.dump_jsonl`` series files
   (the ``RAFT_TPU_BENCH_OBS_JSONL`` sink),
 - Chrome-trace JSON  — :func:`raft_tpu.obs.trace.export_chrome` output
-  (or anything with a ``traceEvents`` array).
+  (or anything with a ``traceEvents`` array),
+- benchdiff verdicts — ``tools/benchdiff.py --json`` output (schema
+  ``raft_tpu.benchdiff/1``), rendered as the scoreboard.
 
-Rendered tables: top spans by total time (count/total/mean/p50/p99),
-comm traffic by op × axis (``comms.ops``/``comms.bytes``), and HBM
-gauges (per-device when labeled). ``--merge`` merges multiple
-per-process Chrome traces into one Perfetto-loadable timeline.
+Rendered tables: top spans by total time (count/total/mean/p50/p99,
+``--top N`` bounds the table), cost/roofline attribution per program
+(``prof.*`` gauges: flops, bytes, arithmetic intensity, memory- vs
+compute-bound, achieved bandwidth fraction), comm traffic by op × axis
+(``comms.ops``/``comms.bytes``), and HBM gauges (per-device when
+labeled). ``--merge`` merges multiple per-process Chrome traces into
+one Perfetto-loadable timeline.
 
 Usage::
 
     python -m tools.obsdump flight_20260803-120000_123.json
     python -m tools.obsdump trace_host0.json trace_host1.json --merge all.json
     python -m tools.obsdump bench_obs.jsonl --top 30
+    python -m tools.obsdump benchdiff_verdict.json
 
 Stdlib + raft_tpu.obs only — runs device-free (no jax import needed to
 read a dump).
@@ -173,6 +179,9 @@ def load_any(path: str) -> Tuple[str, Dict[str, Any], Dict[str, Any]]:
         events = doc if isinstance(doc, list) else doc.get("traceEvents", [])
         return "trace", _from_trace_events(events), \
             doc if isinstance(doc, dict) else {"traceEvents": doc}
+    if str(doc.get("schema", "")).startswith("raft_tpu.benchdiff"):
+        return "benchdiff", \
+            {"counters": {}, "gauges": {}, "histograms": {}}, doc
     if "metrics" in doc:  # flight dump: snapshot + its own event ring
         snap = {k: dict(doc["metrics"].get(k, {}))
                 for k in ("counters", "gauges", "histograms")}
@@ -242,6 +251,50 @@ def comms_table(snap: Dict[str, Any]) -> str:
     return _table(["collective", "axis", "ops", "payload"], rows)
 
 
+def prof_table(snap: Dict[str, Any], top: int) -> str:
+    """Cost/roofline attribution per program from the ``prof.*`` gauges
+    (:mod:`raft_tpu.obs.prof`): flops, bytes accessed, arithmetic
+    intensity, memory-/compute-bound classification, and the achieved
+    bandwidth/flops fractions when an elapsed time was attributed."""
+    per: Dict[str, Dict[str, Any]] = {}
+    for key, v in snap["gauges"].items():
+        name, labels = parse_key(key)
+        if not name.startswith("prof."):
+            continue
+        prog = labels.get("program", "-")
+        slot = per.setdefault(prog, {})
+        if name == "prof.bound":
+            slot["bound"] = labels.get("bound", "?")
+        else:
+            slot[name[len("prof."):]] = v
+    rows = []
+    for prog, st in per.items():
+        rows.append((st.get("bytes", 0.0), [
+            prog if len(prog) <= 48 else prog[:45] + "...",
+            "-" if st.get("flops") is None else f"{st['flops']:.4g}",
+            "-" if st.get("bytes") is None
+            else _human_bytes(st["bytes"]),
+            "-" if st.get("arith_intensity") is None
+            else f"{st['arith_intensity']:.2f}",
+            st.get("bound", "-"),
+            "-" if st.get("achieved_bw_frac") is None
+            else f"{st['achieved_bw_frac']:.3f}",
+            "-" if st.get("achieved_flops_frac") is None
+            else f"{st['achieved_flops_frac']:.3f}",
+        ]))
+    rows.sort(key=lambda r: -r[0])
+    return _table(["program", "flops", "bytes", "flops/B", "bound",
+                   "bw_frac", "flops_frac"], [r for _, r in rows[:top]])
+
+
+def benchdiff_section(doc: Dict[str, Any]) -> str:
+    """Render a benchdiff JSON verdict via the scoreboard renderer
+    (``tools.benchdiff.render_markdown`` — also stdlib-only)."""
+    from tools import benchdiff as _benchdiff
+
+    return _benchdiff.render_markdown(doc)
+
+
 def hbm_table(snap: Dict[str, Any]) -> str:
     rows = []
     for key, v in sorted(snap["gauges"].items()):
@@ -256,6 +309,9 @@ def hbm_table(snap: Dict[str, Any]) -> str:
 def render(path: str, top: int) -> str:
     kind, snap, raw = load_any(path)
     out = [f"== {path} ({kind}) =="]
+    if kind == "benchdiff":
+        out.append(benchdiff_section(raw))
+        return "\n".join(out)
     if kind == "flight":
         out.append(f"  reason={raw.get('reason')} pid={raw.get('pid')} "
                    f"host={raw.get('host')} time={raw.get('time')} "
@@ -263,8 +319,28 @@ def render(path: str, top: int) -> str:
                    f"events={len(raw.get('events', []))} "
                    f"(+{raw.get('dropped_events', 0)} dropped) "
                    f"log_lines={len(raw.get('logs', []))}")
+        robust = raw.get("robust")
+        if robust:
+            # what the chaos lane injected + how the run degraded —
+            # a killed run's dump says WHAT was in flight, not just
+            # that it died
+            plan = robust.get("fault_plan")
+            if plan:
+                out.append("  fault plan: " + "; ".join(
+                    f"{r.get('site')}:{r.get('kind')} "
+                    f"(fired {r.get('fired', 0)}/{r.get('times', 0) or '∞'})"
+                    for r in plan))
+            steps = robust.get("degrade_recent")
+            if steps:
+                out.append("  degrade steps: " + "; ".join(
+                    f"{s.get('site')} {s.get('from')}->{s.get('to')} "
+                    f"[{s.get('reason')}]" for s in steps[-8:]))
     out.append("-- top spans by total time --")
     out.append(spans_table(snap, top))
+    if any(parse_key(k)[0].startswith("prof.")
+           for k in snap["gauges"]):
+        out.append("-- cost / roofline attribution (prof.*) --")
+        out.append(prof_table(snap, top))
     out.append("-- comm traffic by op x axis --")
     out.append(comms_table(snap))
     out.append("-- HBM --")
