@@ -23,10 +23,21 @@ echo "== graftlint static analysis (blocking; CPU-only, no device) =="
 # tree — no XLA compile cache, no pytest cache, no device backend, so
 # it cannot go stale or flake with the environment. Zero unsuppressed
 # findings is the gate (tools/graftlint, docs/developer_guide.md);
-# covers GL01–GL05 plus the SPMD/DMA pass GL06–GL10. The JSON report
-# is the CI artifact (per-finding rule/path/line).
+# covers GL01–GL05, the SPMD/DMA pass GL06–GL10, and the capacity/
+# numeric-safety pass GL11–GL15. The JSON report is the CI artifact
+# (per-finding rule/path/line).
 python -m tools.graftlint raft_tpu --report /tmp/graftlint_report.json
 echo "graftlint report artifact: /tmp/graftlint_report.json"
+
+echo "== capacity prover (device-free eval_shape proofs, n = 2.2e9) =="
+# the runtime half of the capacity pass: every public search entry,
+# the sharded merge tier, and build_chunked's assignment/encode pass
+# traced at billion-scale synthetic shapes (ShapeDtypeStruct — zero
+# bytes allocated) and walked for int32-indexes-≥2³¹-axis eqns
+# (obs.sanitize.assert_billion_safe; tools/capacity_prove.py)
+JAX_PLATFORMS=cpu python -m tools.capacity_prove \
+    --report /tmp/capacity_prove_report.json
+echo "capacity report artifact: /tmp/capacity_prove_report.json"
 
 echo "== raft_tpu unit+integration tests (8-device CPU mesh) =="
 python -m pytest tests/ -q "$@"
@@ -36,6 +47,7 @@ echo "   + debug_nans + transfer guards + recompile budgets + the"
 echo "   collective-schedule checker over the parallel/distributed suites) =="
 RAFT_TPU_SANITIZE=1 python -m pytest \
     tests/test_sanitize.py tests/test_graftlint.py tests/test_core.py \
+    tests/test_capacity.py \
     tests/test_parallel.py tests/test_parallel_ivf.py \
     tests/test_ring_topk.py \
     -q -p no:cacheprovider
@@ -507,6 +519,7 @@ echo "== CI artifacts =="
 ARTIFACTS="${RAFT_TPU_CI_ARTIFACTS:-/tmp/raft_tpu_ci_artifacts}"
 mkdir -p "$ARTIFACTS"
 cp /tmp/graftlint_report.json \
+   /tmp/capacity_prove_report.json \
    /tmp/raft_tpu_obs_bench.json \
    /tmp/raft_tpu_benchdiff_scoreboard.md \
    /tmp/raft_tpu_benchdiff_verdict.json "$ARTIFACTS"/

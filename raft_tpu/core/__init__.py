@@ -22,4 +22,4 @@ from raft_tpu.core.interruptible import (  # noqa: F401
     interrupted_exception,
     synchronize,
 )
-from raft_tpu.core import logging, serialize, bitset  # noqa: F401
+from raft_tpu.core import logging, serialize, bitset, ids  # noqa: F401

@@ -1,0 +1,120 @@
+"""Row-id dtype policy — the TPU-native analog of the reference's
+64-bit ``IdxT`` templating.
+
+The reference templates every index on ``IdxT`` (``int64_t`` for the
+billion-scale paths) so a dataset with n ≥ 2³¹ rows can be addressed at
+all; raft_tpu instead carries ONE policy function and threads it through
+every id-producing site. The contract:
+
+- **int32 when provably safe, int64 when the row count demands it** —
+  decided by :func:`id_dtype` from the addressed row count, never by
+  per-site casts. int32 ids halve id-table HBM and are what the Pallas
+  kernels (int32-only by construction) consume; they are kept exactly
+  while ``n_rows ≤ 2³¹ − 1`` (ids span ``0 … n−1``; ``-1`` stays the
+  invalid sentinel in both widths).
+- **global-id arithmetic goes through** :func:`global_ids` /
+  :func:`local_ids`: ``shard · shard_rows + local`` overflows int32 the
+  moment the POD holds ≥ 2³¹ rows even though every per-shard id fits,
+  so the offset math must run in the policy dtype of the *total* row
+  count, not the shard's.
+- **never narrow an id array blindly**: downstream code preserves the
+  dtype an index/search produced (:func:`id_dtype_like`), so an int64
+  index built for SIFT-1B flows through merge tiers and refine remaps
+  without a silent ``astype(int32)`` truncation.
+
+Enforced twice over: graftlint GL11 flags hard-coded int32 id
+arithmetic at lint time, and ``obs.sanitize.assert_billion_safe``
+(the eval_shape capacity prover) fails any entry whose traced program
+still indexes a ≥ 2³¹ axis with int32 — see
+docs/developer_guide.md ("id & accumulator dtype policy").
+
+Note on x64: jax canonicalizes int64 → int32 unless ``jax_enable_x64``
+is set. :func:`id_dtype` only ever *returns* int64 when the row count
+actually needs it (> 2³¹ − 1 rows), and real billion-row runs require
+x64 anyway; the capacity prover enables x64 inside a scoped
+save/restore so proofs never leak the flag into the process.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+# Largest row count whose ids (0 … n−1) all fit int32. The -1 invalid
+# sentinel is representable in both widths, so it does not shrink the
+# bound.
+INT32_MAX_ROWS = 2**31 - 1
+
+
+def id_dtype(n_rows: int):
+    """The id dtype addressing ``n_rows`` dataset rows: ``jnp.int32``
+    while every id fits (n_rows ≤ 2³¹ − 1), ``jnp.int64`` beyond — ONE
+    policy decision instead of per-site casts."""
+    import jax.numpy as jnp
+
+    return jnp.int32 if int(n_rows) <= INT32_MAX_ROWS else jnp.int64
+
+
+def np_id_dtype(n_rows: int):
+    """Host (numpy) twin of :func:`id_dtype` — the chunked builders
+    stamp global ids into host-side id tables."""
+    return np.int32 if int(n_rows) <= INT32_MAX_ROWS else np.int64
+
+
+def np_id_dtype_like(*id_arrays):
+    """Host twin of :func:`id_dtype_like` over one or more numpy id
+    arrays: int64 if ANY input is 64-bit (widths never narrow through a
+    repack), int32 otherwise."""
+    wide = any(np.dtype(a.dtype).itemsize >= 8
+               and np.issubdtype(np.dtype(a.dtype), np.signedinteger)
+               for a in id_arrays)
+    return np.int64 if wide else np.int32
+
+
+def id_dtype_like(ids):
+    """Preserve an existing id array's width: int64 stays int64 (never
+    silently truncate a billion-scale id), anything narrower or
+    non-integer normalizes to int32."""
+    import jax.numpy as jnp
+
+    if np.issubdtype(np.dtype(ids.dtype), np.signedinteger) \
+            and np.dtype(ids.dtype).itemsize >= 8:
+        return jnp.int64
+    return jnp.int32
+
+
+def make_ids(n: int, start: int = 0, n_total: int = 0):
+    """``jnp.arange(start, start + n)`` in the policy dtype — the
+    replacement for default-dtype (or hard-int32) id iotas. The dtype is
+    sized by the largest id produced (``start + n``) or by ``n_total``
+    (the full dataset row count) when the caller knows it is larger."""
+    import jax.numpy as jnp
+
+    dt = id_dtype(max(int(start) + int(n), int(n_total)))
+    return jnp.arange(start, start + n, dtype=dt)
+
+
+def global_ids(rank, shard_rows: int, local_ids, n_total: int):
+    """Shard-local ids → global ids: ``local + rank · shard_rows`` in
+    ``id_dtype(n_total)`` (the POD-wide row count — the product
+    overflows int32 even when every operand fits it). ``rank`` may be a
+    traced per-device scalar (``Comms.get_rank()``). Invalid (< 0) local
+    ids stay ``-1``."""
+    import jax.numpy as jnp
+
+    dt = id_dtype(n_total)
+    loc = local_ids.astype(dt)
+    off = jnp.asarray(rank).astype(dt) * jnp.asarray(shard_rows, dt)
+    return jnp.where(loc >= 0, loc + off, jnp.asarray(-1, dt))
+
+
+def local_ids(gids, rank, shard_rows: int):
+    """Global ids → shard-local ids (the refine remap): ``gid − rank ·
+    shard_rows`` computed in the incoming id width (never narrowed);
+    invalid (< 0) global ids stay ``-1``. The caller masks ids outside
+    ``[0, shard_rows)`` — they belong to other shards."""
+    import jax.numpy as jnp
+
+    dt = id_dtype_like(gids)
+    g = gids.astype(dt)
+    off = jnp.asarray(rank).astype(dt) * jnp.asarray(shard_rows, dt)
+    return jnp.where(g >= 0, g - off, jnp.asarray(-1, dt))

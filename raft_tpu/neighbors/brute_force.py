@@ -26,6 +26,7 @@ from jax import lax
 
 from raft_tpu.core.errors import expects
 from raft_tpu.core.tracing import traced
+from raft_tpu.core import ids as _ids
 from raft_tpu.distance import pairwise_distance, resolve_metric, DistanceType, SELECT_MIN
 from raft_tpu.matrix import select_k as _select_k
 from raft_tpu.matrix.select_k import merge_parts
@@ -240,16 +241,21 @@ def knn(
                 tv, ti = _two_best_per_bin(dists, select_min)
             else:
                 tv, ti = _select_k(dists, kk, select_min=select_min)
-            ti = ti.astype(jnp.int32) + base
+            ti = ti.astype(idt) + base
             cat_v = jnp.concatenate([best_v, tv], axis=1)
             cat_i = jnp.concatenate([best_i, ti], axis=1)
             nv, pos = _top_k_merge(cat_v, k, select_min)
             ni = jnp.take_along_axis(cat_i, pos, axis=1)
             return (nv, ni), None
 
+        # global ids = tile base + in-tile position: the bases (and the
+        # add) run in the policy dtype of the FULL row count (core.ids) —
+        # base values reach n, which overflows int32 past 2³¹ rows even
+        # though every in-tile position fits it
+        idt = _ids.id_dtype(n)
         init_v = jnp.full((m, k), pad_val, jnp.float32)
-        init_i = jnp.zeros((m, k), jnp.int32)
-        bases = (jnp.arange(n_tiles) * it).astype(jnp.int32)
+        init_i = jnp.zeros((m, k), idt)
+        bases = jnp.arange(n_tiles, dtype=idt) * it
         (vals, idx), _ = lax.scan(
             step, (init_v, init_i), (db_blocks, sq_blocks, bases, fmask_blocks))
         return _finalize(vals, idx)

@@ -39,6 +39,7 @@ from jax import lax
 
 from raft_tpu.core.errors import expects
 from raft_tpu.core.tracing import traced, span
+from raft_tpu.core import ids as _ids
 from raft_tpu.core import serialize as ser
 from raft_tpu.distance.types import DistanceType, resolve_metric
 from raft_tpu.matrix import select_k as _select_k
@@ -436,15 +437,18 @@ def optimize_graph(knn_graph: jax.Array, out_degree: int) -> jax.Array:
     # edges (from the pruned forward graph) and splice them after the
     # d_half best forward edges (graph_core.cuh rev_graph).
     fwd = pruned[:, :d_half]
-    src = jnp.repeat(jnp.arange(n, dtype=jnp.int32)[:, None], d_half, 1).reshape(-1)
+    src = jnp.repeat(_ids.make_ids(n)[:, None], d_half, 1).reshape(-1)
     dst = fwd.reshape(-1)
     # count and slot reverse edges per destination node
     order = jnp.argsort(dst, stable=True)
     dst_s, src_s = dst[order], src[order]
     # position of each edge within its destination group
-    first_idx = jnp.searchsorted(dst_s, jnp.arange(n))
+    first_idx = jnp.searchsorted(dst_s, _ids.make_ids(n))
     slot = jnp.arange(dst_s.shape[0]) - first_idx[dst_s]
-    rev = jnp.full((n, d_half), -1, jnp.int32)
+    # table dtype follows the source ids' policy width (core.ids) — a
+    # hard int32 table would silently truncate int64 node ids through
+    # the scatter at n ≥ 2³¹ (jnp .at[].set casts, it doesn't error)
+    rev = jnp.full((n, d_half), -1, src.dtype)
     valid = slot < d_half
     # out-of-quota reverse edges write to row n → dropped
     rev = rev.at[jnp.where(valid, dst_s, n),
@@ -555,6 +559,9 @@ def _search_impl(index: CagraIndex, queries: jax.Array, k: int,
     m = queries.shape[0]
     q_all = jnp.asarray(queries, jnp.float32)
     BIG = jnp.float32(jnp.inf)
+    # node ids (traversal buffer, seeds, neighbor lists) ride the policy
+    # dtype of the dataset row count (core.ids): int32 until n ≥ 2³¹
+    idt = _ids.id_dtype(n)
 
     def dists_to(q, ids):
         """q [t, d], ids [t, C] → metric scores [t, C] (lower = better).
@@ -611,8 +618,9 @@ def _search_impl(index: CagraIndex, queries: jax.Array, k: int,
                 [ent, jnp.full((t, n_seed - ent.shape[1]), -1, ent.dtype)],
                 axis=1)
             rnd = jax.vmap(
-                lambda kk: jax.random.randint(kk, (n_seed,), 0, n))(keys)
-            init_ids = jnp.where(ent >= 0, ent, rnd)
+                lambda kk: jax.random.randint(kk, (n_seed,), 0, n,
+                                              dtype=idt))(keys)
+            init_ids = jnp.where(ent >= 0, ent.astype(idt), rnd)
         else:
             # oversample candidates and keep the best itopk — the
             # reference's random_sampling makes multiple hashed draws per
@@ -627,7 +635,8 @@ def _search_impl(index: CagraIndex, queries: jax.Array, k: int,
                          itopk_size)
             n_seed = -(-n_seed // 128) * 128
             init_ids = jax.vmap(
-                lambda kk: jax.random.randint(kk, (n_seed,), 0, n))(keys)
+                lambda kk: jax.random.randint(kk, (n_seed,), 0, n,
+                                              dtype=idt))(keys)
         # sampled with replacement: demote duplicate entry slots so an id
         # can never surface twice in the buffer. Sort-based dedup — the
         # quadratic pairwise mask would be O(n_seed²) per query
@@ -663,7 +672,7 @@ def _search_impl(index: CagraIndex, queries: jax.Array, k: int,
             # nor get expanded — the exclusion point the reference's
             # cagra sample_filter hooks
             buf_d = jnp.where(passes(filter_bits, init_ids), buf_d, BIG)
-        buf_i = init_ids.astype(jnp.int32)
+        buf_i = init_ids.astype(idt)
         order = jnp.argsort(buf_d, axis=1)
         buf_d = jnp.take_along_axis(buf_d, order, 1)
         buf_i = jnp.take_along_axis(buf_i, order, 1)
@@ -722,7 +731,7 @@ def _search_impl(index: CagraIndex, queries: jax.Array, k: int,
                 nd = jnp.where(jnp.any(eq & earlier[None], axis=2), BIG, nd)
             # 5. merge into itopk: concat + select
             all_d = jnp.concatenate([buf_d, nd], axis=1)
-            all_i = jnp.concatenate([buf_i, nbrs.astype(jnp.int32)], axis=1)
+            all_i = jnp.concatenate([buf_i, nbrs.astype(idt)], axis=1)
             all_v = jnp.concatenate(
                 [buf_v, jnp.zeros_like(nd, dtype=jnp.bool_)], axis=1)
             _, pos = lax.top_k(-all_d, itopk_size)

@@ -377,7 +377,13 @@ def pack_lists(row_arrays, labels: jax.Array, row_ids: jax.Array,
     packed (list, slot) address; slot >= L marks an overflow-dropped
     row. Returning the addresses here keeps consumers (e.g. CAGRA's
     cluster-blocked graph) from re-deriving the packing order.)
+
+    The stored id table preserves ``row_ids``' policy width
+    (``core.ids.id_dtype_like``): an int64 global-id array from a
+    ≥ 2³¹-row sharded build packs without a silent int32 truncation.
     """
+    from raft_tpu.core import ids as _ids
+
     n = labels.shape[0]
     labels = labels.astype(jnp.int32)
     order = jnp.argsort(labels, stable=True)
@@ -389,8 +395,9 @@ def pack_lists(row_arrays, labels: jax.Array, row_ids: jax.Array,
     for arr, fill in zip(row_arrays, fill_values):
         out = jnp.full((n_lists, L) + arr.shape[1:], fill, arr.dtype)
         packed.append(out.at[sorted_l, rank].set(arr[order], mode="drop"))
-    ids = jnp.full((n_lists, L), -1, jnp.int32).at[sorted_l, rank].set(
-        row_ids[order].astype(jnp.int32), mode="drop")
+    idt = _ids.id_dtype_like(row_ids)
+    ids = jnp.full((n_lists, L), -1, idt).at[sorted_l, rank].set(
+        row_ids[order].astype(idt), mode="drop")
     counts = jnp.zeros((n_lists,), jnp.int32).at[labels].add(1, mode="drop")
     sizes = jnp.minimum(counts, L)
     n_dropped = jnp.sum(counts - sizes)

@@ -38,6 +38,7 @@ from jax import lax
 
 from raft_tpu.core.errors import expects
 from raft_tpu.core.tracing import traced, span
+from raft_tpu.core import ids as _ids
 from raft_tpu.core import serialize as ser
 from raft_tpu.cluster import kmeans_balanced
 from raft_tpu.cluster.kmeans_balanced import KMeansBalancedParams
@@ -145,7 +146,9 @@ def _pack_lists(dataset: np.ndarray, labels: np.ndarray, n_lists: int,
     keep = rank < max_list_size
     dropped = int(n - keep.sum())
     packed = np.zeros((n_lists, max_list_size, d), dtype=dtype)
-    ids = np.full((n_lists, max_list_size), -1, np.int32)
+    # row ids are 0 … n−1: the table width follows the policy dtype of n
+    # (core.ids) — int64 past 2³¹ rows
+    ids = np.full((n_lists, max_list_size), -1, _ids.np_id_dtype(n))
     rows = order[keep]
     packed[sorted_labels[keep], rank[keep]] = dataset[rows]
     ids[sorted_labels[keep], rank[keep]] = rows
@@ -271,7 +274,7 @@ def build(dataset: jax.Array, params: Optional[IndexParams] = None) -> IvfFlatIn
                 chunk_rows=1 << 16)
         else:
             (packed,), ids, sizes, dropped, _ = ic.pack_lists_jit(
-                [x], labels, jnp.arange(n, dtype=jnp.int32),
+                [x], labels, _ids.make_ids(n),
                 n_lists=params.n_lists, L=max_list_size,
                 fill_values=[jnp.zeros((), x.dtype)])
         _sp.attach(packed, ids)
@@ -299,7 +302,7 @@ def extend(index: IvfFlatIndex, new_vectors: jax.Array,  # graftlint: disable-fn
     old_n = index.size
     new_vectors = jnp.asarray(new_vectors)
     if new_ids is None:
-        new_ids = jnp.arange(old_n, old_n + new_vectors.shape[0], dtype=jnp.int32)
+        new_ids = _ids.make_ids(new_vectors.shape[0], start=old_n)
     labels = np.asarray(kmeans_balanced.predict(
         index.centers, new_vectors.astype(jnp.float32), km_params))
 
@@ -311,12 +314,13 @@ def extend(index: IvfFlatIndex, new_vectors: jax.Array,  # graftlint: disable-fn
     new_L = max(L, int(need.max()))
     new_L = max(8, -(-new_L // 8) * 8)
 
-    packed = np.zeros((n_lists, new_L, d), np.asarray(index.packed_data).dtype)
-    ids = np.full((n_lists, new_L), -1, np.int32)
-    packed[:, :L] = np.asarray(index.packed_data)
-    ids[:, :L] = np.asarray(index.packed_ids)
-    nv = np.asarray(new_vectors)
+    old_ids = np.asarray(index.packed_ids)
     ni = np.asarray(new_ids)
+    packed = np.zeros((n_lists, new_L, d), np.asarray(index.packed_data).dtype)
+    ids = np.full((n_lists, new_L), -1, _ids.np_id_dtype_like(old_ids, ni))
+    packed[:, :L] = np.asarray(index.packed_data)
+    ids[:, :L] = old_ids
+    nv = np.asarray(new_vectors)
     # vectorized append: slot = old_size[list] + rank within the new rows
     order = np.argsort(labels, kind="stable")
     sorted_l = labels[order]

@@ -45,6 +45,7 @@ from jax import lax
 
 from raft_tpu.core.errors import expects
 from raft_tpu.core.tracing import traced, span
+from raft_tpu.core import ids as _ids
 from raft_tpu.core import serialize as ser
 from raft_tpu.obs import spans as _obs_spans
 from raft_tpu.robust import degrade as _degrade
@@ -537,7 +538,10 @@ def _pack_codes(codes: np.ndarray, labels: np.ndarray, norms: np.ndarray,
     keep = rank < max_list_size
     dropped = int(n - keep.sum())
     packed = np.zeros((n_lists, max_list_size, S), np.uint8)
-    ids = np.full((n_lists, max_list_size), -1, np.int32)
+    # id-table width follows the incoming global ids (core.ids policy:
+    # int32 until the row count demands int64, never narrowed here)
+    ids = np.full((n_lists, max_list_size), -1,
+                  _ids.np_id_dtype_like(row_ids))
     pnorm = np.zeros((n_lists, max_list_size), np.float32)
     rows = order[keep]
     ls, rk = sorted_labels[keep], rank[keep]
@@ -722,7 +726,7 @@ def build(dataset: jax.Array, params: Optional[IndexParams] = None) -> IvfPqInde
         _sp.attach(codes_p, norms)
     with span("pack") as _sp:
         (packed, pnorm), ids, sizes, dropped, _ = ic.pack_lists_jit(
-            [codes_p, norms], labels, jnp.arange(n, dtype=jnp.int32),
+            [codes_p, norms], labels, _ids.make_ids(n),
             n_lists=params.n_lists, L=max_list_size,
             fill_values=[jnp.zeros((), jnp.uint8),
                          jnp.zeros((), jnp.float32)])
@@ -1007,7 +1011,10 @@ def build_chunked(dataset, params: Optional[IndexParams] = None,  # graftlint: d
 
     chunks_done = int(manifest.get("chunks_done", 0)) if have_labels else 0
     packed = np.zeros((params.n_lists, L, nbytes), np.uint8)
-    ids = np.full((params.n_lists, L), -1, np.int32)
+    # global ids stamped below are a + row ∈ [0, n): the table width
+    # follows the POLICY dtype of n (core.ids) — int64 past 2³¹ rows,
+    # where the old hard np.int32 silently wrapped
+    ids = np.full((params.n_lists, L), -1, _ids.np_id_dtype(n))
     pnorm = np.zeros((params.n_lists, L), np.float32)
     cursor = np.zeros(params.n_lists, np.int64)  # next free slot per list
     dropped = 0
@@ -1044,7 +1051,7 @@ def build_chunked(dataset, params: Optional[IndexParams] = None,  # graftlint: d
             rows = order[keep]
             ls, sl = sorted_l[keep], slot[keep].astype(np.int64)
             packed[ls, sl] = codes_h[rows]
-            ids[ls, sl] = (a + rows).astype(np.int32)
+            ids[ls, sl] = (a + rows).astype(ids.dtype)
             pnorm[ls, sl] = norms_h[rows]
             cursor = np.minimum(
                 cursor + np.bincount(lb_h, minlength=params.n_lists)[
@@ -1146,7 +1153,7 @@ def extend(index: IvfPqIndex, new_vectors: jax.Array,  # graftlint: disable-fn=G
         x = x / jnp.sqrt(jnp.maximum(jnp.sum(x * x, -1, keepdims=True), 1e-12))
     old_n = index.size
     if new_ids is None:
-        new_ids = jnp.arange(old_n, old_n + x.shape[0], dtype=jnp.int32)
+        new_ids = _ids.make_ids(x.shape[0], start=old_n)
 
     labels = kmeans_balanced.predict(index.centers, x, km)
     codes, norms = _encode_with_norms(x @ index.rotation.T, index.centers_rot,
@@ -1160,14 +1167,17 @@ def extend(index: IvfPqIndex, new_vectors: jax.Array,  # graftlint: disable-fn=G
     need = old_sizes + np.bincount(labels_h, minlength=n_lists)
     new_L = max(L, max(8, -(-int(need.max()) // 8) * 8))
 
+    old_ids = np.asarray(index.packed_ids)
+    nid_h0 = np.asarray(new_ids)
     packed = np.zeros((n_lists, new_L, S), np.uint8)
-    ids = np.full((n_lists, new_L), -1, np.int32)
+    ids = np.full((n_lists, new_L), -1,
+                  _ids.np_id_dtype_like(old_ids, nid_h0))
     pnorm = np.zeros((n_lists, new_L), np.float32)
     packed[:, :L] = np.asarray(index.packed_codes).reshape(n_lists, L, -1)
-    ids[:, :L] = np.asarray(index.packed_ids)
+    ids[:, :L] = old_ids
     pnorm[:, :L] = np.asarray(index.packed_norms)
     codes_h = pack_bits_np(np.asarray(codes), index.pq_bits)
-    norms_h, nid_h = np.asarray(norms), np.asarray(new_ids)
+    norms_h, nid_h = np.asarray(norms), nid_h0
     # vectorized append: slot = old_size[list] + rank within the new rows
     order, sorted_l, slot = _stable_slots(labels_h, n_lists, old_sizes)
     keep = slot < new_L

@@ -35,6 +35,20 @@ compute-sanitizer (RAFT ci/test.sh) :
   comms-facade calls (verb, axis, payload bytes) per traced program,
   so tests can assert WHAT schedule a distributed entry point commits
   every device to.
+- :func:`capacity_report` / :func:`assert_billion_safe` — the
+  **capacity prover**, the runtime half of graftlint's capacity pass
+  (GL11–GL15): traces a program at synthetic billion-scale shapes
+  (``jax.ShapeDtypeStruct`` — ``jax.eval_shape`` semantics, zero bytes
+  allocated, device-free) and walks the jaxpr for int32-dtyped
+  intermediates that index axes ≥ 2³¹ (int32 iota over an oversized
+  axis; gather/scatter/dynamic-slice indexing an oversized dim with
+  int32 indices) plus peak intermediate bytes.
+  ``assert_billion_safe`` raises :class:`CapacityError` with eqn
+  provenance — the compile-time ``IdxT`` check the reference gets from
+  64-bit index templating, here as a CI gate over the public search /
+  build entries (``tools/capacity_prove.py``). x64 is enabled only
+  inside a scoped save/restore (:func:`scoped_x64`): the prover never
+  leaks ``jax_enable_x64`` into the test process.
 
 Everything here is import-cheap: jax is only imported when a guard is
 actually used, and the monitoring listener is installed once on first
@@ -284,6 +298,233 @@ def note_collective(verb: str, axis: str, nbytes: int) -> None:
     rec = _comms_schedule
     if rec is not None:
         rec.append((verb, axis, int(nbytes)))
+
+
+# ---------------------------------------------------------------------------
+# capacity prover — the runtime half of graftlint's capacity pass
+# (GL11–GL15): eval_shape-only billion-scale proofs, device-free
+# ---------------------------------------------------------------------------
+
+INT32_MAX_INDEX = 2**31 - 1  # largest axis position an int32 id can hold
+
+
+class CapacityError(RuntimeError):
+    """A traced program indexes a ≥ 2³¹ axis through int32-dtyped
+    intermediates — the silent-overflow class 64-bit ``IdxT`` templating
+    exists to prevent. Carries eqn provenance in the message."""
+
+
+@contextlib.contextmanager
+def scoped_x64(enable: bool = True) -> Iterator[None]:
+    """Enable (or disable) ``jax_enable_x64`` for the scope ONLY —
+    save/restore, exception-safe. The prover traces int64 id paths, but
+    the flag is process-global and silently changes every test's
+    dtypes, so it must never leak out of a proof."""
+    import jax
+
+    prev = jax.config.jax_enable_x64
+    jax.config.update("jax_enable_x64", bool(enable))
+    try:
+        yield
+    finally:
+        jax.config.update("jax_enable_x64", prev)
+
+
+def _is_i32(dtype) -> bool:
+    import numpy as _np
+
+    return _np.dtype(dtype) == _np.dtype("int32")
+
+
+def _eqn_where(eqn) -> str:
+    """Best-effort user-frame provenance of one eqn."""
+    try:
+        tb = eqn.source_info.traceback
+        # jax eqn tracebacks are innermost-first: the FIRST non-jax
+        # frame is the offending user line (the last would be the
+        # prover's own call site)
+        for fr in tb.frames:
+            fn = getattr(fr, "file_name", "")
+            if "site-packages" not in fn and "/jax/" not in fn:
+                return (f"{fr.file_name}:{fr.line_num} "
+                        f"({fr.function_name})")
+    except Exception:
+        pass
+    return "<unknown site>"
+
+
+def _aval_bytes(v) -> int:
+    import math as _math
+    import numpy as _np
+
+    aval = getattr(v, "aval", v)
+    shape = getattr(aval, "shape", None)
+    dtype = getattr(aval, "dtype", None)
+    if shape is None or dtype is None:
+        return 0
+    try:
+        itemsize = _np.dtype(dtype).itemsize
+    except TypeError:  # extended dtypes (PRNG keys) have no numpy dtype
+        itemsize = getattr(dtype, "itemsize", 0) or 0
+    return _math.prod(shape) * itemsize if shape else itemsize
+
+
+def _scan_eqn(eqn, hits: list) -> None:
+    """Record int32-index-over-≥2³¹-axis violations for one eqn."""
+    name = eqn.primitive.name
+    params = eqn.params or {}
+
+    def hit(msg: str) -> None:
+        hits.append({"primitive": name, "where": _eqn_where(eqn),
+                     "message": msg})
+
+    if name == "iota":
+        shape = params.get("shape") or getattr(
+            eqn.outvars[0].aval, "shape", ())
+        dim = params.get("dimension", 0)
+        if _is_i32(params.get("dtype", "int32")) and shape \
+                and shape[dim] - 1 > INT32_MAX_INDEX:
+            hit(f"int32 iota over an axis of {shape[dim]} positions — "
+                "ids past 2³¹ wrap negative (use core.ids.make_ids)")
+    elif name in ("gather", "dynamic_gather"):
+        dnums = params.get("dimension_numbers")
+        if dnums is None or len(eqn.invars) < 2:
+            return
+        operand, indices = eqn.invars[0], eqn.invars[1]
+        if not _is_i32(getattr(indices.aval, "dtype", None)):
+            return
+        oshape = getattr(operand.aval, "shape", ())
+        for d in getattr(dnums, "start_index_map", ()):
+            if d < len(oshape) and oshape[d] - 1 > INT32_MAX_INDEX:
+                hit(f"gather indexes operand dim {d} of {oshape[d]} "
+                    "rows with int32 indices — rows past 2³¹ are "
+                    "unaddressable (thread core.ids.id_dtype through "
+                    "the id path)")
+    elif name.startswith("scatter"):
+        dnums = params.get("dimension_numbers")
+        if dnums is None or len(eqn.invars) < 2:
+            return
+        operand, indices = eqn.invars[0], eqn.invars[1]
+        if not _is_i32(getattr(indices.aval, "dtype", None)):
+            return
+        oshape = getattr(operand.aval, "shape", ())
+        for d in getattr(dnums, "scatter_dims_to_operand_dims", ()):
+            if d < len(oshape) and oshape[d] - 1 > INT32_MAX_INDEX:
+                hit(f"scatter addresses operand dim {d} of {oshape[d]} "
+                    "rows with int32 indices")
+    elif name in ("dynamic_slice", "dynamic_update_slice"):
+        n_lead = 2 if name == "dynamic_update_slice" else 1
+        operand = eqn.invars[0]
+        oshape = getattr(operand.aval, "shape", ())
+        starts = eqn.invars[n_lead:]
+        for d, sv in enumerate(starts):
+            if d < len(oshape) and oshape[d] - 1 > INT32_MAX_INDEX \
+                    and _is_i32(getattr(sv.aval, "dtype", None)):
+                hit(f"{name} starts into dim {d} of {oshape[d]} "
+                    "positions with an int32 start index")
+    elif name == "argmax" or name == "argmin":
+        idx_dtype = params.get("index_dtype")
+        axes = params.get("axes", ())
+        ishape = getattr(eqn.invars[0].aval, "shape", ()) if eqn.invars \
+            else ()
+        if idx_dtype is not None and _is_i32(idx_dtype):
+            for d in axes:
+                if d < len(ishape) and ishape[d] - 1 > INT32_MAX_INDEX:
+                    hit(f"{name} over an axis of {ishape[d]} positions "
+                        "returns int32 positions")
+    elif name == "top_k":
+        ishape = getattr(eqn.invars[0].aval, "shape", ()) if eqn.invars \
+            else ()
+        out_i = eqn.outvars[1] if len(eqn.outvars) > 1 else None
+        if ishape and ishape[-1] - 1 > INT32_MAX_INDEX and out_i is not None \
+                and _is_i32(getattr(out_i.aval, "dtype", None)):
+            hit(f"top_k over a {ishape[-1]}-wide axis returns int32 "
+                "positions")
+
+
+def _walk_capacity(jaxpr, hits: list, seen: set, stats: dict) -> None:
+    if id(jaxpr) in seen:
+        return
+    seen.add(id(jaxpr))
+    for eqn in jaxpr.eqns:
+        _scan_eqn(eqn, hits)
+        out_bytes = sum(_aval_bytes(v) for v in eqn.outvars)
+        in_bytes = sum(_aval_bytes(v) for v in eqn.invars
+                       if hasattr(v, "aval"))
+        stats["peak_intermediate_bytes"] = max(
+            stats["peak_intermediate_bytes"], out_bytes + in_bytes)
+        for sub in _jaxpr_like(list((eqn.params or {}).values())):
+            _walk_capacity(sub, hits, seen, stats)
+
+
+def capacity_report(fn, *abstract_args, **abstract_kwargs) -> dict:
+    """Device-free capacity analysis of ``fn`` at synthetic shapes.
+
+    ``abstract_args`` are ``jax.ShapeDtypeStruct`` pytrees (real arrays
+    work too but defeat the point — the prover exists so SIFT-1B shapes
+    cost zero bytes). Traces via ``jax.make_jaxpr`` (the same
+    no-execution semantics as ``jax.eval_shape``) under a scoped-x64
+    context and walks every sub-jaxpr (pjit/shard_map/scan/while/cond)
+    for int32-dtyped intermediates indexing axes ≥ 2³¹.
+
+    Returns ``{"violations": [{primitive, where, message}, ...],
+    "peak_intermediate_bytes": int, "out_shapes": [...]}`` — use
+    :func:`assert_billion_safe` as the raising gate.
+
+    Two violation channels: (a) the jaxpr walk finds int32 iota /
+    gather / scatter / dynamic-slice / arg-select eqns over oversized
+    axes; (b) jax itself refuses to NORMALIZE an int32 index against a
+    ≥ 2³¹ axis at trace time (``OverflowError: Python integer … out of
+    bounds for int32`` from ``jnp``-level indexing) — the same overflow
+    class surfacing earlier, reported with the offending user frame
+    instead of propagating as a confusing trace crash."""
+    import jax
+
+    hits: list = []
+    stats = {"peak_intermediate_bytes": 0}
+    try:
+        with scoped_x64(True):
+            closed = jax.make_jaxpr(fn)(*abstract_args, **abstract_kwargs)
+    except OverflowError as e:
+        import traceback as _tb
+
+        where = "<unknown site>"
+        for fr in _tb.extract_tb(e.__traceback__):
+            if "site-packages" not in fr.filename \
+                    and "/jax/" not in fr.filename:
+                where = f"{fr.filename}:{fr.lineno} ({fr.name})"
+        hits.append({
+            "primitive": "trace", "where": where,
+            "message": f"int32 index cannot address the axis: {e} "
+                       "(thread core.ids.id_dtype through the id path)"})
+        return {"violations": hits, "peak_intermediate_bytes": 0,
+                "out_shapes": []}
+    _walk_capacity(closed.jaxpr, hits, set(), stats)
+    return {
+        "violations": hits,
+        "peak_intermediate_bytes": stats["peak_intermediate_bytes"],
+        "out_shapes": [str(getattr(v, "aval", v))
+                       for v in closed.jaxpr.outvars],
+    }
+
+
+def assert_billion_safe(fn, *abstract_args, what: str = "program",
+                        **abstract_kwargs) -> dict:
+    """Trace ``fn`` at the given (billion-scale) abstract shapes and
+    raise :class:`CapacityError` listing every int32-indexes-≥2³¹-axis
+    eqn with provenance; returns the :func:`capacity_report` dict when
+    clean. The CI gate (``tools/capacity_prove.py``) runs this over the
+    four index search entries, the sharded merge tier, and
+    ``build_chunked``'s assignment/encode pass."""
+    report = capacity_report(fn, *abstract_args, **abstract_kwargs)
+    if report["violations"]:
+        detail = "\n".join(
+            f"  [{v['primitive']}] {v['message']}\n      at {v['where']}"
+            for v in report["violations"])
+        raise CapacityError(
+            f"{what}: {len(report['violations'])} int32 capacity "
+            f"violation(s) at billion-scale shapes:\n{detail}")
+    return report
 
 
 @contextlib.contextmanager

@@ -42,6 +42,7 @@ from raft_tpu.core.compat import shard_map
 from jax.sharding import Mesh, PartitionSpec as P
 
 from raft_tpu.core.errors import expects
+from raft_tpu.core import ids as _ids
 from raft_tpu.cluster import KMeansParams
 from raft_tpu.cluster import distributed as dkm
 from raft_tpu.distance import SELECT_MIN
@@ -164,8 +165,9 @@ def _gather_trainset(x: jax.Array, mesh: Mesh, axis: str, t: int,
         rank = comms.get_rank()
         shard_n = x_shard.shape[0]
         key = jax.random.PRNGKey(seed)  # identical on every shard
-        gidx = jax.random.randint(key, (total,), 0, n_real)
-        local_idx = gidx - rank * shard_n
+        gidx = jax.random.randint(key, (total,), 0, n_real,
+                                  dtype=_ids.id_dtype(n_real))
+        local_idx = _ids.local_ids(gidx, rank, shard_n)
         owned = (local_idx >= 0) & (local_idx < shard_n)
         rows = x_shard[jnp.clip(local_idx, 0, shard_n - 1)]
         contrib = jnp.where(owned[:, None], rows, 0.0)
@@ -243,7 +245,10 @@ def build_ivf_pq(params: _pq.IndexParams, dataset: jax.Array, mesh: Mesh,
     def encode_pack(x_blk, centers, centers_rot, rotation, codebooks):
         xs = x_blk
         rank = comms.get_rank()
-        gid = rank * shard_n + jnp.arange(shard_n, dtype=jnp.int32)
+        # global ids in the policy dtype of the POD row count (core.ids):
+        # rank·shard_n overflows int32 past 2³¹ total rows
+        gid = _ids.global_ids(rank, shard_n, _ids.make_ids(shard_n),
+                              n_total=n_dev * shard_n)
         _, labels = fused_l2_nn_argmin(xs, centers)
         labels = jnp.where(gid < n_real, labels, n_lists)  # drop pad rows
         safe = jnp.clip(labels, 0, n_lists - 1)
@@ -355,10 +360,14 @@ def search_ivf_pq(params: _pq.SearchParams, index: ShardedIvfPq,
             # global with the shard offset baked in at build)
             _, i0 = _pq.search(local, q, k_cand, scan_params)
             rank = comms.get_rank()
-            li = jnp.where(i0 >= 0, i0 - rank * shard_n, -1)
+            # global↔local remap through the one id-dtype policy
+            # (core.ids): the offset math overflows int32 past 2³¹ pod
+            # rows, and the incoming id width is never narrowed
+            li = _ids.local_ids(i0, rank, shard_n)
             vals, lids = _refine.refine(ds[0], q, li, k,
                                         metric=index.metric)
-            gids = jnp.where(lids >= 0, lids + rank * shard_n, -1)
+            gids = _ids.global_ids(rank, shard_n, lids,
+                                   n_total=n_dev * shard_n)
         else:
             vals, gids = _pq._search_impl(local, q, k, n_probes,
                                           params.query_tile,
@@ -410,7 +419,8 @@ def build_ivf_flat(params: _flat.IndexParams, dataset: jax.Array, mesh: Mesh,
 
     def assign_pack(x_blk, centers):
         rank = comms.get_rank()
-        gid = rank * shard_n + jnp.arange(shard_n, dtype=jnp.int32)
+        gid = _ids.global_ids(rank, shard_n, _ids.make_ids(shard_n),
+                              n_total=n_dev * shard_n)
         _, labels = fused_l2_nn_argmin(x_blk, centers)
         labels = jnp.where(gid < n_real, labels, n_lists)
         norms = jnp.sum(x_blk * x_blk, axis=1)

@@ -18,6 +18,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from raft_tpu.core.compat import shard_map
 
 from raft_tpu.core.errors import expects
+from raft_tpu.core import ids as _ids
 from raft_tpu.distance import DistanceType, SELECT_MIN, resolve_metric
 from raft_tpu.neighbors import brute_force
 from raft_tpu.parallel import merge as _merge
@@ -72,7 +73,13 @@ def sharded_knn(
         rank = comms.get_rank()
         idx = brute_force.build(ds_shard, metric=mt)
         vals, ids = brute_force.knn(idx, q, k)
-        gids = ids.astype(jnp.int32) + rank.astype(jnp.int32) * shard_size
+        # global-id remap in the policy dtype of the PADDED total row
+        # count — rank·shard_size overflows int32 past 2³¹ pod rows
+        # even though every per-shard id fits it, and pad-row gids
+        # reach n_dev·shard_size − 1 > n, so the width must cover the
+        # padding or the `gids < n` mask below sees wrapped negatives
+        gids = _ids.global_ids(rank, shard_size, ids,
+                               n_total=n_dev * shard_size)
         vals = jnp.where(gids < n, vals, pad_val)  # mask padded rows
         gids = jnp.where(gids < n, gids, -1)
         return _merge.merge_topk(vals, gids, axis, m, k, n_dev,

@@ -33,6 +33,7 @@ import jax.numpy as jnp
 from jax.sharding import PartitionSpec as P
 
 from raft_tpu.core.errors import expects
+from raft_tpu.core import ids as _ids
 from raft_tpu.matrix import select_k as _select_k
 from raft_tpu.obs import spans as _obs_spans
 from raft_tpu.ops import pallas_kernels as _pk
@@ -131,7 +132,9 @@ def _ring_merge_fallback(vals, ids, comms, axis, m: int, k: int,
     m_pad = mc * n_dev
     big = jnp.inf if select_min else -jnp.inf
     v = vals.astype(jnp.float32)
-    i = ids.astype(jnp.int32)
+    # id width rides the policy (core.ids): an int64 billion-scale id
+    # table must not truncate through the merge
+    i = ids.astype(_ids.id_dtype_like(ids))
     if m_pad > m:
         v = jnp.pad(v, ((0, m_pad - m), (0, 0)), constant_values=big)
         i = jnp.pad(i, ((0, m_pad - m), (0, 0)), constant_values=-1)
@@ -176,6 +179,12 @@ def merge_topk(vals: jax.Array, ids: jax.Array, axis: str, m: int, k: int,
     comms = Comms(axis)
     if tier == "allgather":
         return _merge_allgather(vals, ids, comms, m, k, n_dev, select_min)
+    if impl == "ring_kernel" and jnp.dtype(ids.dtype).itemsize >= 8:
+        # the Pallas kernel is int32-only by construction; an int64
+        # billion-scale id table rides the identical-schedule ppermute
+        # fallback instead of silently truncating through the kernel
+        _obs_spans.count_fallback("parallel.merge", "id_width")
+        impl = "ring_ppermute"
     if impl == "ring_kernel":
         mc = _pk.ring_chunk_rows(m, n_dev)
         # the kernel's remote DMAs bypass lax: attribute its hop traffic

@@ -1,0 +1,235 @@
+"""Capacity-prover tests — the runtime half of the billion-scale pass.
+
+``assert_billion_safe`` (obs.sanitize) must hold over every public
+search entry, the sharded merge tier, and build_chunked's
+assignment/encode pass at n = 2.2e9 synthetic shapes (all device-free:
+``jax.ShapeDtypeStruct`` operands, ``eval_shape``/``make_jaxpr``
+semantics, zero bytes allocated) — and must CATCH a seeded int32
+overflow regression. The x64 scoping satellite (the prover never leaks
+``jax_enable_x64``) is regression-tested in tests/test_sanitize.py
+alongside the other sanitize-lane tests.
+"""
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+from raft_tpu.core import ids as _ids
+from raft_tpu.obs import sanitize as _san
+import tools.capacity_prove as cp
+
+N = cp.DEFAULT_N  # 2.2e9 — comfortably past 2³¹
+
+
+# ---------------------------------------------------------------------------
+# prover unit behavior
+# ---------------------------------------------------------------------------
+
+class TestCapacityReport:
+    def test_int32_iota_over_big_axis_is_a_violation(self):
+        def bad(q):
+            return jnp.arange(N, dtype=jnp.int32)[:4] + q
+
+        rep = _san.capacity_report(bad, jax.ShapeDtypeStruct((4,),
+                                                             jnp.int32))
+        assert len(rep["violations"]) == 1
+        v = rep["violations"][0]
+        assert v["primitive"] == "iota"
+        assert "make_ids" in v["message"]
+        # provenance points at the OFFENDING line (this file), not the
+        # prover's call site (jax tracebacks are innermost-first)
+        assert "test_capacity.py" in v["where"]
+
+    def test_int32_gather_into_big_axis_is_a_violation(self):
+        def bad(ds, idx):
+            return jnp.take(ds, jnp.clip(idx, 0, 100), axis=0)
+
+        rep = _san.capacity_report(
+            bad, jax.ShapeDtypeStruct((N, 4), jnp.float32),
+            jax.ShapeDtypeStruct((8,), jnp.int32))
+        assert [v["primitive"] for v in rep["violations"]] == ["gather"]
+
+    def test_trace_time_index_overflow_is_reported_not_raised(self):
+        """jnp-level int32 indexing into a ≥2³¹ axis dies inside jax's
+        index normalization (OverflowError) — the prover converts that
+        into a violation with the user frame instead of crashing."""
+        def bad(ds, idx):
+            return ds[idx]
+
+        rep = _san.capacity_report(
+            bad, jax.ShapeDtypeStruct((N, 4), jnp.float32),
+            jax.ShapeDtypeStruct((8,), jnp.int32))
+        assert len(rep["violations"]) == 1
+        assert rep["violations"][0]["primitive"] == "trace"
+        assert "test_capacity.py" in rep["violations"][0]["where"]
+
+    def test_int64_id_path_is_clean_and_reports_peak_bytes(self):
+        def good(ds):
+            ids = _ids.make_ids(8, start=N - 8, n_total=N)
+            return ds[ids]
+
+        rep = _san.assert_billion_safe(
+            good, jax.ShapeDtypeStruct((N, 4), jnp.float32), what="good")
+        assert not rep["violations"]
+        # the [N, 4] f32 operand alone is > 32 GB of (abstract) bytes
+        assert rep["peak_intermediate_bytes"] > 32 * 2**30
+
+    def test_small_shapes_never_violate(self):
+        """int32 everything is FINE below 2³¹ — the policy keeps int32
+        when provably safe, and the prover must not cry wolf."""
+        def fn(ds, idx):
+            return ds[jnp.clip(idx.astype(jnp.int32), 0,
+                               ds.shape[0] - 1)]
+
+        rep = _san.assert_billion_safe(
+            fn, jax.ShapeDtypeStruct((1 << 20, 4), jnp.float32),
+            jax.ShapeDtypeStruct((8,), jnp.int32), what="small")
+        assert not rep["violations"]
+
+    def test_assert_raises_with_eqn_provenance(self):
+        def bad(q):
+            return jnp.arange(N, dtype=jnp.int32)[:4] + q
+
+        with pytest.raises(_san.CapacityError) as ei:
+            _san.assert_billion_safe(
+                bad, jax.ShapeDtypeStruct((4,), jnp.int32), what="seeded")
+        msg = str(ei.value)
+        assert "seeded" in msg and "iota" in msg and "at " in msg
+
+
+# ---------------------------------------------------------------------------
+# the acceptance proofs: all public entries at n = 2.2e9
+# ---------------------------------------------------------------------------
+
+class TestBillionScaleProofs:
+    def test_brute_force_search(self):
+        assert not cp.prove_brute_force(N)["violations"]
+
+    def test_ivf_pq_search(self):
+        assert not cp.prove_ivf_pq(N)["violations"]
+
+    def test_ivf_flat_search(self):
+        assert not cp.prove_ivf_flat(N)["violations"]
+
+    def test_cagra_search(self):
+        assert not cp.prove_cagra(N)["violations"]
+
+    def test_sharded_merge_ring(self):
+        assert not cp.prove_sharded_merge(N, "ring")["violations"]
+
+    def test_sharded_merge_allgather(self):
+        assert not cp.prove_sharded_merge(N, "allgather")["violations"]
+
+    def test_sharded_knn_pad_rows_widen_ids(self):
+        """Boundary regression (code-review find): when the REAL row
+        count still fits int32 but the padded total does not, gids must
+        ride the padded width — otherwise pad-row gids wrap negative
+        and escape the `gids < n` mask."""
+        import numpy as np
+        from jax.sharding import Mesh
+        from raft_tpu.parallel import knn as _pknn
+
+        n = 2**31 - 1  # int32-safe real rows; padded-to-8 total is not
+        mesh = Mesh(np.array(jax.devices()[:8]), ("shard",))
+
+        def fn(ds, q):
+            return _pknn.sharded_knn(ds, q, 4, mesh, merge="allgather")
+
+        with _san.scoped_x64(True):
+            closed = jax.make_jaxpr(fn)(
+                jax.ShapeDtypeStruct((n, 8), jnp.float32),
+                jax.ShapeDtypeStruct((4, 8), jnp.float32))
+        assert "int64" in str(closed.jaxpr.outvars[1].aval)
+
+    def test_build_chunked_assign_encode(self):
+        assert not cp.prove_build_chunked_pass(N)["violations"]
+
+    def test_seeded_int32_regression_fails(self):
+        """The negative control: the OLD hard-int32 global-id remap
+        (pre-core.ids parallel/knn.py) must fail the prover."""
+        def old_remap(lids, marker):
+            gids = lids.astype(jnp.int32) \
+                + jnp.int32(3) * jnp.int32(N // 8)
+            return cp._address_rows(marker, gids)
+
+        with pytest.raises(_san.CapacityError):
+            _san.assert_billion_safe(
+                old_remap, jax.ShapeDtypeStruct((4, 4), jnp.int32),
+                jax.ShapeDtypeStruct((N, 1), jnp.int8),
+                what="old-remap")
+
+    def test_seeded_policy_regression_fails_an_entry_proof(self):
+        """Re-pinning the id policy to int32 (simulating a reverted
+        core/ids.py) must break a real entry's proof — the proofs
+        depend on the policy, not on hand-built indexes."""
+        orig = _ids.id_dtype
+        _ids.id_dtype = lambda n_rows: jnp.int32
+        try:
+            with pytest.raises(_san.CapacityError):
+                cp.prove_cagra(N)
+        finally:
+            _ids.id_dtype = orig
+
+    def test_cagra_optimize_graph_preserves_id_width(self):
+        """Build-side regression (code-review find): the reverse-edge
+        table must follow the graph's id width — a hard int32 table
+        silently truncates int64 node ids through the .at[].set scatter
+        (jnp casts, it doesn't error), dropping every reverse edge from
+        the upper half of a ≥2³¹-row dataset."""
+        from raft_tpu.neighbors import cagra as _cagra
+
+        def fn(g):
+            return _cagra.optimize_graph(g, 8)
+
+        with _san.scoped_x64(True):
+            closed = jax.make_jaxpr(fn)(
+                jax.ShapeDtypeStruct((128, 16), jnp.int64))
+        assert "int64" in str(closed.jaxpr.outvars[0].aval)
+
+    def test_cli_report(self, tmp_path):
+        """The CI entry point: all proofs clean, report artifact
+        written."""
+        import json
+
+        report = tmp_path / "capacity.json"
+        rc = cp.main(["--report", str(report),
+                      "--only", "ivf_pq.search,merge.ring"])
+        assert rc == 0
+        doc = json.loads(report.read_text())
+        assert doc["version"] == "raft_tpu.capacity_prove/1"
+        assert all(p["ok"] for p in doc["proofs"].values())
+
+
+# ---------------------------------------------------------------------------
+# the id-dtype policy itself
+# ---------------------------------------------------------------------------
+
+class TestIdPolicy:
+    def test_id_dtype_threshold(self):
+        assert _ids.id_dtype(2**31 - 1) == jnp.int32
+        assert _ids.id_dtype(2**31) == jnp.int64
+        import numpy as np
+
+        assert _ids.np_id_dtype(10) == np.int32
+        assert _ids.np_id_dtype(N) == np.int64
+
+    def test_make_ids_small_is_int32(self):
+        ids = _ids.make_ids(16, start=4)
+        assert ids.dtype == jnp.int32
+        assert int(ids[0]) == 4 and int(ids[-1]) == 19
+
+    def test_global_local_roundtrip_preserves_sentinels(self):
+        import numpy as np
+
+        local = jnp.asarray([0, 5, -1, 7], jnp.int32)
+        g = _ids.global_ids(jnp.int32(3), 100, local, n_total=800)
+        np.testing.assert_array_equal(np.asarray(g), [300, 305, -1, 307])
+        back = _ids.local_ids(g, jnp.int32(3), 100)
+        np.testing.assert_array_equal(np.asarray(back), [0, 5, -1, 7])
+
+    def test_id_dtype_like_never_narrows(self):
+        with _san.scoped_x64(True):
+            wide = jnp.asarray([1, 2], jnp.int64)
+            assert _ids.id_dtype_like(wide) == jnp.int64
+        narrow = jnp.asarray([1, 2], jnp.int32)
+        assert _ids.id_dtype_like(narrow) == jnp.int32

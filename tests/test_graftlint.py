@@ -518,6 +518,262 @@ def test_gl10_fires_outside_the_facade_module():
 
 
 # ---------------------------------------------------------------------------
+# GL11 — int-overflow hazards in id arithmetic
+# ---------------------------------------------------------------------------
+
+GL11_BAD = """
+import jax.numpy as jnp
+import numpy as np
+
+
+def remap(ids, rank, shard_rows):
+    gids = ids.astype(jnp.int32) + rank.astype(jnp.int32) * shard_rows
+    return gids
+
+
+def iota(n):
+    row_ids = jnp.arange(n)
+    return row_ids
+
+
+def host_math(shard, rows):
+    offs = np.int32(shard * rows)
+    return offs
+"""
+
+GL11_GOOD = """
+import jax.numpy as jnp
+from raft_tpu.core import ids as _ids
+
+
+def remap(ids, rank, shard_rows, n_total):
+    return _ids.global_ids(rank, shard_rows, ids, n_total=n_total)
+
+
+def iota(n):
+    row_ids = _ids.make_ids(n)
+    return row_ids
+
+
+def small_stuff(k, dim):
+    mask = jnp.arange(k)          # not an id binding: no finding
+    probes = jnp.arange(dim, dtype=jnp.int32) * 2
+    return mask, probes
+"""
+
+
+def test_gl11_fires_on_id_overflow_hazards():
+    findings = [f for f in lint(GL11_BAD) if f.rule == "GL11"]
+    msgs = " | ".join(f.message for f in findings)
+    assert len(findings) == 3
+    assert "global-id arithmetic" in msgs
+    assert "default-dtype jnp.arange" in msgs
+    assert "int32()" in msgs
+
+
+def test_gl11_quiet_on_policy_helpers_and_small_iotas():
+    assert not [f for f in lint(GL11_GOOD) if f.rule == "GL11"]
+    # host np.arange building static tables is exempt by design
+    src = """
+import numpy as np
+
+def sel(S):
+    s_idx = np.arange(S)
+    return s_idx
+"""
+    assert not [f for f in lint(src) if f.rule == "GL11"]
+
+
+# ---------------------------------------------------------------------------
+# GL12 — accumulator narrowing
+# ---------------------------------------------------------------------------
+
+GL12_BAD = """
+import jax.numpy as jnp
+
+
+def lut(q, cb):
+    cbq = cb.astype(jnp.bfloat16)
+    d1 = jnp.einsum("sp,skp->sk", q, cbq)
+    d2 = jnp.dot(q, cb.astype(jnp.float8_e4m3fn))
+    acc = jnp.sum(q.astype(jnp.bfloat16))
+    return d1, d2, acc
+"""
+
+GL12_GOOD = """
+import jax.numpy as jnp
+
+
+def lut(q, cb):
+    d = jnp.einsum("sp,skp->sk", q, cb.astype(jnp.bfloat16),
+                   preferred_element_type=jnp.float32)
+    up = jnp.dot(q, cb.astype(jnp.bfloat16).astype(jnp.float32),
+                 preferred_element_type=jnp.float32)
+    s = jnp.sum(q.astype(jnp.bfloat16), dtype=jnp.float32)
+    plain = jnp.dot(q, cb)       # f32 operands: no finding
+    return d, up, s, plain
+"""
+
+
+def test_gl12_fires_on_narrowed_contractions():
+    findings = [f for f in lint(GL12_BAD) if f.rule == "GL12"]
+    assert len(findings) == 3
+    assert all("preferred_element_type" in f.message for f in findings)
+
+
+def test_gl12_quiet_on_pinned_accumulators():
+    assert not [f for f in lint(GL12_GOOD) if f.rule == "GL12"]
+
+
+# ---------------------------------------------------------------------------
+# GL13 — sentinel safety
+# ---------------------------------------------------------------------------
+
+GL13_BAD = """
+import jax.numpy as jnp
+
+
+def bad_inf(mask, ids):
+    return jnp.where(mask, jnp.inf, ids)
+
+
+def bad_arith(mask, raw, base):
+    ids = jnp.where(mask, raw, -1)
+    offs = ids + base
+    return offs
+"""
+
+GL13_GOOD = """
+import jax.numpy as jnp
+
+
+def guarded(mask, raw, base):
+    ids = jnp.where(mask, raw, -1)
+    return jnp.where(ids >= 0, ids + base, -1)
+
+
+def float_sentinels(mask, dists):
+    return jnp.where(mask, jnp.inf, dists)  # float array: fine
+"""
+
+
+def test_gl13_fires_on_sentinel_misuse():
+    findings = [f for f in lint(GL13_BAD) if f.rule == "GL13"]
+    msgs = " | ".join(f.message for f in findings)
+    assert len(findings) == 2
+    assert "upcasts ids to float" in msgs
+    assert "without a >= 0 guard" in msgs
+
+
+def test_gl13_quiet_on_guarded_idioms():
+    assert not [f for f in lint(GL13_GOOD) if f.rule == "GL13"]
+
+
+# ---------------------------------------------------------------------------
+# GL14 — Pallas per-grid-step resource budgets
+# ---------------------------------------------------------------------------
+
+GL14_BAD = """
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+import jax.numpy as jnp
+
+_FAT = 4096
+
+
+def kern(x_ref, o_ref):
+    o_ref[:] = x_ref[:]
+
+
+def fat_caller(x):
+    return pl.pallas_call(
+        kern,
+        in_specs=[pl.BlockSpec((_FAT, 2048), lambda i: (i, 0))],
+        out_specs=pl.BlockSpec((8, 128), lambda i: (i, 0)),
+        scratch_shapes=[pltpu.SMEM((1024, 1024), jnp.int32)],
+    )(x)
+"""
+
+
+def test_gl14_fires_on_budget_breaches():
+    findings = [f for f in lint(GL14_BAD, path="raft_tpu/ops/fake.py")
+                if f.rule == "GL14"]
+    msgs = " | ".join(f.message for f in findings)
+    assert len(findings) == 2
+    assert "VMEM footprint" in msgs and "16 MB" in msgs
+    assert "SMEM-resident" in msgs
+    # dynamic block shapes defer to the runtime budget: no finding
+    src_ok = GL14_BAD.replace("(_FAT, 2048)", "(bq, 2048)") \
+                     .replace("pltpu.SMEM((1024, 1024), jnp.int32)",
+                              "pltpu.SMEM((8, 128), jnp.int32)")
+    assert not [f for f in lint(src_ok, path="raft_tpu/ops/fake.py")
+                if f.rule == "GL14"]
+    # an over-budget SMEM-resident BLOCK fires even with no SMEM
+    # scratch allocation at all (regression: the check must run after
+    # the whole-function sweep, not only inside the scratch branch)
+    src_blk = GL14_BAD.replace("(_FAT, 2048)", "(8, 128)") \
+                      .replace(
+        "in_specs=[pl.BlockSpec((8, 128), lambda i: (i, 0))],",
+        "in_specs=[pl.BlockSpec((1024, 1024), lambda i: (i, 0),\n"
+        "                       memory_space=pltpu.SMEM)],") \
+                      .replace(
+        "scratch_shapes=[pltpu.SMEM((1024, 1024), jnp.int32)],", "")
+    blk = [f for f in lint(src_blk, path="raft_tpu/ops/fake.py")
+           if f.rule == "GL14"]
+    assert len(blk) == 1 and "SMEM-resident" in blk[0].message
+
+
+def test_gl14_quiet_on_the_existing_kernels():
+    """The three shipped streaming kernels' BlockSpecs stay under the
+    static budget check (their block shapes are parameter-dynamic and
+    measured VMEM-safe — the satellite acceptance case)."""
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    findings = graftlint.lint_paths(
+        [os.path.join(root, "raft_tpu", "ops", "pallas_kernels.py")],
+        select={"GL14"})
+    assert not findings, "\n".join(f.render() for f in findings)
+
+
+# ---------------------------------------------------------------------------
+# GL15 — streaming-tier dispatch without an admission guard
+# ---------------------------------------------------------------------------
+
+GL15_BAD = """
+from raft_tpu.ops import pallas_kernels as _pk
+
+
+def refine(ds, q, cand, k):
+    return _pk.gather_refine_topk(ds, q, cand, k, "l2")
+"""
+
+GL15_GOOD = """
+from raft_tpu.neighbors import ivf_common as ic
+from raft_tpu.ops import pallas_kernels as _pk
+
+
+def refine(ds, q, cand, k):
+    if not ic.gather_refine_mem_ok(ds.shape[0], ds.shape[1]):
+        return None
+    return _pk.gather_refine_topk(ds, q, cand, k, "l2")
+"""
+
+
+def test_gl15_fires_on_unguarded_kernel_dispatch():
+    findings = [f for f in lint(GL15_BAD) if f.rule == "GL15"]
+    assert len(findings) == 1
+    assert "admission guard" in findings[0].message
+    # guarded module: quiet
+    assert not [f for f in lint(GL15_GOOD) if f.rule == "GL15"]
+    # outside raft_tpu/ (tools, tests): no contract
+    assert not [f for f in lint(GL15_BAD, path="tools/fake.py")
+                if f.rule == "GL15"]
+    # the defining module itself is exempt
+    assert not [f for f in lint(GL15_BAD,
+                                path="raft_tpu/ops/pallas_kernels.py")
+                if f.rule == "GL15"]
+
+
+# ---------------------------------------------------------------------------
 # suppressions
 # ---------------------------------------------------------------------------
 
@@ -618,6 +874,15 @@ def test_every_rule_has_a_suppressible_finding():
         "GL10": (GL10_BAD, "    s = lax.psum(vals, axis_name)",
                  "    s = lax.psum(vals, axis_name)"
                  "  # graftlint: disable=GL10"),
+        "GL11": (GL11_BAD, "    row_ids = jnp.arange(n)",
+                 "    row_ids = jnp.arange(n)"
+                 "  # graftlint: disable=GL11"),
+        "GL13": (GL13_BAD, "    offs = ids + base",
+                 "    offs = ids + base  # graftlint: disable=GL13"),
+        "GL15": (GL15_BAD,
+                 '    return _pk.gather_refine_topk(ds, q, cand, k, "l2")',
+                 '    return _pk.gather_refine_topk(ds, q, cand, k, "l2")'
+                 "  # graftlint: disable=GL15"),
     }
     for rule, (src, old, new) in cases.items():
         before = [f for f in lint(src) if f.rule == rule]
@@ -741,3 +1006,70 @@ def test_cli_changed_lints_only_modified_files(tmp_path):
          "json"], capture_output=True, text=True, cwd=repo, env=env)
     assert p.returncode == 1
     assert any("legacy.py" in f["path"] for f in json.loads(p.stdout))
+
+
+def test_cli_baseline_gates_only_new_findings(tmp_path):
+    """--baseline records current findings and gates only NEW ones —
+    the mechanism that lets a future rule land blocking without blanket
+    suppressions (same reporter/exit codes as the plain run)."""
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    pkg = tmp_path / "repo" / "raft_tpu" / "neighbors"
+    pkg.mkdir(parents=True)
+    (pkg / "mod.py").write_text(GL04_BAD)   # two legacy GL04 findings
+    bl = tmp_path / "baseline.json"
+    env = dict(os.environ, PYTHONPATH=root)
+    repo = str(tmp_path / "repo")
+
+    def run(*extra):
+        return subprocess.run(
+            [sys.executable, "-m", "tools.graftlint", "raft_tpu",
+             "--baseline", str(bl), *extra],
+            capture_output=True, text=True, cwd=repo, env=env)
+
+    # a missing baseline file is an empty baseline: everything gates
+    p = run()
+    assert p.returncode == 1 and "NEW finding" in p.stdout
+
+    # record, then the gated run is clean despite the legacy findings
+    p = run("--update-baseline")
+    assert p.returncode == 0, p.stdout + p.stderr
+    doc = json.loads(bl.read_text())
+    assert doc["count"] == 2
+    p = run()
+    assert p.returncode == 0, p.stdout + p.stderr
+    assert "2 baseline finding(s) suppressed" in p.stdout
+
+    # line drift above a legacy finding does NOT un-baseline it...
+    (pkg / "mod.py").write_text("import os\n\n\n" + GL04_BAD)
+    p = run()
+    assert p.returncode == 0, p.stdout + p.stderr
+
+    # ...but a brand-new finding still gates, and only IT is reported
+    (pkg / "mod.py").write_text(GL04_BAD + "\n\ndef fit(x):\n    return x\n")
+    report = tmp_path / "report.json"
+    p = run("--format", "json", "--report", str(report))
+    assert p.returncode == 1
+    payload = json.loads(p.stdout)
+    assert len(payload) == 1 and "fit" in payload[0]["message"]
+    rep = json.loads(report.read_text())
+    assert rep["count"] == 1 and rep["baseline_suppressed"] == 2
+
+    # --update-baseline without --baseline is a usage error
+    p = subprocess.run(
+        [sys.executable, "-m", "tools.graftlint", "raft_tpu",
+         "--update-baseline"],
+        capture_output=True, text=True, cwd=repo, env=env)
+    assert p.returncode == 2
+
+    # --update-baseline refuses the --changed scope (recording only the
+    # changed files would ERASE unchanged files' baseline entries)
+    p = run("--update-baseline", "--changed")
+    assert p.returncode == 2
+    assert "--changed" in p.stderr
+
+    # an update run still writes the --report artifact (full finding set)
+    rep2 = tmp_path / "update_report.json"
+    p = run("--update-baseline", "--report", str(rep2))
+    assert p.returncode == 0
+    doc2 = json.loads(rep2.read_text())
+    assert doc2["count"] == 3 and doc2["baseline_suppressed"] == 0

@@ -150,6 +150,11 @@ class TestRingMergeTier:
     top-k tier return results identical to the allgather tier on the
     8-device CPU mesh (same per-shard candidates, same selection)."""
 
+    # the two ring-vs-allgather builds below are the module's heaviest
+    # programs (~20 s each on the CPU mesh): slow-marked so the tier-1
+    # lane (-m 'not slow') keeps its 870 s budget — the CI pytest lane
+    # and the RAFT_TPU_SANITIZE=1 lane (no -m filter) still run them
+    @pytest.mark.slow
     def test_sharded_ivf_pq_ring_matches_allgather(self, mesh, data):
         dataset, queries = data
         params = ivf_pq.IndexParams(n_lists=32, pq_dim=16, pq_bits=8,
@@ -163,6 +168,7 @@ class TestRingMergeTier:
         np.testing.assert_array_equal(np.asarray(ia), np.asarray(ir))
         np.testing.assert_allclose(np.asarray(va), np.asarray(vr))
 
+    @pytest.mark.slow
     def test_sharded_ivf_flat_ring_matches_allgather(self, mesh, data):
         dataset, queries = data
         params = ivf_flat.IndexParams(n_lists=16, kmeans_n_iters=4)
@@ -192,6 +198,7 @@ class TestShardedFusedPipeline:
     per-shard exact refine against the shard's own rows, only refined
     survivors entering the merge (BASELINE config 5's shape)."""
 
+    @pytest.mark.slow  # ~24 s: see the tier-1-budget note above
     def test_refined_sharded_search(self, mesh, data):
         dataset, queries = data
         k = 10

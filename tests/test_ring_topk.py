@@ -169,6 +169,42 @@ class TestRingFallbackParity:
         np.testing.assert_array_equal(ki, fi)
         np.testing.assert_allclose(kv, fv)
 
+    def test_int64_ids_decline_the_kernel(self, mesh, rng):
+        """The id-width admission (PR-10 capacity pass): the ring
+        KERNEL is int32-only by construction, so an int64 billion-scale
+        id table must reroute to the identical-schedule ppermute
+        fallback (fallback{reason=id_width}) instead of silently
+        truncating. Trace-only under scoped x64 — the branch is a
+        trace-time dtype check."""
+        from raft_tpu import obs
+        from raft_tpu.obs.metrics import MetricsRegistry
+
+        m, k = 40, 8
+        vals, ids = make_tables(rng, m, k, True)
+
+        def body(v, i):
+            return merge_topk(v[0], i[0].astype(jnp.int64), "shard", m,
+                              k, N_DEV, True, tier="ring",
+                              impl="ring_kernel", interpret=True)
+
+        fn = shard_map(
+            body, mesh=mesh,
+            in_specs=(P("shard", None, None), P("shard", None, None)),
+            out_specs=(P("shard", None), P("shard", None)),
+            check_vma=False)
+        reg = MetricsRegistry()
+        obs.enable(registry=reg, hbm=False)
+        try:
+            with sanitize.scoped_x64(True):
+                closed = jax.make_jaxpr(fn)(jnp.asarray(vals),
+                                            jnp.asarray(ids))
+        finally:
+            obs.disable()
+        c = reg.snapshot()["counters"]
+        assert c.get("parallel.merge.fallback{reason=id_width}") == 1.0
+        # merged ids keep their 64-bit width end to end
+        assert "int64" in str(closed.jaxpr.outvars[1].aval)
+
 
 class TestMergeTierDispatch:
     def test_env_tristate(self, monkeypatch):

@@ -201,6 +201,42 @@ class TestCollectiveSchedule:
         assert not sanitize.comms_schedule_recording()
 
 
+class TestScopedX64:
+    """The capacity prover's x64 scoping (PR-10 satellite): proofs
+    trace int64 id paths, but ``jax_enable_x64`` is process-global and
+    silently changes every later test's dtypes — the scope must
+    save/restore, including on exceptions."""
+
+    def test_scope_enables_and_restores(self):
+        assert not jax.config.jax_enable_x64  # conftest pins it off
+        with sanitize.scoped_x64(True):
+            assert jax.config.jax_enable_x64
+            assert jnp.arange(3, dtype=jnp.int64).dtype == jnp.int64
+        assert not jax.config.jax_enable_x64
+        assert jnp.asarray([1]).dtype == jnp.int32
+
+    def test_scope_restores_on_exception(self):
+        with pytest.raises(RuntimeError):
+            with sanitize.scoped_x64(True):
+                raise RuntimeError("boom")
+        assert not jax.config.jax_enable_x64
+
+    def test_prover_never_leaks_x64(self):
+        """A full capacity proof (which traces int64 ids under the
+        scope) leaves the process exactly as it found it — whether the
+        proof passes or raises."""
+        import tools.capacity_prove as cp
+
+        cp.prove_ivf_flat()
+        assert not jax.config.jax_enable_x64
+        with pytest.raises(sanitize.CapacityError):
+            sanitize.assert_billion_safe(
+                lambda q: jnp.arange(cp.DEFAULT_N, dtype=jnp.int32)[:2] + q,
+                jax.ShapeDtypeStruct((2,), jnp.int32), what="seeded")
+        assert not jax.config.jax_enable_x64
+        assert jnp.asarray([1]).dtype == jnp.int32
+
+
 def test_recompile_budget_fires():
     """The budget context itself: a fresh shape inside a 0-budget scope
     must raise RecompileBudgetExceeded."""

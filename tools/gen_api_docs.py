@@ -17,7 +17,7 @@ MODULES = [
     "raft_tpu.core.resources", "raft_tpu.core.errors",
     "raft_tpu.core.logging", "raft_tpu.core.tracing",
     "raft_tpu.core.bitset", "raft_tpu.core.interruptible",
-    "raft_tpu.core.serialize",
+    "raft_tpu.core.serialize", "raft_tpu.core.ids",
     "raft_tpu.obs.metrics", "raft_tpu.obs.spans", "raft_tpu.obs.hbm",
     "raft_tpu.obs.prof",
     "raft_tpu.obs.trace", "raft_tpu.obs.flight", "raft_tpu.obs.sanitize",

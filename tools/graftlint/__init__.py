@@ -45,6 +45,17 @@ raw-``lax``-collective bypass of the Comms telemetry facade (GL10).
 The runtime complement — the collective-schedule checker for divergence
 the AST cannot see — lives in :mod:`raft_tpu.obs.sanitize`.
 
+Capacity / numeric-safety rules (GL11–GL15,
+:mod:`tools.graftlint.capacity`) — the billion-scale pass: int32
+id-arithmetic overflow hazards (GL11), accumulator narrowing without
+``preferred_element_type`` (GL12), sentinel-safety violations (GL13),
+Pallas per-grid-step VMEM/SMEM budget breaches (GL14), and streaming-
+tier dispatch without a ``*_mem_ok`` admission guard (GL15). The
+runtime complement — the ``eval_shape`` capacity prover over the public
+entries at n ≥ 2³¹ synthetic shapes — is
+:func:`raft_tpu.obs.sanitize.assert_billion_safe` /
+``tools/capacity_prove.py``.
+
 Suppression
 -----------
 
@@ -86,6 +97,15 @@ RULES: Dict[str, str] = {
             "names)",
     "GL10": "raw lax collective outside parallel/comms.py (bypasses "
             "comms telemetry)",
+    "GL11": "int32 overflow hazard in id arithmetic (use the core.ids "
+            "id_dtype policy)",
+    "GL12": "accumulator narrowing (bf16/fp8 contraction without "
+            "preferred_element_type)",
+    "GL13": "sentinel safety (float inf in id arrays / unguarded -1 "
+            "arithmetic)",
+    "GL14": "Pallas per-grid-step VMEM/SMEM budget exceeded",
+    "GL15": "Pallas streaming-tier dispatch without a *_mem_ok/"
+            "*_kernel_ok admission guard",
 }
 
 # GL02: string literals that mark an env read as *flag* parsing (vs a
@@ -622,8 +642,10 @@ def lint_source(source: str, path: str = "<string>",
     _check_gl04(tree, path, add)
     _check_gl05(tree, fns, add)
     from tools.graftlint import spmd  # deferred: spmd imports helpers
+    from tools.graftlint import capacity as _capacity
 
     spmd.check(tree, parents, path, add)
+    _capacity.check(tree, parents, path, add)
     findings.sort(key=lambda f: (f.path, f.line, f.col, f.rule))
     return findings
 
@@ -688,6 +710,62 @@ def changed_files(cwd: Optional[str] = None) -> List[str]:
     return out
 
 
+def _finding_key(f: "Finding | Dict[str, object]") -> Tuple[str, str, str]:
+    """Baseline identity of one finding: (path, rule, message) — line
+    numbers drift with every edit above a legacy finding, so they are
+    deliberately NOT part of the key."""
+    if isinstance(f, Finding):
+        return (f.path.replace(os.sep, "/"), f.rule, f.message)
+    return (str(f.get("path", "")).replace(os.sep, "/"),
+            str(f.get("rule", "")), str(f.get("message", "")))
+
+
+def load_baseline(path: str) -> List[Dict[str, object]]:
+    """Read a baseline file (the ``--update-baseline`` writer's schema,
+    compatible with ``--report``'s). A missing file is an empty baseline
+    — the first gated run reports everything, then records it."""
+    if not os.path.exists(path):
+        return []
+    with open(path, encoding="utf-8") as fh:
+        doc = json.load(fh)
+    return list(doc.get("findings", []))
+
+
+def apply_baseline(findings: Sequence[Finding],
+                   baseline: Sequence[Dict[str, object]]
+                   ) -> Tuple[List[Finding], int]:
+    """Split current findings against a recorded baseline: returns
+    (new findings — the gate, count of baseline-matched ones). Matching
+    is a MULTISET consume on (path, rule, message): two identical
+    legacy findings excuse exactly two current ones, so a rule that
+    starts firing an extra time on the same line still gates."""
+    budget: Dict[Tuple[str, str, str], int] = {}
+    for b in baseline:
+        k = _finding_key(b)
+        budget[k] = budget.get(k, 0) + 1
+    new: List[Finding] = []
+    matched = 0
+    for f in findings:
+        k = _finding_key(f)
+        if budget.get(k, 0) > 0:
+            budget[k] -= 1
+            matched += 1
+        else:
+            new.append(f)
+    return new, matched
+
+
+def write_baseline(path: str, findings: Sequence[Finding]) -> None:
+    """Record the current findings as the baseline (atomic write)."""
+    doc = {"version": "graftlint.baseline/1",
+           "count": len(findings),
+           "findings": [f.as_dict() for f in findings]}
+    tmp = path + ".tmp"
+    with open(tmp, "w", encoding="utf-8") as fh:
+        json.dump(doc, fh, indent=2)
+    os.replace(tmp, path)
+
+
 def _scope_filter(files: Sequence[str], paths: Sequence[str]) -> List[str]:
     """Keep only files that a full run over ``paths`` would lint."""
     scopes = [os.path.abspath(p) for p in paths]
@@ -719,8 +797,29 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
     ap.add_argument("--report", metavar="PATH", default=None,
                     help="also write a JSON report (findings + rule "
                          "table) to PATH — the CI artifact")
+    ap.add_argument("--baseline", metavar="PATH", default=None,
+                    help="gate only findings NOT recorded in PATH (a "
+                         "missing file is an empty baseline) — lets a "
+                         "new rule land blocking without blanket "
+                         "suppressions; matched legacy findings are "
+                         "counted, not reported")
+    ap.add_argument("--update-baseline", action="store_true",
+                    help="with --baseline: record the current findings "
+                         "as the new baseline and exit 0")
     ap.add_argument("--list-rules", action="store_true")
     args = ap.parse_args(argv)
+
+    if args.update_baseline and not args.baseline:
+        print("graftlint: --update-baseline needs --baseline PATH",
+              file=sys.stderr)
+        return 2
+    if args.update_baseline and args.changed:
+        # a --changed scope sees only modified files: recording it would
+        # ERASE the baseline entries of every unchanged file
+        print("graftlint: --update-baseline needs a full run — combining "
+              "it with --changed would drop unchanged files' baseline "
+              "entries", file=sys.stderr)
+        return 2
 
     if args.list_rules:
         for rule, desc in sorted(RULES.items()):
@@ -749,9 +848,29 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
         findings = lint_paths(targets, select=select)
     else:
         findings = lint_paths(paths, select=select)
+    baseline_matched = 0
+    if args.baseline:
+        if args.update_baseline:
+            write_baseline(args.baseline, findings)
+            if args.report:
+                # the CI artifact still ships on update runs (the full
+                # finding set; nothing is baseline-suppressed here)
+                with open(args.report, "w", encoding="utf-8") as fh:
+                    json.dump({"rules": RULES, "count": len(findings),
+                               "baseline_suppressed": 0,
+                               "findings": [f.as_dict()
+                                            for f in findings]},
+                              fh, indent=2)
+            if args.format == "human":
+                print(f"graftlint: baseline updated — {len(findings)} "
+                      f"finding(s) recorded to {args.baseline}")
+            return 0
+        findings, baseline_matched = apply_baseline(
+            findings, load_baseline(args.baseline))
     if args.report:
         with open(args.report, "w", encoding="utf-8") as fh:
             json.dump({"rules": RULES, "count": len(findings),
+                       "baseline_suppressed": baseline_matched,
                        "findings": [f.as_dict() for f in findings]},
                       fh, indent=2)
     if args.format == "json":
@@ -760,6 +879,10 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
         for f in findings:
             print(f.render())
         n = len(findings)
-        print(f"graftlint: {n} finding{'s' if n != 1 else ''}"
-              if n else "graftlint: clean")
+        note = (f" ({baseline_matched} baseline finding(s) suppressed)"
+                if baseline_matched else "")
+        print((f"graftlint: {n} NEW finding{'s' if n != 1 else ''}{note}"
+               if args.baseline else
+               f"graftlint: {n} finding{'s' if n != 1 else ''}")
+              if n else f"graftlint: clean{note}")
     return 1 if findings else 0

@@ -1,0 +1,508 @@
+"""graftlint/capacity — billion-scale capacity & numeric-safety rules
+(GL11–GL15).
+
+The third graftlint pass (after jit hygiene GL01–GL05 and SPMD
+correctness GL06–GL10): the bug classes that stay invisible until the
+dataset crosses 2³¹ rows or an accumulator quietly narrows — the
+lint-time counterpart of the reference templating every index on a
+64-bit ``IdxT`` and pinning accumulator types per kernel. The runtime
+complement is the ``eval_shape`` capacity prover
+(:func:`raft_tpu.obs.sanitize.assert_billion_safe`,
+``tools/capacity_prove.py``).
+
+GL11  int-overflow hazards in id arithmetic: hard-int32 global-id math
+      (an int32-cast operand combined with a product of dataset-size-
+      like symbols in an id-producing expression — the
+      ``rank · shard_rows + local`` remap class), default-dtype
+      ``jnp.arange`` feeding an id-named binding, and Python-int size
+      math routed through ``np.int32``/``jnp.int32``. The fix is ONE
+      policy function, not per-site casts: ``core.ids.id_dtype`` /
+      ``make_ids`` / ``global_ids`` / ``local_ids``.
+GL12  accumulator narrowing: a ``dot``/``matmul``/``einsum``/``sum``
+      whose operand was cast to bf16/fp8/f16 without
+      ``preferred_element_type`` (or an explicit f32 upcast of the
+      operand) — on the MXU the accumulator silently follows the
+      operand dtype and a 10⁶-term distance accumulation loses the low
+      bits that decide top-k order.
+GL13  sentinel safety: a float ±inf sentinel written into an id-array
+      branch of ``jnp.where`` (the where upcasts ids to float — ids
+      above 2²⁴ lose precision), and arithmetic on a name assigned from
+      a ``-1``-sentinel maker (``jnp.where(..., -1)`` /
+      ``jnp.full(..., -1)``) without a ``>= 0`` guard — offsetting a
+      ``-1`` turns "invalid" into a live (wrong) id.
+GL14  Pallas per-grid-step resource budgets: statically-resolvable
+      BlockSpec block shapes + VMEM scratch allocations summing past
+      the ~16 MB VMEM budget (module-const resolution, like GL05), and
+      SMEM-resident blocks/scratch past the scalar-memory budget.
+GL15  Pallas streaming-tier dispatch without an admission guard: a
+      module invoking an HBM-streaming kernel entry (lut_scan /
+      gather_refine / ring_topk / the segmented scans) must consult a
+      ``*_mem_ok`` / ``*_kernel_ok`` guard somewhere — the convention
+      every existing tier follows, now enforced.
+
+Conservative by construction: every finding needs a statically-
+resolvable shape/dtype/name pattern; dynamic sites defer to the
+runtime prover.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import re
+from typing import Dict, List, Optional, Sequence, Set
+
+from tools.graftlint import _Parents, _const_env, _const_int, _dotted
+
+# GL11: names that look like dataset-row-scale quantities (row counts,
+# shard geometry). Deliberately narrow — `k`, `dim`, tile widths and
+# class/list counts (`n_classes`, `n_lists`) don't qualify; the runtime
+# prover covers what the name heuristic can't.
+_SIZE_RE = re.compile(
+    r"(^|_)(rows|size|total)(_|$)|^(shard|chunk)_|^shard$|(^|_)n$")
+# GL11/GL13: names that carry row ids.
+_ID_RE = re.compile(r"(^|_)(id|ids|gid|gids|lid|lids|idx|indices|iota)(_|$)")
+
+# GL12: narrow dtypes whose MXU accumulation inherits the operand width.
+_NARROW_DTYPES = {"bfloat16", "float16", "float8_e4m3", "float8_e4m3fn",
+                  "float8_e5m2"}
+_CONTRACTIONS = {"dot", "dot_general", "matmul", "einsum", "sum", "mean",
+                 "tensordot", "vdot"}
+
+# GL14 budgets (bytes): VMEM per core ≈ 16 MB (pallas guide); scalar
+# memory is far smaller — 1 MB flags only unambiguous misuse.
+VMEM_BUDGET = 16 * 1024 * 1024
+SMEM_BUDGET = 1 * 1024 * 1024
+_DTYPE_BYTES = {
+    "float64": 8, "int64": 8, "uint64": 8,
+    "float32": 4, "int32": 4, "uint32": 4,
+    "bfloat16": 2, "float16": 2, "int16": 2, "uint16": 2,
+    "int8": 1, "uint8": 1, "bool_": 1, "bool": 1,
+    "float8_e4m3": 1, "float8_e4m3fn": 1, "float8_e5m2": 1,
+}
+
+# GL15: the HBM-streaming kernel entries (ops/pallas_kernels) whose
+# dispatch sites must consult an admission guard; the per-tile bounded
+# kernels (select_k_pallas, fused_l2_argmin) are VMEM-safe by shape
+# construction and exempt.
+_STREAM_KERNELS = {
+    "ivfpq_lut_scan_topk", "gather_refine_topk", "ring_topk_merge",
+    "segmented_scan_topk", "grouped_scan_topk",
+}
+_GUARD_SUFFIXES = ("_mem_ok", "_kernel_ok")
+
+
+def _is_sizeish(name: str) -> bool:
+    return bool(_SIZE_RE.search(name))
+
+
+def _is_idish(name: str) -> bool:
+    return bool(_ID_RE.search(name))
+
+
+def _names_in(node: ast.AST) -> Set[str]:
+    return {n.id for n in ast.walk(node) if isinstance(n, ast.Name)}
+
+
+def _dtype_tail(node: ast.AST) -> str:
+    """'int32' for jnp.int32 / np.int32 / 'int32' literals, '' else."""
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return node.value
+    d = _dotted(node)
+    return d.split(".")[-1] if d else ""
+
+
+def _is_int32_cast(node: ast.AST) -> bool:
+    """``x.astype(jnp.int32)`` / ``jnp.int32(x)`` / ``np.int32(x)``."""
+    if not isinstance(node, ast.Call):
+        return False
+    if isinstance(node.func, ast.Attribute) and node.func.attr == "astype" \
+            and node.args:
+        return _dtype_tail(node.args[0]) == "int32"
+    return _dotted(node.func).split(".")[-1] == "int32" if node.func else False
+
+
+def _assign_target_names(stmt: ast.AST) -> List[str]:
+    if isinstance(stmt, ast.Assign):
+        out = []
+        for t in stmt.targets:
+            for n in ast.walk(t):
+                if isinstance(n, ast.Name):
+                    out.append(n.id)
+        return out
+    if isinstance(stmt, ast.AnnAssign) and isinstance(stmt.target, ast.Name):
+        return [stmt.target.id]
+    return []
+
+
+def _enclosing_stmt(node: ast.AST, parents: _Parents) -> Optional[ast.stmt]:
+    cur = node
+    while cur is not None and not isinstance(cur, ast.stmt):
+        cur = parents.parent.get(cur)
+    return cur
+
+
+# ---------------------------------------------------------------------------
+# GL11 — int-overflow hazards in id arithmetic
+# ---------------------------------------------------------------------------
+
+def _is_default_arange(call: ast.Call) -> bool:
+    """Device (jnp) arange without an explicit dtype — host np.arange
+    stays exempt (it builds static selection tables, and numpy's
+    default int is 64-bit on every platform we run on)."""
+    callee = _dotted(call.func)
+    if callee not in ("jnp.arange", "jax.numpy.arange"):
+        return False
+    return not any(kw.arg == "dtype" for kw in call.keywords)
+
+
+def _has_sizeish_product(expr: ast.AST) -> bool:
+    for node in ast.walk(expr):
+        if isinstance(node, ast.BinOp) and isinstance(node.op, ast.Mult):
+            if any(_is_sizeish(nm)
+                   for nm in _names_in(node.left) | _names_in(node.right)):
+                return True
+    return False
+
+
+def _check_gl11(tree: ast.Module, parents: _Parents, add) -> None:
+    for node in ast.walk(tree):
+        # (a) hard-int32 global-id arithmetic: an int32-cast operand
+        # combined (+/-) with a size-like product, in an id context
+        if isinstance(node, ast.BinOp) \
+                and isinstance(node.op, (ast.Add, ast.Sub)):
+            has_cast = any(_is_int32_cast(sub) for sub in ast.walk(node))
+            if has_cast and _has_sizeish_product(node):
+                stmt = _enclosing_stmt(node, parents)
+                targets = _assign_target_names(stmt) if stmt else []
+                idish = any(_is_idish(t) for t in targets) \
+                    or any(_is_idish(nm) for nm in _names_in(node))
+                par = parents.parent.get(node)
+                if idish and not isinstance(par, ast.BinOp):
+                    add(node, "GL11",
+                        "global-id arithmetic on hard int32 operands — "
+                        "rank·shard_rows-style offsets overflow int32 "
+                        "past 2³¹ rows; use core.ids.global_ids/"
+                        "local_ids (the id_dtype policy)")
+        # (b) default-dtype arange feeding an id-named binding
+        elif isinstance(node, ast.Call) and _is_default_arange(node):
+            stmt = _enclosing_stmt(node, parents)
+            targets = _assign_target_names(stmt) if stmt else []
+            if any(_is_idish(t) for t in targets):
+                add(node, "GL11",
+                    "default-dtype jnp.arange feeding an id binding — "
+                    "the canonical int dtype is whatever x64 says, not "
+                    "the id policy; use core.ids.make_ids(n)")
+        # (c) Python-int size math routed through np.int32/jnp.int32
+        elif isinstance(node, ast.Call) and node.func is not None \
+                and _dotted(node.func).split(".")[-1] == "int32" \
+                and node.args and _has_sizeish_product(node.args[0]):
+            add(node, "GL11",
+                "size-symbol product routed through int32() — the "
+                "Python-int result is exact but the cast wraps past "
+                "2³¹; size it with core.ids.id_dtype / np_id_dtype")
+
+
+# ---------------------------------------------------------------------------
+# GL12 — accumulator narrowing
+# ---------------------------------------------------------------------------
+
+def _is_narrow_cast(node: ast.AST) -> bool:
+    """``x.astype(jnp.bfloat16)`` / ``jnp.bfloat16(x)`` / one_hot(...,
+    dtype=bf16) — anything that pins a narrow float dtype."""
+    if not isinstance(node, ast.Call):
+        return False
+    if isinstance(node.func, ast.Attribute) and node.func.attr == "astype" \
+            and node.args:
+        return _dtype_tail(node.args[0]) in _NARROW_DTYPES
+    callee = _dotted(node.func).split(".")[-1] if node.func else ""
+    if callee in _NARROW_DTYPES:
+        return True
+    for kw in node.keywords:
+        if kw.arg == "dtype" and _dtype_tail(kw.value) in _NARROW_DTYPES:
+            return True
+    return False
+
+
+def _check_gl12(tree: ast.Module, add) -> None:
+    for fn in [n for n in ast.walk(tree)
+               if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))]:
+        # names bound to narrow-cast values inside this function
+        narrow_names: Set[str] = set()
+        for stmt in ast.walk(fn):
+            if isinstance(stmt, ast.Assign):
+                if any(_is_narrow_cast(sub) for sub in ast.walk(stmt.value)):
+                    narrow_names.update(_assign_target_names(stmt))
+        for call in ast.walk(fn):
+            if not isinstance(call, ast.Call) or call.func is None:
+                continue
+            verb = _dotted(call.func).split(".")[-1]
+            if verb not in _CONTRACTIONS:
+                continue
+            kwargs = {kw.arg for kw in call.keywords}
+            if "preferred_element_type" in kwargs or "dtype" in kwargs:
+                continue
+            narrow = False
+            for arg in call.args:
+                if any(_is_narrow_cast(sub) for sub in ast.walk(arg)):
+                    narrow = True
+                if any(isinstance(sub, ast.Name) and sub.id in narrow_names
+                       for sub in ast.walk(arg)):
+                    narrow = True
+            if narrow:
+                add(call, "GL12",
+                    f"{verb}() over a bf16/fp8-narrowed operand without "
+                    "preferred_element_type — the MXU accumulator "
+                    "follows the operand dtype and a long distance "
+                    "accumulation loses the bits that order top-k; pin "
+                    "preferred_element_type=jnp.float32")
+
+
+# ---------------------------------------------------------------------------
+# GL13 — sentinel safety
+# ---------------------------------------------------------------------------
+
+def _is_float_inf(node: ast.AST) -> bool:
+    if isinstance(node, ast.UnaryOp) and isinstance(node.op, ast.USub):
+        node = node.operand
+    tail = _dotted(node).split(".")[-1] if _dotted(node) else ""
+    if tail == "inf":
+        return True
+    if isinstance(node, ast.Call) and _dotted(node.func) == "float" \
+            and node.args and isinstance(node.args[0], ast.Constant) \
+            and str(node.args[0].value).lower() in ("inf", "-inf"):
+        return True
+    return False
+
+
+def _is_neg_sentinel_maker(node: ast.AST) -> bool:
+    """``jnp.where(..., ..., -1)`` / ``jnp.full(..., -1, ...)`` — an
+    expression that bakes the -1 invalid-id sentinel into its result."""
+    if not isinstance(node, ast.Call) or node.func is None:
+        return False
+    verb = _dotted(node.func).split(".")[-1]
+    if verb not in ("where", "full", "full_like"):
+        return False
+    for arg in list(node.args) + [kw.value for kw in node.keywords
+                                  if kw.arg in ("fill_value",)]:
+        if isinstance(arg, ast.UnaryOp) and isinstance(arg.op, ast.USub) \
+                and isinstance(arg.operand, ast.Constant) \
+                and arg.operand.value == 1:
+            return True
+    return False
+
+
+def _where_guards(call: ast.Call, name: str) -> bool:
+    """True when a ``jnp.where`` call's CONDITION compares ``name``
+    (>= 0 / < 0 / > -1 …) — the idiomatic sentinel guard."""
+    if not call.args:
+        return False
+    cond = call.args[0]
+    for node in ast.walk(cond):
+        if isinstance(node, ast.Compare) and name in _names_in(node):
+            return True
+    return False
+
+
+def _check_gl13(tree: ast.Module, parents: _Parents, add) -> None:
+    for node in ast.walk(tree):
+        # (a) float ±inf sentinel poured into an id-array where-branch
+        if isinstance(node, ast.Call) and node.func is not None \
+                and _dotted(node.func).split(".")[-1] == "where" \
+                and len(node.args) == 3:
+            a, b = node.args[1], node.args[2]
+            for inf_side, other in ((a, b), (b, a)):
+                if _is_float_inf(inf_side):
+                    other_idish = any(_is_idish(nm)
+                                      for nm in _names_in(other)) \
+                        or _is_int32_cast(other)
+                    if other_idish:
+                        add(node, "GL13",
+                            "float ±inf sentinel mixed into an integer "
+                            "id array — the where() upcasts ids to "
+                            "float and ids above 2²⁴ lose precision; "
+                            "use the -1 integer sentinel")
+                        break
+    # (b) unguarded arithmetic on a -1-sentinel-bearing name
+    for fn in [n for n in ast.walk(tree)
+               if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))]:
+        sentinel_names: Set[str] = set()
+        for stmt in ast.walk(fn):
+            if isinstance(stmt, ast.Assign) \
+                    and _is_neg_sentinel_maker(stmt.value):
+                sentinel_names.update(t for t in _assign_target_names(stmt)
+                                      if _is_idish(t))
+        if not sentinel_names:
+            continue
+        for node in ast.walk(fn):
+            if not (isinstance(node, ast.BinOp)
+                    and isinstance(node.op, (ast.Add, ast.Sub, ast.Mult))):
+                continue
+            used = _names_in(node) & sentinel_names
+            if not used:
+                continue
+            # guarded when the arithmetic sits inside a jnp.where whose
+            # condition re-tests the sentinel name
+            guarded = False
+            cur = parents.parent.get(node)
+            while cur is not None and not isinstance(cur, ast.stmt):
+                if isinstance(cur, ast.Call) and cur.func is not None \
+                        and _dotted(cur.func).split(".")[-1] == "where" \
+                        and any(_where_guards(cur, nm) for nm in used):
+                    guarded = True
+                    break
+                cur = parents.parent.get(cur)
+            if not guarded:
+                add(node, "GL13",
+                    f"arithmetic on sentinel-bearing id name(s) "
+                    f"{sorted(used)} without a >= 0 guard — offsetting "
+                    "a -1 sentinel turns 'invalid' into a live wrong "
+                    "id; wrap in jnp.where(ids >= 0, ..., -1) or use "
+                    "core.ids.global_ids/local_ids")
+
+
+# ---------------------------------------------------------------------------
+# GL14 — Pallas per-grid-step resource budgets
+# ---------------------------------------------------------------------------
+
+def _spec_memory_space(call: ast.Call) -> str:
+    for kw in call.keywords:
+        if kw.arg == "memory_space":
+            tail = _dotted(kw.value).split(".")[-1]
+            if tail:
+                return tail
+            if isinstance(kw.value, ast.Constant):
+                return str(kw.value.value)
+    return "vmem"
+
+
+def _block_shape_elems(call: ast.Call,
+                       env: Dict[str, int]) -> Optional[int]:
+    shape = None
+    if call.args and isinstance(call.args[0], ast.Tuple):
+        shape = call.args[0]
+    for kw in call.keywords:
+        if kw.arg == "block_shape" and isinstance(kw.value, ast.Tuple):
+            shape = kw.value
+    if shape is None or not shape.elts:
+        return None
+    total = 1
+    for el in shape.elts:
+        v = _const_int(el, env)
+        if v is None:
+            return None  # dynamic — defer to the runtime budget
+        total *= v
+    return total
+
+
+def _scratch_bytes(call: ast.Call, env: Dict[str, int]) -> Optional[int]:
+    """Bytes of a ``pltpu.VMEM((shape), dtype)`` / ``pltpu.SMEM(...)``
+    scratch allocation when statically resolvable."""
+    if not call.args or not isinstance(call.args[0], ast.Tuple):
+        return None
+    total = 1
+    for el in call.args[0].elts:
+        v = _const_int(el, env)
+        if v is None:
+            return None
+        total *= v
+    nbytes = 4
+    if len(call.args) >= 2:
+        nbytes = _DTYPE_BYTES.get(_dtype_tail(call.args[1]), 4)
+    return total * nbytes
+
+
+def _check_gl14(tree: ast.Module, add) -> None:
+    env = _const_env(tree)
+    for fn in [n for n in ast.walk(tree)
+               if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))]:
+        has_pallas_call = any(
+            isinstance(c, ast.Call) and c.func is not None
+            and _dotted(c.func).split(".")[-1] in ("pallas_call",
+                                                   "PrefetchScalarGridSpec")
+            for c in ast.walk(fn))
+        if not has_pallas_call:
+            continue
+        vmem = smem = 0
+        anchor = smem_anchor = None
+        for call in ast.walk(fn):
+            if not isinstance(call, ast.Call) or call.func is None:
+                continue
+            tail = _dotted(call.func).split(".")[-1]
+            if tail == "BlockSpec":
+                elems = _block_shape_elems(call, env)
+                if elems is None:
+                    continue
+                space = _spec_memory_space(call).lower()
+                if "smem" in space:
+                    smem += elems * 4
+                    smem_anchor = smem_anchor or call
+                elif "any" in space:
+                    continue  # stays in HBM
+                else:
+                    vmem += elems * 4  # f32-conservative
+                    anchor = anchor or call
+            elif tail == "VMEM":
+                b = _scratch_bytes(call, env)
+                if b:
+                    vmem += b
+                    anchor = anchor or call
+            elif tail == "SMEM":
+                b = _scratch_bytes(call, env)
+                if b:
+                    smem += b
+                    smem_anchor = smem_anchor or call
+        if smem > SMEM_BUDGET and smem_anchor is not None:
+            add(smem_anchor, "GL14",
+                f"SMEM-resident blocks/scratch total {smem / 2**20:.1f} "
+                f"MB in {fn.name}() — scalar memory holds KBs of "
+                "control data, not tensors; stream through VMEM instead")
+        if vmem > VMEM_BUDGET and anchor is not None:
+            add(anchor, "GL14",
+                f"per-grid-step VMEM footprint ≈ {vmem / 2**20:.1f} MB "
+                f"in {fn.name}() exceeds the ~16 MB budget — shrink the "
+                "block shapes / scratch or re-tile the grid")
+
+
+# ---------------------------------------------------------------------------
+# GL15 — streaming-tier dispatch without an admission guard
+# ---------------------------------------------------------------------------
+
+def _check_gl15(tree: ast.Module, path: str, add) -> None:
+    norm = path.replace(os.sep, "/")
+    if "raft_tpu/" not in norm or norm.endswith("ops/pallas_kernels.py"):
+        return
+    defined = {n.name for n in ast.walk(tree)
+               if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))}
+    kernel_calls = []
+    has_guard = False
+    for call in ast.walk(tree):
+        if not isinstance(call, ast.Call) or call.func is None:
+            continue
+        tail = _dotted(call.func).split(".")[-1]
+        if tail in _STREAM_KERNELS and tail not in defined:
+            kernel_calls.append((call, tail))
+        if tail.endswith(_GUARD_SUFFIXES):
+            has_guard = True
+    if has_guard or any(d.endswith(_GUARD_SUFFIXES) for d in defined):
+        return
+    for call, tail in kernel_calls:
+        add(call, "GL15",
+            f"{tail}() dispatched with no *_mem_ok/*_kernel_ok "
+            "admission guard anywhere in this module — the HBM-"
+            "streaming tiers must decline shapes their transients "
+            "can't afford (the lut_scan/gather_refine/ring_topk "
+            "convention, robust.degrade counts the declines)")
+
+
+# ---------------------------------------------------------------------------
+# entry
+# ---------------------------------------------------------------------------
+
+def check(tree: ast.Module, parents: _Parents, path: str, add) -> None:
+    """Run GL11–GL15 over one module (called from lint_source)."""
+    _check_gl11(tree, parents, add)
+    _check_gl12(tree, add)
+    _check_gl13(tree, parents, add)
+    _check_gl14(tree, add)
+    _check_gl15(tree, path, add)
