@@ -217,6 +217,14 @@ def hard_config(n: int, n_queries: int, algos):
                                "scan_select": "approx"},
                               {"n_probes": 128, "refine_ratio": 4,
                                "scan_select": "approx"}]
+            # fp8-QLUT recall-delta legs (ISSUE 11): the lut_dtype
+            # triple at FIXED search params — the recorded per-dataset
+            # recall cost backing the fp8 dispatch default
+            # (ivf_pq.resolve_lut_dtype / FP8_LUT_RECALL_FLOOR), held
+            # row-by-row by the benchdiff gate
+            + [{"n_probes": 64, "refine_ratio": 4,
+                "scan_select": "approx", "lut_dtype": dt}
+               for dt in ("float32", "bfloat16", "float8_e4m3")]
             + _small_batch_legs({"n_probes": 64, "refine_ratio": 4,
                                  "scan_select": "approx"}, n_queries),
         })

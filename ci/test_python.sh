@@ -170,6 +170,33 @@ assert snap["counters"].get("faults.fired{kind=oom,site=ivf_pq.search}",
                             0) >= 1, snap["counters"]
 print("chaos OOM OK: ladder completed via halve_batch, results match, "
       "degrade.steps + faults.fired recorded")
+
+# 1b. three injected OOMs walk halve_batch → bf16_lut → fp8_lut
+#     (ISSUE 11's new rung): the request completes, the walk is
+#     counted, and results equal the fp8-configuration run without
+#     faults (the rung is the documented precision trade; batch
+#     splitting stays exact).
+import dataclasses
+
+sp8 = dataclasses.replace(sp, lut_dtype="float8_e4m3")
+d8a, i8a = ivf_pq.search(idx, x[:32], 40, sp8)
+d8b, i8b = ivf_pq.search(idx, x[32:64], 40, sp8)
+reg2 = MetricsRegistry()
+obs.enable(registry=reg2, hbm=False)
+faults.install_plan({"faults": [
+    {"site": "ivf_pq.search", "kind": "oom", "times": 3}]})
+try:
+    d_f8, i_f8 = ivf_pq.search_resilient(idx, x[:64], 40, sp)
+finally:
+    faults.clear_plan()
+    obs.disable()
+np.testing.assert_array_equal(
+    np.asarray(i_f8), np.concatenate([np.asarray(i8a), np.asarray(i8b)]))
+c2 = reg2.snapshot()["counters"]
+assert c2.get("degrade.steps{from=bf16_lut,reason=resource_exhausted,"
+              "site=ivf_pq.search,to=fp8_lut}", 0) == 1, c2
+print("chaos OOM OK (fp8 rung): 3 OOMs walked halve_batch -> bf16_lut "
+      "-> fp8_lut; results equal the fault-free fp8 configuration")
 EOF
 python - <<'EOF'
 # 2. injected SIGTERM mid-build_chunked, then resume=True: the resumed

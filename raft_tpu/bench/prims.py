@@ -339,36 +339,74 @@ def bench_refine(grid=None, iters: int = 3) -> List[PrimResult]:
     return rows
 
 
-def measure_merge_tier(mesh, x, q, k: int, tier: str, iters: int = 3):
+def measure_merge_tier(mesh, x, q, k: int, tier: str, iters: int = 3,
+                       schedule: Optional[str] = None,
+                       with_cost: bool = False):
     """Measure ONE cross-shard merge tier through sharded kNN on
-    ``mesh``: returns ``(median ms per call, merge-phase comms bytes)``.
+    ``mesh``: returns ``(median ms per call, merge-phase comms bytes,
+    cost)`` where ``cost`` is the PR-9 roofline attribution of the
+    measured ring/merge program (an ``obs.prof.ProgramCost``, or
+    ``None`` when ``with_cost`` is off or the closure won't lower).
     The single harness behind both the prims `ring_merge` rows and the
     dryrun's MULTICHIP scaling rows — byte-model or dispatch changes
     land in one place. Jits once so timed calls hit the cache (a bare
     ``sharded_knn`` call rebuilds its shard_map closure and re-traces
     every call — that would time the tracer), and enables a private
     registry only around the tracing call so the per-trace comms
-    counters attribute exactly one merge."""
+    counters attribute exactly one merge.
+
+    ``schedule`` env-forces the ring kernel's hop schedule
+    (``RAFT_TPU_RING_OVERLAP``: "overlap" → on, "serial" → off) around
+    BOTH the trace and the timed calls — the dispatch is read at trace
+    time, so the force must cover the jit."""
+    import os
+
     from raft_tpu import obs
     from raft_tpu.obs import spans as _spans
     from raft_tpu.obs.metrics import MetricsRegistry
     from raft_tpu.parallel import sharded_knn
 
     op = "ring_topk" if tier == "ring" else "allgather"
-    fn = jax.jit(lambda xx, qq: sharded_knn(xx, qq, k, mesh, merge=tier))
-    reg = MetricsRegistry()
-    prev = _spans._state()  # a RAFT_TPU_OBS=1 enable must survive this
+    prev_env = os.environ.get("RAFT_TPU_RING_OVERLAP")
+    if schedule is not None:
+        os.environ["RAFT_TPU_RING_OVERLAP"] = (
+            "on" if schedule == "overlap" else "off")
     try:
-        obs.enable(registry=reg, hbm=False)
-        jax.block_until_ready(fn(x, q))
+        fn = jax.jit(
+            lambda xx, qq: sharded_knn(xx, qq, k, mesh, merge=tier))
+        reg = MetricsRegistry()
+        prev = _spans._state()  # a RAFT_TPU_OBS=1 enable must survive
+        try:
+            obs.enable(registry=reg, hbm=False)
+            # the ONE trace: per-trace comms counters attribute exactly
+            # one merge, and the AOT-compiled program below is what the
+            # timed loop AND the cost attribution both use (PR-9 rule:
+            # cost columns describe the measured program) — no second
+            # trace, no second XLA compile
+            compiled = fn.lower(x, q).compile()
+        finally:
+            _spans._restore(prev)
+        c = reg.snapshot()["counters"]
+        merge_bytes = sum(
+            v for key, v in c.items()
+            if key.startswith("comms.bytes{") and f"op={op}" in key)
+        ms = _time(lambda: compiled(x, q)[0], iters=iters, warmup=1)
+        cost = None
+        if with_cost:
+            from raft_tpu.obs import prof as _prof
+
+            try:
+                cost = _prof.analyze_compiled(compiled,
+                                              elapsed_s=ms / 1e3)
+            except Exception:
+                cost = None
     finally:
-        _spans._restore(prev)
-    c = reg.snapshot()["counters"]
-    merge_bytes = sum(
-        v for key, v in c.items()
-        if key.startswith("comms.bytes{") and f"op={op}" in key)
-    ms = _time(lambda: fn(x, q)[0], iters=iters, warmup=1)
-    return ms, int(merge_bytes)
+        if schedule is not None:
+            if prev_env is None:
+                os.environ.pop("RAFT_TPU_RING_OVERLAP", None)
+            else:
+                os.environ["RAFT_TPU_RING_OVERLAP"] = prev_env
+    return ms, int(merge_bytes), cost
 
 
 def bench_ring_merge(grid=None, iters: int = 3) -> List[PrimResult]:
@@ -378,9 +416,16 @@ def bench_ring_merge(grid=None, iters: int = 3) -> List[PrimResult]:
     sharded kNN over the full local mesh with the merge tier forced,
     and decomposes the merge's interconnect cost from the PR-5
     ``comms.bytes`` counters (allgather: the materialized table; ring:
-    n_dev−1 surviving-block hops). Off-TPU the ring rides the ppermute
-    fallback — identical schedule and identical counted bytes, wall
-    time is CPU-mesh-shaped."""
+    n_dev−1 surviving-block hops). The ring tier measures BOTH hop
+    schedules (``ring_serial`` = the PR-8 bulk-synchronous exchange,
+    ``ring_overlap`` = the half-pipelined compute/comms-overlapped
+    schedule, env-forced per row) plus the PR-9 roofline attribution
+    of the measured ring program (flops/bytes/bound columns). Off-TPU
+    the ring rides the ppermute fallback — identical schedule and
+    identical counted bytes; wall time is CPU-mesh-shaped and the two
+    schedule rows measure the same fallback program (the overlap is a
+    kernel-internal property), so the comparison column is only
+    load-bearing on real TPU rows."""
     from raft_tpu.parallel import make_mesh
 
     n_dev = len(jax.devices())
@@ -394,16 +439,27 @@ def bench_ring_merge(grid=None, iters: int = 3) -> List[PrimResult]:
     mesh = make_mesh()
     rows: List[PrimResult] = []
     rng = np.random.default_rng(0)
+    legs = (("allgather", "allgather", None),
+            ("ring", "ring_serial", "serial"),
+            ("ring", "ring_overlap", "overlap"))
     for n, d, m, k in grid:
         x = jnp.asarray(rng.random((n, d), dtype=np.float32))
         q = jnp.asarray(rng.random((m, d), dtype=np.float32))
-        for tier in ("allgather", "ring"):
-            ms, merge_bytes = measure_merge_tier(mesh, x, q, k, tier,
-                                                 iters=iters)
+        for tier, impl, schedule in legs:
+            ms, merge_bytes, cost = measure_merge_tier(
+                mesh, x, q, k, tier, iters=iters, schedule=schedule,
+                with_cost=True)
+            p = {"n": n, "d": d, "m": m, "k": k, "n_dev": n_dev,
+                 "merge_bytes": merge_bytes}
+            if schedule is not None:
+                p["schedule"] = schedule
+            if cost is not None:
+                p.update(flops=cost.flops,
+                         bytes_accessed=cost.bytes_accessed,
+                         arith_intensity=cost.arithmetic_intensity,
+                         bound=cost.bound)
             rows.append(PrimResult(
-                "ring_merge", tier, ms, m * 1e3 / ms, "queries/s",
-                {"n": n, "d": d, "m": m, "k": k, "n_dev": n_dev,
-                 "merge_bytes": merge_bytes}))
+                "ring_merge", impl, ms, m * 1e3 / ms, "queries/s", p))
     return rows
 
 

@@ -105,9 +105,15 @@ class SearchParams:
     ``lut_dtype``: dtype the query LUT is quantized to before the scan
     contraction — the reference's ``search_params::lut_dtype`` fp8 option
     (detail/ivf_pq_fp_8bit.cuh) trading LUT precision for on-chip
-    footprint. One of "float32" | "bfloat16" | "float8_e4m3". The Pallas
-    LUT-scan tier applies the same knob to its codebook operand (see
-    ops.pallas_kernels.ivfpq_lut_scan_topk).
+    footprint. One of "auto" | "float32" | "bfloat16" | "float8_e4m3".
+    The Pallas LUT-scan tier applies the same knob to its codebook
+    operand (see ops.pallas_kernels.ivfpq_lut_scan_topk). The default
+    "auto" resolves per dispatch (:func:`resolve_lut_dtype`): fp8 for
+    oversampled scans on TPU — the measured-default trade, recall
+    deltas recorded per dataset by the bench lut_dtype legs and held by
+    the benchdiff gate — declining to bf16 when the candidate slack is
+    too thin to absorb fp8's ranking noise, and exact f32 everywhere
+    else.
 
     ``scan_select`` picks the grouped path's selection engine:
     "exact" (reference semantics), "approx" (TPU hardware top-k,
@@ -125,7 +131,7 @@ class SearchParams:
     query_tile: int = 64
     scan_mode: str = "auto"  # "auto" | "grouped" | "per_query"
     list_chunk: int = 64
-    lut_dtype: str = "float32"
+    lut_dtype: str = "auto"  # | "float32" | "bfloat16" | "float8_e4m3"
     # grouped-path per-segment selection: "exact" (reference semantics),
     # "approx" (TPU hardware top-k, recall-targeted; see ivf_flat), or
     # "pallas" (fused LUT-scan kernel over packed codes)
@@ -145,6 +151,51 @@ class SearchParams:
 
 _LUT_DTYPES = {"float32": jnp.float32, "bfloat16": jnp.bfloat16,
                "float8_e4m3": jnp.float8_e4m3fn}
+
+#: Documented recall floor for the fp8-QLUT dispatch default: the
+#: recorded per-dataset recall@10 delta of the fp8 legs (bench conf
+#: ``lut_dtype`` sweeps, held by the benchdiff gate) must stay within
+#: this of the f32 leg at fixed search params. A dataset measured past
+#: the floor runs with ``lut_dtype="bfloat16"`` (or f32) explicitly —
+#: dispatch cannot see recall at runtime, so the floor's static proxy
+#: is candidate slack (:data:`FP8_LUT_MIN_SLACK`): with ≥4× more
+#: scanned candidates than requested neighbors, fp8's LUT rounding
+#: reorders within the oversample margin, not across the cut.
+FP8_LUT_RECALL_FLOOR = 0.01
+#: Minimum candidate slack (n_probes·LUT_SCAN_BINS / k) before "auto"
+#: picks fp8 over bf16 for an oversampled scan.
+FP8_LUT_MIN_SLACK = 4
+
+
+def resolve_lut_dtype(lut_dtype: str, n_probes: int, k: int) -> str:
+    """Resolve ``SearchParams.lut_dtype="auto"`` for one dispatch.
+
+    fp8 QLUTs are the measured default for OVERSAMPLED scans (the
+    LUT-tier auto-upgrade shape: n_probes ≥ 64 or k ≥ 400) on TPU —
+    the reference's fp8 trade (ivf_pq_fp_8bit.cuh) promoted from
+    opt-in to default where the recall cost is bounded (see
+    :data:`FP8_LUT_RECALL_FLOOR`). When the candidate slack is under
+    :data:`FP8_LUT_MIN_SLACK`, dispatch declines to bf16 instead; every
+    other shape keeps exact f32. ``RAFT_TPU_FP8_LUT`` = auto | on | off
+    (tri-state): "on" applies the policy off-TPU too (interpret-mode
+    tests), "off" pins auto to f32. Explicit dtypes pass through
+    untouched; each auto resolution lands in
+    ``ivf_pq.lut.dispatch{dtype=...}``."""
+    if lut_dtype != "auto":
+        return lut_dtype
+    from raft_tpu.ops import pallas_kernels as _pk
+
+    force = _obs_spans.env_tristate("RAFT_TPU_FP8_LUT")
+    oversampled = n_probes >= 64 or k >= 400
+    chosen = "float32"
+    if (force != "off" and oversampled
+            and (force == "on" or _pk._on_tpu())):
+        slack_ok = n_probes * _pk.LUT_SCAN_BINS >= FP8_LUT_MIN_SLACK * k
+        chosen = "float8_e4m3" if slack_ok else "bfloat16"
+    if _obs_spans.enabled():
+        _obs_spans.registry().inc("ivf_pq.lut.dispatch",
+                                  labels={"dtype": chosen})
+    return chosen
 
 
 def _quantize_lut(lut: jax.Array, lut_dtype: str) -> jax.Array:
@@ -1743,6 +1794,17 @@ def search(index, queries: jax.Array, k: int,
     for now."""
     if params is None:
         params = SearchParams()
+    if params.lut_dtype == "auto" and params.refine == "none":
+        # one resolution point for the fp8-default policy: every scan
+        # tier below (LUT kernel, staged, grouped, per-query) and the
+        # sharded dispatch receive a concrete dtype. Refined searches
+        # resolve at the _route_refined RE-ENTRY instead, where k is
+        # the oversampled k_cand = k·refine_ratio — the selection
+        # width the fp8 slack floor (FP8_LUT_MIN_SLACK) is defined
+        # over; resolving here with the final k would overstate the
+        # slack by refine_ratio×
+        params = dataclasses.replace(params, lut_dtype=resolve_lut_dtype(
+            "auto", min(params.n_probes, index.n_lists), k))
     from raft_tpu.neighbors import ivf_common as ic
 
     _divf = ic.sharded_dispatch(index, mesh, "ShardedIvfPq")
@@ -1889,7 +1951,8 @@ def search_resilient(index: IvfPqIndex, queries: jax.Array, k: int,
                      dataset=None) -> Tuple[jax.Array, jax.Array]:
     """:func:`search` behind the standard degradation ladder
     (:mod:`raft_tpu.robust.degrade`): a ``RESOURCE_EXHAUSTED`` walks
-    halve-batch → bf16 LUT → decline fused tier → host gather (then
+    halve-batch → bf16 LUT → fp8 LUT → decline fused tier → host
+    gather (then
     keeps halving) instead of crashing the request, recording every
     move in ``degrade.steps{site=ivf_pq.search,from=,to=,reason=}``.
     Results are the degraded configuration's results — batch splitting
@@ -1899,6 +1962,18 @@ def search_resilient(index: IvfPqIndex, queries: jax.Array, k: int,
     to a silently degraded number keep calling :func:`search`."""
     if params is None:
         params = SearchParams()
+    if params.lut_dtype == "auto":
+        # resolve BEFORE the ladder, exactly as the wrapped search
+        # would (refined searches select over k_cand = k·refine_ratio):
+        # the LUT rungs must see the concrete dtype dispatch runs with
+        # — on a TPU oversampled shape "auto" is already fp8, and
+        # pinning bf16 over that would ENLARGE the operand under the
+        # very memory pressure the ladder exists to relieve (both LUT
+        # rungs correctly skip instead)
+        kr = k if params.refine == "none" else max(
+            k, int(round(k * params.refine_ratio)))
+        params = dataclasses.replace(params, lut_dtype=resolve_lut_dtype(
+            "auto", min(params.n_probes, index.n_lists), kr))
     queries = jnp.asarray(queries)
     return _degrade.run_with_degradation(
         _degrade.batched_search_call(search, index, queries, k,

@@ -526,6 +526,153 @@ def _roll_lanes(x: jax.Array, sh: int) -> jax.Array:
     return jnp.concatenate([x[:, -sh:], x[:, :-sh]], axis=1)
 
 
+def _lut_unpack_codes(bytes_f, sel_lo, sel_hi, off_row, pq_bits: int,
+                      K: int):
+    """In-kernel unpack_bits: stored byte rows → integer code values via
+    the exact f32 selection matmuls (Mosaic has no lane gather) plus
+    integer shift/mask. ``bytes_f`` [Rt, Wb] f32; returns [Rt, G·S]
+    i32. Shared by the standalone LUT-scan kernel and the fused
+    scan-in-ring kernel."""
+    lo = jax.lax.dot_general(
+        bytes_f, sel_lo, (((1,), (0,)), ((), ())),
+        precision=jax.lax.Precision.HIGHEST,
+        preferred_element_type=jnp.float32)          # [Rt, G·S]
+    if pq_bits == 8:
+        return lo.astype(jnp.int32)
+    hi = jax.lax.dot_general(
+        bytes_f, sel_hi, (((1,), (0,)), ((), ())),
+        precision=jax.lax.Precision.HIGHEST,
+        preferred_element_type=jnp.float32)
+    v16 = lo.astype(jnp.int32) | (hi.astype(jnp.int32) << 8)
+    return jax.lax.shift_right_logical(v16, off_row) & (K - 1)
+
+
+def _lut_tile_update(code, qv, qc, ids_row, norms_row, cbp_ref, t,
+                     state, *, metric: str, pq_bits: int, S: int,
+                     P: int, G: int, Sg: int, Kc: int, L: int, Rt: int,
+                     rot: int, rotp: int, exact: bool, key_bias=None):
+    """One code tile's ADC + 2-deep strided-bin update — the shared
+    compute body of the LUT scan (steps 3–4 of
+    :func:`_ivfpq_lut_scan_kernel`'s docstring), factored so the fused
+    scan-in-ring kernel runs the identical math per tile.
+
+    ``code`` [Rt, G·S] i32 unpacked code values; ``qv`` [rows, rotp]
+    f32 rotated queries; ``qc`` [rows] ⟨q, c⟩; ``ids_row``/``norms_row``
+    [1, G·Rt]; ``cbp_ref`` the grouped block-diagonal codebook operand
+    (indexable per subspace group); ``t`` the code-tile index within
+    the list (traced or static); ``state`` = (b1k, b1i, b2k, b2i)
+    running 2-deep bin values; ``key_bias`` an optional [rows, 1]
+    additive key column (the fused ring mode's per-query probe mask —
+    un-probed rows get +``_LUT_MASK_BIG``). Returns the updated
+    state."""
+    rows = qv.shape[0]
+    n_sg = S // Sg
+    slabs = Rt // _LANES
+    K = 1 << pq_bits
+    opd = jnp.float32 if exact else jnp.bfloat16
+    prec = (jax.lax.Precision.HIGHEST if exact
+            else jax.lax.Precision.DEFAULT)
+    b1k, b1i, b2k, b2i = state
+    one = jnp.asarray(1.0, opd)
+    zero = jnp.asarray(0.0, opd)
+    for si in range(slabs):
+        for g in range(G):
+            # decode this slab's fold group in VMEM: [128, rot]
+            parts = []
+            for sg in range(n_sg):
+                cs = jax.lax.slice(
+                    code, (si * _LANES, g * S + sg * Sg),
+                    ((si + 1) * _LANES, g * S + (sg + 1) * Sg))
+                tiled = cs
+                for _ in range(Kc.bit_length() - 1):
+                    tiled = jnp.concatenate([tiled, tiled], axis=1)
+                acc = jnp.zeros((_LANES, Sg * P), jnp.float32)
+                for kc in range(K // Kc):
+                    kidx = (jax.lax.broadcasted_iota(
+                        jnp.int32, (_LANES, Kc * Sg), 1) // Sg + kc * Kc)
+                    oh = jnp.where(tiled == kidx, one, zero)
+                    cbp = jax.lax.slice(
+                        cbp_ref[sg], (kc * Kc * Sg, 0),
+                        ((kc + 1) * Kc * Sg, Sg * P))
+                    acc = acc + jax.lax.dot_general(
+                        oh, cbp, (((1,), (0,)), ((), ())),
+                        precision=prec,
+                        preferred_element_type=jnp.float32)
+                parts.append(acc)
+            if rotp > rot:
+                parts.append(jnp.zeros((_LANES, rotp - rot), jnp.float32))
+            dec = jnp.concatenate(parts, axis=1)     # [128, rotp]
+            qd = jax.lax.dot_general(
+                qv, dec, (((1,), (1,)), ((), ())),
+                precision=prec,
+                preferred_element_type=jnp.float32)  # [rows, 128] ⟨q, d⟩
+            lane0 = G * si * _LANES + g
+            ids_g = _lane_pick(ids_row, lane0, G, _LANES)      # [1, 128]
+            # list position of lane r: G·(t·Rt + si·128 + r) + g — OOB
+            # tail lanes of the last tile carry garbage, mask them
+            l_pos = (t * Rt + si * _LANES) * G + g \
+                + G * jax.lax.broadcasted_iota(jnp.int32, (1, _LANES), 1)
+            valid = (ids_g >= 0) & (l_pos < L)
+            if metric == "ip":
+                key = -(qc[:, None] + qd)
+            else:  # l2: ‖c+d‖² − 2⟨q, c+d⟩ (caller adds ‖q‖²)
+                norms_g = _lane_pick(norms_row, lane0, G, _LANES)
+                key = norms_g - 2.0 * (qc[:, None] + qd)
+            key = jnp.where(valid, key, jnp.inf)
+            if key_bias is not None:
+                key = key + key_bias
+            idv = jnp.broadcast_to(jnp.where(valid, ids_g, -1),
+                                   (rows, _LANES))
+            # spread fold groups across bins: lane rotate by g·(128/G)
+            sh = g * (_LANES // G)
+            kn = _roll_lanes(key, sh)
+            inew = _roll_lanes(idv, sh)
+            # 2-deep running bin merge
+            lt1 = kn < b1k
+            lt2 = jnp.logical_and(jnp.logical_not(lt1), kn < b2k)
+            b2k = jnp.where(lt1, b1k, jnp.where(lt2, kn, b2k))
+            b2i = jnp.where(lt1, b1i, jnp.where(lt2, inew, b2i))
+            b1k = jnp.where(lt1, kn, b1k)
+            b1i = jnp.where(lt1, inew, b1i)
+    return b1k, b1i, b2k, b2i
+
+
+def _lut_scan_operands(codebooks: jax.Array, pq_bits: int, nb: int,
+                       Wb: int, G: int, Sg: int, lut_dtype: str):
+    """Host-side operand prep shared by the standalone LUT scan and the
+    fused scan-in-ring kernel: the byte-column selection matrices +
+    per-column shift row feeding :func:`_lut_unpack_codes`, and the
+    grouped block-diagonal codebook operand feeding
+    :func:`_lut_tile_update` (``cbp[gi, k·Sg + j, j·P : (j+1)·P] =
+    cb[gi·Sg + j, k]`` — the one-hot's lane order is k-major, then j).
+    One construction site keeps the two kernels' operands bit-identical
+    — the fused tier's exact-parity contract with the standalone tier
+    rides on it. Returns (sel_lo, sel_hi, off_arr, cbp)."""
+    S, K, P = codebooks.shape
+    s_idx = np.arange(S)
+    byte_idx = (s_idx * pq_bits) // 8
+    off_np = ((s_idx * pq_bits) % 8).astype(np.int32)
+    sel_lo = np.zeros((Wb, G * S), np.float32)
+    sel_hi = np.zeros((Wb, G * S), np.float32)
+    for g in range(G):
+        for s in range(S):
+            sel_lo[g * nb + byte_idx[s], g * S + s] = 1.0
+            if byte_idx[s] + 1 < nb:
+                sel_hi[g * nb + byte_idx[s] + 1, g * S + s] = 1.0
+    off_arr = jnp.asarray(np.tile(off_np, G)[None, :])
+    opd = jnp.float32 if lut_dtype == "float32" else jnp.bfloat16
+    cb = codebooks.astype(jnp.float32)
+    if lut_dtype == "float8_e4m3":
+        cb = cb.astype(jnp.float8_e4m3fn).astype(jnp.bfloat16)
+    n_sg = S // Sg
+    cb_t = cb.reshape(n_sg, Sg, K, P).transpose(0, 2, 1, 3)
+    eye = jnp.eye(Sg, dtype=jnp.float32)
+    cbp = (cb_t.astype(jnp.float32)[:, :, :, None, :]
+           * eye[None, None, :, :, None]).reshape(
+               n_sg, K * Sg, Sg * P).astype(opd)
+    return jnp.asarray(sel_lo), jnp.asarray(sel_hi), off_arr, cbp
+
+
 def _ivfpq_lut_scan_kernel(seg_list_ref, qv_ref, codes_ref, ids_ref,
                            norms_ref, ctr_ref, sel_lo_ref, sel_hi_ref,
                            off_ref, cbp_ref, keys_ref, oids_ref, *,
@@ -562,14 +709,8 @@ def _ivfpq_lut_scan_kernel(seg_list_ref, qv_ref, codes_ref, ids_ref,
     """
     t = pl.program_id(1)
     seg = qv_ref.shape[1]
-    Wb = codes_ref.shape[2]
     K = 1 << pq_bits
     rotp = qv_ref.shape[2]
-    n_sg = S // Sg
-    slabs = Rt // _LANES
-    opd = jnp.float32 if exact else jnp.bfloat16
-    prec = (jax.lax.Precision.HIGHEST if exact
-            else jax.lax.Precision.DEFAULT)
 
     @pl.when(t == 0)
     def _init():
@@ -579,93 +720,23 @@ def _ivfpq_lut_scan_kernel(seg_list_ref, qv_ref, codes_ref, ids_ref,
     qv = qv_ref[0]                                   # [seg, rotp] f32
     ctr = ctr_ref[:]                                 # [1, rotp] f32
     qc = jnp.sum(qv * ctr, axis=1)                   # [seg] ⟨q, c⟩
-    ids_row = ids_ref[:]                             # [1, G·Rt] i32
-    norms_row = norms_ref[:]                         # [1, G·Rt] f32
 
     # bytes → code values: selection matmul (exact: values ≤ 255 in f32)
     # then integer shift/mask — the in-kernel unpack_bits
     bytes_f = codes_ref[0].astype(jnp.int32).astype(jnp.float32)
-    lo = jax.lax.dot_general(
-        bytes_f, sel_lo_ref[:], (((1,), (0,)), ((), ())),
-        precision=jax.lax.Precision.HIGHEST,
-        preferred_element_type=jnp.float32)          # [Rt, G·S]
-    if pq_bits == 8:
-        code = lo.astype(jnp.int32)
-    else:
-        hi = jax.lax.dot_general(
-            bytes_f, sel_hi_ref[:], (((1,), (0,)), ((), ())),
-            precision=jax.lax.Precision.HIGHEST,
-            preferred_element_type=jnp.float32)
-        v16 = lo.astype(jnp.int32) | (hi.astype(jnp.int32) << 8)
-        code = jax.lax.shift_right_logical(v16, off_ref[:]) & (K - 1)
+    code = _lut_unpack_codes(bytes_f, sel_lo_ref[:], sel_hi_ref[:],
+                             off_ref[:], pq_bits, K)
 
     cur_k = keys_ref[0]                              # [seg, 256]
     cur_i = oids_ref[0]
-    b1k = jax.lax.slice(cur_k, (0, 0), (seg, _LANES))
-    b2k = jax.lax.slice(cur_k, (0, _LANES), (seg, 2 * _LANES))
-    b1i = jax.lax.slice(cur_i, (0, 0), (seg, _LANES))
-    b2i = jax.lax.slice(cur_i, (0, _LANES), (seg, 2 * _LANES))
-
-    one = jnp.asarray(1.0, opd)
-    zero = jnp.asarray(0.0, opd)
-    for si in range(slabs):
-        for g in range(G):
-            # decode this slab's fold group in VMEM: [128, rot]
-            parts = []
-            for sg in range(n_sg):
-                cs = jax.lax.slice(
-                    code, (si * _LANES, g * S + sg * Sg),
-                    ((si + 1) * _LANES, g * S + (sg + 1) * Sg))
-                tiled = cs
-                for _ in range(Kc.bit_length() - 1):
-                    tiled = jnp.concatenate([tiled, tiled], axis=1)
-                acc = jnp.zeros((_LANES, Sg * P), jnp.float32)
-                for kc in range(K // Kc):
-                    kidx = (jax.lax.broadcasted_iota(
-                        jnp.int32, (_LANES, Kc * Sg), 1) // Sg + kc * Kc)
-                    oh = jnp.where(tiled == kidx, one, zero)
-                    cbp = jax.lax.slice(
-                        cbp_ref[sg], (kc * Kc * Sg, 0),
-                        ((kc + 1) * Kc * Sg, Sg * P))
-                    acc = acc + jax.lax.dot_general(
-                        oh, cbp, (((1,), (0,)), ((), ())),
-                        precision=prec,
-                        preferred_element_type=jnp.float32)
-                parts.append(acc)
-            if rotp > rot:
-                parts.append(jnp.zeros((_LANES, rotp - rot), jnp.float32))
-            dec = jnp.concatenate(parts, axis=1)     # [128, rotp]
-            qd = jax.lax.dot_general(
-                qv, dec, (((1,), (1,)), ((), ())),
-                precision=prec,
-                preferred_element_type=jnp.float32)  # [seg, 128] ⟨q, d⟩
-            lane0 = G * si * _LANES + g
-            ids_g = _lane_pick(ids_row, lane0, G, _LANES)      # [1, 128]
-            # list position of lane r: G·(t·Rt + si·128 + r) + g — OOB
-            # tail lanes of the last tile carry garbage, mask them
-            l_pos = (t * Rt + si * _LANES) * G + g + G * jax.lax.broadcasted_iota(
-                jnp.int32, (1, _LANES), 1)
-            valid = (ids_g >= 0) & (l_pos < L)
-            if metric == "ip":
-                key = -(qc[:, None] + qd)
-            else:  # l2: ‖c+d‖² − 2⟨q, c+d⟩ (caller adds ‖q‖²)
-                norms_g = _lane_pick(norms_row, lane0, G, _LANES)
-                key = norms_g - 2.0 * (qc[:, None] + qd)
-            key = jnp.where(valid, key, jnp.inf)
-            idv = jnp.broadcast_to(jnp.where(valid, ids_g, -1),
-                                   (seg, _LANES))
-            # spread fold groups across bins: lane rotate by g·(128/G)
-            sh = g * (_LANES // G)
-            kn = _roll_lanes(key, sh)
-            inew = _roll_lanes(idv, sh)
-            # 2-deep running bin merge
-            lt1 = kn < b1k
-            lt2 = jnp.logical_and(jnp.logical_not(lt1), kn < b2k)
-            b2k = jnp.where(lt1, b1k, jnp.where(lt2, kn, b2k))
-            b2i = jnp.where(lt1, b1i, jnp.where(lt2, inew, b2i))
-            b1k = jnp.where(lt1, kn, b1k)
-            b1i = jnp.where(lt1, inew, b1i)
-
+    state = (jax.lax.slice(cur_k, (0, 0), (seg, _LANES)),
+             jax.lax.slice(cur_i, (0, 0), (seg, _LANES)),
+             jax.lax.slice(cur_k, (0, _LANES), (seg, 2 * _LANES)),
+             jax.lax.slice(cur_i, (0, _LANES), (seg, 2 * _LANES)))
+    b1k, b1i, b2k, b2i = _lut_tile_update(
+        code, qv, qc, ids_ref[:], norms_ref[:], cbp_ref, t, state,
+        metric=metric, pq_bits=pq_bits, S=S, P=P, G=G, Sg=Sg, Kc=Kc,
+        L=L, Rt=Rt, rot=rot, rotp=rotp, exact=exact)
     keys_ref[0] = jnp.concatenate([b1k, b2k], axis=1)
     oids_ref[0] = jnp.concatenate([b1i, b2i], axis=1)
 
@@ -727,7 +798,6 @@ def ivfpq_lut_scan_topk(seg_list: jax.Array, qv: jax.Array,
             f"nb={nb} Wb={Wb} (gate with pallas_lut_scan_wanted)")
     G, Sg, Kc = cfg
     exact = lut_dtype == "float32"
-    opd = jnp.float32 if exact else jnp.bfloat16
 
     R = packed.shape[1]
     Rt = 2 * _LANES if R >= 2 * _LANES else _LANES
@@ -742,30 +812,9 @@ def ivfpq_lut_scan_topk(seg_list: jax.Array, qv: jax.Array,
     segp, rotp = qvp.shape[1], qvp.shape[2]
     ctr = _pad_to(centers_rot.astype(jnp.float32), _LANES, 1, 0.0)
 
-    # unpack selection matrices + per-column shift amounts (static)
-    s_idx = np.arange(S)
-    byte_idx = (s_idx * pq_bits) // 8
-    off_np = ((s_idx * pq_bits) % 8).astype(np.int32)
-    sel_lo = np.zeros((Wb, G * S), np.float32)
-    sel_hi = np.zeros((Wb, G * S), np.float32)
-    for g in range(G):
-        for s in range(S):
-            sel_lo[g * nb + byte_idx[s], g * S + s] = 1.0
-            if byte_idx[s] + 1 < nb:
-                sel_hi[g * nb + byte_idx[s] + 1, g * S + s] = 1.0
-    off_arr = jnp.asarray(np.tile(off_np, G)[None, :])
-
-    # grouped block-diagonal codebooks: cbp[gi, k·Sg + j, j·P : (j+1)·P]
-    # = cb[gi·Sg + j, k] — the one-hot's lane order is (k-major, then j)
-    cb = codebooks.astype(jnp.float32)
-    if lut_dtype == "float8_e4m3":
-        cb = cb.astype(jnp.float8_e4m3fn).astype(jnp.bfloat16)
+    sel_lo, sel_hi, off_arr, cbp = _lut_scan_operands(
+        codebooks, pq_bits, nb, Wb, G, Sg, lut_dtype)
     n_sg = S // Sg
-    cb_t = cb.reshape(n_sg, Sg, K, P).transpose(0, 2, 1, 3)
-    eye = jnp.eye(Sg, dtype=jnp.float32)
-    cbp = (cb_t.astype(jnp.float32)[:, :, :, None, :]
-           * eye[None, None, :, :, None]).reshape(
-               n_sg, K * Sg, Sg * P).astype(opd)
 
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=1,
@@ -800,7 +849,7 @@ def ivfpq_lut_scan_topk(seg_list: jax.Array, qv: jax.Array,
         ],
         interpret=interpret,
     )(seg_list.astype(jnp.int32), qvp, packed, ids, norms, ctr,
-      jnp.asarray(sel_lo), jnp.asarray(sel_hi), off_arr, cbp)
+      sel_lo, sel_hi, off_arr, cbp)
     return keys[:, :seg], kids[:, :seg]
 
 
@@ -1179,42 +1228,68 @@ def ring_topk_kernel_ok(m: int, k: int, n_dev: int) -> bool:
     return vmem <= _RING_VMEM_BUDGET
 
 
+def ring_topk_splits(mc: int, schedule: str) -> Tuple[Tuple[int, int], ...]:
+    """Row sub-blocks of one [mc, kpad] hop block, as (offset, rows)
+    pairs. The ``serial`` schedule is one block — the PR-8 bulk-
+    synchronous ring. The ``overlap`` schedule splits the block into two
+    sublane-aligned halves so each half's hop-(s+1) transfer can start
+    as soon as ITS merge lands, while the other half of hop s is still
+    being merged — the compute/comms overlap. Chunks too short to split
+    (mc < 16) degenerate to one block either way; the byte model is
+    untouched (same rows cross the link per hop, in 2 DMAs instead
+    of 1)."""
+    if schedule == "serial" or mc < 2 * _SUBLANES:
+        return ((0, mc),)
+    mh = (mc // 2 // _SUBLANES) * _SUBLANES
+    return ((0, mh), (mh, mc - mh))
+
+
 def _ring_topk_kernel(vals_hbm, ids_hbm, out_v_ref, out_i_ref,
                       buf_v, buf_i, run_v, run_i, loc_v, loc_i,
                       send_sems, recv_sems, cap_sems, copy_sems, *,
                       k: int, n_dev: int, mc: int, axis_name: str,
-                      flow_control: bool):
+                      flow_control: bool, splits):
     """One device's program of the ring reduce-scatter-of-top-k.
 
     The local [n_dev·mc, kpad] candidate table lives in HBM; chunk ``c``
     (rows [c·mc, (c+1)·mc)) is query chunk ``c``'s local top-k. Chunk
     ``c``'s partial starts at device ``(c+1) mod n_dev`` and travels the
     ring for ``n_dev−1`` hops, merged against each host device's local
-    chunk on the way, landing fully merged at its owner ``c``. Per hop:
+    chunk on the way, landing fully merged at its owner ``c``.
 
-    1. the running block (vals + ids) streams to the right neighbor's
-       recv slot via async remote DMA, and the owning chunk's local
-       block starts its HBM→VMEM copies in the same breath — the local
-       gather rides under the remote transfer instead of after it;
-    2. recv slots are double-buffered (slot = s mod 2), so the LEFT
-       neighbor — which may run a hop ahead — can land hop s+1's block
-       in slot (s+1)%2 while this device still merges slot s%2;
-    3. once both transfers land, the k-round extraction merge
-       (``_extract_topk_block``, the gather-refine merge) reduces
-       incoming ++ local to the surviving top-k — the only bytes hop
-       s+1 ever ships. The send wait stays ahead of the merge by
-       necessity: the merge overwrites the running block the send
-       reads.
+    The hop block is cut into ``splits`` row sub-blocks (see
+    :func:`ring_topk_splits`) and the schedule is software-pipelined
+    across the hop boundary, per sub-block ``h``:
 
-    ``flow_control``: on real hardware a capacity semaphore guards slot
-    reuse (the right neighbor confirms it consumed slot s%2 before the
-    step-s+2 send restarts it) and a neighbor barrier aligns kernel
-    entry; interpret mode executes remote copies synchronously and
-    implements neither remote signal, so both are compiled out there.
+    1. hop s's transfers for ``h`` were started at the END of hop s−1
+       (prologue for hop 0), so they are in flight while hop s−1's
+       later sub-blocks are still being merged — with two halves, hop
+       s's exchange rides under hop s−1's on-chip merge work and vice
+       versa. The owning chunk's local HBM→VMEM copies start in the
+       same breath and hide under the same transfer.
+    2. recv slots are double-buffered (slot = s mod 2) per sub-block,
+       so the LEFT neighbor — which may run a hop ahead — can land hop
+       s+1's half in slot (s+1)%2 while this device still merges slot
+       s%2;
+    3. waits gate only slot reuse: the send wait (running sub-block
+       about to be overwritten by ITS merge), the recv wait (this
+       half's incoming partial landed — SPMD symmetry), and the local
+       copy wait. Then the k-round extraction merge
+       (``_extract_topk_block``) reduces incoming ++ local to the
+       surviving top-k, and the NEXT hop's send/recv pair for this
+       half starts immediately — before the next half's merge runs.
+
+    ``flow_control``: on real hardware a capacity semaphore per
+    (slot, half) guards slot reuse (the right neighbor confirms it
+    consumed (s, h) before the step-s+2 send restarts that slot) and a
+    neighbor barrier aligns kernel entry; interpret mode executes
+    remote copies synchronously and implements neither remote signal,
+    so both are compiled out there.
     """
     my = jax.lax.axis_index(axis_name)
     right = jax.lax.rem(my + 1, n_dev)
     left = jax.lax.rem(my + n_dev - 1, n_dev)
+    H = len(splits)
 
     if flow_control:
         barrier = pltpu.get_barrier_semaphore()
@@ -1224,61 +1299,105 @@ def _ring_topk_kernel(vals_hbm, ids_hbm, out_v_ref, out_i_ref,
                                device_id_type=pltpu.DeviceIdType.LOGICAL)
         pltpu.semaphore_wait(barrier, 2)
 
-    def chunk_copy(hbm, dst, c, which):
+    def chunk_copy(hbm, dst, c, h, which):
+        off, rows = splits[h]
         return pltpu.make_async_copy(
-            hbm.at[pl.ds(c * mc, mc)], dst, copy_sems.at[which])
+            hbm.at[pl.ds(c * mc + off, rows)],
+            dst.at[pl.ds(off, rows)], copy_sems.at[h, which])
 
-    # init: this device starts chunk (my−1)'s journey with its local block
-    c0 = jax.lax.rem(my + n_dev - 1, n_dev)
-    chunk_copy(vals_hbm, run_v, c0, 0).start()
-    chunk_copy(ids_hbm, run_i, c0, 1).start()
-    chunk_copy(vals_hbm, run_v, c0, 0).wait()
-    chunk_copy(ids_hbm, run_i, c0, 1).wait()
-
-    def ring_send(src, dst, slot, which):
+    def ring_send(slot, h, which):
+        off, rows = splits[h]
+        src = run_v if which == 0 else run_i
+        dst = buf_v if which == 0 else buf_i
         return pltpu.make_async_remote_copy(
-            src_ref=src, dst_ref=dst,
-            send_sem=send_sems.at[slot, which],
-            recv_sem=recv_sems.at[slot, which],
+            src_ref=src.at[pl.ds(off, rows)],
+            dst_ref=dst.at[slot, pl.ds(off, rows)],
+            send_sem=send_sems.at[slot, h, which],
+            recv_sem=recv_sems.at[slot, h, which],
             device_id=right,
             device_id_type=pltpu.DeviceIdType.LOGICAL)
 
+    def hop_chunk(s):
+        # the partial arriving at hop s is chunk (my − s − 2)'s
+        return jax.lax.rem(my + 2 * n_dev - s - 2, n_dev)
+
+    # init: this device starts chunk (my−1)'s journey with its local block
+    c0 = jax.lax.rem(my + n_dev - 1, n_dev)
+    for h in range(H):
+        chunk_copy(vals_hbm, run_v, c0, h, 0).start()
+        chunk_copy(ids_hbm, run_i, c0, h, 1).start()
+    for h in range(H):
+        chunk_copy(vals_hbm, run_v, c0, h, 0).wait()
+        chunk_copy(ids_hbm, run_i, c0, h, 1).wait()
+
+    # prologue: hop 0's sends + local-chunk copies, all sub-blocks
+    for h in range(H):
+        ring_send(0, h, 0).start()
+        ring_send(0, h, 1).start()
+        chunk_copy(vals_hbm, loc_v, hop_chunk(0), h, 0).start()
+        chunk_copy(ids_hbm, loc_i, hop_chunk(0), h, 1).start()
+
     for s in range(n_dev - 1):  # static unroll: n_dev−1 hops
         slot = s % 2
-        if flow_control and s >= 2:
-            # right neighbor consumed slot s−2 → safe to restart it
-            pltpu.semaphore_wait(cap_sems.at[slot], 1)
-        ring_send(run_v, buf_v.at[slot], slot, 0).start()
-        ring_send(run_i, buf_i.at[slot], slot, 1).start()
-        # the incoming partial is chunk (my − s − 2)'s: start its local
-        # block's HBM→VMEM copies NOW so they overlap the remote
-        # transfer (loc_* was last read by the previous hop's merge,
-        # which completed before this send started)
-        c = jax.lax.rem(my + 2 * n_dev - s - 2, n_dev)
-        chunk_copy(vals_hbm, loc_v, c, 0).start()
-        chunk_copy(ids_hbm, loc_i, c, 1).start()
-        # wait = send_sem (running block reusable) + recv_sem (this hop's
-        # incoming partial landed in MY slot — SPMD symmetry)
-        ring_send(run_v, buf_v.at[slot], slot, 0).wait()
-        ring_send(run_i, buf_i.at[slot], slot, 1).wait()
-        chunk_copy(vals_hbm, loc_v, c, 0).wait()
-        chunk_copy(ids_hbm, loc_i, c, 1).wait()
-        comb_v = jnp.concatenate([buf_v[slot], loc_v[:]], axis=1)
-        comb_i = jnp.concatenate([buf_i[slot], loc_i[:]], axis=1)
-        mv, mi = _extract_topk_block(comb_v, comb_i, k, run_v.shape[1])
-        run_v[:] = mv
-        run_i[:] = mi
-        if flow_control and s + 2 <= n_dev - 2:
-            # tell the left neighbor its slot is free for step s+2
-            pltpu.semaphore_signal(cap_sems.at[slot], inc=1, device_id=left,
-                                   device_id_type=pltpu.DeviceIdType.LOGICAL)
+        nxt = (s + 1) % 2
+        c = hop_chunk(s)
+        for h in range(H):
+            off, rows = splits[h]
+            # waits gate slot reuse only: the send (its merge overwrites
+            # run), the recv (this half's partial landed), the local copy
+            ring_send(slot, h, 0).wait()
+            ring_send(slot, h, 1).wait()
+            chunk_copy(vals_hbm, loc_v, c, h, 0).wait()
+            chunk_copy(ids_hbm, loc_i, c, h, 1).wait()
+            comb_v = jnp.concatenate(
+                [buf_v[slot, pl.ds(off, rows)], loc_v[pl.ds(off, rows)]],
+                axis=1)
+            comb_i = jnp.concatenate(
+                [buf_i[slot, pl.ds(off, rows)], loc_i[pl.ds(off, rows)]],
+                axis=1)
+            mv, mi = _extract_topk_block(comb_v, comb_i, k,
+                                         run_v.shape[1])
+            run_v[pl.ds(off, rows)] = mv
+            run_i[pl.ds(off, rows)] = mi
+            if flow_control and s + 2 <= n_dev - 2:
+                # this half's recv slot is consumed: free it for the
+                # left neighbor's step-s+2 send
+                pltpu.semaphore_signal(
+                    cap_sems.at[slot, h], inc=1, device_id=left,
+                    device_id_type=pltpu.DeviceIdType.LOGICAL)
+            if s + 1 <= n_dev - 2:
+                # start the NEXT hop's pair for this half NOW — before
+                # the next half's merge — so hop s+1's transfer rides
+                # under the remaining hop-s merge work
+                if flow_control and s + 1 >= 2:
+                    # right neighbor consumed (s−1, h) → slot reusable
+                    pltpu.semaphore_wait(cap_sems.at[nxt, h], 1)
+                ring_send(nxt, h, 0).start()
+                ring_send(nxt, h, 1).start()
+                chunk_copy(vals_hbm, loc_v, hop_chunk(s + 1), h,
+                           0).start()
+                chunk_copy(ids_hbm, loc_i, hop_chunk(s + 1), h,
+                           1).start()
     out_v_ref[:] = run_v[:]
     out_i_ref[:] = run_i[:]
 
 
+def ring_schedule(schedule: str = "auto") -> str:
+    """Resolve the ring kernel's hop schedule: ``overlap`` (default —
+    half-pipelined, hop i's merge runs under hop i+1's in-flight remote
+    copy) or ``serial`` (the PR-8 bulk-synchronous schedule, kept for
+    the bench comparison column). ``RAFT_TPU_RING_OVERLAP`` = auto | on
+    | off (tri-state, :func:`raft_tpu.obs.env_tristate`) decides
+    ``auto``; an explicit argument wins."""
+    if schedule in ("overlap", "serial"):
+        return schedule
+    force = _env_tristate("RAFT_TPU_RING_OVERLAP")
+    return "serial" if force == "off" else "overlap"
+
+
 def ring_topk_merge(vals: jax.Array, ids: jax.Array, k: int,
                     axis_name: str, n_dev: int, select_min: bool = True,
-                    interpret: bool = False
+                    interpret: bool = False, schedule: str = "auto"
                     ) -> Tuple[jax.Array, jax.Array]:
     """Ring reduce-scatter-of-top-k over a mesh axis — the Pallas merge
     tier replacing allgather-and-select (reference: knn_merge_parts.cuh
@@ -1295,6 +1414,10 @@ def ring_topk_merge(vals: jax.Array, ids: jax.Array, k: int,
     [m, k]. The allgather buffer is gone: per hop only the surviving
     [mc, k] block crosses the interconnect, counted per hop as
     ``comms.ops/bytes{op=ring_topk}`` by the dispatching merge tier.
+
+    ``schedule`` = auto | overlap | serial (:func:`ring_schedule`):
+    both are exact-parity, the overlap schedule pipelines each hop's
+    merge under the next hop's in-flight exchange.
     """
     m, kin = vals.shape
     if k > kin:
@@ -1304,6 +1427,8 @@ def ring_topk_merge(vals: jax.Array, ids: jax.Array, k: int,
             f"k={k} > {RING_TOPK_MAX_K} (the in-kernel merge is k "
             "extraction rounds per hop — gate with ring_topk_kernel_ok)")
     mc = ring_chunk_rows(m, n_dev)
+    splits = ring_topk_splits(mc, ring_schedule(schedule))
+    H = len(splits)
     m_pad = mc * n_dev
     kpad = _LANES
     keys = vals.astype(jnp.float32)
@@ -1326,7 +1451,7 @@ def ring_topk_merge(vals: jax.Array, ids: jax.Array, k: int,
     out_v, out_i = pl.pallas_call(
         functools.partial(_ring_topk_kernel, k=k, n_dev=n_dev, mc=mc,
                           axis_name=axis_name,
-                          flow_control=not interpret),
+                          flow_control=not interpret, splits=splits),
         in_specs=[
             pl.BlockSpec(memory_space=pltpu.ANY),
             pl.BlockSpec(memory_space=pltpu.ANY),
@@ -1346,10 +1471,10 @@ def ring_topk_merge(vals: jax.Array, ids: jax.Array, k: int,
             pltpu.VMEM((mc, kpad), jnp.int32),
             pltpu.VMEM((mc, kpad), jnp.float32),      # local chunk staging
             pltpu.VMEM((mc, kpad), jnp.int32),
-            pltpu.SemaphoreType.DMA((2, 2)),          # send, per slot×array
-            pltpu.SemaphoreType.DMA((2, 2)),          # recv
-            pltpu.SemaphoreType.REGULAR((2,)),        # slot capacity
-            pltpu.SemaphoreType.DMA((2,)),            # local chunk copies
+            pltpu.SemaphoreType.DMA((2, H, 2)),       # send: slot×half×array
+            pltpu.SemaphoreType.DMA((2, H, 2)),       # recv
+            pltpu.SemaphoreType.REGULAR((2, H)),      # slot capacity
+            pltpu.SemaphoreType.DMA((H, 2)),          # local chunk copies
         ],
         interpret=interpret,
         **kwargs,
@@ -1359,3 +1484,447 @@ def ring_topk_merge(vals: jax.Array, ids: jax.Array, k: int,
     if not select_min:
         res_v = jnp.where(jnp.isinf(res_v), -jnp.inf, -res_v)
     return res_v, res_i
+
+
+# ---------------------------------------------------------------------------
+# fused scan-in-ring: the per-shard LUT scan folded INTO the ring schedule —
+# chunk c_s's list scan hides under hop s's in-flight exchange, and the
+# per-shard [m, k] candidate table handed from the scan stage to the merge
+# never materializes in HBM
+# ---------------------------------------------------------------------------
+
+# Additive key bias marking un-probed (query, list) pairs in the fused
+# scan-in-ring kernel. A finite sentinel rather than +inf: the bias rides
+# through an f32 selection matmul (inf·0 = NaN) and real ADC keys are
+# bounded by the data scale (≪ 1e29), so biased keys are thresholded back
+# to the +inf/-1 empty-slot convention at segment extraction.
+_LUT_MASK_BIG = 1e30
+# Union-probe segments per ring chunk the fused kernel will serve: the
+# scan loop is NS·n_t tiles per chunk, and the [n_dev, NS] list table
+# must fit SMEM.
+RING_FUSED_MAX_SEGS = 512
+
+
+def _ring_lut_scan_kernel(cl_smem, ind_hbm, qv_hbm, codes_hbm, ids_hbm,
+                          norms_hbm, ctr_hbm, sel_lo_ref, sel_hi_ref,
+                          off_ref, cbp_ref, out_v_ref, out_i_ref,
+                          qv_vmem, ctr_vmem, ind_vmem, code_sl, idrow_sl,
+                          nrow_sl, qc_col, bias_col,
+                          b1k, b1i, b2k, b2i, cand_v, cand_i,
+                          run_v, run_i, buf_v, buf_i, qv_sem, seg_sems,
+                          tile_sems, send_sems, recv_sems, cap_sems, *,
+                          k: int, n_dev: int, mc: int, NS: int, n_t: int,
+                          metric: str, pq_bits: int, S: int, P: int,
+                          G: int, Sg: int, Kc: int, L: int, Rt: int,
+                          rot: int, rotp: int, indl: int,
+                          axis_name: str, flow_control: bool):
+    """One device's program of the fused scan-in-ring search.
+
+    The ring schedule is the serialized PR-8 exchange; what fills the
+    dead time is the SCAN. Per ring step the device must merge the
+    incoming partial against its local top-k of query chunk ``c`` — and
+    in this kernel that local top-k does not pre-exist in HBM: it is
+    computed ON THE SPOT, between the send start and the recv wait, by
+    streaming the chunk's union probe lists' packed codes through the
+    shared LUT-scan tile body (:func:`_lut_tile_update`). The chunk's
+    candidates live only in the ``cand_*`` VMEM blocks; the per-shard
+    ``[m, k]`` table the unfused pipeline hands from ``search`` to
+    ``merge_topk`` never exists.
+
+    Chunk scan: per segment p (one union list, −1 pads clamped and
+    masked), the list's code tiles stream HBM→VMEM double-buffered
+    (slots alternate per tile, each waited before reuse — GL08);
+    per-query probe membership rides an additive ``_LUT_MASK_BIG`` key
+    bias (the [1, mc] indicator row is transposed to a [mc, 1] column
+    by an exact iota-eye matmul — Mosaic has no sublane gather), so a
+    chunk query that did not probe the list contributes nothing after
+    the segment extraction thresholds biased keys back to +inf/-1.
+    Per-segment 2-deep strided bins (reset at tile 0, extracted at the
+    last tile) keep candidate semantics identical to the standalone
+    ``ivfpq_lut_scan_topk`` tier: per (query, probed list), the two
+    best per strided bin, then a running k-merge across lists.
+
+    Ring: identical slot/semaphore discipline to
+    :func:`_ring_topk_kernel`'s serial schedule (double-buffered recv
+    slots, capacity semaphores + entry barrier compiled out in
+    interpret mode) — the overlap here comes from the scan, not from
+    half-splitting."""
+    my = jax.lax.axis_index(axis_name)
+    right = jax.lax.rem(my + 1, n_dev)
+    left = jax.lax.rem(my + n_dev - 1, n_dev)
+    K = 1 << pq_bits
+    kpad = run_v.shape[1]
+    T = NS * n_t
+
+    if flow_control:
+        barrier = pltpu.get_barrier_semaphore()
+        pltpu.semaphore_signal(barrier, inc=1, device_id=left,
+                               device_id_type=pltpu.DeviceIdType.LOGICAL)
+        pltpu.semaphore_signal(barrier, inc=1, device_id=right,
+                               device_id_type=pltpu.DeviceIdType.LOGICAL)
+        pltpu.semaphore_wait(barrier, 2)
+
+    # sentinel inits anchored on a real operand (see _extract_topk_block:
+    # bare paired broadcasted-constant stores abort XLA CPU's sharding
+    # propagation in a discharged kernel that also issued a remote DMA)
+    def fill_bins(anchor_f, anchor_i):
+        cols = jax.lax.broadcasted_iota(jnp.int32, (mc, _LANES), 1)
+        b1k[:] = jnp.where(cols < 0, anchor_f[:, :_LANES], jnp.inf)
+        b2k[:] = jnp.where(cols < 0, anchor_f[:, :_LANES], jnp.inf)
+        b1i[:] = jnp.where(cols < 0, anchor_i[:, :_LANES], -1)
+        b2i[:] = jnp.where(cols < 0, anchor_i[:, :_LANES], -1)
+
+    def tile_copies(c, t, sl):
+        p = t // n_t
+        tt = jax.lax.rem(t, n_t)
+        lst = jnp.maximum(cl_smem[c, p], 0)
+        return (
+            pltpu.make_async_copy(
+                codes_hbm.at[pl.ds(lst, 1), pl.ds(tt * Rt, Rt), :],
+                code_sl.at[pl.ds(sl, 1)], tile_sems.at[sl, 0]),
+            pltpu.make_async_copy(
+                ids_hbm.at[pl.ds(lst, 1), pl.ds(tt * G * Rt, G * Rt)],
+                idrow_sl.at[pl.ds(sl, 1)], tile_sems.at[sl, 1]),
+            pltpu.make_async_copy(
+                norms_hbm.at[pl.ds(lst, 1), pl.ds(tt * G * Rt, G * Rt)],
+                nrow_sl.at[pl.ds(sl, 1)], tile_sems.at[sl, 2]),
+        )
+
+    def scan_chunk(c):
+        """Stream chunk ``c``'s union probe lists; leaves the chunk's
+        local top-k in ``cand_v``/``cand_i``."""
+        cp = pltpu.make_async_copy(qv_hbm.at[pl.ds(c, 1)], qv_vmem,
+                                   qv_sem)
+        cp.start()
+        cp.wait()
+        qv = qv_vmem[0]                              # [mc, rotp]
+        cols_k = jax.lax.broadcasted_iota(jnp.int32, (mc, kpad), 1)
+        cand_v[:] = jnp.where(cols_k < 0, qv[:, :kpad], jnp.inf)
+        cand_i[:] = jnp.where(cols_k < 0, cols_k, -1)
+        for cc in tile_copies(c, 0, 0):
+            cc.start()
+
+        def step(t, sl):
+            p = t // n_t
+            tt = jax.lax.rem(t, n_t)
+
+            @pl.when(tt == 0)
+            def _seg_head():
+                # per-segment operands: the list's rotated center row +
+                # the chunk's probe-indicator row (blocking: once per
+                # NS·n_t tiles), and fresh bins
+                lst = jnp.maximum(cl_smem[c, p], 0)
+                s1 = pltpu.make_async_copy(
+                    ctr_hbm.at[pl.ds(lst, 1), :], ctr_vmem,
+                    seg_sems.at[0])
+                s2 = pltpu.make_async_copy(
+                    ind_hbm.at[pl.ds(c, 1), pl.ds(p, 1), :], ind_vmem,
+                    seg_sems.at[1])
+                s1.start()
+                s2.start()
+                s1.wait()
+                s2.wait()
+                # per-segment scalars, staged once for the segment's
+                # n_t tiles: ⟨q, c⟩ against the just-landed center row,
+                # and the probe-indicator lane row → sublane column via
+                # an exact iota-eye matmul (Mosaic has no sublane
+                # gather) → the additive _LUT_MASK_BIG key bias
+                ctr = ctr_vmem[:]                    # [1, rotp]
+                qc_col[:] = jnp.broadcast_to(
+                    jnp.sum(qv * ctr, axis=1)[:, None], (mc, _LANES))
+                ind = ind_vmem[0]                    # [1, indl]
+                eye = (jax.lax.broadcasted_iota(jnp.int32, (mc, indl), 0)
+                       == jax.lax.broadcasted_iota(
+                           jnp.int32, (mc, indl), 1)).astype(jnp.float32)
+                mcol = jax.lax.dot_general(
+                    eye, ind, (((1,), (1,)), ((), ())),
+                    precision=jax.lax.Precision.HIGHEST,
+                    preferred_element_type=jnp.float32)  # [mc, 1]
+                bias_col[:] = jnp.broadcast_to(
+                    (1.0 - mcol) * _LUT_MASK_BIG, (mc, _LANES))
+                fill_bins(qv, cols_k)
+
+            for cc in tile_copies(c, t, sl):
+                cc.wait()
+
+            @pl.when(t + 1 < T)
+            def _prefetch():  # next tile rides under this tile's compute
+                for cc in tile_copies(c, t + 1, 1 - sl):
+                    cc.start()
+
+            bytes_f = code_sl[sl].astype(jnp.int32).astype(jnp.float32)
+            code = _lut_unpack_codes(bytes_f, sel_lo_ref[:],
+                                     sel_hi_ref[:], off_ref[:],
+                                     pq_bits, K)
+            # per-segment scalars staged by _seg_head (computed once
+            # per NS·n_t tiles, not per tile)
+            qc = qc_col[:, 0]                        # [mc] ⟨q, c⟩
+            bias = bias_col[:, :1]                   # [mc, 1]
+            state = (b1k[:], b1i[:], b2k[:], b2i[:])
+            nb1k, nb1i, nb2k, nb2i = _lut_tile_update(
+                code, qv, qc, idrow_sl[pl.ds(sl, 1)],
+                nrow_sl[pl.ds(sl, 1)], cbp_ref, tt, state,
+                metric=metric, pq_bits=pq_bits, S=S, P=P, G=G, Sg=Sg,
+                Kc=Kc, L=L, Rt=Rt, rot=rot, rotp=rotp,
+                exact=cbp_ref.dtype == jnp.float32, key_bias=bias)
+            b1k[:] = nb1k
+            b1i[:] = nb1i
+            b2k[:] = nb2k
+            b2i[:] = nb2i
+
+            @pl.when(tt == n_t - 1)
+            def _seg_tail():
+                # extraction merge: this segment's bins ++ the chunk's
+                # running candidates; biased (un-probed) keys threshold
+                # back to the +inf/-1 empty-slot convention first
+                bins_k = jnp.concatenate([b1k[:], b2k[:]], axis=1)
+                bins_i = jnp.concatenate([b1i[:], b2i[:]], axis=1)
+                drop = bins_k >= _LUT_MASK_BIG * 0.5
+                bins_k2 = jnp.where(drop, jnp.inf, bins_k)
+                bins_i2 = jnp.where(drop, -1, bins_i)
+                comb_v = jnp.concatenate([cand_v[:], bins_k2], axis=1)
+                comb_i = jnp.concatenate([cand_i[:], bins_i2], axis=1)
+                mv, mi = _extract_topk_block(comb_v, comb_i, k, kpad)
+                cand_v[:] = mv
+                cand_i[:] = mi
+
+        def pair_body(j, carry):
+            # two tiles per iteration so the double-buffer slots stay
+            # STATIC (dynamic leading-index VMEM reads are off the
+            # Mosaic fast path); tile indices stay traced
+            t0 = 2 * j
+            step(t0, 0)
+
+            @pl.when(t0 + 1 < T)
+            def _odd():
+                step(t0 + 1, 1)
+
+            return carry
+
+        jax.lax.fori_loop(0, (T + 1) // 2, pair_body, 0)
+
+    def ring_send(slot, which):
+        src = run_v if which == 0 else run_i
+        dst = buf_v if which == 0 else buf_i
+        return pltpu.make_async_remote_copy(
+            src_ref=src, dst_ref=dst.at[slot],
+            send_sem=send_sems.at[slot, which],
+            recv_sem=recv_sems.at[slot, which],
+            device_id=right,
+            device_id_type=pltpu.DeviceIdType.LOGICAL)
+
+    # init: chunk (my−1)'s journey starts with its freshly scanned top-k
+    c0 = jax.lax.rem(my + n_dev - 1, n_dev)
+    scan_chunk(c0)
+    run_v[:] = cand_v[:]
+    run_i[:] = cand_i[:]
+    for s in range(n_dev - 1):  # static unroll: n_dev−1 hops
+        slot = s % 2
+        if flow_control and s >= 2:
+            pltpu.semaphore_wait(cap_sems.at[slot], 1)
+        ring_send(slot, 0).start()
+        ring_send(slot, 1).start()
+        # the hop's merge partner is chunk (my − s − 2)'s local top-k:
+        # SCAN it now, under the in-flight exchange — this is the
+        # compute the serialized pipeline ran before the ring started
+        c = jax.lax.rem(my + 2 * n_dev - s - 2, n_dev)
+        scan_chunk(c)
+        ring_send(slot, 0).wait()
+        ring_send(slot, 1).wait()
+        comb_v = jnp.concatenate([buf_v[slot], cand_v[:]], axis=1)
+        comb_i = jnp.concatenate([buf_i[slot], cand_i[:]], axis=1)
+        mv, mi = _extract_topk_block(comb_v, comb_i, k, kpad)
+        run_v[:] = mv
+        run_i[:] = mi
+        if flow_control and s + 2 <= n_dev - 2:
+            pltpu.semaphore_signal(cap_sems.at[slot], inc=1,
+                                   device_id=left,
+                                   device_id_type=pltpu.DeviceIdType.LOGICAL)
+    out_v_ref[:] = run_v[:]
+    out_i_ref[:] = run_i[:]
+
+
+def ring_lut_scan_kernel_ok(S: int, K: int, P: int, nb: int, Wb: int, mc: int,
+                     NS: int, k: int, n_dev: int, rot: int,
+                     lut_dtype: str = "float32") -> bool:
+    """Admission for :func:`ring_lut_scan_merge`: the packed layout must
+    be one the in-kernel unpack supports, the merge budget holds (k
+    extraction rounds per segment and per hop), the union-segment table
+    fits the scan loop, and the VMEM working set — chunk queries + code
+    slots + codebook operand + bins + ring blocks — fits the budget."""
+    if k > RING_TOPK_MAX_K or n_dev < 2 or NS > RING_FUSED_MAX_SEGS:
+        return False
+    cfg = _lut_scan_config(S, K, P, nb, Wb, lut_dtype)
+    if cfg is None:
+        return False
+    G, Sg, Kc = cfg
+    op_bytes = 4 if lut_dtype == "float32" else 2
+    rotp = -(-rot // _LANES) * _LANES
+    Rt = 2 * _LANES
+    vmem = (
+        mc * rotp * 4                  # chunk queries
+        + 2 * Rt * max(Wb, _LANES)     # u8 code slots (double buffer)
+        + 2 * 2 * G * Rt * 8           # id + norm rows (2 slots)
+        + Rt * G * S * 8               # unpacked bytes + codes (f32+i32)
+        + S * K * P * Sg * op_bytes    # grouped block-diag codebooks
+        + _LANES * Kc * Sg * 8         # one-hot transient (+tiled codes)
+        + _LANES * rotp * 4            # decoded block
+        + mc * _LANES * 4              # qd block
+        + mc * indl_pad(mc) * 4        # probe-indicator eye transient
+        + 2 * mc * _LANES * 4          # staged per-segment ⟨q,c⟩ + bias
+        + 4 * mc * _LANES * 8          # 2-deep bins (keys+ids)
+        + 10 * mc * _LANES * 8         # cand/run/recv ring blocks
+        + 2 * Wb * G * S * 4           # selection matrices
+    )
+    return vmem <= _GROUPED_VMEM_BUDGET
+
+
+def indl_pad(mc: int) -> int:
+    """Lane padding of the probe-indicator rows (one lane per chunk
+    query row)."""
+    return -(-mc // _LANES) * _LANES
+
+
+def ring_lut_scan_merge(chunk_lists: jax.Array, probe_ind: jax.Array,
+                        qv_chunks: jax.Array, packed: jax.Array,
+                        ids: jax.Array, norms: jax.Array,
+                        centers_rot: jax.Array, codebooks: jax.Array,
+                        k: int, metric: str = "l2", *, pq_bits: int,
+                        pq_dim: int, L: int, axis_name: str, n_dev: int,
+                        lut_dtype: str = "float32",
+                        interpret: bool = False
+                        ) -> Tuple[jax.Array, jax.Array]:
+    """Fused per-shard LUT scan + ring top-k exchange — codes to merged
+    top-k in ONE persistent kernel (ROADMAP item 5's end state for the
+    sharded hot path).
+
+    Must be called inside ``shard_map`` over ``axis_name`` on a 1-D
+    mesh. The query axis is pre-split into the ring's n_dev chunks:
+
+    - ``chunk_lists [n_dev, NS]`` i32 — each chunk's union of probed
+      lists, −1 pad (replicated; lives in SMEM);
+    - ``probe_ind [n_dev, NS, mc]`` f32 — 1 where chunk query row r
+      probed that list (0 rows make a pad segment inert);
+    - ``qv_chunks [n_dev, mc, rot]`` f32 — ROTATED queries per chunk;
+    - ``packed`` / ``ids`` / ``norms`` / ``centers_rot`` /
+      ``codebooks`` — this shard's index arrays, exactly as
+      :func:`ivfpq_lut_scan_topk` takes them (ids must be GLOBAL row
+      ids, as the sharded build bakes them).
+
+    Per ring step the kernel scans the next chunk's lists UNDER the
+    in-flight exchange and merges on arrival; the per-shard ``[m, k]``
+    candidate table never reaches HBM — the only HBM traffic beyond
+    the streamed index arrays is the [mc, 128] result block. Keys
+    follow the LUT-scan convention (l2: ‖c+d‖² − 2⟨q,c+d⟩, caller adds
+    ‖q‖²; ip: −⟨q,c+d⟩); comms bytes are the ring tier's (count via
+    ``Comms.count_ring_topk``, byte model unchanged).
+
+    Returns (keys [mc, 128], ids [mc, 128]) — this device's owned query
+    chunk, ascending, ids −1 for empty slots; callers emit ``P(axis)``
+    out-specs and slice ``[:, :k]``.
+    """
+    n_dev2, mc, rot = qv_chunks.shape
+    NS = chunk_lists.shape[1]
+    S, K, Pl = codebooks.shape
+    assert metric in ("l2", "ip")
+    assert S == pq_dim and K == (1 << pq_bits) and n_dev2 == n_dev
+    if k > RING_TOPK_MAX_K:
+        raise ValueError(
+            f"k={k} > {RING_TOPK_MAX_K} (the in-kernel merge is k "
+            "extraction rounds per segment/hop — gate with "
+            "ring_lut_scan_kernel_ok)")
+    nb = (S * pq_bits + 7) // 8
+    Wb = packed.shape[2]
+    cfg = _lut_scan_config(S, K, Pl, nb, Wb, lut_dtype)
+    if cfg is None:
+        raise ValueError(
+            f"unsupported packed-code layout for the fused scan-in-ring "
+            f"kernel: nb={nb} Wb={Wb} (gate with ring_lut_scan_kernel_ok)")
+    G, Sg, Kc = cfg
+
+    R = packed.shape[1]
+    Rt = 2 * _LANES if R >= 2 * _LANES else _LANES
+    n_t = -(-R // Rt)
+    # the manual tile DMAs address [tt·Rt, (tt+1)·Rt) directly — pad the
+    # stored arrays to whole tiles (the grid pipeline clamps for the
+    # standalone kernel; a raw make_async_copy must not read OOB)
+    if packed.shape[1] < n_t * Rt:
+        packed = _pad_to(packed, n_t * Rt, 1, 0)
+    ids = _pad_to(ids, G * n_t * Rt, 1, -1)
+    norms = _pad_to(norms, G * n_t * Rt, 1, 0.0)
+
+    qvp = _pad_to(qv_chunks.astype(jnp.float32), _LANES, 2, 0.0)
+    rotp = qvp.shape[2]
+    ctr = _pad_to(centers_rot.astype(jnp.float32), _LANES, 1, 0.0)
+    indl = indl_pad(mc)
+    ind = _pad_to(probe_ind.astype(jnp.float32), indl, 2, 0.0)
+
+    sel_lo, sel_hi, off_arr, cbp = _lut_scan_operands(
+        codebooks, pq_bits, nb, Wb, G, Sg, lut_dtype)
+    n_sg = S // Sg
+
+    kpad = _LANES
+    kwargs = {}
+    if not interpret:
+        # distinct collective id from ring_topk_merge: a fused search
+        # and a plain merge must never share a barrier semaphore
+        kwargs["compiler_params"] = pltpu.TPUCompilerParams(
+            collective_id=2)
+    out_v, out_i = pl.pallas_call(
+        functools.partial(
+            _ring_lut_scan_kernel, k=k, n_dev=n_dev, mc=mc, NS=NS,
+            n_t=n_t, metric=metric, pq_bits=pq_bits, S=S, P=Pl, G=G,
+            Sg=Sg, Kc=Kc, L=L, Rt=Rt, rot=rot, rotp=rotp, indl=indl,
+            axis_name=axis_name, flow_control=not interpret),
+        in_specs=[
+            pl.BlockSpec(memory_space=pltpu.SMEM),    # chunk_lists
+            pl.BlockSpec(memory_space=pltpu.ANY),     # probe indicator
+            pl.BlockSpec(memory_space=pltpu.ANY),     # chunk queries
+            pl.BlockSpec(memory_space=pltpu.ANY),     # packed codes
+            pl.BlockSpec(memory_space=pltpu.ANY),     # ids
+            pl.BlockSpec(memory_space=pltpu.ANY),     # norms
+            pl.BlockSpec(memory_space=pltpu.ANY),     # rotated centers
+            pl.BlockSpec((Wb, G * S), lambda: (0, 0)),
+            pl.BlockSpec((Wb, G * S), lambda: (0, 0)),
+            pl.BlockSpec((1, G * S), lambda: (0, 0)),
+            pl.BlockSpec((n_sg, K * Sg, Sg * Pl), lambda: (0, 0, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((mc, kpad), lambda: (0, 0)),
+            pl.BlockSpec((mc, kpad), lambda: (0, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((mc, kpad), jnp.float32),
+            jax.ShapeDtypeStruct((mc, kpad), jnp.int32),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((1, mc, rotp), jnp.float32),   # chunk queries
+            pltpu.VMEM((1, rotp), jnp.float32),       # center row
+            pltpu.VMEM((1, 1, indl), jnp.float32),    # probe indicator
+            pltpu.VMEM((2, Rt, Wb), jnp.uint8),       # code tile slots
+            pltpu.VMEM((2, G * Rt), jnp.int32),       # id row slots
+            pltpu.VMEM((2, G * Rt), jnp.float32),     # norm row slots
+            pltpu.VMEM((mc, _LANES), jnp.float32),    # seg scalars: ⟨q,c⟩
+            pltpu.VMEM((mc, _LANES), jnp.float32),    # seg scalars: bias
+            pltpu.VMEM((mc, _LANES), jnp.float32),    # bins: best
+            pltpu.VMEM((mc, _LANES), jnp.int32),
+            pltpu.VMEM((mc, _LANES), jnp.float32),    # bins: second
+            pltpu.VMEM((mc, _LANES), jnp.int32),
+            pltpu.VMEM((mc, kpad), jnp.float32),      # chunk candidates
+            pltpu.VMEM((mc, kpad), jnp.int32),
+            pltpu.VMEM((mc, kpad), jnp.float32),      # ring running block
+            pltpu.VMEM((mc, kpad), jnp.int32),
+            pltpu.VMEM((2, mc, kpad), jnp.float32),   # recv slots
+            pltpu.VMEM((2, mc, kpad), jnp.int32),
+            pltpu.SemaphoreType.DMA,                  # chunk-query copy
+            pltpu.SemaphoreType.DMA((2,)),            # center + indicator
+            pltpu.SemaphoreType.DMA((2, 3)),          # code/id/norm slots
+            pltpu.SemaphoreType.DMA((2, 2)),          # ring send
+            pltpu.SemaphoreType.DMA((2, 2)),          # ring recv
+            pltpu.SemaphoreType.REGULAR((2,)),        # slot capacity
+        ],
+        interpret=interpret,
+        **kwargs,
+    )(chunk_lists.astype(jnp.int32), ind, qvp, packed, ids, norms, ctr,
+      sel_lo, sel_hi, off_arr, cbp)
+    return out_v, out_i

@@ -185,6 +185,184 @@ def _gather_trainset(x: jax.Array, mesh: Mesh, axis: str, t: int,
 # lands in the ``comms.ops``/``comms.bytes`` counters per axis.
 
 
+# ---------------------------------------------------------------------------
+# fused scan-in-ring tier (ROADMAP item 5): per-shard LUT scan folded
+# into the ring exchange — one persistent kernel from packed codes to
+# the merged top-k; the per-shard [m, k] candidate table never exists
+# ---------------------------------------------------------------------------
+
+def _ring_fused_wanted(index: "ShardedIvfPq", m: int, k: int,
+                       n_probes: int, n_dev: int, whole_mesh: bool,
+                       merge: str, mt: DistanceType, lut_dtype: str,
+                       scan_select: str) -> Tuple[bool, str]:
+    """Dispatch for the fused scan-in-ring tier. Returns
+    ``(take_it, decline_reason)`` — reason is non-empty only when the
+    tier was WANTED (env force, or auto on an eligible ring setup) but
+    a capability check declined it; those land in
+    ``parallel.merge.fallback{reason=...}`` so "why isn't the sharded
+    scan fused?" is one counter query.
+
+    ``RAFT_TPU_RING_FUSED`` = auto | on | off: auto takes the tier
+    exactly where the ring KERNEL would have carried the merge (TPU,
+    whole-mesh axis, ring-winning shape) — the fused kernel is the same
+    exchange with the scan moved inside; "on" forces it (interpret mode
+    off-TPU — tests), "off" never. The tier declines (fallback to the
+    unfused scan + merge path, preserving every existing dispatch rung,
+    including the int64-id ppermute decline):
+
+    - ``scan_select``: the fused kernel carries the LUT-bin tier's
+      recall-targeted selection semantics, so it only serves searches
+      the single-chip dispatch would route there anyway — an explicit
+      ``scan_select="pallas"``, or ``"approx"`` at the oversampled
+      auto-upgrade shape. The default ``"exact"`` keeps exact-selection
+      semantics on the unfused path, even under env force;
+    - ``id_width``: int64 id tables — the kernel is int32-only;
+    - ``metric``: cosine (the fused epilogue serves l2/ip keys);
+    - ``kernel_ineligible``: unsupported packed layout, k past the
+      merge budget, VMEM budget, or a union-segment table past
+      ``RING_FUSED_MAX_SEGS``;
+    - ``latency_bound``: shapes where auto mode keeps the single
+      allgather (``ring_auto_wanted``).
+    """
+    from raft_tpu.obs import spans as _obs_spans
+    from raft_tpu.ops import pallas_kernels as _pk
+
+    force = _obs_spans.env_tristate("RAFT_TPU_RING_FUSED")
+    if force == "off" or merge == "allgather":
+        return False, ""
+    if force != "on" and not (_pk._on_tpu() and whole_mesh):
+        return False, ""
+    if not (scan_select == "pallas"
+            or (scan_select == "approx"
+                and (n_probes >= 64 or k >= 400))):
+        # never swap exact-selection semantics for the bin tier's —
+        # mirror of the single-chip LUT-tier routing
+        return False, "scan_select"
+    if mt not in (DistanceType.L2Expanded, DistanceType.L2SqrtExpanded,
+                  DistanceType.InnerProduct):
+        return False, "metric"
+    if jnp.dtype(index.packed_ids.dtype).itemsize >= 8:
+        return False, "id_width"
+    if force != "on" and not _merge.ring_auto_wanted(m, k, n_dev):
+        return False, "latency_bound"
+    mc = _pk.ring_chunk_rows(m, n_dev)
+    NS = min(mc * n_probes, index.n_lists)
+    # nb from pq geometry, Wb from the stored layout — the same pair
+    # ring_lut_scan_merge derives, so the admission check and the
+    # kernel agree on lane-folded layouts (Wb > nb) instead of only on
+    # the unfolded sharded build where the two coincide
+    nb = (index.pq_dim * index.pq_bits + 7) // 8
+    Wb = index.packed_codes.shape[3]
+    ok = _pk.ring_lut_scan_kernel_ok(
+        index.pq_dim, 1 << index.pq_bits,
+        index.codebooks.shape[2], nb, Wb, mc, NS, k, n_dev,
+        index.centers_rot.shape[1], lut_dtype=lut_dtype)
+    if not ok:
+        return False, "kernel_ineligible"
+    return True, ""
+
+
+def _search_fused_ring(index: "ShardedIvfPq", q: jax.Array, k: int,
+                       n_probes: int, mesh: Mesh, axis: str,
+                       lut_dtype: str, mt: DistanceType
+                       ) -> Tuple[jax.Array, jax.Array]:
+    """The fused scan-in-ring search: probes + chunk unions + one
+    persistent Pallas kernel per shard (``ring_lut_scan_merge``), then
+    the LUT-key → metric epilogue. Results are query-sharded like the
+    ring merge tier's."""
+    from raft_tpu.obs import spans as _obs_spans
+    from raft_tpu.ops import pallas_kernels as _pk
+
+    m = q.shape[0]
+    n_dev = index.n_shards
+    mc = _pk.ring_chunk_rows(m, n_dev)
+    mq = mc * n_dev
+    ip_like = mt == DistanceType.InnerProduct
+    NS = min(mc * n_probes, index.n_lists)
+    L = index.packed_codes.shape[2]
+    qp = jnp.pad(q, ((0, mq - m), (0, 0))) if mq > m else q
+    comms = Comms(axis)
+    interpret = not _pk._on_tpu()
+
+    def body(codes, ids, norms, sizes, qp, centers, centers_rot,
+             rotation, codebooks):
+        local = _pq.IvfPqIndex(
+            centers=centers, centers_rot=centers_rot, rotation=rotation,
+            codebooks=codebooks, packed_codes=codes[0],
+            packed_ids=ids[0], packed_norms=norms[0],
+            list_sizes=sizes[0], metric=index.metric,
+            pq_bits=index.pq_bits, pq_dim_static=index.pq_dim)
+        # probes on replicated operands: identical on every shard
+        _, probes = _pq._coarse_probes(local, qp, n_probes, ip_like)
+        q_rot = qp @ rotation.T
+        lists, ind = _chunk_unions(
+            probes.reshape(n_dev, mc, n_probes), NS)
+        qv = q_rot.reshape(n_dev, mc, q_rot.shape[1])
+        # the kernel's remote DMAs bypass lax — attribute the hop
+        # traffic through the facade at trace time, the same [mc, k]
+        # logical block per hop as the plain ring merge (the fusion
+        # moves compute, not bytes)
+        comms.count_ring_topk(
+            n_dev - 1,
+            jax.ShapeDtypeStruct((mc, k), jnp.float32),
+            jax.ShapeDtypeStruct((mc, k), jnp.int32))
+        kv, ki = _pk.ring_lut_scan_merge(
+            lists, ind, qv, codes[0], ids[0], norms[0], centers_rot,
+            codebooks, k, "ip" if ip_like else "l2",
+            pq_bits=index.pq_bits, pq_dim=index.pq_dim, L=L,
+            axis_name=axis, n_dev=n_dev, lut_dtype=lut_dtype,
+            interpret=interpret)
+        return kv[:, :k], ki[:, :k]
+
+    out_spec = P(axis, None)
+    fn = shard_map(
+        body, mesh=mesh,
+        in_specs=(P(axis, None, None, None), P(axis, None, None),
+                  P(axis, None, None), P(axis, None), P(),
+                  P(), P(), P(), P()),
+        out_specs=(out_spec, out_spec),
+        check_vma=False)
+    rv, ri = fn(index.packed_codes, index.packed_ids, index.packed_norms,
+                index.list_sizes, qp, index.centers, index.centers_rot,
+                index.rotation, index.codebooks)
+    rv, ri = rv[:m], ri[:m]
+    # LUT-key → metric epilogue (the _finish_candidates conventions)
+    if ip_like:
+        dists = jnp.where(ri < 0, -jnp.inf, -rv)
+    else:
+        q_sq = jnp.sum((q @ index.rotation.T) ** 2, axis=1)
+        dists = jnp.maximum(rv + q_sq[:, None], 0.0)
+        if mt == DistanceType.L2SqrtExpanded:
+            dists = jnp.sqrt(dists)
+        dists = jnp.where(ri < 0, jnp.inf, dists)
+    return dists, ri
+
+
+def _chunk_unions(pc: jax.Array, NS: int
+                  ) -> Tuple[jax.Array, jax.Array]:
+    """Per ring chunk, the padded union of probed lists and the
+    per-(list, query) membership indicator the fused kernel masks with.
+
+    ``pc [n_dev, mc, n_probes]`` i32 → (``lists [n_dev, NS]`` i32, −1
+    pad; ``ind [n_dev, NS, mc]`` f32 0/1). Sort + first-occurrence +
+    one bounded scatter — ``NS = min(mc·n_probes, n_lists)`` bounds the
+    distinct count by construction, so the scatter never drops a real
+    list."""
+    def one(p):
+        flat = jnp.sort(p.reshape(-1).astype(jnp.int32))
+        first = jnp.concatenate(
+            [jnp.ones((1,), bool), flat[1:] != flat[:-1]])
+        rank = jnp.cumsum(first) - 1
+        lists = jnp.full((NS,), -1, jnp.int32)
+        lists = lists.at[jnp.where(first, rank, NS)].set(flat,
+                                                         mode="drop")
+        ind = jnp.any(p[None, :, :] == lists[:, None, None], axis=2)
+        ind = ind & (lists >= 0)[:, None]
+        return lists, ind.astype(jnp.float32)
+
+    return jax.vmap(one)(pc)
+
+
 def build_ivf_pq(params: _pq.IndexParams, dataset: jax.Array, mesh: Mesh,
                  axis: str = "shard") -> ShardedIvfPq:
     """Distributed IVF-PQ build over a row-sharded dataset.
@@ -313,12 +491,37 @@ def search_ivf_pq(params: _pq.SearchParams, index: ShardedIvfPq,
     expects(n_dev == mesh.shape[axis],
             "index sharded over %d devices, mesh axis has %d",
             n_dev, mesh.shape[axis])
+    refined = params.refine != "none"
+    if params.lut_dtype == "auto" and not refined:
+        # direct sharded calls resolve the fp8-default policy here (the
+        # neighbors entry resolves before dispatching to this tier).
+        # Refined searches stay "auto" so the per-shard oversampled
+        # scan resolves against its ACTUAL selection width k_cand —
+        # the slack the fp8 floor is defined over
+        params = dataclasses.replace(
+            params, lut_dtype=_pq.resolve_lut_dtype("auto", n_probes, k))
+    if not refined:
+        from raft_tpu.obs import spans as _obs_spans
+
+        fused, fused_reason = _ring_fused_wanted(
+            index, m, k, n_probes, n_dev,
+            whole_mesh=n_dev == mesh.devices.size, merge=merge, mt=mt,
+            lut_dtype=params.lut_dtype, scan_select=params.scan_select)
+        if fused:
+            # codes → merged top-k in one persistent kernel: the scan
+            # IS the merge's compute phase, no per-shard candidate
+            # table, no separate merge dispatch
+            _obs_spans.count_dispatch("parallel.merge", "ring_fused_scan")
+            _obs_spans.count_dispatch("ivf_pq.scan", "ring_lut_fused")
+            rv, ri = _search_fused_ring(index, q, k, n_probes, mesh,
+                                        axis, params.lut_dtype, mt)
+            return rv, ri
+        if fused_reason:
+            _obs_spans.count_fallback("parallel.merge", fused_reason)
     tier, impl = _merge.merge_tier(
         n_dev, m, k, explicit=merge,
         whole_mesh=n_dev == mesh.devices.size)
     comms = Comms(axis)
-
-    refined = params.refine != "none"
     if refined:
         from raft_tpu.neighbors import refine as _refine
 

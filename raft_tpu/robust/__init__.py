@@ -11,7 +11,7 @@ recovery path must be CI-testable instead of outage-tested.
   exponential backoff + jitter, deadline budgets,
   ``retry.attempts{site=}`` counters;
 - :mod:`raft_tpu.robust.degrade`    — the RESOURCE_EXHAUSTED
-  degradation ladder (halve batch → bf16 LUT → decline fused tier →
+  degradation ladder (halve batch → bf16 LUT → fp8 LUT → decline fused tier →
   host gather) with ``degrade.steps{from=,to=,reason=}`` counters;
 - :mod:`raft_tpu.robust.checkpoint` — atomic (tmp+fsync+rename) build
   manifests + encoded-list shards behind

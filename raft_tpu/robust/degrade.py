@@ -12,7 +12,7 @@ Production ANN services degrade through exactly those knobs instead of
   InjectedResourceExhausted` identically (so the ladder is CI-testable);
 - a :class:`Ladder` declares ordered :class:`Step` rungs; each
   RESOURCE_EXHAUSTED advances one rung (``halve_batch → bf16_lut →
-  decline_fused → host_gather → halve_batch…``, see
+  fp8_lut → decline_fused → host_gather → halve_batch…``, see
   :func:`standard_search_ladder`);
 - :func:`run_with_degradation` drives a callable through the ladder and
   counts every move: ``degrade.steps{site=,from=,to=,reason=}``, plus
@@ -217,9 +217,27 @@ def _halve_batch(total: int):
 
 def _bf16_lut(knobs):
     params = knobs["params"]
-    if getattr(params, "lut_dtype", None) != "float32":
+    # "auto" is accepted only for callers driving the ladder directly:
+    # the public entry (ivf_pq.search_resilient) resolves "auto" to its
+    # concrete dispatch dtype BEFORE the ladder, so an fp8-resolved
+    # config skips this rung instead of being enlarged back to bf16
+    if getattr(params, "lut_dtype", None) not in ("float32", "auto"):
         return None
     knobs["params"] = dataclasses.replace(params, lut_dtype="bfloat16")
+    return knobs
+
+
+def _fp8_lut(knobs):
+    """One more halving of the LUT/codebook operand footprint past the
+    bf16 rung (the reference's fp8 trade, ivf_pq_fp_8bit.cuh — also the
+    dispatch DEFAULT for oversampled scans, see
+    ``ivf_pq.resolve_lut_dtype``): under memory pressure the ladder
+    pins it regardless of shape, trading the documented recall margin
+    (``ivf_pq.FP8_LUT_RECALL_FLOOR``) for staying up."""
+    params = knobs["params"]
+    if getattr(params, "lut_dtype", None) in ("float8_e4m3", None):
+        return None
+    knobs["params"] = dataclasses.replace(params, lut_dtype="float8_e4m3")
     return knobs
 
 
@@ -256,12 +274,16 @@ def _host_gather(knobs):
 
 def standard_search_ladder(batch: int, has_lut: bool = False) -> Ladder:
     """The declared search ladder. ``batch`` is the incoming query
-    count; ``has_lut`` adds the bf16-LUT rung (IVF-PQ only — IVF-Flat
-    has no LUT to quantize). The terminal rung keeps halving the batch
-    down to 1 so a pathological shape still completes, just slowly."""
+    count; ``has_lut`` adds the bf16-LUT and fp8-LUT rungs (IVF-PQ only
+    — IVF-Flat has no LUT to quantize): two successive halvings of the
+    LUT/codebook operand footprint between "halve batch" and "decline
+    fused", each a documented precision trade rather than a tier
+    change. The terminal rung keeps halving the batch down to 1 so a
+    pathological shape still completes, just slowly."""
     steps = [Step("halve_batch", _halve_batch(batch))]
     if has_lut:
         steps.append(Step("bf16_lut", _bf16_lut))
+        steps.append(Step("fp8_lut", _fp8_lut))
     # repeatable: declining the fused tier is two moves (pallas select →
     # approx, then the grouped scan → the tile-bounded per_query path)
     steps.append(Step("decline_fused", _decline_fused, repeatable=True))

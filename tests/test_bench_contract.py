@@ -144,10 +144,18 @@ class TestGistConf:
         assert cfg["dataset"]["name"] == "gist-960-euclidean"
         assert cfg["k"] == 10
         algos = {i["algo"] for i in cfg["index"]}
-        assert algos == {"cagra", "ivf_flat"}
+        assert algos == {"cagra", "ivf_flat", "ivf_pq"}
         # BASELINE config 4: CAGRA graph_degree=64 on GIST-1M
         cagra = next(i for i in cfg["index"] if i["algo"] == "cagra")
         assert cagra["build_param"]["graph_degree"] == 64
+        # ISSUE 11: the fp8-QLUT recall-delta legs — the lut_dtype
+        # triple at FIXED search params, per dataset
+        pq = next(i for i in cfg["index"] if i["algo"] == "ivf_pq")
+        triple = [sp["lut_dtype"] for sp in pq["search_params"]]
+        assert triple == ["float32", "bfloat16", "float8_e4m3"]
+        fixed = [{k: v for k, v in sp.items() if k != "lut_dtype"}
+                 for sp in pq["search_params"]]
+        assert all(f == fixed[0] for f in fixed)
 
     def test_cpu_shaped_smoke(self):
         """Run the conf's index entries through the real runner on a
@@ -168,10 +176,19 @@ class TestGistConf:
                 entry["build_param"]["graph_degree"] = 8
                 entry["search_params"] = [{"itopk_size": 16,
                                            "search_width": 4}]
+                continue
+            entry["build_param"]["n_lists"] = 8
+            entry["build_param"].pop("spill", None)
+            entry["build_param"].pop("list_size_cap_factor", None)
+            if entry["algo"] == "ivf_pq":
+                # keep the lut_dtype triple (the legs under test),
+                # shrink everything else to CPU shape
+                entry["build_param"]["pq_dim"] = 16
+                entry["search_params"] = [
+                    {"n_probes": 4, "scan_select": "approx",
+                     "refine_ratio": 4, "lut_dtype": dt}
+                    for dt in ("float32", "bfloat16", "float8_e4m3")]
             else:
-                entry["build_param"]["n_lists"] = 8
-                entry["build_param"].pop("spill", None)
-                entry["build_param"].pop("list_size_cap_factor", None)
                 entry["search_params"] = [
                     {"n_probes": 4, "scan_select": "approx"},
                     {"n_probes": 4, "scan_select": "approx",
@@ -180,6 +197,10 @@ class TestGistConf:
         by_algo = {}
         for r in rows:
             by_algo.setdefault(r.algo, []).append(r)
-        assert set(by_algo) == {"cagra", "ivf_flat"}, by_algo.keys()
+        assert set(by_algo) == {"cagra", "ivf_flat", "ivf_pq"}, \
+            by_algo.keys()
         assert len(by_algo["ivf_flat"]) == 2
+        # one row per lut_dtype leg, recall recorded on each (the
+        # recall-delta rows the fp8 default is judged by)
+        assert len(by_algo["ivf_pq"]) == 3
         assert all(r.qps > 0 and 0.0 <= r.recall <= 1.0 for r in rows)

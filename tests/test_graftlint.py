@@ -446,6 +446,92 @@ def test_gl08_quiet_on_the_queue_idiom():
     assert not [f for f in lint(GL08_GOOD) if f.rule == "GL08"]
 
 
+# the ISSUE 11 overlap-idiom extension: factory calls with statically
+# stable arguments resolve to concrete semaphore slots
+
+GL08_FACTORY_BAD = """
+from jax.experimental.pallas import tpu as pltpu
+
+
+def same_sem_kernel(hbm, out, sems):
+    def cp(which):
+        return pltpu.make_async_copy(hbm.at[which], out.at[which],
+                                     sems.at[0])
+    cp(0).start()
+    cp(1).start()
+    cp(0).wait()
+    cp(1).wait()
+
+
+def loop_restart_kernel(hbm, out, sems):
+    def cp(i):
+        return pltpu.make_async_copy(hbm.at[i], out.at[i], sems.at[i])
+    for t in range(4):
+        cp(0).start()
+    cp(0).wait()
+
+
+def exit_unwaited_kernel(hbm, out, sems):
+    def cp(i):
+        return pltpu.make_async_copy(hbm.at[i], out.at[i], sems.at[i])
+    cp(0).start()
+    cp(0).wait()
+    cp(0).start()
+"""
+
+GL08_FACTORY_GOOD = """
+from jax.experimental.pallas import tpu as pltpu
+
+
+def overlap_kernel(hbm, out, sems):
+    # two in-flight copies on DISTINCT semaphores: the legitimate
+    # pipelined schedule (the ring kernel's overlap idiom)
+    def cp(i):
+        return pltpu.make_async_copy(hbm.at[i], out.at[i], sems.at[i])
+    cp(0).start()
+    cp(1).start()
+    cp(0).wait()
+    cp(1).wait()
+
+
+def loop_carried_kernel(hbm, out, sems):
+    # slot reuse across loop-carried hops, waited before restart
+    def cp(i):
+        return pltpu.make_async_copy(hbm.at[i], out.at[i], sems.at[i])
+    cp(0).start()
+    for s in range(4):
+        cp(0).wait()
+        cp(0).start()
+    cp(0).wait()
+
+
+def rotated_kernel(hbm, out, sems):
+    # dynamically-rotated slots (loop-varying args) defer to the
+    # whole-tree tally — the gather-refine prologue-fill idiom
+    def cp(t):
+        return pltpu.make_async_copy(hbm.at[t], out.at[t],
+                                     sems.at[t % 2])
+    cp(0).start()
+    for t in range(1, 8):
+        cp(t).start()
+        cp(t - 1).wait()
+    cp(7).wait()
+"""
+
+
+def test_gl08_factory_slot_violations_fire():
+    findings = [f for f in lint(GL08_FACTORY_BAD) if f.rule == "GL08"]
+    msgs = " | ".join(f.message for f in findings)
+    assert len(findings) == 3, msgs
+    assert "SAME semaphore" in msgs
+    assert "restarted" in msgs
+    assert "all control paths" in msgs
+
+
+def test_gl08_factory_overlap_idiom_quiet():
+    assert not [f for f in lint(GL08_FACTORY_GOOD) if f.rule == "GL08"]
+
+
 # ---------------------------------------------------------------------------
 # GL09 — shard_map contract
 # ---------------------------------------------------------------------------

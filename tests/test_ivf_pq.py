@@ -622,6 +622,83 @@ class TestPallasLutScanTier:
             >= 1, counters
 
 
+class TestFp8LutDispatchDefault:
+    """ISSUE 11: SearchParams.lut_dtype defaults to "auto" and
+    :func:`ivf_pq.resolve_lut_dtype` makes fp8 QLUTs the measured
+    default for oversampled dispatch — fp8 when the candidate slack
+    absorbs the quantization noise, declining to bf16 when it can't,
+    exact f32 everywhere else (and everywhere off-TPU unless forced)."""
+
+    def test_default_is_auto(self):
+        assert SearchParams().lut_dtype == "auto"
+
+    def test_explicit_passthrough(self):
+        for dt in ("float32", "bfloat16", "float8_e4m3"):
+            assert ivf_pq.resolve_lut_dtype(dt, 128, 10) == dt
+
+    def test_auto_off_tpu_is_f32(self, monkeypatch):
+        monkeypatch.delenv("RAFT_TPU_FP8_LUT", raising=False)
+        # oversampled shape, but this host is a CPU: exact f32
+        assert ivf_pq.resolve_lut_dtype("auto", 128, 10) == "float32"
+
+    def test_auto_forced_picks_fp8_with_slack(self, monkeypatch):
+        monkeypatch.setenv("RAFT_TPU_FP8_LUT", "on")
+        # oversampled + slack ≥ FP8_LUT_MIN_SLACK·k → fp8
+        assert ivf_pq.resolve_lut_dtype("auto", 64, 10) == "float8_e4m3"
+        # oversampled via k ≥ 400 but slack too thin for fp8 → bf16
+        # (the documented recall-floor decline)
+        n_probes = 4
+        k = 500
+        assert n_probes * 256 < ivf_pq.FP8_LUT_MIN_SLACK * k
+        assert ivf_pq.resolve_lut_dtype("auto", n_probes, k) == "bfloat16"
+        # not oversampled → exact f32 even when forced
+        assert ivf_pq.resolve_lut_dtype("auto", 8, 10) == "float32"
+
+    def test_env_off_pins_f32(self, monkeypatch):
+        monkeypatch.setenv("RAFT_TPU_FP8_LUT", "off")
+        assert ivf_pq.resolve_lut_dtype("auto", 128, 500) == "float32"
+
+    def test_resolution_counter(self, monkeypatch):
+        from raft_tpu import obs
+        from raft_tpu.obs.metrics import MetricsRegistry
+
+        monkeypatch.setenv("RAFT_TPU_FP8_LUT", "on")
+        reg = MetricsRegistry()
+        obs.enable(registry=reg, hbm=False)
+        try:
+            ivf_pq.resolve_lut_dtype("auto", 64, 10)
+            ivf_pq.resolve_lut_dtype("auto", 4, 500)
+            ivf_pq.resolve_lut_dtype("auto", 8, 10)
+        finally:
+            obs.disable()
+        c = reg.snapshot()["counters"]
+        assert c["ivf_pq.lut.dispatch{dtype=float8_e4m3}"] == 1.0
+        assert c["ivf_pq.lut.dispatch{dtype=bfloat16}"] == 1.0
+        assert c["ivf_pq.lut.dispatch{dtype=float32}"] == 1.0
+
+    @pytest.mark.slow  # 64-list build + two searches; CI lanes run it
+    def test_search_resolves_auto_before_the_scan(self, rng,
+                                                  monkeypatch):
+        """An "auto" params object runs end-to-end (no tier ever sees
+        the unresolved token) and a forced-fp8 oversampled search stays
+        within the documented recall envelope of the f32 run."""
+        x = rng.random((2000, 32), dtype=np.float32)
+        q = rng.random((32, 32), dtype=np.float32)
+        idx = ivf_pq.build(jnp.asarray(x),
+                           IndexParams(n_lists=64, pq_dim=8,
+                                       kmeans_n_iters=2))
+        de, ie = ivf_pq.search(idx, jnp.asarray(q), 10,
+                               SearchParams(n_probes=64,
+                                            lut_dtype="float32"))
+        monkeypatch.setenv("RAFT_TPU_FP8_LUT", "on")
+        da, ia = ivf_pq.search(idx, jnp.asarray(q), 10,
+                               SearchParams(n_probes=64))  # auto → fp8
+        overlap = np.mean([len(set(a) & set(b)) / 10.0 for a, b in
+                           zip(np.asarray(ia), np.asarray(ie))])
+        assert overlap >= 1.0 - ivf_pq.FP8_LUT_RECALL_FLOOR - 0.05, \
+            overlap
+
+
 def test_folded_codes_storage_matches(rng):
     """Lane-folded code storage (codes_folded=True) must search
     identically — it is the same bytes reshaped to a [*, 128] trailing

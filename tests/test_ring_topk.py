@@ -123,6 +123,101 @@ class TestRingKernelParity:
         assert pk.ring_topk_kernel_ok(64, 8, 8)
 
 
+class TestRingOverlapSchedule:
+    """ISSUE 11 tentpole: the compute/comms-overlapped (half-pipelined)
+    hop schedule is exact-parity with the PR-8 serialized schedule —
+    kernel-vs-numpy across both schedules at shapes where the overlap
+    actually splits (mc ≥ 16), plus the split/env plumbing."""
+
+    def _run_kernel(self, mesh, vals, ids, k, select_min, schedule):
+        m = vals.shape[1]
+
+        def body(v, i):
+            return pk.ring_topk_merge(v[0], i[0], k, "shard", N_DEV,
+                                      select_min, interpret=True,
+                                      schedule=schedule)
+
+        fn = shard_map(
+            body, mesh=mesh,
+            in_specs=(P("shard", None, None), P("shard", None, None)),
+            out_specs=(P("shard", None), P("shard", None)),
+            check_vma=False)
+        gv, gi = fn(jnp.asarray(vals), jnp.asarray(ids))
+        return np.asarray(gv)[:m], np.asarray(gi)[:m]
+
+    # the serial leg re-proves the PR-8 schedule (already covered by
+    # TestRingKernelParity) — slow lane; the overlap leg stays tier-1
+    @pytest.mark.parametrize("schedule", [
+        pytest.param("serial", marks=pytest.mark.slow), "overlap"])
+    def test_two_half_parity_min_select(self, mesh, rng, schedule):
+        # m=200 → mc=32 → the overlap schedule really splits (16+16)
+        vals, ids = make_tables(rng, 200, 10, True, dup_ids=True)
+        gv, gi = self._run_kernel(mesh, vals, ids, 10, True, schedule)
+        rv, ri = numpy_merge(vals, ids, 10, True)
+        np.testing.assert_array_equal(gv, rv)
+        np.testing.assert_array_equal(gi, ri)
+
+    @pytest.mark.parametrize("schedule", ["serial", "overlap"])
+    @pytest.mark.slow  # heavy interpret-mode kernel traces; CI lanes run it
+    def test_uneven_halves_max_select(self, mesh, rng, schedule):
+        # m=129 → mc=24 → uneven (8, 16) halves; −inf sentinels ride
+        vals, ids = make_tables(rng, 129, 6, False, sentinels=True)
+        gv, gi = self._run_kernel(mesh, vals, ids, 6, False, schedule)
+        rv, ri = numpy_merge(vals, ids, 6, False)
+        np.testing.assert_array_equal(gv, rv)
+        np.testing.assert_array_equal(gi, ri)
+
+    @pytest.mark.slow  # k=64 extraction rounds x 7 hops x 2 schedules
+    def test_overlap_matches_serial(self, mesh, rng):
+        vals, ids = make_tables(rng, 256, pk.RING_TOPK_MAX_K, True,
+                                sentinels=True)
+        so = self._run_kernel(mesh, vals, ids, pk.RING_TOPK_MAX_K, True,
+                              "overlap")
+        ss = self._run_kernel(mesh, vals, ids, pk.RING_TOPK_MAX_K, True,
+                              "serial")
+        np.testing.assert_array_equal(so[0], ss[0])
+        np.testing.assert_array_equal(so[1], ss[1])
+
+    def test_splits(self):
+        # serial: one block; overlap: two sublane-aligned halves that
+        # tile the chunk exactly (the byte model is rows-preserving)
+        assert pk.ring_topk_splits(32, "serial") == ((0, 32),)
+        assert pk.ring_topk_splits(32, "overlap") == ((0, 16), (16, 16))
+        assert pk.ring_topk_splits(24, "overlap") == ((0, 8), (8, 16))
+        # chunks too short to split degenerate to one block
+        assert pk.ring_topk_splits(8, "overlap") == ((0, 8),)
+        for mc in (8, 16, 24, 32, 104):
+            for sched in ("serial", "overlap"):
+                splits = pk.ring_topk_splits(mc, sched)
+                assert sum(r for _, r in splits) == mc
+                assert all(r % 8 == 0 and o % 8 == 0 for o, r in splits)
+
+    def test_schedule_env(self, monkeypatch):
+        assert pk.ring_schedule("serial") == "serial"
+        assert pk.ring_schedule("overlap") == "overlap"
+        monkeypatch.setenv("RAFT_TPU_RING_OVERLAP", "off")
+        assert pk.ring_schedule("auto") == "serial"
+        monkeypatch.setenv("RAFT_TPU_RING_OVERLAP", "on")
+        assert pk.ring_schedule("auto") == "overlap"
+        monkeypatch.delenv("RAFT_TPU_RING_OVERLAP")
+        assert pk.ring_schedule("auto") == "overlap"  # the default
+
+    def test_overlap_schedule_uniform_and_counted(self, mesh, rng,
+                                                  monkeypatch):
+        # the overlapped kernel under the collective-schedule checker +
+        # facade hop accounting: byte model identical to serial
+        monkeypatch.setenv("RAFT_TPU_RING_OVERLAP", "on")
+        x = jnp.asarray(rng.random((2048, 16), dtype=np.float32))
+        q = jnp.asarray(rng.random((256, 16), dtype=np.float32))
+        with sanitize.record_comms_schedule() as rec:
+            sanitize.assert_uniform_collective_schedule(
+                lambda: sharded_knn(x, q, 4, mesh, merge="ring"))
+        hops = [e for e in rec if e[0] == "ring_topk"]
+        assert len(hops) == N_DEV - 1, rec
+        mc = pk.ring_chunk_rows(256, N_DEV)
+        assert all(b == mc * 4 * 8 for _, _, b in hops), rec
+
+
 class TestRingFallbackParity:
     """The ppermute fallback inside real sharded searches: identical
     results to the allgather tier (same candidates, same selection)."""
@@ -373,3 +468,213 @@ def _flat(sched):
             yield from _flat(e[1])
         else:
             yield e
+
+
+@pytest.fixture(scope="module")
+def pq_sharded(mesh):
+    """A small sharded IVF-PQ index + its build data (module-scoped:
+    the distributed build is the expensive part)."""
+    from raft_tpu.neighbors import ivf_pq as _pq
+    from raft_tpu.parallel import build_ivf_pq
+
+    rng = np.random.default_rng(11)
+    x = jnp.asarray(rng.random((1024, 32), dtype=np.float32))
+    params = _pq.IndexParams(n_lists=16, pq_dim=8, pq_bits=4,
+                             kmeans_n_iters=3)
+    return build_ivf_pq(params, x, mesh), x
+
+
+class TestRingFusedScan:
+    """ISSUE 11 tentpole, second half: the fused scan-in-ring tier —
+    per-shard LUT scan folded into the ring exchange, exact parity with
+    the unfused sharded search, unchanged byte model, every decline
+    rung preserved."""
+
+    def _search(self, idx, q, k, mesh, merge="ring", n_probes=4,
+                lut_dtype="float32", scan_select="pallas"):
+        from raft_tpu.neighbors import ivf_pq as _pq
+        from raft_tpu.parallel import search_ivf_pq
+
+        # scan_select="pallas": the fused tier carries the LUT-bin
+        # tier's selection semantics, so it only serves searches the
+        # single-chip dispatch would route there (default "exact"
+        # declines with reason=scan_select)
+        sp = _pq.SearchParams(n_probes=n_probes, lut_dtype=lut_dtype,
+                              scan_select=scan_select)
+        return search_ivf_pq(sp, idx, q, k, mesh, merge=merge)
+
+    def test_fused_matches_unfused(self, mesh, rng, pq_sharded,
+                                   monkeypatch):
+        idx, _ = pq_sharded
+        q = jnp.asarray(rng.random((77, 32), dtype=np.float32))  # ragged
+        monkeypatch.setenv("RAFT_TPU_RING_FUSED", "off")
+        va, ia = self._search(idx, q, 8, mesh, merge="allgather")
+        monkeypatch.setenv("RAFT_TPU_RING_FUSED", "on")
+        vf, iff = self._search(idx, q, 8, mesh, merge="ring")
+        np.testing.assert_array_equal(np.asarray(ia), np.asarray(iff))
+        np.testing.assert_allclose(np.asarray(va), np.asarray(vf),
+                                   rtol=1e-4, atol=1e-4)
+
+    @pytest.mark.slow  # own sharded build + fused kernel trace
+    def test_fused_inner_product(self, mesh, rng, monkeypatch):
+        from raft_tpu.neighbors import ivf_pq as _pq
+        from raft_tpu.parallel import build_ivf_pq
+
+        x = jnp.asarray(rng.random((768, 32), dtype=np.float32))
+        q = jnp.asarray(rng.random((40, 32), dtype=np.float32))
+        idx = build_ivf_pq(
+            _pq.IndexParams(n_lists=8, pq_dim=8, pq_bits=4,
+                            kmeans_n_iters=2, metric="inner_product"),
+            x, mesh)
+        monkeypatch.setenv("RAFT_TPU_RING_FUSED", "off")
+        va, ia = self._search(idx, q, 5, mesh, merge="allgather")
+        monkeypatch.setenv("RAFT_TPU_RING_FUSED", "on")
+        vf, iff = self._search(idx, q, 5, mesh, merge="ring")
+        np.testing.assert_array_equal(np.asarray(ia), np.asarray(iff))
+        np.testing.assert_allclose(np.asarray(va), np.asarray(vf),
+                                   rtol=1e-4, atol=1e-4)
+
+    @pytest.mark.slow  # two more full sharded traces; CI lanes run it
+    def test_fused_dispatch_counters_and_bytes(self, mesh, rng,
+                                               pq_sharded, monkeypatch):
+        from raft_tpu import obs
+        from raft_tpu.obs.metrics import MetricsRegistry
+
+        idx, _ = pq_sharded
+        q = jnp.asarray(rng.random((64, 32), dtype=np.float32))
+
+        def run(fused):
+            monkeypatch.setenv("RAFT_TPU_RING_FUSED", fused)
+            reg = MetricsRegistry()
+            obs.enable(registry=reg, hbm=False)
+            try:
+                jax.block_until_ready(
+                    self._search(idx, q, 8, mesh, merge="ring"))
+            finally:
+                obs.disable()
+            return reg.snapshot()["counters"]
+
+        cf = run("on")
+        assert cf["parallel.merge.dispatch{impl=ring_fused_scan}"] == 1.0
+        assert cf["ivf_pq.scan.dispatch{impl=ring_lut_fused}"] == 1.0
+        cu = run("off")
+        # the fusion moves compute, not bytes: identical ring hop model
+        key_ops = "comms.ops{axis=shard,op=ring_topk}"
+        key_b = "comms.bytes{axis=shard,op=ring_topk}"
+        assert cf[key_ops] == cu[key_ops] == N_DEV - 1
+        assert cf[key_b] == cu[key_b] > 0
+
+    @pytest.mark.slow  # one more full fused-kernel trace
+    def test_fused_schedule_uniform(self, mesh, rng, pq_sharded,
+                                    monkeypatch):
+        idx, _ = pq_sharded
+        monkeypatch.setenv("RAFT_TPU_RING_FUSED", "on")
+        q = jnp.asarray(rng.random((32, 32), dtype=np.float32))
+        with sanitize.record_comms_schedule() as rec:
+            sanitize.assert_uniform_collective_schedule(
+                lambda: self._search(idx, q, 4, mesh, merge="ring"))
+        hops = [e for e in rec if e[0] == "ring_topk"]
+        assert len(hops) == N_DEV - 1, rec
+
+    @pytest.mark.slow  # x64 retrace of the whole sharded search
+    def test_int64_ids_decline_fused(self, mesh, rng, pq_sharded,
+                                     monkeypatch):
+        """The id-width admission is preserved through the fused tier:
+        an int64 id table declines the fused kernel (int32-only) AND
+        the plain ring kernel, landing on the identical-schedule
+        ppermute fallback — counted, never truncated."""
+        from raft_tpu import obs
+        from raft_tpu.obs import sanitize as _san
+        from raft_tpu.obs.metrics import MetricsRegistry
+
+        idx, _ = pq_sharded
+        q = jnp.asarray(rng.random((64, 32), dtype=np.float32))
+        monkeypatch.setenv("RAFT_TPU_RING_FUSED", "on")
+        reg = MetricsRegistry()
+        obs.enable(registry=reg, hbm=False)
+        try:
+            # trace-only under scoped x64, like the plain-ring id-width
+            # test: the declines are trace-time dtype checks
+            with _san.scoped_x64(True):
+                idx64 = idx.replace(
+                    packed_ids=idx.packed_ids.astype(jnp.int64))
+                closed = jax.make_jaxpr(
+                    lambda qq: self._search(idx64, qq, 8, mesh,
+                                            merge="ring"))(q)
+        finally:
+            obs.disable()
+        c = reg.snapshot()["counters"]
+        assert c.get("parallel.merge.fallback{reason=id_width}", 0) >= 1.0
+        assert "ivf_pq.scan.dispatch{impl=ring_lut_fused}" not in c
+        # merged ids keep their 64-bit width end to end
+        assert "int64" in str(closed.jaxpr.outvars[1].aval)
+
+    @pytest.mark.slow  # own sharded build
+    def test_cosine_declines_fused(self, mesh, rng, monkeypatch):
+        from raft_tpu import obs
+        from raft_tpu.neighbors import ivf_pq as _pq
+        from raft_tpu.obs.metrics import MetricsRegistry
+        from raft_tpu.parallel import build_ivf_pq
+
+        x = jnp.asarray(rng.random((512, 32), dtype=np.float32))
+        q = jnp.asarray(rng.random((40, 32), dtype=np.float32))
+        idx = build_ivf_pq(
+            _pq.IndexParams(n_lists=8, pq_dim=8, pq_bits=4,
+                            kmeans_n_iters=2, metric="cosine"),
+            x, mesh)
+        monkeypatch.setenv("RAFT_TPU_RING_FUSED", "on")
+        reg = MetricsRegistry()
+        obs.enable(registry=reg, hbm=False)
+        try:
+            jax.block_until_ready(
+                self._search(idx, q, 5, mesh, merge="ring"))
+        finally:
+            obs.disable()
+        c = reg.snapshot()["counters"]
+        assert c.get("parallel.merge.fallback{reason=metric}", 0) == 1.0
+        assert "ivf_pq.scan.dispatch{impl=ring_lut_fused}" not in c
+
+    def test_exact_scan_select_declines(self, pq_sharded, monkeypatch):
+        """The default scan_select="exact" must never be silently
+        swapped for the bin tier's recall-targeted selection — even
+        under env force the fused tier declines (reason=scan_select)
+        unless the single-chip dispatch would have picked the LUT
+        tier."""
+        from raft_tpu.distance.types import DistanceType
+        from raft_tpu.parallel.ivf import _ring_fused_wanted
+
+        idx, _ = pq_sharded
+        monkeypatch.setenv("RAFT_TPU_RING_FUSED", "on")
+        args = dict(m=64, k=8, n_probes=4, n_dev=N_DEV, whole_mesh=True,
+                    merge="ring", mt=DistanceType.L2Expanded,
+                    lut_dtype="float32")
+        take, reason = _ring_fused_wanted(idx, scan_select="exact",
+                                          **args)
+        assert (take, reason) == (False, "scan_select")
+        take, reason = _ring_fused_wanted(idx, scan_select="pallas",
+                                          **args)
+        assert (take, reason) == (True, "")
+        # "approx" only at the oversampled auto-upgrade shape
+        take, reason = _ring_fused_wanted(idx, scan_select="approx",
+                                          **args)
+        assert (take, reason) == (False, "scan_select")
+
+    @pytest.mark.slow  # one more sharded trace; CI lanes run it
+    def test_env_off_keeps_plain_path(self, mesh, rng, pq_sharded,
+                                      monkeypatch):
+        from raft_tpu import obs
+        from raft_tpu.obs.metrics import MetricsRegistry
+
+        idx, _ = pq_sharded
+        q = jnp.asarray(rng.random((64, 32), dtype=np.float32))
+        monkeypatch.setenv("RAFT_TPU_RING_FUSED", "off")
+        reg = MetricsRegistry()
+        obs.enable(registry=reg, hbm=False)
+        try:
+            jax.block_until_ready(
+                self._search(idx, q, 8, mesh, merge="ring"))
+        finally:
+            obs.disable()
+        c = reg.snapshot()["counters"]
+        assert "parallel.merge.dispatch{impl=ring_fused_scan}" not in c
+        assert c["parallel.merge.dispatch{impl=ring_ppermute}"] == 1.0

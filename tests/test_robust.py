@@ -1,6 +1,8 @@
 """raft_tpu.robust — fault injection, retry policy, degradation ladder
 (ISSUE 7 tentpole; docs/developer_guide.md "Robustness")."""
 
+import dataclasses
+
 import numpy as np
 import pytest
 
@@ -304,20 +306,21 @@ class TestDegrade:
         knobs = {"params": ivf_pq.SearchParams(scan_select="pallas"),
                  "dataset": jnp.ones((8, 4))}
         names = []
-        for _ in range(6):
+        for _ in range(7):
             adv = ladder.advance(knobs)
             if adv is None:
                 break
             step, knobs = adv
             names.append(step.name)
-        # pallas→approx then →per_query are two decline_fused moves;
-        # host_gather skipped (refine off); terminal halving repeats
-        assert names[:2] == ["halve_batch", "bf16_lut"]
-        assert names[2:4] == ["decline_fused", "decline_fused"]
-        assert set(names[4:]) == {"halve_batch"}
+        # two LUT-footprint halvings (bf16 then fp8), then pallas→approx
+        # and →per_query as two decline_fused moves; host_gather skipped
+        # (refine off); terminal halving repeats
+        assert names[:3] == ["halve_batch", "bf16_lut", "fp8_lut"]
+        assert names[3:5] == ["decline_fused", "decline_fused"]
+        assert set(names[5:]) == {"halve_batch"}
         assert knobs["params"].scan_select == "approx"
         assert knobs["params"].scan_mode == "per_query"
-        assert knobs["params"].lut_dtype == "bfloat16"
+        assert knobs["params"].lut_dtype == "float8_e4m3"
 
     def test_host_gather_rung_moves_dataset(self):
         from raft_tpu.neighbors import ivf_pq
@@ -380,6 +383,52 @@ class TestSearchResilient:
         assert c["degrade.steps{from=halve_batch,"
                  "reason=resource_exhausted,site=ivf_pq.search,"
                  "to=bf16_lut}"] == 1.0
+
+    def test_three_ooms_reach_the_fp8_rung(self, pq_index):
+        """ISSUE 11: the fp8-LUT rung between bf16 and decline_fused —
+        an injected-OOM walk lands on it (counted as
+        ``degrade.steps{to=fp8_lut}``), results equal the undegraded
+        search (exact top-k stable under LUT quantization at this
+        scale), and the flight recorder's robust section shows the
+        move."""
+        from raft_tpu.neighbors import ivf_pq
+        from raft_tpu.obs import flight
+
+        idx, x = pq_index
+        sp = ivf_pq.SearchParams(n_probes=8, scan_mode="per_query")
+        d0, i0 = ivf_pq.search(idx, x[:32], 10, sp)
+        # what the fp8 rung's configuration produces WITHOUT any fault:
+        # the degraded run must reproduce exactly this (batch splitting
+        # is exact; the fp8-LUT rung is the documented precision trade,
+        # so equality to the native f32 run is a recall bound, not
+        # bit-equality — same contract as the bf16 rung)
+        sp8 = dataclasses.replace(sp, lut_dtype="float8_e4m3")
+        d8, i8 = ivf_pq.search(idx, x[:16], 10, sp8)
+        d8b, i8b = ivf_pq.search(idx, x[16:32], 10, sp8)
+        d8 = jnp.concatenate([d8, d8b])
+        i8 = jnp.concatenate([i8, i8b])
+        degrade.clear_recent()
+        reg = MetricsRegistry()
+        obs.enable(registry=reg, hbm=False)
+        faults.install_plan({"faults": [
+            {"site": "ivf_pq.search", "kind": "oom", "times": 3}]})
+        d1, i1 = ivf_pq.search_resilient(idx, x[:32], 10, sp)
+        np.testing.assert_array_equal(np.asarray(i1), np.asarray(i8))
+        np.testing.assert_allclose(np.asarray(d1), np.asarray(d8),
+                                   rtol=1e-6, atol=1e-6)
+        overlap = np.mean([len(set(a) & set(b)) / 10.0 for a, b in
+                           zip(np.asarray(i1), np.asarray(i0))])
+        assert overlap >= 0.9, overlap
+        c = _counters(reg)
+        assert c["degrade.steps{from=bf16_lut,"
+                 "reason=resource_exhausted,site=ivf_pq.search,"
+                 "to=fp8_lut}"] == 1.0
+        assert c["degrade.recovered{site=ivf_pq.search}"] == 1.0
+        # the flight recorder's black box records the walk
+        recent = degrade.recent_steps()
+        assert any(s["to"] == "fp8_lut" for s in recent), recent
+        moves = flight._robust_state()["degrade_recent"]
+        assert any(s["to"] == "fp8_lut" for s in moves), moves
 
     def test_no_fault_means_no_counters(self, pq_index):
         from raft_tpu.neighbors import ivf_pq

@@ -95,6 +95,12 @@ Every sharded search's candidate merge routes through `merge_topk`
 | `allgather` | auto off-TPU, small/latency-bound shapes, or forced | one `all_gather` of the `[n_dev, m, k]` tables + local select; result replicated | O(n_dev·m·k) — the materialized table (`comms.bytes{op=allgather}`) |
 | `ring_kernel` | TPU + whole-mesh 1-D axis + `k ≤ 64` + VMEM guard (`ops.pallas_kernels.ring_topk_kernel_ok`) | Pallas `ring_topk_merge`: n_dev−1 async-remote-DMA hops, each shipping only the surviving `[m/n_dev, k]` block, k-round extraction merge on-chip; result query-sharded | O(m·k) total (per-hop `comms.bytes{op=ring_topk}`, attributed via `Comms.count_ring_topk`) |
 | `ring_ppermute` | ring tier forced/auto off-TPU or on a sub-axis of a multi-axis mesh | `Comms.ring_topk_hop` ppermute hops — the kernel's schedule, identical results and identical counted bytes | O(m·k) total (per-hop `comms.bytes{op=ring_topk}`) |
+| `ring_fused_scan` | non-refined sharded IVF-PQ where the ring kernel would run (`RAFT_TPU_RING_FUSED` tri-state; l2/ip metrics, int32 ids, supported packed layout, union table ≤ `RING_FUSED_MAX_SEGS`) | ONE persistent kernel (`ops.pallas_kernels.ring_lut_scan_merge`): each hop's exchange hides the NEXT query chunk's LUT scan; the per-shard `[m, k]` candidate table never reaches HBM | identical to the ring tiers (the fusion moves compute, not bytes) |
+
+The ring kernel's hop schedule is `RAFT_TPU_RING_OVERLAP` (auto |
+on | off; auto = the half-pipelined overlap schedule, `off` = the
+serialized PR-8 exchange kept for bench comparison) — exact parity
+either way, see docs/developer_guide.md "The ring schedule".
 
 See docs/developer_guide.md "The cross-shard merge tier" for the full
 latency/bandwidth trade and docs/observability.md for the byte model.
@@ -112,14 +118,24 @@ latency/bandwidth trade and docs/observability.md for the byte model.
 | `grouped_pallas` | `scan_select="exact"` + recon cache + VMEM fit (TPU) | fused contraction + running top-k per segment chunk | `[n_seg, seg, k]` accumulators |
 | `segk` | `scan_select="approx"` + recon cache + VMEM fit (TPU) | scalar-prefetch DMA kernel over bf16 recon rows | `[n_seg, seg, 256]` bin tables |
 | `pallas_lut` | `scan_select="pallas"`, or `"approx"` auto-upgraded for oversampled shapes (`n_probes ≥ 64` or `k ≥ 400`) with NO recon cache; needs `n_probes·256 ≥ k`, no filter bitset (TPU) | fused LUT-scan over PACKED codes: in-kernel n-bit unpack, on-chip ADC Σ_s QLUT[s, code_s], 2-deep bin top-k | `[n_seg, seg, 256]` bin tables only |
+| `ring_lut_fused` | sharded (`mesh=`) non-refined search where the ring merge would run (see `parallel.merge`'s table) | the scan folded INTO the ring exchange — one persistent kernel per shard from packed codes to the merged top-k | none: chunk candidates live in VMEM only |
 | `staged` | obs stage mode (`RAFT_TPU_OBS_STAGES=1`) | per-stage programs under recording spans | as per_query |
 
-`lut_dtype` ("float32" | "bfloat16" | "float8_e4m3") is the reference's
-fp8-LUT accuracy/footprint trade (`ivf_pq_fp_8bit.cuh`): float32 keeps
-exact f32 ADC (and exact parity between tiers); bfloat16 ≈ the TPU
-decode default, ~1e-2-relative key drift, candidate overlap ≥ 0.99 in
-practice; float8_e4m3 quantizes harder — use only with a refine pass
-behind it. The XLA paths quantize LUT entries, the `pallas_lut` kernel
+`lut_dtype` ("auto" | "float32" | "bfloat16" | "float8_e4m3") is the
+reference's fp8-LUT accuracy/footprint trade (`ivf_pq_fp_8bit.cuh`):
+float32 keeps exact f32 ADC (and exact parity between tiers);
+bfloat16 ≈ the TPU decode default, ~1e-2-relative key drift, candidate
+overlap ≥ 0.99 in practice; float8_e4m3 quantizes harder — sized for
+oversampled scans where the candidate slack absorbs the reordering.
+The default "auto" resolves per dispatch (`resolve_lut_dtype`,
+counted in `ivf_pq.lut.dispatch{dtype=…}`): **fp8 is the measured
+default for oversampled TPU scans** when the candidate slack is ≥
+`FP8_LUT_MIN_SLACK`×k, declining to bf16 on thin slack and to exact
+f32 for everything else (and everywhere off-TPU unless
+`RAFT_TPU_FP8_LUT=on`). The recorded per-dataset recall deltas (bench
+`lut_dtype` legs, held by the benchdiff gate) must stay within
+`FP8_LUT_RECALL_FLOOR` (0.01 recall@10); a dataset past the floor
+pins `lut_dtype="bfloat16"` explicitly. The XLA paths quantize LUT entries, the `pallas_lut` kernel
 quantizes its codebook operand — same knob, numerically siblings.
 
 `SearchParams.refine="f32_regen"` + `search(..., dataset=...)` folds

@@ -87,7 +87,7 @@ _DTYPE_BYTES = {
 # construction and exempt.
 _STREAM_KERNELS = {
     "ivfpq_lut_scan_topk", "gather_refine_topk", "ring_topk_merge",
-    "segmented_scan_topk", "grouped_scan_topk",
+    "ring_lut_scan_merge", "segmented_scan_topk", "grouped_scan_topk",
 }
 _GUARD_SUFFIXES = ("_mem_ok", "_kernel_ok")
 

@@ -21,7 +21,13 @@ GL08  Pallas DMA lifetime — every ``make_async_copy`` /
       ``.wait()`` on all control paths before kernel exit; a slot
       restarted while its previous copy is in flight, or two
       concurrently-live copies sharing one semaphore, is the
-      double-buffering race class.
+      double-buffering race class. Copy-factory calls with statically
+      stable arguments resolve to concrete semaphore slots (actuals
+      substituted into the factory's sem expression), so the overlap
+      idiom — loop-carried slot reuse with two in-flight copies on
+      DISTINCT semaphores — is checked too; dynamically-rotated slots
+      (loop-varying arguments) stay with the whole-tree start/wait
+      tally.
 GL09  ``shard_map`` contract — ``in_specs`` arity vs. the wrapped
       function's positional signature, and ``P()`` axis names absent
       from the mesh / module axis declarations.
@@ -652,10 +658,100 @@ def _dma_check_fn(root: ast.FunctionDef, add) -> None:
                 f"{what} is started but never waited anywhere in "
                 f"{root.name}() — in-flight DMA at kernel exit")
 
+    # factory slot identity (the overlap idiom — ISSUE 11): a factory
+    # call whose arguments are statically stable resolves to a concrete
+    # semaphore slot by substituting the actuals into the factory's sem
+    # expression, so loop-carried slot reuse across hops is checkable:
+    # two in-flight copies on DISTINCT semaphores are the legitimate
+    # pipelined schedule; a restart of the SAME slot without a wait is
+    # the race. Calls carrying loop-varying names (slot = s % 2, the
+    # gather-refine queue's t % NBUF) rotate dynamically and stay with
+    # the whole-tree tally.
+    fac_sems: Dict[str, Tuple[List[str], List[ast.AST]]] = {}
+    for f in ast.walk(root):
+        if isinstance(f, (ast.FunctionDef, ast.AsyncFunctionDef)) \
+                and f is not root and f.name in factories:
+            for s in ast.walk(f):
+                if isinstance(s, ast.Return) and _is_dma_make(s.value):
+                    sems = [kw.value for kw in s.value.keywords
+                            if kw.arg in ("sem", "send_sem", "recv_sem")]
+                    if not sems and len(s.value.args) >= 3:
+                        sems = [s.value.args[2]]
+                    fac_sems[f.name] = ([a.arg for a in f.args.args],
+                                        sems)
+                    break
+    varying: Set[str] = set()
+    for node in ast.walk(root):
+        if isinstance(node, ast.For) and isinstance(node.target,
+                                                    ast.Name):
+            varying.add(node.target.id)
+        elif isinstance(node, ast.Assign):
+            for tgt in node.targets:
+                if isinstance(tgt, ast.Name):
+                    varying.add(tgt.id)
+        elif isinstance(node, ast.AugAssign) \
+                and isinstance(node.target, ast.Name):
+            varying.add(node.target.id)
+
+    class _Subst(ast.NodeTransformer):
+        def __init__(self, mapping):
+            self.mapping = mapping
+
+        def visit_Name(self, node):
+            return self.mapping.get(node.id, node)
+
+    # a factory with ANY dynamically-slotted wait (loop-varying
+    # argument) makes per-slot liveness unsound — a rotated wait may
+    # cover any static slot (the prologue-fill + drain-in-loop queue
+    # idiom) — so its calls stay with the whole-tree tally entirely
+    def _args_vary(recv: ast.Call) -> bool:
+        return any(isinstance(n, ast.Name) and n.id in varying
+                   for a in recv.args for n in ast.walk(a))
+
+    dyn_wait_facs: Set[str] = set()
+    for node in ast.walk(root):
+        if isinstance(node, ast.Call) \
+                and isinstance(node.func, ast.Attribute) \
+                and node.func.attr.startswith("wait") \
+                and isinstance(node.func.value, ast.Call):
+            fname = _last_seg(_dotted(node.func.value.func))
+            if fname in fac_sems and _args_vary(node.func.value):
+                dyn_wait_facs.add(fname)
+
+    def fac_slot(recv: ast.Call):
+        """(key, sem-dump, label) for a statically-slotted factory call,
+        or ``None`` when the slot rotates dynamically."""
+        import copy as _copy
+
+        name = _last_seg(_dotted(recv.func))
+        if name not in fac_sems or name in dyn_wait_facs \
+                or recv.keywords:
+            return None
+        params, sems = fac_sems[name]
+        if not sems or len(recv.args) > len(params):
+            return None
+        for a in recv.args:
+            if any(isinstance(n, ast.Name) and n.id in varying
+                   for n in ast.walk(a)):
+                return None
+        mapping = dict(zip(params, recv.args))
+        subst = []
+        for s_expr in sems:
+            cp = _Subst(mapping).visit(_copy.deepcopy(s_expr))
+            if any(isinstance(n, ast.Name) and n.id in varying
+                   for n in ast.walk(cp)):
+                return None
+            subst.append(ast.dump(cp))
+        sem = "|".join(subst)
+        args = ",".join(ast.dump(a) for a in recv.args)
+        return (("fslot", name, args), sem,
+                f"{name}({', '.join(ast.unparse(a) for a in recv.args)})")
+
     # sequential abstract interpretation over the kernel body: per-slot
     # liveness, loop-carried reuse, semaphore sharing, all-paths waits
-    def merge(l1: Dict[str, dict], l2: Dict[str, dict]) -> Dict[str, dict]:
-        out: Dict[str, dict] = {}
+    def merge(l1: Dict[object, dict], l2: Dict[object, dict]
+              ) -> Dict[object, dict]:
+        out: Dict[object, dict] = {}
         for name in set(l1) | set(l2):
             a, b = l1.get(name), l2.get(name)
             ent = dict(a or b)
@@ -664,46 +760,56 @@ def _dma_check_fn(root: ast.FunctionDef, add) -> None:
             out[name] = ent
         return out
 
-    def handle_call(call: ast.Call, live: Dict[str, dict]) -> None:
+    def handle_call(call: ast.Call, live: Dict[object, dict]) -> None:
         if not isinstance(call.func, ast.Attribute):
             return
         recv = call.func.value
-        if not (isinstance(recv, ast.Name) and recv.id in dma_vars):
+        if isinstance(recv, ast.Name) and recv.id in dma_vars:
+            key = recv.id
+            sem = var_sem.get(recv.id, "")
+            label = repr(recv.id)
+        elif isinstance(recv, ast.Call):
+            fs = fac_slot(recv)
+            if fs is None:
+                return
+            key, sem, label = fs
+            label = f"factory {label}"
+        else:
             return
-        name = recv.id
         if call.func.attr == "start":
-            sem = var_sem.get(name, "")
-            ent = live.get(name)
+            ent = live.get(key)
             if ent is not None and ent["definite"]:
-                if ("restart", name) not in flagged:
-                    flagged.add(("restart", name))
+                if ("restart", key) not in flagged:
+                    flagged.add(("restart", key))
                     add(call, "GL08",
-                        f"DMA slot {name!r} restarted while its previous "
+                        f"DMA slot {label} restarted while its previous "
                         "copy is still in flight — wait() the slot "
                         "before reuse (double-buffering race)")
             else:
                 for other, oent in live.items():
-                    if other != name and sem and oent.get("sem") == sem \
-                            and ("sem", name) not in flagged:
-                        flagged.add(("sem", name))
+                    if other != key and sem and oent.get("sem") == sem \
+                            and ("sem", key) not in flagged:
+                        flagged.add(("sem", key))
                         add(call, "GL08",
-                            f"DMAs {other!r} and {name!r} are "
-                            "concurrently live on the SAME semaphore — "
-                            "waits become ambiguous; give each "
-                            "in-flight copy its own semaphore slot")
-            live[name] = {"sem": sem, "node": call, "definite": True}
+                            f"DMAs {oent.get('label', other)} and "
+                            f"{label} are concurrently live on the SAME "
+                            "semaphore — waits become ambiguous; give "
+                            "each in-flight copy its own semaphore slot")
+            live[key] = {"sem": sem, "node": call, "definite": True,
+                         "label": label}
         elif call.func.attr.startswith("wait"):
-            live.pop(name, None)
+            live.pop(key, None)
 
-    def exit_check(live: Dict[str, dict]) -> None:
+    def exit_check(live: Dict[object, dict]) -> None:
         for name, ent in live.items():
-            if ("nowait", name) in flagged or ("exit", name) in flagged \
+            fname = name[1] if isinstance(name, tuple) else name
+            if ("nowait", fname) in flagged or ("exit", name) in flagged \
                     or ("restart", name) in flagged:
                 continue
             flagged.add(("exit", name))
             add(ent["node"], "GL08",
-                f"DMA {name!r} is not waited on all control paths "
-                f"before {root.name}() exits")
+                f"DMA {ent.get('label', repr(name))} is not waited on "
+                f"all control paths before {root.name}() exits")
 
     def exec_block(stmts: Sequence[ast.stmt],
                    live: Dict[str, dict]) -> Dict[str, dict]:
