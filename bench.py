@@ -225,6 +225,17 @@ def hard_config(n: int, n_queries: int, algos):
             + [{"n_probes": 64, "refine_ratio": 4,
                 "scan_select": "approx", "lut_dtype": dt}
                for dt in ("float32", "bfloat16", "float8_e4m3")]
+            # filtered-search legs (ISSUE 12): the selectivity sweep at
+            # fixed search params, plus one forced-fallback twin at 10%
+            # (leg_env pins the pre-ISSUE-12 tier) — the fused-vs-
+            # fallback qps gap and the filtered recall are held
+            # row-by-row by the benchdiff gate
+            + [{"n_probes": 64, "refine_ratio": 4,
+                "scan_select": "approx", "filter_selectivity": s}
+               for s in (0.01, 0.1, 0.5)]
+            + [{"n_probes": 64, "refine_ratio": 4,
+                "scan_select": "approx", "filter_selectivity": 0.1,
+                "leg_env": {"RAFT_TPU_PALLAS_LUTSCAN": "never"}}]
             + _small_batch_legs({"n_probes": 64, "refine_ratio": 4,
                                  "scan_select": "approx"}, n_queries),
         })

@@ -306,6 +306,18 @@ assert all(r["sum"] > 0 for r in rows
 disp = [r for r in rows if r["name"] == "ivf_pq.scan.dispatch"]
 assert disp and all(r["value"] > 0 for r in disp), \
     f"ivf_pq.scan.dispatch counter missing: {sorted(names)}"
+# ISSUE 12: the hard leg now carries filtered rows (the selectivity
+# sweep) — the RETIRED filter_bitset fallback reason must stay at ZERO
+# across every filtered leg for eligible shapes (a regression that
+# re-disqualifies filtered searches from the fused tiers trips here),
+# and filtered dispatch decisions carry the filtered=1 label
+fb_rows = [r for r in rows if r["name"] == "ivf_pq.scan.fallback"
+           and r["labels"].get("reason") == "filter_bitset"]
+assert not fb_rows, \
+    f"retired filter_bitset fallback reason resurfaced: {fb_rows}"
+filt = [r for r in disp if r["labels"].get("filtered") == "1"]
+assert filt and all(r["value"] > 0 for r in filt), \
+    f"no filtered=1 scan dispatches recorded: {disp}"
 # the prof.* roofline gauges must have landed in the captured series
 prof = [r for r in rows if r["name"].startswith("prof.")]
 assert {"prof.flops", "prof.bytes", "prof.bound"} <= \
@@ -504,7 +516,32 @@ c = snap["counters"].get("ivf_pq.scan.dispatch{impl=pallas_lut}", 0)
 assert c >= 1, snap["counters"]
 scan_span = snap["histograms"].get("span.ivf_pq.search.scan")
 assert scan_span and scan_span["count"] >= 1, snap["histograms"].keys()
-print("pallas LUT-scan smoke OK: dispatch counter + scan span recorded")
+# ISSUE 12: the SAME eligible shape with a filter_bitset stays on the
+# tier — the kernel streams the packed keep bits; the dispatch counts
+# filtered=1 and the retired filter_bitset fallback reason stays ZERO
+from raft_tpu.core import bitset
+
+mask = np.ones(3000, bool)
+mask[::3] = False
+bits = bitset.from_mask(jnp.asarray(mask))
+reg2 = MetricsRegistry()
+obs.enable(registry=reg2, hbm=False)
+try:
+    _, ids = ivf_pq.search(idx, x[:64], 400, ivf_pq.SearchParams(
+        n_probes=8, scan_mode="grouped", scan_select="approx"),
+        filter_bitset=bits)
+finally:
+    obs.disable()
+c2 = reg2.snapshot()["counters"]
+assert c2.get("ivf_pq.scan.dispatch{filtered=1,impl=pallas_lut}",
+              0) >= 1, c2
+assert c2.get("ivf_pq.scan.fallback{reason=filter_bitset}", 0) == 0, c2
+got = np.asarray(ids)
+got = got[got >= 0]
+assert got.size and not (got % 3 == 0).any(), \
+    "filtered ids leaked through the fused scan"
+print("pallas LUT-scan smoke OK: dispatch counter + scan span recorded; "
+      "filtered dispatch stays on the tier (filter_bitset fallback = 0)")
 EOF
 
 echo "== Pallas gather-refine tier smoke (interpret mode, streamed refine) =="

@@ -28,6 +28,7 @@ docs/tpu_design_notes.md for measured examples.
 
 from __future__ import annotations
 
+import os
 import time
 from dataclasses import dataclass, field
 from typing import Any, Callable, Dict, List, Optional
@@ -263,6 +264,58 @@ def bench_pq_scan(grid=None, iters: int = 3) -> List[PrimResult]:
                        iters=iters, warmup=1)
             rows.append(PrimResult("ivf_pq.lut_scan", name, ms,
                                    batch * 1e3 / ms, "queries/s", p))
+        # FILTERED rows (ISSUE 12 acceptance): the fused filtered scan
+        # vs the forced-fallback tier the same filtered shape used to
+        # pay (grouped XLA under RAFT_TPU_PALLAS_LUTSCAN=never) at 10%
+        # selectivity — the cliff this PR removes, as one prims pair
+        from raft_tpu.core import bitset as _bitset
+
+        keep = rng.random(n) < 0.1
+        fb = _bitset.from_mask(jnp.asarray(keep))
+        pf = {**p, "filter_selectivity": 0.1}
+        # the filtered gate re-checks the kernel admission with
+        # filtered=True — the filter-byte slots + unpack selection
+        # matrix grow the VMEM model, so a shape that fits unfiltered
+        # can still decline filtered (search() would silently run the
+        # approx tier and this row would be mislabeled)
+        filtered_ok = (lut_ok
+                       and ic.filtered_scan_mem_ok(
+                           n_lists, index.max_list_size)
+                       and pallas_lut_scan_wanted(
+                           index.pq_dim, index.pq_book_size,
+                           index.pq_len,
+                           packed_nbytes(index.pq_dim, index.pq_bits),
+                           index.packed_codes.shape[-1],
+                           index.max_list_size, index.rot_dim,
+                           lut_dtype="bfloat16", filtered=True))
+        if filtered_ok:
+            sp_f = ivf_pq.SearchParams(
+                n_probes=n_probes, scan_mode="grouped",
+                scan_select="pallas", lut_dtype="bfloat16")
+            ms = _time(lambda: ivf_pq.search(index, q, k_cand, sp_f,
+                                             filter_bitset=fb),
+                       iters=iters, warmup=1)
+            rows.append(PrimResult("ivf_pq.lut_scan",
+                                   "filtered_pallas_lut", ms,
+                                   batch * 1e3 / ms, "queries/s", pf))
+        else:
+            rows.append(PrimResult("ivf_pq.lut_scan",
+                                   "filtered_pallas_skipped", 0.0, 0.0,
+                                   "queries/s",
+                                   {**pf, "skipped": "outside the "
+                                    "kernel/HBM gate"}))
+        from raft_tpu.bench.runner import _scoped_env
+
+        with _scoped_env({"RAFT_TPU_PALLAS_LUTSCAN": "never"}):
+            sp_u = ivf_pq.SearchParams(n_probes=n_probes,
+                                       scan_mode="grouped",
+                                       scan_select="approx")
+            ms = _time(lambda: ivf_pq.search(index, q, k_cand, sp_u,
+                                             filter_bitset=fb),
+                       iters=iters, warmup=1)
+            rows.append(PrimResult("ivf_pq.lut_scan",
+                                   "filtered_fallback", ms,
+                                   batch * 1e3 / ms, "queries/s", pf))
     return rows
 
 

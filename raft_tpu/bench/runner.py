@@ -20,10 +20,22 @@ Config schema (mirrors run/conf/*.json)::
         ...
       ]
     }
+
+Per-row search_param extras (popped before the algo sees them):
+``batch_size``/``n_queries``/``fence_per_call`` (the reference's batch
+1/10 latency protocol), ``filter_selectivity`` (ISSUE 12: pre-filter
+the search with a seeded bitset at that set-bit fraction; recall is
+measured against EXACT filtered groundtruth shared per selectivity),
+and ``leg_env`` (env overrides held for the row's measurement +
+diagnostics — how a config pins a dispatch tier for an honest
+fused-vs-forced-fallback comparison). All of these stay in the
+recorded ``search_param`` so benchdiff's join key distinguishes the
+legs.
 """
 
 from __future__ import annotations
 
+import contextlib
 import csv
 import json
 import os
@@ -117,14 +129,16 @@ def _algo_ivf_flat(dsx, build_param, metric):
         # ivf_flat._route_refined (device → fused gather-refine kernel
         # on TPU oversampled shapes; memmap base → host gather)
         sp = dict(sp)
+        fb = sp.pop("filter_bitset", None)
         ratio = sp.pop("refine_ratio", 1)
         if ratio > 1:
             return ivf_flat.search(
                 index, q, k,
                 ivf_flat.SearchParams(**sp, refine="f32_regen",
                                       refine_ratio=float(ratio)),
-                dataset=dsx)
-        return ivf_flat.search(index, q, k, ivf_flat.SearchParams(**sp))
+                filter_bitset=fb, dataset=dsx)
+        return ivf_flat.search(index, q, k, ivf_flat.SearchParams(**sp),
+                               filter_bitset=fb)
 
     return search, index
 
@@ -147,16 +161,23 @@ def _algo_ivf_pq(dsx, build_param, metric):
 
     def search(q, k, sp):
         sp = dict(sp)
+        fb = sp.pop("filter_bitset", None)
         ratio = sp.pop("refine_ratio", refine_ratio)
         if ratio > 1:
-            d0, i0 = ivf_pq.search(index, q, k * int(ratio), ivf_pq.SearchParams(**sp))
+            # the oversampled scan already excludes filtered candidates
+            # (the fused tiers stream the mask), so i0 is filter-clean
+            # entering the re-rank
+            d0, i0 = ivf_pq.search(index, q, k * int(ratio),
+                                   ivf_pq.SearchParams(**sp),
+                                   filter_bitset=fb)
             if host_base is not None:
                 # memmapped base: gather only candidate rows on the host —
                 # jitted refine would materialize the whole base in HBM
                 return refine.refine_gathered(host_base, q, i0, k,
                                               metric=index.metric)
             return refine.refine(dsx, q, i0, k, metric=index.metric)
-        return ivf_pq.search(index, q, k, ivf_pq.SearchParams(**sp))
+        return ivf_pq.search(index, q, k, ivf_pq.SearchParams(**sp),
+                             filter_bitset=fb)
 
     return search, index
 
@@ -347,6 +368,64 @@ def _xprof_capture(search_fn, queries, k, sp, batch_size, xprof_dir):
         print(f"[bench] xprof capture written under {xprof_dir}")
 
 
+@contextlib.contextmanager
+def _scoped_env(overrides: Optional[Dict[str, Any]]):
+    """Apply a leg's env overrides for the duration of its measurement
+    (timed loop + diagnostic captures), restoring prior values — unset
+    variables are removed again — even when the leg dies."""
+    if not overrides:
+        yield
+        return
+    saved = {name: os.environ.get(name) for name in overrides}
+    try:
+        for name, val in overrides.items():
+            os.environ[name] = str(val)
+        yield
+    finally:
+        for name, prev in saved.items():
+            if prev is None:
+                os.environ.pop(name, None)
+            else:
+                os.environ[name] = prev
+
+
+def _filter_leg(data: ds_mod.Dataset, selectivity: float, k: int):
+    """Deterministic filtered-search leg state (ISSUE 12): a seeded
+    keep mask at ``selectivity``, its packed bitset, and EXACT filtered
+    groundtruth (brute force over only the kept rows, kept-row ids
+    mapped back to global). Cached on the Dataset per selectivity —
+    the fused and forced-fallback rows of one sweep share the mask and
+    the GT, so their recall columns are comparable."""
+    from raft_tpu.core import bitset as _bitset
+    from ..neighbors import brute_force
+
+    cache = getattr(data, "_filter_legs", None)
+    if cache is None:
+        cache = {}
+        data._filter_legs = cache
+    key = round(float(selectivity), 6)
+    if key in cache:
+        return cache[key]
+    rng = np.random.default_rng(981_000 + int(key * 1_000_000))
+    keep = rng.random(data.n) < key
+    if keep.sum() < k:  # degenerate tiny-selectivity guard
+        keep[rng.permutation(data.n)[:k]] = True
+    bits = _bitset.from_mask(jnp.asarray(keep))
+    kept_rows = np.where(keep)[0].astype(np.int64)
+    base_kept = jnp.asarray(np.ascontiguousarray(data.base[kept_rows],
+                                                 dtype=np.float32))
+    index = brute_force.build(base_kept, metric=data.metric)
+    # impl="sort": guaranteed-exact GT, same contract as the unfiltered
+    # groundtruth above
+    _, ids = brute_force.knn(index, jnp.asarray(data.queries), k,
+                             impl="sort")
+    gt = kept_rows[np.clip(np.asarray(ids), 0, len(kept_rows) - 1)]
+    gt = np.where(np.asarray(ids) >= 0, gt, -1).astype(np.int64)
+    del index, base_kept
+    cache[key] = (bits, gt)
+    return cache[key]
+
+
 def _bench_search(search_fn, queries, k, sp, batch_size, iters=5,
                   fence_per_call=False):
     m = queries.shape[0]
@@ -482,29 +561,58 @@ def _run_one_index(index_cfg, algo, dsx, data, queries, k, batch_size,
         # (that is what batch 1/10 measures); override with
         # "fence_per_call": false to pipeline anyway
         fenced = bool(sp.pop("fence_per_call", row_bs < batch_size))
+        # filtered-search legs (ISSUE 12): "filter_selectivity": 0.1
+        # pre-filters the search with a seeded bitset at that set-bit
+        # fraction; recall is measured against EXACT filtered
+        # groundtruth shared across the sweep's rows at the same
+        # selectivity (fused vs forced-fallback rows stay comparable)
+        fsel = sp.pop("filter_selectivity", None)
+        leg_fn, gt = search_fn, data.groundtruth
+        if fsel is not None:
+            fbits, gt = _filter_leg(data, float(fsel), k)
+
+            def leg_fn(q, kk, s, _fb=fbits, _fn=search_fn):
+                return _fn(q, kk, {**s, "filter_bitset": _fb})
+        # "leg_env": env overrides scoped to this row's measurement —
+        # how a config pins a dispatch tier for an honest fused-vs-
+        # forced-fallback comparison (e.g. RAFT_TPU_PALLAS_LUTSCAN=
+        # "never" reproduces the pre-ISSUE-12 filtered fallback tier).
+        # Held through the obs/xprof captures (they must describe the
+        # same program the timed loop ran), restored after the row;
+        # recorded in search_param (part of the benchdiff join key).
+        leg_env = sp.pop("leg_env", None)
         q_leg = queries if row_nq is None else \
             queries[: min(int(row_nq), queries.shape[0])]
-        ids, dt, qps = _bench_search(search_fn, q_leg, k, sp, row_bs,
-                                     fence_per_call=fenced)
-        rec = ds_mod.recall(ids, data.groundtruth[: q_leg.shape[0]])
-        stages = stage_path = peak_hbm = latency_q = cost_row = None
-        if _env_flag("RAFT_TPU_BENCH_OBS"):
-            try:
-                stages, stage_path, peak_hbm, latency_q, cost_row = \
-                    _obs_capture(
-                        search_fn, q_leg, k, sp, row_bs,
-                        context=f"{index_cfg.get('name', algo)} {sp}")
-            except Exception as e:  # diagnostics must never cost a row
-                print(f"[bench] obs capture failed ({e!r}) — "
-                      "row kept without stage breakdown")
-        xprof_dir = os.environ.get("RAFT_TPU_XPROF_DIR")
-        if xprof_dir:
-            _xprof_capture(search_fn, q_leg, k, sp, row_bs, xprof_dir)
+        with _scoped_env(leg_env):
+            ids, dt, qps = _bench_search(leg_fn, q_leg, k, sp, row_bs,
+                                         fence_per_call=fenced)
+            rec = ds_mod.recall(ids, gt[: q_leg.shape[0]])
+            stages = stage_path = peak_hbm = latency_q = cost_row = None
+            if _env_flag("RAFT_TPU_BENCH_OBS"):
+                try:
+                    stages, stage_path, peak_hbm, latency_q, cost_row = \
+                        _obs_capture(
+                            leg_fn, q_leg, k, sp, row_bs,
+                            context=f"{index_cfg.get('name', algo)} {sp}")
+                except Exception as e:  # diagnostics never cost a row
+                    print(f"[bench] obs capture failed ({e!r}) — "
+                          "row kept without stage breakdown")
+            xprof_dir = os.environ.get("RAFT_TPU_XPROF_DIR")
+            if xprof_dir:
+                _xprof_capture(leg_fn, q_leg, k, sp, row_bs, xprof_dir)
+        # the recorded search_param keeps filter_selectivity + leg_env —
+        # the join key benchdiff matches rows by must distinguish
+        # filtered and env-pinned legs
+        sp_rec = dict(sp)
+        if fsel is not None:
+            sp_rec["filter_selectivity"] = float(fsel)
+        if leg_env:
+            sp_rec["leg_env"] = dict(leg_env)
         row = BenchResult(
             algo=algo, index_name=index_cfg.get("name", algo),
             dataset=data.name, k=k, batch_size=row_bs,
             build_s=build_s, search_s=dt, qps=qps, recall=rec,
-            build_param=bp, search_param=dict(sp),
+            build_param=bp, search_param=sp_rec,
             stage_breakdown=stages, stage_path=stage_path,
             peak_hbm_bytes=peak_hbm, latency_quantiles=latency_q,
             fence_per_call=fenced, cost=cost_row,

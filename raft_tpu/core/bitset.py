@@ -59,11 +59,33 @@ def to_mask(bits: jax.Array, bitset_len: int) -> jax.Array:
 
 
 @jax.jit
+def word_at(bits: jax.Array, ids) -> jax.Array:
+    """Gather the bitset word covering each id — the shared primitive
+    behind :func:`test`, ``sample_filter.passes``, and the fused
+    kernels' host-side filter-operand prep.
+
+    Sentinel-preserving per the ``core/ids.py`` policy: negative ids
+    (the ``-1`` invalid sentinel, in either id width) read word 0 —
+    callers mask the result with ``ids >= 0``. The word-index divide
+    runs in the INCOMING id dtype: an int64 id past 2³¹ must not narrow
+    to int32 before ``// WORD_BITS`` (GL11; the filtered capacity proof
+    traces this at n = 2.2e9)."""
+    ids = jnp.asarray(ids)
+    safe = jnp.where(ids >= 0, ids, 0)  # id-dtype preserved
+    return bits[safe // WORD_BITS]
+
+
+@jax.jit
 def test(bits: jax.Array, idx) -> jax.Array:
-    """Test bit(s) at ``idx`` (reference: bitset::test, core/bitset.cuh:235)."""
+    """Test bit(s) at ``idx`` (reference: bitset::test, core/bitset.cuh:235).
+
+    Sentinel-preserving: negative ids (the ``-1`` pad sentinel) test
+    False instead of wrapping to a live word."""
     idx = jnp.asarray(idx)
-    word = bits[idx // WORD_BITS]
-    return ((word >> (idx % WORD_BITS).astype(jnp.uint32)) & 1).astype(jnp.bool_)
+    word = word_at(bits, idx)
+    off = jnp.where(idx >= 0, idx, 0) % WORD_BITS
+    bit = ((word >> off.astype(jnp.uint32)) & 1).astype(jnp.bool_)
+    return bit & (idx >= 0)
 
 
 @partial(jax.jit, static_argnames=("value",))
@@ -96,3 +118,14 @@ def flip(bits: jax.Array) -> jax.Array:
 def count(bits: jax.Array, bitset_len: int) -> jax.Array:
     """Population count over the valid prefix."""
     return jnp.sum(to_mask(bits, bitset_len).astype(jnp.int32))
+
+
+@jax.jit
+def density(bits: jax.Array) -> jax.Array:
+    """Set-bit fraction over the WHOLE word array — the cheap
+    selectivity estimate feeding the fp8-LUT dispatch slack
+    (``ivf_pq.resolve_lut_dtype``). Trailing pad bits inside the last
+    word (at most 31) are counted as-is: a rounding error of
+    ``< 32/n``, irrelevant to a dispatch heuristic."""
+    pc = jax.lax.population_count(bits).astype(jnp.float32)
+    return jnp.mean(pc) / WORD_BITS

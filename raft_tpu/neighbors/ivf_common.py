@@ -256,6 +256,23 @@ def lut_scan_mem_ok(n_seg: int, seg: int, rot: int, pairs: int,
     return qv + bins + gathered <= GROUPED_BYTES_CAP
 
 
+def filtered_scan_mem_ok(n_lists: int, L: int,
+                         slot_bytes: int = 1) -> bool:
+    """HBM budget for a FILTERED fused-scan dispatch (the admission
+    guard GL15 expects beside every streaming-kernel call site that
+    hands a kernel filter operands). ``slot_bytes`` is the per-slot
+    transient width of the filter operand the dispatching tier builds:
+    1 for the LUT/ring tiers — a ``[n_lists, L]`` bool keep mask
+    re-packed to ``[n_lists, ceil(L/8)]`` u8 byte rows
+    (``sample_filter.list_filter_bytes``; ~2.5 GB at n = 2.2e9, inside
+    the cap, so billion-scale filtered searches stay on the fused
+    tier) — and 5 for segk's sentinel-masked i32 id table (mask +
+    i32; segk's recon-cache precondition keeps its n small anyway).
+    The packed byte rows are counted in both cases."""
+    slots = n_lists * L
+    return slots * slot_bytes + slots // 8 <= GROUPED_BYTES_CAP
+
+
 def gather_refine_mem_ok(n: int, d: int, itemsize: int = 4,
                          m: int = 0, C: int = 0) -> bool:
     """HBM guard for the fused gather-refine tier (ops.pallas_kernels.

@@ -604,7 +604,10 @@ def _route_refined(index: IvfFlatIndex, queries: jax.Array, k: int,
         return _refine.refine_provider(dataset, queries, i0, k,
                                        metric=index.metric)
     if isinstance(dataset, jax.Array):
-        return _refine.refine(dataset, queries, i0, k, metric=index.metric)
+        # i0 is already filter-clean; the refine-tier filter is defense
+        # in depth (the fused kernel's in-DMA bit test costs nothing)
+        return _refine.refine(dataset, queries, i0, k, metric=index.metric,
+                              filter_bits=filter_bitset)
     return _refine.refine_gathered(dataset, queries, i0, k,
                                    metric=index.metric)
 
@@ -636,11 +639,11 @@ def search(index, queries: jax.Array, k: int,
 
     _divf = ic.sharded_dispatch(index, mesh, "ShardedIvfFlat")
     if _divf is not None:
-        expects(filter_bitset is None and params.refine == "none",
-                "sharded IVF-Flat search supports neither filter "
-                "bitsets nor refine yet")
+        expects(params.refine == "none",
+                "sharded IVF-Flat search does not support refine yet")
         return _divf.search_ivf_flat(params, index, queries, k, mesh,
-                                     axis=mesh_axis, merge=merge)
+                                     axis=mesh_axis, merge=merge,
+                                     filter_bitset=filter_bitset)
     expects(queries.ndim == 2 and queries.shape[1] == index.dim,
             "queries must be [m, %d]", index.dim)
     _faults.faultpoint("ivf_flat.search")

@@ -123,9 +123,11 @@ class SearchParams:
     table). "approx" auto-upgrades to the pallas tier on TPU for
     oversampled shapes (n_probes ≥ 64 or k ≥ 400) when no recon cache
     exists — the configs where the XLA scan's HBM transients are
-    hostile. The tier needs n_probes·256 ≥ k and no filter bitset (its
-    bin pre-selection is filter-blind); ineligible explicit requests
-    warn once and run the approx tier instead."""
+    hostile. The tier needs n_probes·256 ≥ k; a ``filter_bitset`` rides
+    along as a streamed per-candidate mask (packed keep bits beside the
+    codes, sentinel-masked before bin selection — filtered searches no
+    longer leave the fast path); ineligible explicit requests warn once
+    (with the concrete reason) and run the approx tier instead."""
 
     n_probes: int = 20
     query_tile: int = 64
@@ -167,7 +169,8 @@ FP8_LUT_RECALL_FLOOR = 0.01
 FP8_LUT_MIN_SLACK = 4
 
 
-def resolve_lut_dtype(lut_dtype: str, n_probes: int, k: int) -> str:
+def resolve_lut_dtype(lut_dtype: str, n_probes: int, k: int,
+                      selectivity: float = 1.0) -> str:
     """Resolve ``SearchParams.lut_dtype="auto"`` for one dispatch.
 
     fp8 QLUTs are the measured default for OVERSAMPLED scans (the
@@ -176,7 +179,17 @@ def resolve_lut_dtype(lut_dtype: str, n_probes: int, k: int) -> str:
     opt-in to default where the recall cost is bounded (see
     :data:`FP8_LUT_RECALL_FLOOR`). When the candidate slack is under
     :data:`FP8_LUT_MIN_SLACK`, dispatch declines to bf16 instead; every
-    other shape keeps exact f32. ``RAFT_TPU_FP8_LUT`` = auto | on | off
+    other shape keeps exact f32.
+
+    ``selectivity`` (set-bit fraction of a ``filter_bitset``, 1.0
+    unfiltered — :func:`_filter_selectivity`) discounts the slack: a
+    filtered scan's bins hold only SURVIVING candidates, so the
+    effective oversample margin fp8's ranking noise must stay inside is
+    ``selectivity · n_probes · LUT_SCAN_BINS`` — at 1% selectivity a
+    nominally 25× slack is really 0.25× and fp8 reordering would cross
+    the cut, so dispatch declines to bf16.
+
+    ``RAFT_TPU_FP8_LUT`` = auto | on | off
     (tri-state): "on" applies the policy off-TPU too (interpret-mode
     tests), "off" pins auto to f32. Explicit dtypes pass through
     untouched; each auto resolution lands in
@@ -190,12 +203,33 @@ def resolve_lut_dtype(lut_dtype: str, n_probes: int, k: int) -> str:
     chosen = "float32"
     if (force != "off" and oversampled
             and (force == "on" or _pk._on_tpu())):
-        slack_ok = n_probes * _pk.LUT_SCAN_BINS >= FP8_LUT_MIN_SLACK * k
+        surviving = selectivity * n_probes * _pk.LUT_SCAN_BINS
+        slack_ok = surviving >= FP8_LUT_MIN_SLACK * k
         chosen = "float8_e4m3" if slack_ok else "bfloat16"
     if _obs_spans.enabled():
         _obs_spans.registry().inc("ivf_pq.lut.dispatch",
                                   labels={"dtype": chosen})
     return chosen
+
+
+def _filter_selectivity(filter_bits) -> float:
+    """Eager set-bit-fraction estimate of a filter bitset feeding the
+    fp8-LUT slack discount (one tiny popcount reduction + host sync per
+    filtered dispatch with ``lut_dtype="auto"``). Returns 1.0 for no
+    filter. Under an abstract trace (a jitted ``search`` call, the
+    eval_shape capacity prover) the popcount cannot concretize — the
+    filter IS present but its density is unknowable, so return 0.0:
+    the slack check then declines fp8 to bf16, the conservative side
+    of the precision policy (a 1.0 fallback would silently disable the
+    discount exactly when a selective filter needs it)."""
+    if filter_bits is None:
+        return 1.0
+    from raft_tpu.core import bitset as _bitset
+
+    try:
+        return float(_bitset.density(filter_bits))
+    except (jax.errors.ConcretizationTypeError, TypeError):
+        return 0.0
 
 
 def _quantize_lut(lut: jax.Array, lut_dtype: str) -> jax.Array:
@@ -1489,11 +1523,18 @@ def _search_grouped(index: IvfPqIndex, queries: jax.Array, k: int,
     kk_ = min(k, L)
     if use_segk:
         # scalar-prefetch kernel over the bf16 recon cache (see ivf_flat:
-        # the XLA gather of list blocks runs ~20 GB/s and dominates)
+        # the XLA gather of list blocks runs ~20 GB/s and dominates).
+        # A filter rides as a SENTINEL-MASKED id table: filtered slots
+        # become the -1 invalid id the kernel already poisons to +inf
+        # before its bin pre-selection (the GL13 pattern), so the bins
+        # hold only kept candidates — dispatch admits the [n_lists, L]
+        # mask+i32 transient via filtered_scan_mem_ok(slot_bytes=5)
         met = "ip" if ip_like else "l2"
         qv_all = q_rot[jnp.clip(seg_q, 0, B - 1)]         # [n_seg, S, rot]
+        seg_ids = (index.packed_ids if filter_bits is None
+                   else jnp.where(valid_full, index.packed_ids, -1))
         keys, kids = _pk.segmented_scan_topk(
-            seg_list, qv_all, index.packed_recon, index.packed_ids, met,
+            seg_list, qv_all, index.packed_recon, seg_ids, met,
             interpret=not _pk._on_tpu())
         out_vals, out_ids = ic.merge_bin_results(
             keys, kids, pair_seg, pair_slot, k, select_min, invalid,
@@ -1640,10 +1681,15 @@ def _search_lut_pallas(index: IvfPqIndex, queries: jax.Array, k: int,
     run through the shared :func:`_finish_candidates` epilogue, so
     results cannot drift from the fused/staged paths' semantics.
 
-    ``filter_bits`` applies AFTER the kernel's filter-blind 2×128-bin
-    pre-selection, so under a selective filter kept neighbors outside a
-    probe's unfiltered top bins are unreachable — ``search()`` therefore
-    never routes filtered searches here (same guard as segk)."""
+    ``filter_bits`` streams INTO the kernel as a per-candidate packed
+    mask (``sample_filter.list_filter_bytes`` over the same id table
+    the kernel scans, 1 bit/candidate): filtered candidates take the
+    +inf/-1 sentinel BEFORE the 2×128-bin pre-selection, so the emitted
+    bins hold only kept candidates and a selective filter no longer
+    makes kept neighbors unreachable. The shared
+    :func:`_finish_candidates` epilogue re-applies the same filter over
+    the merged candidates — a no-op on the kernel's output, kept so the
+    fused and unfused paths share one exclusion site."""
     from raft_tpu.neighbors import ivf_common as ic
     from raft_tpu.ops import pallas_kernels as _pk
 
@@ -1662,12 +1708,20 @@ def _search_lut_pallas(index: IvfPqIndex, queries: jax.Array, k: int,
     q_sq = jnp.sum(q_rot * q_rot, axis=1)
     qv_all = q_rot[jnp.clip(seg_q, 0, B - 1)]         # [n_seg, seg, rot]
 
+    filter_bytes = None
+    if filter_bits is not None:
+        from raft_tpu.neighbors import sample_filter as _sf
+
+        # per-list packed keep bits over the SAME [n_lists, L] id table
+        # the kernel streams — one gather + byte re-pack, n/8 bytes
+        filter_bytes = _sf.list_filter_bytes(filter_bits,
+                                             index.packed_ids)
     keys, kids = _pk.ivfpq_lut_scan_topk(
         seg_list, qv_all, index.packed_codes, index.packed_ids,
         index.packed_norms, index.centers_rot, index.codebooks,
         "ip" if ip_like else "l2", pq_bits=index.pq_bits,
         pq_dim=index.pq_dim, L=index.max_list_size, lut_dtype=lut_dtype,
-        interpret=not _pk._on_tpu())
+        filter_bytes=filter_bytes, interpret=not _pk._on_tpu())
     pv, pi = ic.gather_segment_results(keys, kids, pair_seg, pair_slot)
     C = n_probes * keys.shape[-1]
     pv = pv.reshape(B, C)
@@ -1691,11 +1745,17 @@ def _search_lut_pallas(index: IvfPqIndex, queries: jax.Array, k: int,
     return out_vals, out_ids
 
 
-def _count_scan_dispatch(impl: str) -> None:
+def _count_scan_dispatch(impl: str, filtered: bool = False) -> None:
     """Record which scan engine ``search`` dispatched to (the obs
     ``ivf_pq.scan.dispatch{impl=...}`` counter) — eager, so it counts
-    dispatch decisions, not device executions."""
-    _obs_spans.count_dispatch("ivf_pq.scan", impl)
+    dispatch decisions, not device executions. Filtered searches carry
+    a ``filtered=1`` label so "did the filtered workload stay on the
+    fast tier?" is one counter query (the CI obs-smoke step asserts
+    exactly this)."""
+    if filtered:
+        _obs_spans.count_dispatch("ivf_pq.scan", impl, filtered="1")
+    else:
+        _obs_spans.count_dispatch("ivf_pq.scan", impl)
 
 
 def _count_lut_fallback(reason: str) -> None:
@@ -1704,10 +1764,12 @@ def _count_lut_fallback(reason: str) -> None:
     ``ivf_pq.scan.fallback{reason=...}`` counter. The dispatch counter
     alone shows only the engine that won; triage of "why isn't the
     oversampled config on the fast tier?" needs the losing reason:
-    ``filter_bitset`` (the bin pre-selection is filter-blind),
     ``bin_capacity`` (n_probes·256 < k), ``per_cluster`` codebooks,
-    ``mem_guard`` (lut_scan_mem_ok declined), or ``kernel_ineligible``
-    (packed layout / VMEM / not on TPU)."""
+    ``mem_guard`` (lut_scan_mem_ok / filtered_scan_mem_ok declined), or
+    ``kernel_ineligible`` (packed layout / VMEM / not on TPU). The
+    ``filter_bitset`` reason is RETIRED: the kernels stream the bitset
+    as a per-candidate mask, so a filter no longer disqualifies the
+    tier (CI asserts the retired reason stays at zero)."""
     _obs_spans.count_fallback("ivf_pq.scan", reason)
 
 
@@ -1742,7 +1804,11 @@ def _route_refined(index: IvfPqIndex, queries: jax.Array, k: int,
         return _refine.refine_provider(dataset, queries, i0, k,
                                        metric=index.metric)
     if isinstance(dataset, jax.Array):
-        return _refine.refine(dataset, queries, i0, k, metric=index.metric)
+        # the scan already excluded filtered candidates from i0; the
+        # refine-tier filter is defense in depth at zero extra traffic
+        # (the fused kernel folds the bit test into its row-DMA queue)
+        return _refine.refine(dataset, queries, i0, k, metric=index.metric,
+                              filter_bits=filter_bitset)
     # host array / memmap: gather only candidate rows on the host
     return _refine.refine_gathered(dataset, queries, i0, k,
                                    metric=index.metric)
@@ -1750,21 +1816,39 @@ def _route_refined(index: IvfPqIndex, queries: jax.Array, k: int,
 
 _lut_fallback_warned = False
 
+# human-readable detail per fallback-counter reason. filter_bitset is
+# NOT here: the fused tiers stream the bitset as a per-candidate mask
+# now, so a filter no longer disqualifies the tier and warning for it
+# would point at a cause that cannot occur.
+_LUT_FALLBACK_DETAIL = {
+    "bin_capacity": "too few probes for the requested k "
+                    "(needs n_probes·256 ≥ k)",
+    "per_cluster": "per_cluster codebooks (the kernel decodes "
+                   "per_subspace only)",
+    "mem_guard": "the lut_scan_mem_ok/filtered_scan_mem_ok HBM guard "
+                 "declined the shape",
+    "kernel_ineligible": "unsupported packed layout, VMEM budget, or "
+                         "not on TPU",
+}
 
-def _warn_lut_fallback() -> None:
+
+def _warn_lut_fallback(reason: str) -> None:
     """Once-per-process notice that an explicit scan_select="pallas" was
-    downgraded (the obs dispatch counter still records every decision)."""
+    downgraded, carrying the CONCRETE reason the tier lost (the same
+    label the ``ivf_pq.scan.fallback{reason=...}`` counter records) and
+    the env override that forces the tier off-TPU."""
     global _lut_fallback_warned
     if _lut_fallback_warned:
         return
     _lut_fallback_warned = True
     from raft_tpu.core import logging as _log
+    detail = _LUT_FALLBACK_DETAIL.get(reason, reason)
     _log.warn("ivf_pq: scan_select='pallas' requested but the fused LUT "
-              "kernel cannot serve this search (per_cluster codebooks, "
-              "unsupported packed layout, memory guard, too few probes "
-              "for the requested k, a filter bitset, or not on TPU "
-              "without RAFT_TPU_PALLAS_LUTSCAN=always) — falling back "
-              "to scan_select='approx'")
+              "kernel cannot serve this search — reason=%s: %s "
+              "(RAFT_TPU_PALLAS_LUTSCAN=always forces the tier off-TPU; "
+              "the obs counter ivf_pq.scan.fallback{reason=%s} records "
+              "every decline) — falling back to scan_select='approx'",
+              reason, detail, reason)
 
 
 @traced("raft_tpu.ivf_pq.search")
@@ -1802,18 +1886,18 @@ def search(index, queries: jax.Array, k: int,
         # the oversampled k_cand = k·refine_ratio — the selection
         # width the fp8 slack floor (FP8_LUT_MIN_SLACK) is defined
         # over; resolving here with the final k would overstate the
-        # slack by refine_ratio×
+        # slack by refine_ratio×. A filter's selectivity discounts the
+        # slack the same way: only surviving candidates fill the bins.
         params = dataclasses.replace(params, lut_dtype=resolve_lut_dtype(
-            "auto", min(params.n_probes, index.n_lists), k))
+            "auto", min(params.n_probes, index.n_lists), k,
+            selectivity=_filter_selectivity(filter_bitset)))
     from raft_tpu.neighbors import ivf_common as ic
 
     _divf = ic.sharded_dispatch(index, mesh, "ShardedIvfPq")
     if _divf is not None:
-        expects(filter_bitset is None,
-                "sharded search does not support filter bitsets yet")
         return _divf.search_ivf_pq(params, index, queries, k, mesh,
                                    axis=mesh_axis, dataset=dataset,
-                                   merge=merge)
+                                   merge=merge, filter_bitset=filter_bitset)
     expects(queries.ndim == 2 and queries.shape[1] == index.dim,
             "queries must be [m, %d]", index.dim)
     _faults.faultpoint("ivf_pq.search")
@@ -1858,37 +1942,38 @@ def search(index, queries: jax.Array, k: int,
         # the LUT tier emits at most LUT_SCAN_BINS candidates per probed
         # list — with too few probes for the requested k it would pad
         # the tail with -1s where the XLA tiers return real neighbors.
-        # Filtered searches are excluded outright (like segk): the bin
-        # pre-selection is filter-blind, so under a selective filter the
-        # kept neighbors outside a probe's unfiltered top-256 would be
-        # unreachable — the grouped XLA scan filters before selection.
+        # Filtered searches RIDE the tier: the kernel streams the packed
+        # per-candidate filter bytes beside the codes and masks filtered
+        # candidates to the sentinel BEFORE bin selection, so the bins
+        # hold only kept candidates (the retired filter_bitset fallback)
+        filtered = filter_bitset is not None
         lut_desired = (params.scan_select == "pallas"
                        or (params.scan_select == "approx"
                            and index.packed_recon is None
                            and (n_probes >= 64 or k >= 400)))
-        lut_serviceable = (n_probes * _pk.LUT_SCAN_BINS >= k
-                           and filter_bitset is None)
+        lut_serviceable = n_probes * _pk.LUT_SCAN_BINS >= k
         want_lut = lut_desired and lut_serviceable
         select_impl = params.scan_select
         if lut_desired and not lut_serviceable:
             # the fallback counter records WHY the tier lost (satellite:
             # the dispatch counter alone shows only the winner)
-            _count_lut_fallback("filter_bitset" if filter_bitset is not None
-                                else "bin_capacity")
+            _count_lut_fallback("bin_capacity")
             if params.scan_select == "pallas":
-                _warn_lut_fallback()
+                _warn_lut_fallback("bin_capacity")
                 select_impl = "approx"
         if want_lut:
             mem_ok = (ic.lut_scan_mem_ok(n_seg, seg, index.rot_dim,
                                          pairs, _pk.LUT_SCAN_BINS)
+                      and (not filtered
+                           or ic.filtered_scan_mem_ok(index.n_lists, L))
                       and not _faults.forced("ivf_pq.scan.mem_guard"))
             kernel_ok = mem_ok and _pk.pallas_lut_scan_wanted(
                 index.pq_dim, index.pq_book_size, index.pq_len,
                 packed_nbytes(index.pq_dim, index.pq_bits),
                 index.packed_codes.shape[-1], L, index.rot_dim,
-                seg=seg, lut_dtype=params.lut_dtype)
+                seg=seg, lut_dtype=params.lut_dtype, filtered=filtered)
             if index.codebook_kind == "per_subspace" and kernel_ok:
-                _count_scan_dispatch("pallas_lut")
+                _count_scan_dispatch("pallas_lut", filtered=filtered)
                 with span("scan") as _sp:
                     out = _search_lut_pallas(
                         index, queries, k, n_probes, seg, n_seg,
@@ -1916,29 +2001,36 @@ def search(index, queries: jax.Array, k: int,
                 # the oversampled shapes this tier exists for. Fall back
                 # to the recall-targeted approx tier (which re-enables
                 # segk when a recon cache exists) and say so.
-                _warn_lut_fallback()
+                _warn_lut_fallback(reason)
                 select_impl = "approx"
         if params.scan_mode == "grouped" or ic.grouped_mem_ok(
                 n_seg, seg, kk, pairs):
             chunk = ic.fit_seg_chunk(seg, L, index.rot_dim,
                                      params.list_chunk)
             approx = select_impl == "approx"
-            segk = (approx and filter_bitset is None
-                    and index.packed_recon is not None
+            # segk rides filtered searches through a SENTINEL-MASKED id
+            # table (filtered slots become the -1 invalid id before the
+            # kernel's bin pre-selection — _search_grouped builds it);
+            # the [n_lists, L] bool+i32 transient is the 5-byte/slot
+            # admission filtered_scan_mem_ok budgets
+            segk = (approx and index.packed_recon is not None
+                    and (filter_bitset is None
+                         or ic.filtered_scan_mem_ok(index.n_lists, L,
+                                                    slot_bytes=5))
                     and _pk.pallas_segmented_wanted(kk, L, index.rot_dim,
                                                     S=seg))
             wants = (not approx) and _pk.pallas_grouped_wanted(
                 kk, L, index.rot_dim, bq=seg)
             _count_scan_dispatch("segk" if segk else
                                  ("grouped_pallas" if wants
-                                  else "grouped_xla"))
+                                  else "grouped_xla"), filtered=filtered)
             return _search_grouped(index, queries, k, n_probes, seg,
                                    n_seg, chunk, use_pallas=wants,
                                    filter_bits=filter_bitset,
                                    select_impl=select_impl,
                                    select_recall=params.scan_recall,
                                    use_segk=segk)
-    _count_scan_dispatch("per_query")
+    _count_scan_dispatch("per_query", filtered=filter_bitset is not None)
     return _search_impl(index, queries, k, n_probes,
                         _fit_query_tile(params.query_tile, n_probes, index),
                         filter_bits=filter_bitset, lut_dtype=params.lut_dtype)
@@ -1973,7 +2065,8 @@ def search_resilient(index: IvfPqIndex, queries: jax.Array, k: int,
         kr = k if params.refine == "none" else max(
             k, int(round(k * params.refine_ratio)))
         params = dataclasses.replace(params, lut_dtype=resolve_lut_dtype(
-            "auto", min(params.n_probes, index.n_lists), kr))
+            "auto", min(params.n_probes, index.n_lists), kr,
+            selectivity=_filter_selectivity(filter_bitset)))
     queries = jnp.asarray(queries)
     return _degrade.run_with_degradation(
         _degrade.batched_search_call(search, index, queries, k,

@@ -126,11 +126,13 @@ def _gather_keys_to_dists(keys, ids, metric: str):
     return keys, ids
 
 
-def _fused_refine_wanted(dataset, queries, candidates, k: int) -> bool:
+def _fused_refine_wanted(dataset, queries, candidates, k: int,
+                         filtered: bool = False) -> bool:
     """True when the fused gather-refine tier serves this call: a
     device-resident 2-D dataset whose dtype the row DMAs stream (f32 or
     the bf16 recon cache) and a shape :func:`pallas_gather_refine_wanted`
-    accepts."""
+    accepts (``filtered`` adds the per-candidate bitset-word scratch to
+    its VMEM model)."""
     from raft_tpu.neighbors import ivf_common as ic
     from raft_tpu.ops import pallas_kernels as _pk
 
@@ -153,10 +155,11 @@ def _fused_refine_wanted(dataset, queries, candidates, k: int) -> bool:
         return False
     return _pk.pallas_gather_refine_wanted(
         candidates.shape[0], candidates.shape[1], dataset.shape[1], k,
-        itemsize=dataset.dtype.itemsize)
+        itemsize=dataset.dtype.itemsize, filtered=filtered)
 
 
-def _refine_fused(dataset, queries, candidates, k: int, mt: DistanceType):
+def _refine_fused(dataset, queries, candidates, k: int, mt: DistanceType,
+                  filter_bits=None):
     from raft_tpu.ops import pallas_kernels as _pk
 
     met = ("ip" if mt == DistanceType.InnerProduct
@@ -164,7 +167,7 @@ def _refine_fused(dataset, queries, candidates, k: int, mt: DistanceType):
     with span("fused_scan") as _sp:
         keys, ids = _pk.gather_refine_topk(
             dataset, queries, jnp.asarray(candidates), k, met,
-            interpret=not _pk._on_tpu())
+            filter_bits=filter_bits, interpret=not _pk._on_tpu())
         out = _gather_keys_to_dists(keys, ids, mt.value)
         _sp.attach(out)
     return out
@@ -177,6 +180,7 @@ def refine(
     candidates: jax.Array,
     k: int,
     metric="sqeuclidean",
+    filter_bits=None,
 ) -> Tuple[jax.Array, jax.Array]:
     """Re-rank ``candidates`` [m, n_cand] (row ids into ``dataset``, -1 =
     invalid) down to the exact top-k (reference: refine-inl.cuh).
@@ -187,14 +191,39 @@ def refine(
     XLA gather+einsum path; both share exact semantics (module
     docstring has the tier table). Returns (distances [m, k],
     ids [m, k]).
+
+    ``filter_bits``: optional packed uint32 bitset over dataset rows
+    (``core.bitset`` layout) — candidates whose bit is clear are
+    excluded like invalid ids. The fused tier tests each candidate
+    in-kernel against its bitset word (fetched by the row-DMA queue);
+    the XLA tier sentinel-masks the candidate table first. Oversampled
+    searches hand refine pre-filtered candidates already — the filter
+    here is the enforcement site for DIRECT callers re-ranking an
+    unfiltered candidate list.
     """
     _check_candidates(queries, candidates, k)
     _check_base_dim(dataset, queries)
     mt = resolve_metric(metric)
-    if _fused_refine_wanted(dataset, queries, candidates, k):
-        _obs_spans.count_dispatch("refine", "pallas_gather")
-        return _refine_fused(dataset, queries, candidates, k, mt)
-    _obs_spans.count_dispatch("refine", "xla_gather")
+    filtered = filter_bits is not None
+    if _fused_refine_wanted(dataset, queries, candidates, k,
+                            filtered=filtered):
+        if filtered:
+            _obs_spans.count_dispatch("refine", "pallas_gather",
+                                      filtered="1")
+        else:
+            _obs_spans.count_dispatch("refine", "pallas_gather")
+        return _refine_fused(dataset, queries, candidates, k, mt,
+                             filter_bits=filter_bits)
+    if filtered:
+        from raft_tpu.neighbors.sample_filter import passes
+
+        # sentinel-mask before the gather: a filtered candidate becomes
+        # the -1 invalid id _refine_rows already poisons to ±inf
+        candidates = jnp.where(passes(filter_bits, candidates),
+                               candidates, -1)
+        _obs_spans.count_dispatch("refine", "xla_gather", filtered="1")
+    else:
+        _obs_spans.count_dispatch("refine", "xla_gather")
     return _refine_impl(dataset, queries, candidates, k, mt.value)
 
 

@@ -48,8 +48,46 @@ def passes(filter_bits: Optional[jax.Array], ids: jax.Array) -> jax.Array:
     traces inline (a ``None`` filter is pytree structure, so the branch
     is trace-static); called eagerly it is one program with no implicit
     scalar lifting — the sanitizer-mode transfer guard stays quiet
-    (tests/test_sanitize.py)."""
+    (tests/test_sanitize.py). Routed through ``bitset.word_at`` (via
+    ``bitset.test``) so the word-index math runs in the incoming id
+    width — the shared primitive the fused kernels' operand prep uses
+    (:func:`list_filter_bytes`)."""
     if filter_bits is None:
         return jnp.ones(ids.shape, jnp.bool_)
-    ok = bitset.test(filter_bits, jnp.clip(ids, 0))
-    return ok & (ids >= 0)
+    return bitset.test(filter_bits, ids)
+
+
+def pack_mask_bytes(keep: jax.Array) -> jax.Array:
+    """Pack a boolean keep-mask along its LAST axis into little-endian
+    bytes (bit ``j`` of byte ``b`` = position ``8·b + j``) — the storage
+    layout the fused Pallas scan tiers stream and unpack in-kernel with
+    the same shift/mask machinery as the n-bit code unpack
+    (``ops.pallas_kernels._lut_unpack_filter``). Row-major bits are
+    identical to the uint32 bitset words' (both little-endian), so the
+    byte view and the word view of one filter agree bit-for-bit."""
+    L = keep.shape[-1]
+    pad = (-L) % 8
+    if pad:
+        widths = [(0, 0)] * (keep.ndim - 1) + [(0, pad)]
+        keep = jnp.pad(keep, widths, constant_values=False)
+    m = keep.reshape(*keep.shape[:-1], -1, 8).astype(jnp.int32)
+    # explicit rank-matched shift row (sanitizer mode raises on
+    # implicit rank promotion)
+    shifts = jnp.arange(8, dtype=jnp.int32).reshape(
+        (1,) * (m.ndim - 1) + (8,))
+    return jnp.sum(m << shifts, axis=-1, dtype=jnp.int32).astype(jnp.uint8)
+
+
+@jax.jit
+def list_filter_bytes(filter_bits: jax.Array,
+                      packed_ids: jax.Array) -> jax.Array:
+    """Per-list packed filter mask ``[n_lists, ceil(L/8)]`` u8 — the
+    host-side operand prep for the fused scan kernels: bit ``j`` of
+    byte ``b`` in list ``l``'s row is 1 iff candidate
+    ``packed_ids[l, 8·b + j]`` passes the filter (pad slots, id -1,
+    pack as 0). One :func:`passes` gather over the id table plus a
+    byte re-pack — O(n) work and n/8 output bytes per search, 32×
+    smaller than streaming a per-candidate f32 bias and the reason the
+    fused tiers stay admissible at billion scale
+    (``ivf_common.filtered_scan_mem_ok`` budgets the transients)."""
+    return pack_mask_bytes(passes(filter_bits, packed_ids))

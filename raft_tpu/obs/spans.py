@@ -124,15 +124,17 @@ def current_name() -> str:
     return ".".join(_stack())
 
 
-def count_dispatch(name: str, impl: str) -> None:
+def count_dispatch(name: str, impl: str, **labels: str) -> None:
     """Count one dispatch decision under ``<name>.dispatch{impl=...}`` —
     the #1 thing perf triage asks ("which engine actually ran?"). Free
     when recording is off. Counted per DISPATCH DECISION: once per jit
     trace for jitted callers (the choice is baked into the compiled
     program), once per call in eager dispatchers (``ivf_pq.search``'s
-    scan-tier pick, ``select_k``'s engine pick)."""
+    scan-tier pick, ``select_k``'s engine pick). Extra keyword labels
+    ride along (e.g. ``filtered="1"`` on a filtered fused-scan
+    dispatch)."""
     if _enabled:
-        registry().inc(name + ".dispatch", labels={"impl": impl})
+        registry().inc(name + ".dispatch", labels={"impl": impl, **labels})
 
 
 def count_fallback(name: str, reason: str) -> None:

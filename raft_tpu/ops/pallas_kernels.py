@@ -547,10 +547,49 @@ def _lut_unpack_codes(bytes_f, sel_lo, sel_hi, off_row, pq_bits: int,
     return jax.lax.shift_right_logical(v16, off_row) & (K - 1)
 
 
+def _filter_unpack_operands(n_lanes: int):
+    """Byte-column selection matrix + per-lane shift row unpacking one
+    tile's PACKED filter bytes (``sample_filter.pack_mask_bytes``
+    layout: bit j of byte b = candidate position 8·b + j) to per-lane
+    keep bits — the filter's instance of the n-bit code unpack
+    machinery (:func:`_lut_unpack_codes`): byte values are ≤ 255 so the
+    f32 selection matmul is exact, then integer shift/mask."""
+    sel = np.zeros((n_lanes // 8, n_lanes), np.float32)
+    lanes = np.arange(n_lanes)
+    sel[lanes // 8, lanes] = 1.0
+    off = jnp.asarray((lanes % 8).astype(np.int32)[None, :])
+    return jnp.asarray(sel), off
+
+
+def _filter_vmem_bytes(G: int, Rt: int) -> int:
+    """VMEM cost of one tier's in-kernel filter operands — the byte
+    slots (double-buffered), the unpack selection matrix, and the
+    shift row + unpacked keep bits (:func:`_filter_unpack_operands` /
+    :func:`_lut_unpack_filter`). The ONE model both admission gates
+    (``pallas_lut_scan_wanted``, ``ring_lut_scan_kernel_ok``) consult,
+    so a layout change cannot leave one gate with a stale budget."""
+    lanes = G * Rt
+    return (2 * max(lanes // 8, _LANES)   # filter byte slots
+            + (lanes // 8) * lanes * 4    # unpack selection matrix
+            + 2 * lanes * 4)              # shift row + keep bits
+
+
+def _lut_unpack_filter(fbytes_f, fsel, foff):
+    """``fbytes_f`` [1, n_lanes/8] f32 byte values → [1, n_lanes] i32
+    keep bits (1 = candidate may be returned). Shared by the standalone
+    LUT-scan kernel and the fused scan-in-ring kernel."""
+    b = jax.lax.dot_general(
+        fbytes_f, fsel, (((1,), (0,)), ((), ())),
+        precision=jax.lax.Precision.HIGHEST,
+        preferred_element_type=jnp.float32)          # [1, n_lanes]
+    return jax.lax.shift_right_logical(b.astype(jnp.int32), foff) & 1
+
+
 def _lut_tile_update(code, qv, qc, ids_row, norms_row, cbp_ref, t,
                      state, *, metric: str, pq_bits: int, S: int,
                      P: int, G: int, Sg: int, Kc: int, L: int, Rt: int,
-                     rot: int, rotp: int, exact: bool, key_bias=None):
+                     rot: int, rotp: int, exact: bool, key_bias=None,
+                     filt_row=None):
     """One code tile's ADC + 2-deep strided-bin update — the shared
     compute body of the LUT scan (steps 3–4 of
     :func:`_ivfpq_lut_scan_kernel`'s docstring), factored so the fused
@@ -563,7 +602,10 @@ def _lut_tile_update(code, qv, qc, ids_row, norms_row, cbp_ref, t,
     the list (traced or static); ``state`` = (b1k, b1i, b2k, b2i)
     running 2-deep bin values; ``key_bias`` an optional [rows, 1]
     additive key column (the fused ring mode's per-query probe mask —
-    un-probed rows get +``_LUT_MASK_BIG``). Returns the updated
+    un-probed rows get +``_LUT_MASK_BIG``); ``filt_row`` an optional
+    [1, G·Rt] i32 keep-bit row (:func:`_lut_unpack_filter`) — filtered
+    candidates join the invalid-id lanes in the ±inf/-1 sentinel
+    epilogue, the exact pattern GL13 polices. Returns the updated
     state."""
     rows = qv.shape[0]
     n_sg = S // Sg
@@ -613,6 +655,9 @@ def _lut_tile_update(code, qv, qc, ids_row, norms_row, cbp_ref, t,
             l_pos = (t * Rt + si * _LANES) * G + g \
                 + G * jax.lax.broadcasted_iota(jnp.int32, (1, _LANES), 1)
             valid = (ids_g >= 0) & (l_pos < L)
+            if filt_row is not None:
+                keep = _lane_pick(filt_row, lane0, G, _LANES)
+                valid = jnp.logical_and(valid, keep > 0)
             if metric == "ip":
                 key = -(qc[:, None] + qd)
             else:  # l2: ‖c+d‖² − 2⟨q, c+d⟩ (caller adds ‖q‖²)
@@ -675,10 +720,10 @@ def _lut_scan_operands(codebooks: jax.Array, pq_bits: int, nb: int,
 
 def _ivfpq_lut_scan_kernel(seg_list_ref, qv_ref, codes_ref, ids_ref,
                            norms_ref, ctr_ref, sel_lo_ref, sel_hi_ref,
-                           off_ref, cbp_ref, keys_ref, oids_ref, *,
+                           off_ref, cbp_ref, *rest,
                            metric: str, pq_bits: int, S: int, P: int,
                            G: int, Sg: int, Kc: int, L: int, Rt: int,
-                           rot: int, exact: bool):
+                           rot: int, exact: bool, filtered: bool):
     """One (segment, code-tile) program of the fused IVF-PQ scan.
 
     Grid = (n_seg, n_tiles); the tile axis is the sequential minor axis,
@@ -706,8 +751,20 @@ def _ivfpq_lut_scan_kernel(seg_list_ref, qv_ref, codes_ref, ids_ref,
        candidate ids (fold groups rotate lanes by 128/G so consecutive
        code rows land in distinct bins — see _segmented_scan_kernel's
        clustered-data note).
+
+    ``filtered`` mode streams the list's PACKED per-candidate filter
+    bytes (``sample_filter.list_filter_bytes``) alongside the codes —
+    the same per-tile DMA pattern as the ids/norms rows — unpacks them
+    in-kernel with the code-unpack shift/mask machinery
+    (:func:`_lut_unpack_filter`), and masks filtered candidates to the
+    +inf/-1 sentinel in the bin epilogue, exactly as invalid ids.
     """
     t = pl.program_id(1)
+    if filtered:
+        fbits_ref, fsel_ref, foff_ref, keys_ref, oids_ref = rest
+    else:
+        keys_ref, oids_ref = rest
+        fbits_ref = fsel_ref = foff_ref = None
     seg = qv_ref.shape[1]
     K = 1 << pq_bits
     rotp = qv_ref.shape[2]
@@ -726,6 +783,10 @@ def _ivfpq_lut_scan_kernel(seg_list_ref, qv_ref, codes_ref, ids_ref,
     bytes_f = codes_ref[0].astype(jnp.int32).astype(jnp.float32)
     code = _lut_unpack_codes(bytes_f, sel_lo_ref[:], sel_hi_ref[:],
                              off_ref[:], pq_bits, K)
+    filt_row = None
+    if filtered:
+        fb_f = fbits_ref[:].astype(jnp.int32).astype(jnp.float32)
+        filt_row = _lut_unpack_filter(fb_f, fsel_ref[:], foff_ref[:])
 
     cur_k = keys_ref[0]                              # [seg, 256]
     cur_i = oids_ref[0]
@@ -736,7 +797,7 @@ def _ivfpq_lut_scan_kernel(seg_list_ref, qv_ref, codes_ref, ids_ref,
     b1k, b1i, b2k, b2i = _lut_tile_update(
         code, qv, qc, ids_ref[:], norms_ref[:], cbp_ref, t, state,
         metric=metric, pq_bits=pq_bits, S=S, P=P, G=G, Sg=Sg, Kc=Kc,
-        L=L, Rt=Rt, rot=rot, rotp=rotp, exact=exact)
+        L=L, Rt=Rt, rot=rot, rotp=rotp, exact=exact, filt_row=filt_row)
     keys_ref[0] = jnp.concatenate([b1k, b2k], axis=1)
     oids_ref[0] = jnp.concatenate([b1i, b2i], axis=1)
 
@@ -749,6 +810,7 @@ def ivfpq_lut_scan_topk(seg_list: jax.Array, qv: jax.Array,
                         codebooks: jax.Array, metric: str = "l2", *,
                         pq_bits: int, pq_dim: int, L: int,
                         lut_dtype: str = "float32",
+                        filter_bytes=None,
                         interpret: bool = False
                         ) -> Tuple[jax.Array, jax.Array]:
     """Fused segmented IVF-PQ scan over PACKED codes (no recon cache).
@@ -780,6 +842,17 @@ def ivfpq_lut_scan_topk(seg_list: jax.Array, qv: jax.Array,
     quantizes the LUT entries ⟨q_s, cb[s,k]⟩ instead — same knob, same
     footprint trade, numerically a sibling rather than a twin.
 
+    ``filter_bytes`` [n_lists, ceil(L/8)] u8 — optional per-candidate
+    packed filter mask (``sample_filter.list_filter_bytes``): the words
+    of the caller's ``filter_bitset`` re-packed to the per-list slot
+    layout so the kernel streams them HBM→VMEM per code tile alongside
+    the codes (1 bit/candidate — 32× less traffic than an f32 bias
+    row), unpacks them with the code-unpack shift/mask machinery, and
+    masks filtered candidates to the +inf/-1 sentinel at the bin
+    epilogue. With a filter the emitted bins hold only KEPT candidates,
+    so a selective filter no longer makes kept neighbors unreachable —
+    the reason filtered searches used to be banned from this tier.
+
     Returns (keys [n_seg, seg, 256], ids [n_seg, seg, 256]): minimized
     sort keys per strided bin (l2: ‖c+d‖² − 2⟨q,c+d⟩, add ‖q‖²; ip:
     −⟨q,c+d⟩) and GLOBAL candidate ids (-1 invalid), two best per bin —
@@ -806,6 +879,13 @@ def ivfpq_lut_scan_topk(seg_list: jax.Array, qv: jax.Array,
         ids = _pad_to(ids, G * Rt, 1, -1)
         norms = _pad_to(norms, G * Rt, 1, 0.0)
     n_t = -(-packed.shape[1] // Rt)
+    filtered = filter_bytes is not None
+    Fbt = G * Rt // 8
+    if filtered:
+        # pad to WHOLE tiles (0 = filtered): ids/norms tolerate the
+        # pipeline's OOB tail because garbage lanes are masked, but a
+        # misread KEEP bit would admit a filtered candidate
+        fbits = _pad_to(filter_bytes, n_t * Fbt, 1, 0)
 
     qvp = _pad_to(qv.astype(jnp.float32), _SUBLANES, 1, 0.0)
     qvp = _pad_to(qvp, _LANES, 2, 0.0)
@@ -816,21 +896,33 @@ def ivfpq_lut_scan_topk(seg_list: jax.Array, qv: jax.Array,
         codebooks, pq_bits, nb, Wb, G, Sg, lut_dtype)
     n_sg = S // Sg
 
+    in_specs = [
+        pl.BlockSpec((1, segp, rotp), lambda s, t, sl: (s, 0, 0)),
+        pl.BlockSpec((1, Rt, Wb), lambda s, t, sl: (sl[s], t, 0)),
+        pl.BlockSpec((1, G * Rt), lambda s, t, sl: (sl[s], t)),
+        pl.BlockSpec((1, G * Rt), lambda s, t, sl: (sl[s], t)),
+        pl.BlockSpec((1, rotp), lambda s, t, sl: (sl[s], 0)),
+        pl.BlockSpec((Wb, G * S), lambda s, t, sl: (0, 0)),
+        pl.BlockSpec((Wb, G * S), lambda s, t, sl: (0, 0)),
+        pl.BlockSpec((1, G * S), lambda s, t, sl: (0, 0)),
+        pl.BlockSpec((n_sg, K * Sg, Sg * P),
+                     lambda s, t, sl: (0, 0, 0)),
+    ]
+    operands = [qvp, packed, ids, norms, ctr, sel_lo, sel_hi, off_arr,
+                cbp]
+    if filtered:
+        fsel, foff = _filter_unpack_operands(G * Rt)
+        in_specs += [
+            pl.BlockSpec((1, Fbt), lambda s, t, sl: (sl[s], t)),
+            pl.BlockSpec((Fbt, G * Rt), lambda s, t, sl: (0, 0)),
+            pl.BlockSpec((1, G * Rt), lambda s, t, sl: (0, 0)),
+        ]
+        operands += [fbits, fsel, foff]
+
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=1,
         grid=(n_seg, n_t),
-        in_specs=[
-            pl.BlockSpec((1, segp, rotp), lambda s, t, sl: (s, 0, 0)),
-            pl.BlockSpec((1, Rt, Wb), lambda s, t, sl: (sl[s], t, 0)),
-            pl.BlockSpec((1, G * Rt), lambda s, t, sl: (sl[s], t)),
-            pl.BlockSpec((1, G * Rt), lambda s, t, sl: (sl[s], t)),
-            pl.BlockSpec((1, rotp), lambda s, t, sl: (sl[s], 0)),
-            pl.BlockSpec((Wb, G * S), lambda s, t, sl: (0, 0)),
-            pl.BlockSpec((Wb, G * S), lambda s, t, sl: (0, 0)),
-            pl.BlockSpec((1, G * S), lambda s, t, sl: (0, 0)),
-            pl.BlockSpec((n_sg, K * Sg, Sg * P),
-                         lambda s, t, sl: (0, 0, 0)),
-        ],
+        in_specs=in_specs,
         out_specs=[
             pl.BlockSpec((1, segp, LUT_SCAN_BINS),
                          lambda s, t, sl: (s, 0, 0)),
@@ -841,28 +933,31 @@ def ivfpq_lut_scan_topk(seg_list: jax.Array, qv: jax.Array,
     keys, kids = pl.pallas_call(
         functools.partial(
             _ivfpq_lut_scan_kernel, metric=metric, pq_bits=pq_bits, S=S,
-            P=P, G=G, Sg=Sg, Kc=Kc, L=L, Rt=Rt, rot=rot, exact=exact),
+            P=P, G=G, Sg=Sg, Kc=Kc, L=L, Rt=Rt, rot=rot, exact=exact,
+            filtered=filtered),
         grid_spec=grid_spec,
         out_shape=[
             jax.ShapeDtypeStruct((n_seg, segp, LUT_SCAN_BINS), jnp.float32),
             jax.ShapeDtypeStruct((n_seg, segp, LUT_SCAN_BINS), jnp.int32),
         ],
         interpret=interpret,
-    )(seg_list.astype(jnp.int32), qvp, packed, ids, norms, ctr,
-      sel_lo, sel_hi, off_arr, cbp)
+    )(seg_list.astype(jnp.int32), *operands)
     return keys[:, :seg], kids[:, :seg]
 
 
 def pallas_lut_scan_wanted(S: int, K: int, P: int, nb: int, Wb: int,
                            L: int, rot: int, seg: int = 128,
-                           lut_dtype: str = "float32") -> bool:
+                           lut_dtype: str = "float32",
+                           filtered: bool = False) -> bool:
     """Dispatch for :func:`ivfpq_lut_scan_topk` — the ``scan_select=
     "pallas"`` tier. Needs a per_subspace packed layout the in-kernel
     unpack supports (byte width dividing the stored lane width, fold
-    group ≤ 8) and a VMEM-sized working set. Env override
-    ``RAFT_TPU_PALLAS_LUTSCAN`` = always | never | auto (tri-state, see
-    :func:`raft_tpu.obs.env_tristate`) — "on"/"always" runs interpreted
-    off-TPU (tests)."""
+    group ≤ 8) and a VMEM-sized working set (``filtered`` adds the
+    filter-byte stream + its unpack selection matrix; the HBM side of
+    a filtered dispatch is ``ivf_common.filtered_scan_mem_ok``'s job).
+    Env override ``RAFT_TPU_PALLAS_LUTSCAN`` = always | never | auto
+    (tri-state, see :func:`raft_tpu.obs.env_tristate`) — "on"/"always"
+    runs interpreted off-TPU (tests)."""
     force = _env_tristate("RAFT_TPU_PALLAS_LUTSCAN")
     if force == "off":
         return False
@@ -873,7 +968,8 @@ def pallas_lut_scan_wanted(S: int, K: int, P: int, nb: int, Wb: int,
     op_bytes = 4 if lut_dtype == "float32" else 2
     rotp = -(-rot // _LANES) * _LANES
     Rt = 2 * _LANES
-    vmem = (
+    vmem_f = _filter_vmem_bytes(G, Rt) if filtered else 0
+    vmem = vmem_f + (
         2 * seg * rotp * 4            # qv block (+double buffer)
         + 2 * Rt * max(Wb, _LANES)    # u8 codes block
         + Rt * G * S * 8              # unpacked bytes + codes (f32+i32)
@@ -940,9 +1036,8 @@ GATHER_REFINE_MAX_K = 64
 
 
 def _gather_refine_kernel(q_ref, cand_ref, cand_hbm, data_hbm,
-                          vals_ref, ids_ref, ids_smem, rows_vmem,
-                          sem_ids, sems, *, k: int, metric: str,
-                          n_rows: int):
+                          *rest, k: int, metric: str,
+                          n_rows: int, filtered: bool):
     """One (query-tile, candidate-tile) program of the fused refine.
 
     Grid = (m_tiles, c_tiles); the candidate axis is the sequential
@@ -961,7 +1056,20 @@ def _gather_refine_kernel(q_ref, cand_ref, cand_hbm, data_hbm,
        masked to +inf) and a k-round merge of (running buffer ++ tile)
        by iterative extraction, ids resolved gather-free via the
        argmin one-hot.
+
+    ``filtered`` mode rides the same row-DMA queue: each candidate's
+    bitset WORD (its id is already scalar-readable in SMEM — the same
+    address source the row DMA uses) streams HBM→VMEM through a
+    parallel ``_GATHER_NBUF``-deep queue, and the metric epilogue
+    poisons rows whose bit is clear to the +inf/-1 sentinel, exactly
+    as invalid ids.
     """
+    if filtered:
+        (filt_hbm, vals_ref, ids_ref, ids_smem, rows_vmem, fw_vmem,
+         sem_ids, sems, sems_f) = rest
+    else:
+        (vals_ref, ids_ref, ids_smem, rows_vmem, sem_ids, sems) = rest
+        filt_hbm = fw_vmem = sems_f = None
     i = pl.program_id(0)
     jc = pl.program_id(1)
     bq, bc = cand_ref.shape
@@ -995,15 +1103,34 @@ def _gather_refine_kernel(q_ref, cand_ref, cand_hbm, data_hbm,
             rows_vmem.at[pl.ds(t, 1), :],
             sems.at[jax.lax.rem(t, _GATHER_NBUF)])
 
+    def word_copy(t):
+        # the candidate's bitset word, addressed off the same SMEM id
+        # the row DMA reads (word index = row // 32 — int32-exact: the
+        # kernel's ids are int32 by construction, core/ids policy)
+        qq = t // bc
+        rr = jax.lax.rem(t, bc)
+        row = jnp.clip(ids_smem[qq, rr], 0, n_rows - 1)
+        w = jnp.minimum(row // 32, filt_hbm.shape[0] - 1)
+        return pltpu.make_async_copy(
+            filt_hbm.at[pl.ds(w, 1), :],
+            fw_vmem.at[pl.ds(qq, 1), pl.ds(rr, 1)],
+            sems_f.at[jax.lax.rem(t, _GATHER_NBUF)])
+
     for t in range(_GATHER_NBUF):  # static warm-up fills the queue
         row_copy(t).start()
+        if filtered:
+            word_copy(t).start()
 
     def stream(t, carry):
         row_copy(t).wait()
+        if filtered:
+            word_copy(t).wait()
 
         @pl.when(t + _GATHER_NBUF < total)
         def _():
             row_copy(t + _GATHER_NBUF).start()
+            if filtered:
+                word_copy(t + _GATHER_NBUF).start()
 
         return carry
 
@@ -1028,6 +1155,12 @@ def _gather_refine_kernel(q_ref, cand_ref, cand_hbm, data_hbm,
             key = jnp.maximum(qsq[:, None] + rsq - 2.0 * s, 0.0)
     cand = cand_ref[:]                                 # [bq, bc] i32
     valid = cand >= 0
+    if filtered:
+        # poison masked rows in the metric epilogue: the candidate's
+        # keep bit, tested against the word its DMA fetched — the same
+        # ±inf/-1 sentinel path invalid ids take (GL13)
+        bit = jax.lax.shift_right_logical(fw_vmem[:], cand & 31) & 1
+        valid = jnp.logical_and(valid, bit > 0)
     key = jnp.where(valid, key, jnp.inf)
     gid = jnp.where(valid, cand, -1)
 
@@ -1042,6 +1175,7 @@ def _gather_refine_kernel(q_ref, cand_ref, cand_hbm, data_hbm,
 @functools.partial(jax.jit, static_argnames=("k", "metric", "interpret"))
 def gather_refine_topk(dataset: jax.Array, queries: jax.Array,
                        candidates: jax.Array, k: int, metric: str = "l2",
+                       filter_bits=None,
                        interpret: bool = False
                        ) -> Tuple[jax.Array, jax.Array]:
     """Fused exact re-rank of per-query candidate ids — the streaming
@@ -1068,6 +1202,12 @@ def gather_refine_topk(dataset: jax.Array, queries: jax.Array,
     lane-tiled rows) — dispatchers weigh it against the gather buffer
     via ``ivf_common.gather_refine_mem_ok``.
 
+    ``filter_bits``: optional packed uint32 bitset over dataset rows
+    (``core.bitset`` layout) — each candidate's word is fetched by the
+    row-DMA queue and cleared bits are poisoned to +inf/-1 in the
+    epilogue (the streamed filter half of the filtered oversampled
+    pipeline).
+
     Returns (keys [m, k], ids [m, k]): minimized sort keys, sorted
     ascending (l2: squared distance — callers apply sqrt; ip: negated
     score; cos: cosine distance) and global candidate ids (-1 when a
@@ -1081,6 +1221,7 @@ def gather_refine_topk(dataset: jax.Array, queries: jax.Array,
             f"k={k} > {GATHER_REFINE_MAX_K} (the in-kernel merge is k "
             "extraction rounds per tile — gate with "
             "pallas_gather_refine_wanted)")
+    filtered = filter_bits is not None
     bq, bc = GATHER_REFINE_BQ, GATHER_REFINE_BC
     kpad = _LANES
     qf = _pad_to(queries.astype(jnp.float32), bq, 0, 0.0)
@@ -1091,20 +1232,41 @@ def gather_refine_topk(dataset: jax.Array, queries: jax.Array,
     mp, Cp = cand.shape
     dpad = data.shape[1]
 
+    in_specs = [
+        pl.BlockSpec((bq, dpad), lambda i, j: (i, 0)),
+        # candidates ride twice: a VMEM block for the validity mask,
+        # and the full array in HBM for the in-kernel id DMA (DMA
+        # row addresses must come from scalar memory)
+        pl.BlockSpec((bq, bc), lambda i, j: (i, j)),
+        pl.BlockSpec(memory_space=pltpu.ANY),
+        pl.BlockSpec(memory_space=pltpu.ANY),
+    ]
+    operands = [qf, cand, cand, data]
+    scratch = [
+        pltpu.SMEM((bq, bc), jnp.int32),
+        pltpu.VMEM((bq * bc, dpad), data.dtype),
+    ]
+    if filtered:
+        # [W, 1] i32 view of the packed words: per-candidate [1, 1]
+        # word DMAs address rows of a 2-D array
+        fw = jax.lax.bitcast_convert_type(
+            filter_bits, jnp.int32).reshape(-1, 1)
+        in_specs.append(pl.BlockSpec(memory_space=pltpu.ANY))
+        operands.append(fw)
+        scratch.append(pltpu.VMEM((bq, bc), jnp.int32))
+    scratch += [
+        pltpu.SemaphoreType.DMA,
+        pltpu.SemaphoreType.DMA((_GATHER_NBUF,)),
+    ]
+    if filtered:
+        scratch.append(pltpu.SemaphoreType.DMA((_GATHER_NBUF,)))
+
     grid = (mp // bq, Cp // bc)
     vals, ids = pl.pallas_call(
         functools.partial(_gather_refine_kernel, k=k, metric=metric,
-                          n_rows=n),
+                          n_rows=n, filtered=filtered),
         grid=grid,
-        in_specs=[
-            pl.BlockSpec((bq, dpad), lambda i, j: (i, 0)),
-            # candidates ride twice: a VMEM block for the validity mask,
-            # and the full array in HBM for the in-kernel id DMA (DMA
-            # row addresses must come from scalar memory)
-            pl.BlockSpec((bq, bc), lambda i, j: (i, j)),
-            pl.BlockSpec(memory_space=pltpu.ANY),
-            pl.BlockSpec(memory_space=pltpu.ANY),
-        ],
+        in_specs=in_specs,
         out_specs=[
             pl.BlockSpec((bq, kpad), lambda i, j: (i, 0)),
             pl.BlockSpec((bq, kpad), lambda i, j: (i, 0)),
@@ -1113,19 +1275,15 @@ def gather_refine_topk(dataset: jax.Array, queries: jax.Array,
             jax.ShapeDtypeStruct((mp, kpad), jnp.float32),
             jax.ShapeDtypeStruct((mp, kpad), jnp.int32),
         ],
-        scratch_shapes=[
-            pltpu.SMEM((bq, bc), jnp.int32),
-            pltpu.VMEM((bq * bc, dpad), data.dtype),
-            pltpu.SemaphoreType.DMA,
-            pltpu.SemaphoreType.DMA((_GATHER_NBUF,)),
-        ],
+        scratch_shapes=scratch,
         interpret=interpret,
-    )(qf, cand, cand, data)
+    )(*operands)
     return vals[:m, :k], ids[:m, :k]
 
 
 def pallas_gather_refine_wanted(m: int, C: int, d: int, k: int,
-                                itemsize: int = 4) -> bool:
+                                itemsize: int = 4,
+                                filtered: bool = False) -> bool:
     """Dispatch for :func:`gather_refine_topk` — the fused refine tier.
 
     Needs k within the merge budget and a VMEM-sized gathered-row
@@ -1145,7 +1303,8 @@ def pallas_gather_refine_wanted(m: int, C: int, d: int, k: int,
             + 2 * bq * dpad * 4           # query block (+double buffer)
             + 2 * bq * bc * 4             # candidate id block
             + bq * bc * dpad * 4          # f32 row/broadcast transients
-            + 4 * bq * _LANES * 8)        # running buffers + extraction
+            + 4 * bq * _LANES * 8         # running buffers + extraction
+            + (bq * bc * 4 if filtered else 0))  # per-candidate words
     if vmem > _GROUPED_VMEM_BUDGET:
         return False
     if force == "on":
@@ -1506,18 +1665,13 @@ RING_FUSED_MAX_SEGS = 512
 
 
 def _ring_lut_scan_kernel(cl_smem, ind_hbm, qv_hbm, codes_hbm, ids_hbm,
-                          norms_hbm, ctr_hbm, sel_lo_ref, sel_hi_ref,
-                          off_ref, cbp_ref, out_v_ref, out_i_ref,
-                          qv_vmem, ctr_vmem, ind_vmem, code_sl, idrow_sl,
-                          nrow_sl, qc_col, bias_col,
-                          b1k, b1i, b2k, b2i, cand_v, cand_i,
-                          run_v, run_i, buf_v, buf_i, qv_sem, seg_sems,
-                          tile_sems, send_sems, recv_sems, cap_sems, *,
+                          norms_hbm, ctr_hbm, *rest,
                           k: int, n_dev: int, mc: int, NS: int, n_t: int,
                           metric: str, pq_bits: int, S: int, P: int,
                           G: int, Sg: int, Kc: int, L: int, Rt: int,
                           rot: int, rotp: int, indl: int,
-                          axis_name: str, flow_control: bool):
+                          axis_name: str, flow_control: bool,
+                          filtered: bool):
     """One device's program of the fused scan-in-ring search.
 
     The ring schedule is the serialized PR-8 exchange; what fills the
@@ -1548,7 +1702,28 @@ def _ring_lut_scan_kernel(cl_smem, ind_hbm, qv_hbm, codes_hbm, ids_hbm,
     :func:`_ring_topk_kernel`'s serial schedule (double-buffered recv
     slots, capacity semaphores + entry barrier compiled out in
     interpret mode) — the overlap here comes from the scan, not from
-    half-splitting."""
+    half-splitting.
+
+    ``filtered`` streams each list's packed per-candidate filter bytes
+    through the tile-copy queue (a 4th double-buffered slot beside
+    codes/ids/norms), unpacked per tile with the code-unpack shift/mask
+    machinery and folded into the shared tile body's sentinel epilogue
+    — the per-shard bitset slice composed with the global→local remap
+    happens host-side (``parallel.ivf``)."""
+    if filtered:
+        (fbytes_hbm, sel_lo_ref, sel_hi_ref, off_ref, cbp_ref,
+         fsel_ref, foff_ref, out_v_ref, out_i_ref,
+         qv_vmem, ctr_vmem, ind_vmem, code_sl, idrow_sl, nrow_sl,
+         fb_sl, qc_col, bias_col, b1k, b1i, b2k, b2i, cand_v, cand_i,
+         run_v, run_i, buf_v, buf_i, qv_sem, seg_sems, tile_sems,
+         send_sems, recv_sems, cap_sems) = rest
+    else:
+        (sel_lo_ref, sel_hi_ref, off_ref, cbp_ref, out_v_ref, out_i_ref,
+         qv_vmem, ctr_vmem, ind_vmem, code_sl, idrow_sl, nrow_sl,
+         qc_col, bias_col, b1k, b1i, b2k, b2i, cand_v, cand_i,
+         run_v, run_i, buf_v, buf_i, qv_sem, seg_sems, tile_sems,
+         send_sems, recv_sems, cap_sems) = rest
+        fbytes_hbm = fsel_ref = foff_ref = fb_sl = None
     my = jax.lax.axis_index(axis_name)
     right = jax.lax.rem(my + 1, n_dev)
     left = jax.lax.rem(my + n_dev - 1, n_dev)
@@ -1574,11 +1749,13 @@ def _ring_lut_scan_kernel(cl_smem, ind_hbm, qv_hbm, codes_hbm, ids_hbm,
         b1i[:] = jnp.where(cols < 0, anchor_i[:, :_LANES], -1)
         b2i[:] = jnp.where(cols < 0, anchor_i[:, :_LANES], -1)
 
+    Fbt = G * Rt // 8
+
     def tile_copies(c, t, sl):
         p = t // n_t
         tt = jax.lax.rem(t, n_t)
         lst = jnp.maximum(cl_smem[c, p], 0)
-        return (
+        copies = (
             pltpu.make_async_copy(
                 codes_hbm.at[pl.ds(lst, 1), pl.ds(tt * Rt, Rt), :],
                 code_sl.at[pl.ds(sl, 1)], tile_sems.at[sl, 0]),
@@ -1589,6 +1766,11 @@ def _ring_lut_scan_kernel(cl_smem, ind_hbm, qv_hbm, codes_hbm, ids_hbm,
                 norms_hbm.at[pl.ds(lst, 1), pl.ds(tt * G * Rt, G * Rt)],
                 nrow_sl.at[pl.ds(sl, 1)], tile_sems.at[sl, 2]),
         )
+        if filtered:
+            copies += (pltpu.make_async_copy(
+                fbytes_hbm.at[pl.ds(lst, 1), pl.ds(tt * Fbt, Fbt)],
+                fb_sl.at[pl.ds(sl, 1)], tile_sems.at[sl, 3]),)
+        return copies
 
     def scan_chunk(c):
         """Stream chunk ``c``'s union probe lists; leaves the chunk's
@@ -1656,6 +1838,12 @@ def _ring_lut_scan_kernel(cl_smem, ind_hbm, qv_hbm, codes_hbm, ids_hbm,
             code = _lut_unpack_codes(bytes_f, sel_lo_ref[:],
                                      sel_hi_ref[:], off_ref[:],
                                      pq_bits, K)
+            filt_row = None
+            if filtered:
+                fb_f = fb_sl[pl.ds(sl, 1)].astype(jnp.int32).astype(
+                    jnp.float32)
+                filt_row = _lut_unpack_filter(fb_f, fsel_ref[:],
+                                              foff_ref[:])
             # per-segment scalars staged by _seg_head (computed once
             # per NS·n_t tiles, not per tile)
             qc = qc_col[:, 0]                        # [mc] ⟨q, c⟩
@@ -1666,7 +1854,8 @@ def _ring_lut_scan_kernel(cl_smem, ind_hbm, qv_hbm, codes_hbm, ids_hbm,
                 nrow_sl[pl.ds(sl, 1)], cbp_ref, tt, state,
                 metric=metric, pq_bits=pq_bits, S=S, P=P, G=G, Sg=Sg,
                 Kc=Kc, L=L, Rt=Rt, rot=rot, rotp=rotp,
-                exact=cbp_ref.dtype == jnp.float32, key_bias=bias)
+                exact=cbp_ref.dtype == jnp.float32, key_bias=bias,
+                filt_row=filt_row)
             b1k[:] = nb1k
             b1i[:] = nb1i
             b2k[:] = nb2k
@@ -1746,12 +1935,15 @@ def _ring_lut_scan_kernel(cl_smem, ind_hbm, qv_hbm, codes_hbm, ids_hbm,
 
 def ring_lut_scan_kernel_ok(S: int, K: int, P: int, nb: int, Wb: int, mc: int,
                      NS: int, k: int, n_dev: int, rot: int,
-                     lut_dtype: str = "float32") -> bool:
+                     lut_dtype: str = "float32",
+                     filtered: bool = False) -> bool:
     """Admission for :func:`ring_lut_scan_merge`: the packed layout must
     be one the in-kernel unpack supports, the merge budget holds (k
     extraction rounds per segment and per hop), the union-segment table
     fits the scan loop, and the VMEM working set — chunk queries + code
-    slots + codebook operand + bins + ring blocks — fits the budget."""
+    slots + codebook operand + bins + ring blocks (+ the filter-byte
+    slots and unpack selection matrix when ``filtered``) — fits the
+    budget."""
     if k > RING_TOPK_MAX_K or n_dev < 2 or NS > RING_FUSED_MAX_SEGS:
         return False
     cfg = _lut_scan_config(S, K, P, nb, Wb, lut_dtype)
@@ -1761,7 +1953,8 @@ def ring_lut_scan_kernel_ok(S: int, K: int, P: int, nb: int, Wb: int, mc: int,
     op_bytes = 4 if lut_dtype == "float32" else 2
     rotp = -(-rot // _LANES) * _LANES
     Rt = 2 * _LANES
-    vmem = (
+    vmem_f = _filter_vmem_bytes(G, Rt) if filtered else 0
+    vmem = vmem_f + (
         mc * rotp * 4                  # chunk queries
         + 2 * Rt * max(Wb, _LANES)     # u8 code slots (double buffer)
         + 2 * 2 * G * Rt * 8           # id + norm rows (2 slots)
@@ -1792,6 +1985,7 @@ def ring_lut_scan_merge(chunk_lists: jax.Array, probe_ind: jax.Array,
                         k: int, metric: str = "l2", *, pq_bits: int,
                         pq_dim: int, L: int, axis_name: str, n_dev: int,
                         lut_dtype: str = "float32",
+                        filter_bytes=None,
                         interpret: bool = False
                         ) -> Tuple[jax.Array, jax.Array]:
     """Fused per-shard LUT scan + ring top-k exchange — codes to merged
@@ -1818,6 +2012,13 @@ def ring_lut_scan_merge(chunk_lists: jax.Array, probe_ind: jax.Array,
     follow the LUT-scan convention (l2: ‖c+d‖² − 2⟨q,c+d⟩, caller adds
     ‖q‖²; ip: −⟨q,c+d⟩); comms bytes are the ring tier's (count via
     ``Comms.count_ring_topk``, byte model unchanged).
+
+    ``filter_bytes`` [n_lists, ceil(L/8)] u8 — optional per-candidate
+    packed filter mask over THIS SHARD's list slots
+    (``sample_filter.pack_mask_bytes`` of the shard-sliced,
+    local-id-remapped keep mask — see ``parallel.ivf``): streamed per
+    code tile beside the codes and masked to the sentinel in the shared
+    tile body, so filtered pod-scale search rides the ring kernel too.
 
     Returns (keys [mc, 128], ids [mc, 128]) — this device's owned query
     chunk, ascending, ids −1 for empty slots; callers emit ``P(axis)``
@@ -1852,6 +2053,11 @@ def ring_lut_scan_merge(chunk_lists: jax.Array, probe_ind: jax.Array,
         packed = _pad_to(packed, n_t * Rt, 1, 0)
     ids = _pad_to(ids, G * n_t * Rt, 1, -1)
     norms = _pad_to(norms, G * n_t * Rt, 1, 0.0)
+    filtered = filter_bytes is not None
+    Fbt = G * Rt // 8
+    if filtered:
+        fbits = _pad_to(filter_bytes, n_t * Fbt, 1, 0)
+        fsel, foff = _filter_unpack_operands(G * Rt)
 
     qvp = _pad_to(qv_chunks.astype(jnp.float32), _LANES, 2, 0.0)
     rotp = qvp.shape[2]
@@ -1870,25 +2076,72 @@ def ring_lut_scan_merge(chunk_lists: jax.Array, probe_ind: jax.Array,
         # and a plain merge must never share a barrier semaphore
         kwargs["compiler_params"] = pltpu.TPUCompilerParams(
             collective_id=2)
+    in_specs = [
+        pl.BlockSpec(memory_space=pltpu.SMEM),    # chunk_lists
+        pl.BlockSpec(memory_space=pltpu.ANY),     # probe indicator
+        pl.BlockSpec(memory_space=pltpu.ANY),     # chunk queries
+        pl.BlockSpec(memory_space=pltpu.ANY),     # packed codes
+        pl.BlockSpec(memory_space=pltpu.ANY),     # ids
+        pl.BlockSpec(memory_space=pltpu.ANY),     # norms
+        pl.BlockSpec(memory_space=pltpu.ANY),     # rotated centers
+    ]
+    operands = [chunk_lists.astype(jnp.int32), ind, qvp, packed, ids,
+                norms, ctr]
+    if filtered:
+        in_specs.append(pl.BlockSpec(memory_space=pltpu.ANY))  # fbytes
+        operands.append(fbits)
+    in_specs += [
+        pl.BlockSpec((Wb, G * S), lambda: (0, 0)),
+        pl.BlockSpec((Wb, G * S), lambda: (0, 0)),
+        pl.BlockSpec((1, G * S), lambda: (0, 0)),
+        pl.BlockSpec((n_sg, K * Sg, Sg * Pl), lambda: (0, 0, 0)),
+    ]
+    operands += [sel_lo, sel_hi, off_arr, cbp]
+    if filtered:
+        in_specs += [
+            pl.BlockSpec((Fbt, G * Rt), lambda: (0, 0)),
+            pl.BlockSpec((1, G * Rt), lambda: (0, 0)),
+        ]
+        operands += [fsel, foff]
+    scratch = [
+        pltpu.VMEM((1, mc, rotp), jnp.float32),   # chunk queries
+        pltpu.VMEM((1, rotp), jnp.float32),       # center row
+        pltpu.VMEM((1, 1, indl), jnp.float32),    # probe indicator
+        pltpu.VMEM((2, Rt, Wb), jnp.uint8),       # code tile slots
+        pltpu.VMEM((2, G * Rt), jnp.int32),       # id row slots
+        pltpu.VMEM((2, G * Rt), jnp.float32),     # norm row slots
+    ]
+    if filtered:
+        scratch.append(pltpu.VMEM((2, Fbt), jnp.uint8))  # filter slots
+    scratch += [
+        pltpu.VMEM((mc, _LANES), jnp.float32),    # seg scalars: ⟨q,c⟩
+        pltpu.VMEM((mc, _LANES), jnp.float32),    # seg scalars: bias
+        pltpu.VMEM((mc, _LANES), jnp.float32),    # bins: best
+        pltpu.VMEM((mc, _LANES), jnp.int32),
+        pltpu.VMEM((mc, _LANES), jnp.float32),    # bins: second
+        pltpu.VMEM((mc, _LANES), jnp.int32),
+        pltpu.VMEM((mc, kpad), jnp.float32),      # chunk candidates
+        pltpu.VMEM((mc, kpad), jnp.int32),
+        pltpu.VMEM((mc, kpad), jnp.float32),      # ring running block
+        pltpu.VMEM((mc, kpad), jnp.int32),
+        pltpu.VMEM((2, mc, kpad), jnp.float32),   # recv slots
+        pltpu.VMEM((2, mc, kpad), jnp.int32),
+        pltpu.SemaphoreType.DMA,                  # chunk-query copy
+        pltpu.SemaphoreType.DMA((2,)),            # center + indicator
+        # code/id/norm (+filter) tile slots
+        pltpu.SemaphoreType.DMA((2, 4 if filtered else 3)),
+        pltpu.SemaphoreType.DMA((2, 2)),          # ring send
+        pltpu.SemaphoreType.DMA((2, 2)),          # ring recv
+        pltpu.SemaphoreType.REGULAR((2,)),        # slot capacity
+    ]
     out_v, out_i = pl.pallas_call(
         functools.partial(
             _ring_lut_scan_kernel, k=k, n_dev=n_dev, mc=mc, NS=NS,
             n_t=n_t, metric=metric, pq_bits=pq_bits, S=S, P=Pl, G=G,
             Sg=Sg, Kc=Kc, L=L, Rt=Rt, rot=rot, rotp=rotp, indl=indl,
-            axis_name=axis_name, flow_control=not interpret),
-        in_specs=[
-            pl.BlockSpec(memory_space=pltpu.SMEM),    # chunk_lists
-            pl.BlockSpec(memory_space=pltpu.ANY),     # probe indicator
-            pl.BlockSpec(memory_space=pltpu.ANY),     # chunk queries
-            pl.BlockSpec(memory_space=pltpu.ANY),     # packed codes
-            pl.BlockSpec(memory_space=pltpu.ANY),     # ids
-            pl.BlockSpec(memory_space=pltpu.ANY),     # norms
-            pl.BlockSpec(memory_space=pltpu.ANY),     # rotated centers
-            pl.BlockSpec((Wb, G * S), lambda: (0, 0)),
-            pl.BlockSpec((Wb, G * S), lambda: (0, 0)),
-            pl.BlockSpec((1, G * S), lambda: (0, 0)),
-            pl.BlockSpec((n_sg, K * Sg, Sg * Pl), lambda: (0, 0, 0)),
-        ],
+            axis_name=axis_name, flow_control=not interpret,
+            filtered=filtered),
+        in_specs=in_specs,
         out_specs=[
             pl.BlockSpec((mc, kpad), lambda: (0, 0)),
             pl.BlockSpec((mc, kpad), lambda: (0, 0)),
@@ -1897,34 +2150,8 @@ def ring_lut_scan_merge(chunk_lists: jax.Array, probe_ind: jax.Array,
             jax.ShapeDtypeStruct((mc, kpad), jnp.float32),
             jax.ShapeDtypeStruct((mc, kpad), jnp.int32),
         ],
-        scratch_shapes=[
-            pltpu.VMEM((1, mc, rotp), jnp.float32),   # chunk queries
-            pltpu.VMEM((1, rotp), jnp.float32),       # center row
-            pltpu.VMEM((1, 1, indl), jnp.float32),    # probe indicator
-            pltpu.VMEM((2, Rt, Wb), jnp.uint8),       # code tile slots
-            pltpu.VMEM((2, G * Rt), jnp.int32),       # id row slots
-            pltpu.VMEM((2, G * Rt), jnp.float32),     # norm row slots
-            pltpu.VMEM((mc, _LANES), jnp.float32),    # seg scalars: ⟨q,c⟩
-            pltpu.VMEM((mc, _LANES), jnp.float32),    # seg scalars: bias
-            pltpu.VMEM((mc, _LANES), jnp.float32),    # bins: best
-            pltpu.VMEM((mc, _LANES), jnp.int32),
-            pltpu.VMEM((mc, _LANES), jnp.float32),    # bins: second
-            pltpu.VMEM((mc, _LANES), jnp.int32),
-            pltpu.VMEM((mc, kpad), jnp.float32),      # chunk candidates
-            pltpu.VMEM((mc, kpad), jnp.int32),
-            pltpu.VMEM((mc, kpad), jnp.float32),      # ring running block
-            pltpu.VMEM((mc, kpad), jnp.int32),
-            pltpu.VMEM((2, mc, kpad), jnp.float32),   # recv slots
-            pltpu.VMEM((2, mc, kpad), jnp.int32),
-            pltpu.SemaphoreType.DMA,                  # chunk-query copy
-            pltpu.SemaphoreType.DMA((2,)),            # center + indicator
-            pltpu.SemaphoreType.DMA((2, 3)),          # code/id/norm slots
-            pltpu.SemaphoreType.DMA((2, 2)),          # ring send
-            pltpu.SemaphoreType.DMA((2, 2)),          # ring recv
-            pltpu.SemaphoreType.REGULAR((2,)),        # slot capacity
-        ],
+        scratch_shapes=scratch,
         interpret=interpret,
         **kwargs,
-    )(chunk_lists.astype(jnp.int32), ind, qvp, packed, ids, norms, ctr,
-      sel_lo, sel_hi, off_arr, cbp)
+    )(*operands)
     return out_v, out_i

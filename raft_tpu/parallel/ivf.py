@@ -194,7 +194,8 @@ def _gather_trainset(x: jax.Array, mesh: Mesh, axis: str, t: int,
 def _ring_fused_wanted(index: "ShardedIvfPq", m: int, k: int,
                        n_probes: int, n_dev: int, whole_mesh: bool,
                        merge: str, mt: DistanceType, lut_dtype: str,
-                       scan_select: str) -> Tuple[bool, str]:
+                       scan_select: str,
+                       filtered: bool = False) -> Tuple[bool, str]:
     """Dispatch for the fused scan-in-ring tier. Returns
     ``(take_it, decline_reason)`` — reason is non-empty only when the
     tier was WANTED (env force, or auto on an eligible ring setup) but
@@ -223,6 +224,12 @@ def _ring_fused_wanted(index: "ShardedIvfPq", m: int, k: int,
       ``RING_FUSED_MAX_SEGS``;
     - ``latency_bound``: shapes where auto mode keeps the single
       allgather (``ring_auto_wanted``).
+
+    ``filtered`` admits the per-shard filter-byte stream: the kernel's
+    VMEM model grows the filter slots + unpack selection matrix
+    (``ring_lut_scan_kernel_ok``) and the HBM transient — the shard's
+    ``[n_lists, ceil(L/8)]`` packed byte rows — must pass
+    ``ivf_common.filtered_scan_mem_ok`` (``mem_guard`` decline).
     """
     from raft_tpu.obs import spans as _obs_spans
     from raft_tpu.ops import pallas_kernels as _pk
@@ -256,20 +263,32 @@ def _ring_fused_wanted(index: "ShardedIvfPq", m: int, k: int,
     ok = _pk.ring_lut_scan_kernel_ok(
         index.pq_dim, 1 << index.pq_bits,
         index.codebooks.shape[2], nb, Wb, mc, NS, k, n_dev,
-        index.centers_rot.shape[1], lut_dtype=lut_dtype)
+        index.centers_rot.shape[1], lut_dtype=lut_dtype,
+        filtered=filtered)
     if not ok:
         return False, "kernel_ineligible"
+    if filtered and not ic.filtered_scan_mem_ok(
+            index.n_lists, index.packed_ids.shape[2]):
+        return False, "mem_guard"
     return True, ""
 
 
 def _search_fused_ring(index: "ShardedIvfPq", q: jax.Array, k: int,
                        n_probes: int, mesh: Mesh, axis: str,
-                       lut_dtype: str, mt: DistanceType
+                       lut_dtype: str, mt: DistanceType,
+                       filter_bits=None
                        ) -> Tuple[jax.Array, jax.Array]:
     """The fused scan-in-ring search: probes + chunk unions + one
     persistent Pallas kernel per shard (``ring_lut_scan_merge``), then
     the LUT-key → metric epilogue. Results are query-sharded like the
-    ring merge tier's."""
+    ring merge tier's.
+
+    ``filter_bits`` (replicated, GLOBAL row ids): each shard composes
+    the global bitset with its own global-id table — the per-shard
+    bitset slice — into the packed per-candidate byte rows the ring
+    kernel streams beside the codes (``sample_filter.list_filter_bytes``
+    over ``packed_ids[shard]``, whose global ids bake in the shard
+    offset), so filtered pod-scale search rides the ring kernel too."""
     from raft_tpu.obs import spans as _obs_spans
     from raft_tpu.ops import pallas_kernels as _pk
 
@@ -285,7 +304,7 @@ def _search_fused_ring(index: "ShardedIvfPq", q: jax.Array, k: int,
     interpret = not _pk._on_tpu()
 
     def body(codes, ids, norms, sizes, qp, centers, centers_rot,
-             rotation, codebooks):
+             rotation, codebooks, *fb):
         local = _pq.IvfPqIndex(
             centers=centers, centers_rot=centers_rot, rotation=rotation,
             codebooks=codebooks, packed_codes=codes[0],
@@ -298,6 +317,16 @@ def _search_fused_ring(index: "ShardedIvfPq", q: jax.Array, k: int,
         lists, ind = _chunk_unions(
             probes.reshape(n_dev, mc, n_probes), NS)
         qv = q_rot.reshape(n_dev, mc, q_rot.shape[1])
+        fbytes = None
+        if fb:
+            from raft_tpu.neighbors import sample_filter as _sf
+
+            # the per-shard bitset slice: this shard's id table carries
+            # GLOBAL ids (the shard offset baked in at build), so one
+            # passes() gather over it composes the replicated global
+            # bitset with the global→local remap — re-packed to the
+            # per-list byte rows the ring kernel streams per code tile
+            fbytes = _sf.list_filter_bytes(fb[0], ids[0])
         # the kernel's remote DMAs bypass lax — attribute the hop
         # traffic through the facade at trace time, the same [mc, k]
         # logical block per hop as the plain ring merge (the fusion
@@ -311,20 +340,25 @@ def _search_fused_ring(index: "ShardedIvfPq", q: jax.Array, k: int,
             codebooks, k, "ip" if ip_like else "l2",
             pq_bits=index.pq_bits, pq_dim=index.pq_dim, L=L,
             axis_name=axis, n_dev=n_dev, lut_dtype=lut_dtype,
-            interpret=interpret)
+            filter_bytes=fbytes, interpret=interpret)
         return kv[:, :k], ki[:, :k]
 
+    in_specs = [P(axis, None, None, None), P(axis, None, None),
+                P(axis, None, None), P(axis, None), P(),
+                P(), P(), P(), P()]
+    operands = [index.packed_codes, index.packed_ids, index.packed_norms,
+                index.list_sizes, qp, index.centers, index.centers_rot,
+                index.rotation, index.codebooks]
+    if filter_bits is not None:
+        in_specs.append(P())   # the global bitset rides replicated
+        operands.append(filter_bits)
     out_spec = P(axis, None)
     fn = shard_map(
         body, mesh=mesh,
-        in_specs=(P(axis, None, None, None), P(axis, None, None),
-                  P(axis, None, None), P(axis, None), P(),
-                  P(), P(), P(), P()),
+        in_specs=tuple(in_specs),
         out_specs=(out_spec, out_spec),
         check_vma=False)
-    rv, ri = fn(index.packed_codes, index.packed_ids, index.packed_norms,
-                index.list_sizes, qp, index.centers, index.centers_rot,
-                index.rotation, index.codebooks)
+    rv, ri = fn(*operands)
     rv, ri = rv[:m], ri[:m]
     # LUT-key → metric epilogue (the _finish_candidates conventions)
     if ip_like:
@@ -460,7 +494,8 @@ def build_ivf_pq(params: _pq.IndexParams, dataset: jax.Array, mesh: Mesh,
 def search_ivf_pq(params: _pq.SearchParams, index: ShardedIvfPq,
                   queries: jax.Array, k: int, mesh: Mesh,
                   axis: str = "shard", dataset=None,
-                  merge: str = "auto") -> Tuple[jax.Array, jax.Array]:
+                  merge: str = "auto",
+                  filter_bitset=None) -> Tuple[jax.Array, jax.Array]:
     """Sharded IVF-PQ search: per-shard list scan + cross-shard top-k
     merge (reference: per-worker search + knn_merge_parts.cuh). Queries
     are replicated; returns (distances [m, k], global ids [m, k]) —
@@ -475,7 +510,15 @@ def search_ivf_pq(params: _pq.SearchParams, index: ShardedIvfPq,
     exact re-rank rides the gather-refine dispatch tier against the
     shard's own rows, and only each shard's k refined survivors enter
     the merge — BASELINE config 5's shape (sharded IVF-PQ, SIFT-1B on
-    v5e-64) end to end."""
+    v5e-64) end to end.
+
+    ``filter_bitset`` (packed uint32 words over GLOBAL row ids,
+    replicated): every per-shard tier composes it with the shard's
+    global-id tables — the fused ring kernel streams the per-shard
+    byte slice beside the codes, the unfused scan and the refined
+    pipeline's oversampled scan mask in their own tiers — so filtered
+    pod-scale search stays on whatever fast path the unfiltered shape
+    would ride."""
     mt = resolve_metric(index.metric)
     select_min = SELECT_MIN[mt]
     n_probes = min(params.n_probes, index.n_lists)
@@ -492,29 +535,35 @@ def search_ivf_pq(params: _pq.SearchParams, index: ShardedIvfPq,
             "index sharded over %d devices, mesh axis has %d",
             n_dev, mesh.shape[axis])
     refined = params.refine != "none"
+    filtered = filter_bitset is not None
     if params.lut_dtype == "auto" and not refined:
         # direct sharded calls resolve the fp8-default policy here (the
         # neighbors entry resolves before dispatching to this tier).
         # Refined searches stay "auto" so the per-shard oversampled
         # scan resolves against its ACTUAL selection width k_cand —
-        # the slack the fp8 floor is defined over
+        # the slack the fp8 floor is defined over. A filter's
+        # selectivity discounts the slack (surviving candidates only)
         params = dataclasses.replace(
-            params, lut_dtype=_pq.resolve_lut_dtype("auto", n_probes, k))
+            params, lut_dtype=_pq.resolve_lut_dtype(
+                "auto", n_probes, k,
+                selectivity=_pq._filter_selectivity(filter_bitset)))
     if not refined:
         from raft_tpu.obs import spans as _obs_spans
 
         fused, fused_reason = _ring_fused_wanted(
             index, m, k, n_probes, n_dev,
             whole_mesh=n_dev == mesh.devices.size, merge=merge, mt=mt,
-            lut_dtype=params.lut_dtype, scan_select=params.scan_select)
+            lut_dtype=params.lut_dtype, scan_select=params.scan_select,
+            filtered=filtered)
         if fused:
             # codes → merged top-k in one persistent kernel: the scan
             # IS the merge's compute phase, no per-shard candidate
             # table, no separate merge dispatch
             _obs_spans.count_dispatch("parallel.merge", "ring_fused_scan")
-            _obs_spans.count_dispatch("ivf_pq.scan", "ring_lut_fused")
+            _pq._count_scan_dispatch("ring_lut_fused", filtered=filtered)
             rv, ri = _search_fused_ring(index, q, k, n_probes, mesh,
-                                        axis, params.lut_dtype, mt)
+                                        axis, params.lut_dtype, mt,
+                                        filter_bits=filter_bitset)
             return rv, ri
         if fused_reason:
             _obs_spans.count_fallback("parallel.merge", fused_reason)
@@ -550,7 +599,10 @@ def search_ivf_pq(params: _pq.SearchParams, index: ShardedIvfPq,
         scan_params = dataclasses.replace(params, refine="none")
 
     def local_search(codes, ids, norms, sizes, q,
-                     centers, centers_rot, rotation, codebooks, *ds):
+                     centers, centers_rot, rotation, codebooks, *rest):
+        rest = list(rest)
+        ds = rest.pop(0) if refined else None
+        fb = rest.pop(0) if filtered else None
         local = _pq.IvfPqIndex(
             centers=centers, centers_rot=centers_rot, rotation=rotation,
             codebooks=codebooks, packed_codes=codes[0], packed_ids=ids[0],
@@ -558,22 +610,28 @@ def search_ivf_pq(params: _pq.SearchParams, index: ShardedIvfPq,
             pq_bits=index.pq_bits, pq_dim_static=index.pq_dim)
         if refined:
             # per-shard fused pipeline: oversampled scan through the
-            # full single-chip dispatch stack (LUT-scan tier included),
-            # exact re-rank against this shard's own rows (ids are
-            # global with the shard offset baked in at build)
-            _, i0 = _pq.search(local, q, k_cand, scan_params)
+            # full single-chip dispatch stack (LUT-scan tier included —
+            # a filter rides it as the streamed per-candidate mask: the
+            # shard's id tables are global, so the replicated bitset
+            # composes directly), exact re-rank against this shard's
+            # own rows (ids are global with the shard offset baked in
+            # at build)
+            _, i0 = _pq.search(local, q, k_cand, scan_params,
+                               filter_bitset=fb)
             rank = comms.get_rank()
             # global↔local remap through the one id-dtype policy
             # (core.ids): the offset math overflows int32 past 2³¹ pod
-            # rows, and the incoming id width is never narrowed
+            # rows, and the incoming id width is never narrowed. i0 is
+            # already filter-clean — the refine re-rank needs no filter
             li = _ids.local_ids(i0, rank, shard_n)
-            vals, lids = _refine.refine(ds[0], q, li, k,
+            vals, lids = _refine.refine(ds, q, li, k,
                                         metric=index.metric)
             gids = _ids.global_ids(rank, shard_n, lids,
                                    n_total=n_dev * shard_n)
         else:
             vals, gids = _pq._search_impl(local, q, k, n_probes,
                                           params.query_tile,
+                                          filter_bits=fb,
                                           lut_dtype=params.lut_dtype)
         return _merge.merge_topk(vals, gids, axis, m, k, n_dev,
                                  select_min, tier=tier, impl=impl)
@@ -587,6 +645,9 @@ def search_ivf_pq(params: _pq.SearchParams, index: ShardedIvfPq,
     if refined:
         in_specs.append(P(axis, None))
         operands.append(xd)
+    if filtered:
+        in_specs.append(P())   # global bitset, replicated
+        operands.append(filter_bitset)
     out_spec = _merge.merge_out_spec(tier, axis)
     fn = shard_map(
         local_search, mesh=mesh,
@@ -647,10 +708,14 @@ def build_ivf_flat(params: _flat.IndexParams, dataset: jax.Array, mesh: Mesh,
 
 def search_ivf_flat(params: _flat.SearchParams, index: ShardedIvfFlat,
                     queries: jax.Array, k: int, mesh: Mesh,
-                    axis: str = "shard",
-                    merge: str = "auto") -> Tuple[jax.Array, jax.Array]:
+                    axis: str = "shard", merge: str = "auto",
+                    filter_bitset=None) -> Tuple[jax.Array, jax.Array]:
     """Sharded IVF-Flat search: per-shard scan + cross-shard merge
-    through the shared tier (``merge`` = auto | allgather | ring)."""
+    through the shared tier (``merge`` = auto | allgather | ring).
+
+    ``filter_bitset`` (packed words over GLOBAL row ids, replicated)
+    masks each shard's scan through the same per-shard global-id
+    composition as the PQ tier."""
     mt = resolve_metric(index.metric)
     select_min = SELECT_MIN[mt]
     n_probes = min(params.n_probes, index.n_lists)
@@ -667,22 +732,28 @@ def search_ivf_flat(params: _flat.SearchParams, index: ShardedIvfFlat,
         n_dev, m, k, explicit=merge,
         whole_mesh=n_dev == mesh.devices.size)
 
-    def local_search(data, ids, norms, sizes, q, centers):
+    def local_search(data, ids, norms, sizes, q, centers, *fb):
         local = _flat.IvfFlatIndex(
             centers=centers, packed_data=data[0], packed_ids=ids[0],
             packed_norms=norms[0], list_sizes=sizes[0], metric=index.metric)
         vals, gids = _flat._search_impl(local, q, k, n_probes,
-                                        params.query_tile)
+                                        params.query_tile,
+                                        filter_bits=fb[0] if fb else None)
         return _merge.merge_topk(vals, gids, axis, m, k, n_dev,
                                  select_min, tier=tier, impl=impl)
 
+    in_specs = [P(axis, None, None, None), P(axis, None, None),
+                P(axis, None, None), P(axis, None), P(), P()]
+    operands = [index.packed_data, index.packed_ids, index.packed_norms,
+                index.list_sizes, q, index.centers]
+    if filter_bitset is not None:
+        in_specs.append(P())   # global bitset, replicated
+        operands.append(filter_bitset)
     out_spec = _merge.merge_out_spec(tier, axis)
     fn = shard_map(
         local_search, mesh=mesh,
-        in_specs=(P(axis, None, None, None), P(axis, None, None),
-                  P(axis, None, None), P(axis, None), P(), P()),
+        in_specs=tuple(in_specs),
         out_specs=(out_spec, out_spec),
         check_vma=False)
-    rv, ri = fn(index.packed_data, index.packed_ids, index.packed_norms,
-                index.list_sizes, q, index.centers)
+    rv, ri = fn(*operands)
     return rv[:m], ri[:m]

@@ -151,11 +151,25 @@ class TestGistConf:
         # ISSUE 11: the fp8-QLUT recall-delta legs — the lut_dtype
         # triple at FIXED search params, per dataset
         pq = next(i for i in cfg["index"] if i["algo"] == "ivf_pq")
-        triple = [sp["lut_dtype"] for sp in pq["search_params"]]
+        dtype_legs = [sp for sp in pq["search_params"]
+                      if "lut_dtype" in sp]
+        triple = [sp["lut_dtype"] for sp in dtype_legs]
         assert triple == ["float32", "bfloat16", "float8_e4m3"]
         fixed = [{k: v for k, v in sp.items() if k != "lut_dtype"}
-                 for sp in pq["search_params"]]
+                 for sp in dtype_legs]
         assert all(f == fixed[0] for f in fixed)
+        # ISSUE 12: the filtered-search legs — the selectivity sweep on
+        # the fused tier plus the 10% forced-fallback twin (leg_env
+        # pins the pre-ISSUE-12 tier for the cliff comparison)
+        filt = [sp for sp in pq["search_params"]
+                if "filter_selectivity" in sp]
+        fused = sorted(sp["filter_selectivity"] for sp in filt
+                       if "leg_env" not in sp)
+        assert fused == [0.01, 0.1, 0.5], filt
+        forced = [sp for sp in filt if "leg_env" in sp]
+        assert len(forced) == 1 and forced[0]["filter_selectivity"] == 0.1
+        assert forced[0]["leg_env"] == {
+            "RAFT_TPU_PALLAS_LUTSCAN": "never"}, forced
 
     def test_cpu_shaped_smoke(self):
         """Run the conf's index entries through the real runner on a

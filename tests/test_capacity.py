@@ -111,6 +111,35 @@ class TestBillionScaleProofs:
     def test_ivf_flat_search(self):
         assert not cp.prove_ivf_flat(N)["violations"]
 
+    def test_filtered_search(self):
+        """ISSUE 12: the filtered path (word-index divide in bitset.
+        word_at + the fused tiers' list_filter_bytes operand prep) at
+        n = 2.2e9 — int32 word math cannot sneak back in (GL11)."""
+        assert not cp.prove_filtered_search(N)["violations"]
+
+    def test_word_at_keeps_id_width(self):
+        """The word-index divide in bitset.word_at runs in the INCOMING
+        id dtype: `ids.astype(int32) // 32` would wrap negative past
+        2³¹ and silently read a live word for an id that should have
+        been masked — a wrong-RESULT bug the ≥ 2³¹-axis gather check
+        cannot see (the word axis itself is < 2³¹), so the divide's
+        dtype in the traced jaxpr is the proof. (jax may narrow the
+        final in-bounds gather index AFTER the i64 divide — benign.)"""
+        from raft_tpu.core import bitset as _bitset
+
+        n_words = -(-N // 32)
+        with _san.scoped_x64(True):
+            closed = jax.make_jaxpr(_bitset.word_at.__wrapped__)(
+                jax.ShapeDtypeStruct((n_words,), jnp.uint32),
+                jax.ShapeDtypeStruct((4,), jnp.int64))
+        divs = [e for e in closed.jaxpr.eqns
+                if "floor_divide" in str(e.params.get("name", ""))
+                or e.primitive.name == "div"]
+        assert divs, closed.jaxpr
+        for e in divs:
+            assert str(e.invars[0].aval.dtype) == "int64", closed.jaxpr
+            assert str(e.outvars[0].aval.dtype) == "int64", closed.jaxpr
+
     def test_cagra_search(self):
         assert not cp.prove_cagra(N)["violations"]
 

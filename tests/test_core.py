@@ -67,6 +67,78 @@ class TestBitset:
         flipped = bitset.flip(bits)
         assert int(bitset.count(flipped, 70)) == 67
 
+    def test_word_at_gather(self, rng):
+        """word_at: the bitset word covering each id, for arbitrary id
+        arrays — the shared primitive behind test()/passes() and the
+        fused kernels' operand prep."""
+        mask = rng.random(200) < 0.5
+        bits = bitset.from_mask(jnp.asarray(mask))
+        ids = jnp.asarray([0, 31, 32, 63, 64, 199])
+        words = np.asarray(bitset.word_at(bits, ids))
+        np.testing.assert_array_equal(
+            words, np.asarray(bits)[np.asarray(ids) // 32])
+
+    def test_word_at_and_test_sentinel_preserving(self):
+        """Negative ids (the -1 pad sentinel, either id width) never
+        wrap to a live word: word_at reads word 0, test() returns
+        False (core/ids policy)."""
+        bits = bitset.create(64, default_value=True)
+        # int32 here; the int64 width (ids past 2³¹) is proven by the
+        # filtered capacity proof (tools/capacity_prove.py, GL11)
+        ids = jnp.asarray([-1, 5, -7], dtype=jnp.int32)
+        words = np.asarray(bitset.word_at(bits, ids))
+        np.testing.assert_array_equal(words, np.asarray(bits)[[0, 0, 0]])
+        out = np.asarray(bitset.test(bits, ids))
+        np.testing.assert_array_equal(out, [False, True, False])
+
+    def test_density(self, rng):
+        mask = rng.random(320) < 0.25
+        bits = bitset.from_mask(jnp.asarray(mask))
+        got = float(bitset.density(bits))
+        assert abs(got - mask.mean()) < 1e-6
+        assert float(bitset.density(bitset.create(320, True))) == 1.0
+        assert float(bitset.density(bitset.create(320, False))) == 0.0
+
+
+class TestSampleFilterPacking:
+    """pack_mask_bytes / list_filter_bytes — the fused kernels'
+    host-side filter-operand prep (ISSUE 12)."""
+
+    def test_pack_mask_bytes_layout(self):
+        from raft_tpu.neighbors import sample_filter
+
+        keep = jnp.asarray(np.array([1, 0, 0, 0, 0, 0, 0, 0,   # byte 0 = 1
+                                     1, 1, 0, 0, 0, 0, 0, 1],  # byte 1
+                                    bool))
+        b = np.asarray(sample_filter.pack_mask_bytes(keep))
+        np.testing.assert_array_equal(b, [1, 0b10000011])
+
+    def test_pack_mask_bytes_pads_with_zero(self):
+        from raft_tpu.neighbors import sample_filter
+
+        keep = jnp.ones(11, bool)  # 3 pad bits must pack as 0
+        b = np.asarray(sample_filter.pack_mask_bytes(keep))
+        np.testing.assert_array_equal(b, [0xFF, 0b00000111])
+
+    def test_list_filter_bytes_matches_passes(self, rng):
+        """bit j of byte b in list l == passes(filter, ids[l, 8b+j]);
+        pad slots (id -1) pack as 0."""
+        from raft_tpu.neighbors import sample_filter
+
+        n = 500
+        mask = rng.random(n) < 0.5
+        bits = bitset.from_mask(jnp.asarray(mask))
+        ids = np.full((4, 64), -1, np.int32)
+        ids[0] = rng.permutation(n)[:64]
+        ids[1, :10] = rng.permutation(n)[:10]
+        ids[3] = rng.integers(0, n, 64)
+        fbytes = np.asarray(sample_filter.list_filter_bytes(
+            bits, jnp.asarray(ids)))
+        assert fbytes.shape == (4, 8) and fbytes.dtype == np.uint8
+        unpacked = np.unpackbits(fbytes, axis=1, bitorder="little")
+        want = (ids >= 0) & mask[np.clip(ids, 0, n - 1)]
+        np.testing.assert_array_equal(unpacked.astype(bool), want)
+
 
 class TestSerialize:
     def test_scalar_roundtrip(self, tmp_path):

@@ -859,6 +859,81 @@ def test_gl15_fires_on_unguarded_kernel_dispatch():
                 if f.rule == "GL15"]
 
 
+# ISSUE 12: a FILTERED fused dispatch (filter_bytes operand) is still a
+# streaming-kernel dispatch — without any admission guard it fires; the
+# new filtered_scan_mem_ok guard satisfies the contract (_mem_ok suffix
+# registration, same convention as every tier).
+GL15_FILTERED_BAD = """
+from raft_tpu.ops import pallas_kernels as _pk
+
+
+def filtered_scan(seg_list, qv, codes, ids, norms, ctr, cb, fbytes):
+    return _pk.ivfpq_lut_scan_topk(
+        seg_list, qv, codes, ids, norms, ctr, cb, "l2",
+        pq_bits=8, pq_dim=16, L=1024, filter_bytes=fbytes)
+"""
+
+GL15_FILTERED_GOOD = """
+from raft_tpu.neighbors import ivf_common as ic
+from raft_tpu.ops import pallas_kernels as _pk
+
+
+def filtered_scan(seg_list, qv, codes, ids, norms, ctr, cb, fbytes,
+                  n_lists, L):
+    if not ic.filtered_scan_mem_ok(n_lists, L):
+        return None
+    return _pk.ivfpq_lut_scan_topk(
+        seg_list, qv, codes, ids, norms, ctr, cb, "l2",
+        pq_bits=8, pq_dim=16, L=L, filter_bytes=fbytes)
+"""
+
+
+def test_gl15_filtered_dispatch_snippets():
+    findings = [f for f in lint(GL15_FILTERED_BAD) if f.rule == "GL15"]
+    assert len(findings) == 1, findings
+    assert not [f for f in lint(GL15_FILTERED_GOOD) if f.rule == "GL15"]
+
+
+# ISSUE 12: the masked-sentinel epilogue — the filter mask joins the
+# validity mask BEFORE the where that pours the -1 sentinel, and any
+# downstream id arithmetic keeps the >= 0 guard. Folding the filter by
+# OFFSETTING sentinel-bearing ids is the bug GL13 exists for.
+GL13_FILTER_EPILOGUE_BAD = """
+import jax.numpy as jnp
+
+
+def fold_filter_by_offset(keep, raw, base):
+    ids = jnp.where(keep, raw, -1)
+    gids = ids + base
+    return gids
+"""
+
+GL13_FILTER_EPILOGUE_GOOD = """
+import jax.numpy as jnp
+
+
+def masked_sentinel_epilogue(keep, raw, key):
+    valid = (raw >= 0) & keep
+    ids = jnp.where(valid, raw, -1)
+    key = jnp.where(valid, key, jnp.inf)
+    return key, ids
+
+
+def guarded_offset(keep, raw, base):
+    ids = jnp.where(keep, raw, -1)
+    return jnp.where(ids >= 0, ids + base, -1)
+"""
+
+
+def test_gl13_filter_epilogue_snippets():
+    findings = [f for f in lint(GL13_FILTER_EPILOGUE_BAD)
+                if f.rule == "GL13"]
+    assert len(findings) == 1, findings
+    assert "without a >= 0 guard" in findings[0].message
+    assert not [f for f in lint(GL13_FILTER_EPILOGUE_GOOD)
+                if f.rule == "GL13"]
+
+
 # ---------------------------------------------------------------------------
 # suppressions
 # ---------------------------------------------------------------------------

@@ -521,11 +521,15 @@ class TestPallasLutScanTier:
             overlaps[lut] = same
             assert same >= bar, (lut, same)
 
-    def test_filter_bitset_falls_back(self, monkeypatch):
-        """Filtered searches never ride the LUT tier — its bin
-        pre-selection is filter-blind, so a selective filter would lose
-        kept neighbors outside each probe's unfiltered top bins. The
-        request is served correctly by the approx fallback."""
+    @pytest.mark.slow  # oversampled build + 2 searches; CI lanes + the
+    # CI LUT smoke assert the same dispatch property
+    def test_filter_bitset_rides_the_tier(self, monkeypatch):
+        """ISSUE 12: a filter_bitset no longer disqualifies the LUT tier
+        — the kernel streams the packed per-candidate keep bits beside
+        the codes and masks filtered candidates to the sentinel BEFORE
+        bin selection. The dispatch counter carries filtered=1, the
+        retired filter_bitset fallback reason stays at zero, and no
+        filtered id is ever returned."""
         from raft_tpu import obs
         from raft_tpu.core import bitset
         from raft_tpu.obs.metrics import MetricsRegistry
@@ -544,11 +548,98 @@ class TestPallasLutScanTier:
         finally:
             obs.disable()
         counters = reg.snapshot()["counters"]
-        assert counters.get("ivf_pq.scan.dispatch{impl=pallas_lut}",
-                            0) == 0, counters
+        assert counters.get(
+            "ivf_pq.scan.dispatch{filtered=1,impl=pallas_lut}",
+            0) >= 1, counters
+        assert counters.get(
+            "ivf_pq.scan.fallback{reason=filter_bitset}", 0) == 0, counters
         ids = np.asarray(ids)
         got = ids[ids >= 0]
         assert got.size and not np.any(got % 3 == 0)
+
+    @pytest.mark.parametrize("bits", [
+        4, pytest.param(5, marks=pytest.mark.slow),
+        pytest.param(6, marks=pytest.mark.slow), 8])
+    def test_filtered_matches_per_query_nbit(self, bits, monkeypatch):
+        """Filtered fused == unfused parity across pq_bits: same kept-
+        neighbor sets, same sorted distances."""
+        monkeypatch.setenv("RAFT_TPU_PALLAS_LUTSCAN", "always")
+        from raft_tpu.core import bitset
+        x, q = self._corpus()
+        idx = self._build(x, pq_bits=bits)
+        mask = np.random.default_rng(bits).random(len(x)) < 0.3
+        fbits = bitset.from_mask(jnp.asarray(mask))
+        dp, ip_ = ivf_pq.search(idx, jnp.asarray(q), 20,
+                                SearchParams(n_probes=8,
+                                             scan_select="pallas"),
+                                filter_bitset=fbits)
+        de, ie = ivf_pq.search(idx, jnp.asarray(q), 20,
+                               SearchParams(n_probes=8,
+                                            scan_mode="per_query"),
+                               filter_bitset=fbits)
+        ip_, ie = np.asarray(ip_), np.asarray(ie)
+        assert mask[ip_[ip_ >= 0]].all()
+        for a, b in zip(ip_, ie):
+            assert set(a[a >= 0]) == set(b[b >= 0])
+        np.testing.assert_allclose(np.sort(np.asarray(dp), 1),
+                                   np.sort(np.asarray(de), 1),
+                                   rtol=1e-3, atol=1e-3)
+
+    @pytest.mark.parametrize("metric", [
+        pytest.param("euclidean", marks=pytest.mark.slow),
+        "inner_product", "cosine"])
+    def test_filtered_matches_per_query_metrics(self, metric, monkeypatch):
+        monkeypatch.setenv("RAFT_TPU_PALLAS_LUTSCAN", "always")
+        from raft_tpu.core import bitset
+        x, q = self._corpus()
+        idx = self._build(x, metric=metric)
+        mask = np.random.default_rng(9).random(len(x)) < 0.3
+        fbits = bitset.from_mask(jnp.asarray(mask))
+        dp, ip_ = ivf_pq.search(idx, jnp.asarray(q), 10,
+                                SearchParams(n_probes=8,
+                                             scan_select="pallas"),
+                                filter_bitset=fbits)
+        de, ie = ivf_pq.search(idx, jnp.asarray(q), 10,
+                               SearchParams(n_probes=8,
+                                            scan_mode="per_query"),
+                               filter_bitset=fbits)
+        ip_ = np.asarray(ip_)
+        assert mask[ip_[ip_ >= 0]].all()
+        np.testing.assert_allclose(np.sort(np.asarray(dp), 1),
+                                   np.sort(np.asarray(de), 1),
+                                   rtol=1e-3, atol=1e-3)
+
+    @pytest.mark.parametrize("sel", [0.01, 0.1, 0.5])
+    def test_filtered_matches_unfused_selectivity(self, sel, monkeypatch):
+        """Filtered fused == unfused parity across the selectivity sweep
+        (1%/10%/50%): the LUT tier's streamed mask and the per_query
+        tier's in-scan filter must agree on the kept-neighbor set."""
+        monkeypatch.setenv("RAFT_TPU_PALLAS_LUTSCAN", "always")
+        from raft_tpu.core import bitset
+        x, q = self._corpus()
+        idx = self._build(x)
+        rng = np.random.default_rng(5)
+        mask = rng.random(len(x)) < sel
+        mask[0] = True  # never empty
+        bits = bitset.from_mask(jnp.asarray(mask))
+        k = 10
+        dp, ip_ = ivf_pq.search(idx, jnp.asarray(q), k,
+                                SearchParams(n_probes=8,
+                                             scan_select="pallas"),
+                                filter_bitset=bits)
+        de, ie = ivf_pq.search(idx, jnp.asarray(q), k,
+                               SearchParams(n_probes=8,
+                                            scan_mode="per_query"),
+                               filter_bitset=bits)
+        ip_, ie = np.asarray(ip_), np.asarray(ie)
+        assert mask[ip_[ip_ >= 0]].all() and mask[ie[ie >= 0]].all()
+        # identical kept-neighbor sets per query (tie order may differ
+        # between scan algorithms; the SET is the contract)
+        for a, b in zip(ip_, ie):
+            assert set(a[a >= 0]) == set(b[b >= 0])
+        np.testing.assert_allclose(np.sort(np.asarray(dp), 1),
+                                   np.sort(np.asarray(de), 1),
+                                   rtol=1e-3, atol=1e-3)
 
     def test_falls_back_gracefully_off_tpu(self, monkeypatch):
         """Without the env force, scan_select="pallas" off-TPU downgrades
@@ -568,6 +659,13 @@ class TestPallasLutScanTier:
         finally:
             rlog.set_callback(None)
         assert any("scan_select='pallas' requested" in m for m in msgs)
+        # satellite (ISSUE 12): the warning names the CONCRETE reason +
+        # the env override, and never the retired filter_bitset reason
+        warned = [m for m in msgs if "scan_select='pallas'" in m]
+        assert any("reason=kernel_ineligible" in m for m in warned), warned
+        assert any("RAFT_TPU_PALLAS_LUTSCAN" in m for m in warned), warned
+        assert not any("filter_bitset" in m for m in warned), warned
+        assert "filter_bitset" not in ivf_pq._LUT_FALLBACK_DETAIL
         de, _ = ivf_pq.search(idx, jnp.asarray(q), 10,
                               SearchParams(n_probes=8,
                                            scan_mode="per_query"))
@@ -866,7 +964,11 @@ class TestScanFallbackCounter:
             obs.disable()
         return reg.snapshot()["counters"]
 
-    def test_filter_bitset_reason(self, monkeypatch):
+    def test_filter_bitset_reason_retired(self, monkeypatch):
+        """ISSUE 12: the filter_bitset fallback reason is RETIRED — a
+        filtered search on an eligible shape dispatches the LUT tier
+        (filtered=1) and the old reason stays at zero (the CI obs-smoke
+        step asserts the same invariant over the filtered legs)."""
         from raft_tpu.core import bitset
 
         monkeypatch.setenv("RAFT_TPU_PALLAS_LUTSCAN", "always")
@@ -877,7 +979,9 @@ class TestScanFallbackCounter:
             SearchParams(n_probes=8, scan_mode="grouped",
                          scan_select="pallas"),
             filter_bitset=bits))
-        assert c.get("ivf_pq.scan.fallback{reason=filter_bitset}", 0) >= 1, c
+        assert c.get("ivf_pq.scan.fallback{reason=filter_bitset}", 0) == 0, c
+        assert c.get(
+            "ivf_pq.scan.dispatch{filtered=1,impl=pallas_lut}", 0) >= 1, c
 
     def test_bin_capacity_reason(self, monkeypatch):
         monkeypatch.setenv("RAFT_TPU_PALLAS_LUTSCAN", "always")

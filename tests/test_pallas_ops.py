@@ -319,12 +319,144 @@ class TestIvfPqLutScan:
         assert pallas_lut_scan_wanted(64, 256, 2, 64, 64, 1024, 128)
         # folded deep-100m shape: nb=64 inside 128-byte rows (G=2)
         assert pallas_lut_scan_wanted(64, 256, 2, 64, 128, 18312, 128)
+        # a filter adds its byte stream + unpack operands to the VMEM
+        # model without disqualifying the workhorse shapes
+        assert pallas_lut_scan_wanted(64, 256, 2, 64, 64, 1024, 128,
+                                      filtered=True)
+        assert pallas_lut_scan_wanted(64, 256, 2, 64, 128, 18312, 128,
+                                      filtered=True)
         # byte width not dividing the stored row width → unsupported
         assert not pallas_lut_scan_wanted(96, 256, 1, 96, 128, 1024, 96)
         # fold group too deep (G=16)
         assert not pallas_lut_scan_wanted(8, 256, 2, 8, 128, 1024, 16)
         monkeypatch.setenv("RAFT_TPU_PALLAS_LUTSCAN", "never")
         assert not pallas_lut_scan_wanted(64, 256, 2, 64, 64, 1024, 128)
+
+    def _filter_bytes(self, ids, keep_global):
+        """Packed per-list filter bytes over a [n_lists, L] GLOBAL id
+        table — the host-side operand prep the dispatchers run
+        (sample_filter.list_filter_bytes), built here via the same
+        public helpers the tier uses."""
+        from raft_tpu.core import bitset
+        from raft_tpu.neighbors import sample_filter
+
+        bits = bitset.from_mask(jnp.asarray(keep_global))
+        return np.asarray(sample_filter.list_filter_bytes(
+            bits, jnp.asarray(ids)))
+
+    def _filtered_want(self, codes, cb, ids, norms, ctr, qv, li, s,
+                       keep_global, L):
+        ref = self._ref_keys(codes, cb, ids, norms, ctr, qv, li, s, "l2")
+        return ref, {int(ids[li, l]): ref[:, l] for l in range(L)
+                     if ids[li, l] >= 0 and keep_global[ids[li, l]]}
+
+    @pytest.mark.parametrize("sel", [0.01, 0.1, 0.5])
+    def test_filtered_parity_selectivity(self, sel):
+        """Streamed filter mask: at every selectivity the emitted
+        candidate set is exactly the KEPT subset of the unfiltered
+        lossless set (L ≤ bins), keys exact, filtered ids absent."""
+        from raft_tpu.ops.pallas_kernels import ivfpq_lut_scan_topk
+
+        rng = np.random.default_rng(int(sel * 1000) + 29)
+        n_lists, L, S, P, n_seg, seg = 4, 256, 16, 2, 5, 8
+        codes, packed, cb, ids, norms, ctr, qv, seg_list = self._mk(
+            rng, n_lists, L, S, 8, P, n_seg, seg,
+            sizes=[L, L - 37, 3, 0])
+        keep = rng.random(n_lists * L) < sel
+        fbytes = self._filter_bytes(ids, keep)
+        keys, kids = ivfpq_lut_scan_topk(
+            jnp.asarray(seg_list), jnp.asarray(qv), jnp.asarray(packed),
+            jnp.asarray(ids), jnp.asarray(norms), jnp.asarray(ctr),
+            jnp.asarray(cb), "l2", pq_bits=8, pq_dim=S, L=L,
+            lut_dtype="float32", filter_bytes=jnp.asarray(fbytes),
+            interpret=True)
+        keys, kids = np.asarray(keys), np.asarray(kids)
+        for s in range(n_seg):
+            li = seg_list[s]
+            ref, want_by_id = self._filtered_want(
+                codes, cb, ids, norms, ctr, qv, li, s, keep, L)
+            for q in (0, seg - 1):
+                got = {int(i): k for i, k in zip(kids[s, q], keys[s, q])
+                       if i >= 0}
+                assert set(got) == set(want_by_id), (s, q, sel)
+                for i, kv in got.items():
+                    np.testing.assert_allclose(kv, want_by_id[i][q],
+                                               rtol=1e-4, atol=1e-4)
+
+    def test_filtered_edge_masks(self):
+        """all-pass == unfiltered bit-for-bit; all-fail emits only
+        sentinels; a single survivor is found wherever it hides."""
+        from raft_tpu.ops.pallas_kernels import ivfpq_lut_scan_topk
+
+        rng = np.random.default_rng(31)
+        n_lists, L, S, P, n_seg, seg = 3, 256, 16, 2, 4, 8
+        codes, packed, cb, ids, norms, ctr, qv, seg_list = self._mk(
+            rng, n_lists, L, S, 4, P, n_seg, seg, sizes=[L, 100, 17])
+
+        def run(fbytes):
+            k_, i_ = ivfpq_lut_scan_topk(
+                jnp.asarray(seg_list), jnp.asarray(qv),
+                jnp.asarray(packed), jnp.asarray(ids),
+                jnp.asarray(norms), jnp.asarray(ctr), jnp.asarray(cb),
+                "l2", pq_bits=4, pq_dim=S, L=L, lut_dtype="float32",
+                filter_bytes=(None if fbytes is None
+                              else jnp.asarray(fbytes)),
+                interpret=True)
+            return np.asarray(k_), np.asarray(i_)
+
+        base_k, base_i = run(None)
+        # all-pass: identical to no filter
+        allpass = np.ones(n_lists * L, bool)
+        k1, i1 = run(self._filter_bytes(ids, allpass))
+        np.testing.assert_array_equal(i1, base_i)
+        np.testing.assert_allclose(k1, base_k, rtol=0, atol=0)
+        # all-fail: nothing but sentinels
+        k0, i0 = run(self._filter_bytes(ids, np.zeros(n_lists * L, bool)))
+        assert (i0 == -1).all()
+        assert not np.isfinite(k0).any()
+        # single survivor: exactly that id, everywhere its list is probed
+        surv = np.zeros(n_lists * L, bool)
+        li0 = int(seg_list[1])
+        lane = int(np.where(ids[li0] >= 0)[0].max())  # last valid slot
+        gid = int(ids[li0, lane])
+        assert gid >= 0
+        surv[gid] = True
+        ks, is_ = run(self._filter_bytes(ids, surv))
+        for s in range(n_seg):
+            got = set(int(i) for i in is_[s].ravel() if i >= 0)
+            assert got == ({gid} if int(seg_list[s]) == li0 else set()), s
+
+    def test_filtered_ragged_tail_and_folded(self):
+        """Filter bytes pad to whole code tiles with 0 (= filtered): a
+        ragged list tail plus lane-folded storage must not admit any
+        OOB candidate, and kept candidates survive exactly."""
+        from raft_tpu.ops.pallas_kernels import ivfpq_lut_scan_topk
+
+        rng = np.random.default_rng(37)
+        n_lists, L, S, P, n_seg, seg = 3, 240, 16, 2, 4, 8
+        codes, packed, cb, ids, norms, ctr, qv, seg_list = self._mk(
+            rng, n_lists, L, S, 8, P, n_seg, seg,
+            sizes=[L, 100, 17], fold=True)
+        keep = rng.random(n_lists * L) < 0.4
+        fbytes = self._filter_bytes(ids, keep)
+        keys, kids = ivfpq_lut_scan_topk(
+            jnp.asarray(seg_list), jnp.asarray(qv), jnp.asarray(packed),
+            jnp.asarray(ids), jnp.asarray(norms), jnp.asarray(ctr),
+            jnp.asarray(cb), "l2", pq_bits=8, pq_dim=S, L=L,
+            lut_dtype="float32", filter_bytes=jnp.asarray(fbytes),
+            interpret=True)
+        keys, kids = np.asarray(keys), np.asarray(kids)
+        for s in range(n_seg):
+            li = seg_list[s]
+            ref, want_by_id = self._filtered_want(
+                codes, cb, ids, norms, ctr, qv, li, s, keep, L)
+            for q in (0, seg - 1):
+                got = {int(i): k for i, k in zip(kids[s, q], keys[s, q])
+                       if i >= 0}
+                assert set(got) == set(want_by_id), (s, q)
+                for i, kv in got.items():
+                    np.testing.assert_allclose(kv, want_by_id[i][q],
+                                               rtol=1e-4, atol=1e-4)
 
 
 class TestGatherRefine:
@@ -425,8 +557,90 @@ class TestGatherRefine:
         assert not pallas_gather_refine_wanted(10_000, 2000, 96, 10)
         monkeypatch.setenv("RAFT_TPU_PALLAS_REFINE", "always")
         assert pallas_gather_refine_wanted(10_000, 2000, 96, 10)
+        # a filter adds the per-candidate word scratch without
+        # disqualifying the acceptance shape
+        assert pallas_gather_refine_wanted(10_000, 2000, 96, 10,
+                                           filtered=True)
         # k past the merge budget / tiny candidate sets stay on XLA
         assert not pallas_gather_refine_wanted(10_000, 2000, 96, 65)
         assert not pallas_gather_refine_wanted(10_000, 100, 96, 10)
         monkeypatch.setenv("RAFT_TPU_PALLAS_REFINE", "never")
         assert not pallas_gather_refine_wanted(10_000, 2000, 96, 10)
+
+    def _check_filtered(self, data, q, cand, k, metric, keep, **kw):
+        from raft_tpu.core import bitset
+        from raft_tpu.ops import gather_refine_topk
+
+        bits = bitset.from_mask(jnp.asarray(keep))
+        keys, ids = gather_refine_topk(jnp.asarray(data), jnp.asarray(q),
+                                       jnp.asarray(cand), k, metric,
+                                       filter_bits=bits, interpret=True)
+        keys, ids = np.asarray(keys), np.asarray(ids)
+        ref = self._ref(np.asarray(data, np.float32), q, cand, metric)
+        # the filter joins the invalid-id mask: cleared bits → +inf/-1
+        kept = (cand >= 0) & keep[np.clip(cand, 0, len(keep) - 1)]
+        ref = np.where(kept, ref, np.inf)
+        order = np.argsort(ref, axis=1, kind="stable")[:, :k]
+        want_v = np.take_along_axis(ref, order, 1)
+        np.testing.assert_allclose(keys, want_v, **kw)
+        want_i = np.where(np.isinf(want_v), -1,
+                          np.take_along_axis(cand, order, 1))
+        strict = np.ones_like(keys, dtype=bool)
+        strict[:, 1:] &= want_v[:, 1:] != want_v[:, :-1]
+        strict[:, :-1] &= want_v[:, :-1] != want_v[:, 1:]
+        np.testing.assert_array_equal(ids[strict], want_i[strict])
+        got = ids[ids >= 0]
+        assert keep[got].all() if got.size else True
+
+    def test_filtered_metrics_match_numpy(self, rng):
+        """Per-candidate bitset-word fetch through the row-DMA queue:
+        cleared bits poison rows to +inf/-1 across every metric."""
+        data = rng.standard_normal((700, 96)).astype(np.float32)
+        q = rng.standard_normal((21, 96)).astype(np.float32)
+        cand = rng.integers(0, 700, (21, 300)).astype(np.int32)
+        keep = rng.random(700) < 0.5
+        for metric in ("l2", "ip", "cos"):
+            self._check_filtered(data, q, cand, 10, metric, keep,
+                                 rtol=1e-4, atol=1e-4)
+
+    def test_filtered_edge_masks(self, rng):
+        """all-pass == unfiltered; all-fail → all sentinels; a single
+        surviving candidate is returned alone; ragged/invalid tails
+        compose with the filter."""
+        from raft_tpu.core import bitset
+        from raft_tpu.ops import gather_refine_topk
+
+        data = rng.standard_normal((500, 40)).astype(np.float32)
+        q = rng.standard_normal((9, 40)).astype(np.float32)
+        cand = rng.integers(0, 500, (9, 270)).astype(np.int32)
+        cand[1, -31:] = -1         # ragged tail composes with the filter
+
+        base_k, base_i = gather_refine_topk(
+            jnp.asarray(data), jnp.asarray(q), jnp.asarray(cand), 8,
+            "l2", interpret=True)
+        allpass = bitset.from_mask(jnp.ones(500, bool))
+        k1, i1 = gather_refine_topk(
+            jnp.asarray(data), jnp.asarray(q), jnp.asarray(cand), 8,
+            "l2", filter_bits=allpass, interpret=True)
+        np.testing.assert_array_equal(np.asarray(i1), np.asarray(base_i))
+        np.testing.assert_array_equal(np.asarray(k1), np.asarray(base_k))
+
+        allfail = bitset.from_mask(jnp.zeros(500, bool))
+        k0, i0 = gather_refine_topk(
+            jnp.asarray(data), jnp.asarray(q), jnp.asarray(cand), 8,
+            "l2", filter_bits=allfail, interpret=True)
+        assert (np.asarray(i0) == -1).all()
+        assert np.isinf(np.asarray(k0)).all()
+
+        surv = np.zeros(500, bool)
+        gid = int(cand[4, 100])
+        surv[gid] = True
+        ks, is_ = gather_refine_topk(
+            jnp.asarray(data), jnp.asarray(q), jnp.asarray(cand), 8,
+            "l2", filter_bits=bitset.from_mask(jnp.asarray(surv)),
+            interpret=True)
+        is_ = np.asarray(is_)
+        for m in range(9):
+            got = set(is_[m][is_[m] >= 0].tolist())
+            want = {gid} if gid in set(cand[m].tolist()) else set()
+            assert got == want, m

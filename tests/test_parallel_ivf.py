@@ -192,6 +192,52 @@ class TestRingMergeTier:
             ivf_pq.search(single, jnp.asarray(queries), 5,
                           ivf_pq.SearchParams(n_probes=4), mesh=mesh)
 
+    @pytest.mark.slow  # two sharded traces; CI lanes run it
+    def test_sharded_filtered_ring_matches_allgather(self, mesh, data):
+        """ISSUE 12: a filter_bitset rides the sharded tier — each
+        shard composes the replicated global bitset with its own
+        global-id tables; ring and allgather merges agree exactly and
+        no filtered id is ever returned."""
+        from raft_tpu.core import bitset
+
+        dataset, queries = data
+        rng = np.random.default_rng(13)
+        keep = rng.random(len(dataset)) < 0.3
+        bits = bitset.from_mask(jnp.asarray(keep))
+        params = ivf_pq.IndexParams(n_lists=32, pq_dim=16, pq_bits=8,
+                                    kmeans_n_iters=4, seed=3)
+        sharded = build_ivf_pq(params, jnp.asarray(dataset), mesh)
+        sp = ivf_pq.SearchParams(n_probes=16)
+        va, ia = search_ivf_pq(sp, sharded, jnp.asarray(queries), 10,
+                               mesh, merge="allgather",
+                               filter_bitset=bits)
+        vr, ir = search_ivf_pq(sp, sharded, jnp.asarray(queries), 10,
+                               mesh, merge="ring", filter_bitset=bits)
+        ia, ir = np.asarray(ia), np.asarray(ir)
+        assert keep[ia[ia >= 0]].all() and keep[ir[ir >= 0]].all()
+        np.testing.assert_array_equal(ia, ir)
+        np.testing.assert_allclose(np.asarray(va), np.asarray(vr))
+
+    @pytest.mark.slow  # own sharded flat build; CI lanes run it
+    def test_sharded_ivf_flat_filtered(self, mesh, data):
+        """The flat sharded tier masks each shard's scan through the
+        same global-id composition; the neighbors entry routes the
+        filter through the pod dispatch."""
+        from raft_tpu.core import bitset
+
+        dataset, queries = data
+        rng = np.random.default_rng(17)
+        keep = rng.random(len(dataset)) < 0.5
+        bits = bitset.from_mask(jnp.asarray(keep))
+        params = ivf_flat.IndexParams(n_lists=16, kmeans_n_iters=4)
+        sharded = build_ivf_flat(params, jnp.asarray(dataset), mesh)
+        sp = ivf_flat.SearchParams(n_probes=8)
+        _, ia = ivf_flat.search(sharded, jnp.asarray(queries), 10, sp,
+                                mesh=mesh, filter_bitset=bits)
+        ia = np.asarray(ia)
+        assert (ia >= 0).any()
+        assert keep[ia[ia >= 0]].all()
+
 
 class TestShardedFusedPipeline:
     """The end-to-end sharded oversampled pipeline: per-shard scan +

@@ -31,12 +31,22 @@ def test_ivf_scan_crossover_smoke():
 
 def test_pq_scan_bench_rows(monkeypatch):
     """The scan-kernel microbench must emit a one-hot row and, with the
-    interpret-mode force on, a pallas_lut row (ISSUE 2 acceptance)."""
+    interpret-mode force on, a pallas_lut row (ISSUE 2 acceptance) —
+    plus the ISSUE 12 filtered pair: the fused filtered scan vs the
+    forced-fallback tier on the same shape at 10% selectivity."""
+    import os
+
     monkeypatch.setenv("RAFT_TPU_PALLAS_LUTSCAN", "always")
     rows = prims.bench_pq_scan(grid=[(2000, 32, 16, 8, 40, 64)], iters=1)
     impls = {r.impl for r in rows}
-    assert impls == {"one_hot", "pallas_lut"}, impls
-    assert all(r.ms > 0 and np.isfinite(r.throughput) for r in rows)
+    assert impls == {"one_hot", "pallas_lut", "filtered_pallas_lut",
+                     "filtered_fallback"}, impls
+    measured = [r for r in rows if not r.impl.endswith("skipped")]
+    assert all(r.ms > 0 and np.isfinite(r.throughput) for r in measured)
+    filt = [r for r in rows if r.impl.startswith("filtered_")]
+    assert all(r.params["filter_selectivity"] == 0.1 for r in filt)
+    # the forced-fallback row's env pin must be restored, not leaked
+    assert os.environ.get("RAFT_TPU_PALLAS_LUTSCAN") == "always"
 
 
 def test_refine_bench_rows(monkeypatch):

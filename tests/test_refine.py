@@ -83,6 +83,55 @@ class TestFusedParity:
                                            np.asarray(i_x))])
         assert overlap >= 0.9, overlap
 
+    @pytest.mark.parametrize("metric", METRICS)
+    def test_parity_filtered(self, corpus, monkeypatch, metric):
+        """ISSUE 12: filter_bits excludes cleared-bit candidates on BOTH
+        tiers identically — the fused kernel's in-DMA word test and the
+        XLA tier's sentinel pre-mask agree bit-for-bit."""
+        from raft_tpu.core import bitset
+
+        x, q, cand = corpus
+        rng = np.random.default_rng(23)
+        keep = rng.random(len(x)) < 0.4
+        bits = bitset.from_mask(jnp.asarray(keep))
+        monkeypatch.setenv("RAFT_TPU_PALLAS_REFINE", "never")
+        d_x, i_x = refine.refine(jnp.asarray(x), jnp.asarray(q),
+                                 jnp.asarray(cand), 10, metric,
+                                 filter_bits=bits)
+        monkeypatch.setenv("RAFT_TPU_PALLAS_REFINE", "always")
+        d_p, i_p = refine.refine(jnp.asarray(x), jnp.asarray(q),
+                                 jnp.asarray(cand), 10, metric,
+                                 filter_bits=bits)
+        d_x, i_x = np.asarray(d_x), np.asarray(i_x)
+        d_p, i_p = np.asarray(d_p), np.asarray(i_p)
+        np.testing.assert_allclose(d_p, d_x, rtol=2e-4, atol=2e-4)
+        np.testing.assert_array_equal(i_p, i_x)
+        assert keep[i_p[i_p >= 0]].all()
+        assert keep[i_x[i_x >= 0]].all()
+
+    def test_filtered_dispatch_counters(self, corpus, monkeypatch):
+        """A filtered dispatch carries filtered=1 on both tiers."""
+        from raft_tpu.core import bitset
+
+        x, q, cand = corpus
+        bits = bitset.create(len(x), default_value=True)
+        reg = obs.MetricsRegistry()
+        obs.enable(registry=reg, hbm=False)
+        try:
+            monkeypatch.setenv("RAFT_TPU_PALLAS_REFINE", "always")
+            refine.refine(jnp.asarray(x), jnp.asarray(q),
+                          jnp.asarray(cand), 10, filter_bits=bits)
+            monkeypatch.setenv("RAFT_TPU_PALLAS_REFINE", "never")
+            refine.refine(jnp.asarray(x), jnp.asarray(q),
+                          jnp.asarray(cand), 10, filter_bits=bits)
+        finally:
+            obs.disable()
+        c = reg.snapshot()["counters"]
+        assert c.get("refine.dispatch{filtered=1,impl=pallas_gather}",
+                     0) >= 1, c
+        assert c.get("refine.dispatch{filtered=1,impl=xla_gather}",
+                     0) >= 1, c
+
     def test_fused_declines_oversized_k(self, corpus, monkeypatch):
         """k past the in-kernel merge budget must fall back to XLA, not
         error: the dispatch gate (not the kernel) owns the bound."""

@@ -230,6 +230,7 @@ class TestRingFallbackParity:
         np.testing.assert_array_equal(np.asarray(ia), np.asarray(ir))
         np.testing.assert_allclose(np.asarray(va), np.asarray(vr))
 
+    @pytest.mark.slow  # one more full sharded trace; CI lanes run it
     def test_sharded_knn_ring_inner_product(self, mesh, rng):
         # max-select end to end (negated keys through the ring)
         x = jnp.asarray(rng.random((256, 16), dtype=np.float32))
@@ -241,6 +242,7 @@ class TestRingFallbackParity:
         np.testing.assert_array_equal(np.asarray(ia), np.asarray(ir))
         np.testing.assert_allclose(np.asarray(va), np.asarray(vr))
 
+    @pytest.mark.slow  # two full impl traces; CI lanes run it
     def test_kernel_impl_matches_fallback(self, mesh, rng):
         # the merge_topk dispatch's two ring impls agree hop for hop
         m, k = 40, 8
@@ -413,6 +415,7 @@ class TestRingBytes:
         ring = c["comms.bytes{axis=shard,op=ring_topk}"]
         assert 2 * ring <= ag, (ring, ag)
 
+    @pytest.mark.slow  # two full impl traces; CI lanes run it
     def test_kernel_impl_counts_like_fallback(self, mesh, reg, rng):
         # count_ring_topk (kernel path) == per-hop ring_topk_hop counts
         m, k = 40, 8
@@ -491,7 +494,8 @@ class TestRingFusedScan:
     rung preserved."""
 
     def _search(self, idx, q, k, mesh, merge="ring", n_probes=4,
-                lut_dtype="float32", scan_select="pallas"):
+                lut_dtype="float32", scan_select="pallas",
+                filter_bitset=None):
         from raft_tpu.neighbors import ivf_pq as _pq
         from raft_tpu.parallel import search_ivf_pq
 
@@ -501,7 +505,8 @@ class TestRingFusedScan:
         # declines with reason=scan_select)
         sp = _pq.SearchParams(n_probes=n_probes, lut_dtype=lut_dtype,
                               scan_select=scan_select)
-        return search_ivf_pq(sp, idx, q, k, mesh, merge=merge)
+        return search_ivf_pq(sp, idx, q, k, mesh, merge=merge,
+                             filter_bitset=filter_bitset)
 
     def test_fused_matches_unfused(self, mesh, rng, pq_sharded,
                                    monkeypatch):
@@ -514,6 +519,62 @@ class TestRingFusedScan:
         np.testing.assert_array_equal(np.asarray(ia), np.asarray(iff))
         np.testing.assert_allclose(np.asarray(va), np.asarray(vf),
                                    rtol=1e-4, atol=1e-4)
+
+    @pytest.mark.slow  # two sharded searches + fused trace; CI runs it
+    def test_fused_filtered_matches_unfused(self, mesh, rng, pq_sharded,
+                                            monkeypatch):
+        """ISSUE 12: filtered pod-scale search rides the ring kernel —
+        the per-shard bitset slice streams beside the codes, results
+        identical to the unfused filtered allgather path, no filtered
+        id ever crossing the ring, and the fused dispatch counted with
+        filtered=1 while the retired filter_bitset reason stays zero."""
+        from raft_tpu import obs
+        from raft_tpu.core import bitset
+        from raft_tpu.obs.metrics import MetricsRegistry
+
+        idx, x = pq_sharded
+        n = x.shape[0]
+        keep = np.asarray(rng.random(n) < 0.4)
+        bits = bitset.from_mask(jnp.asarray(keep))
+        q = jnp.asarray(rng.random((64, 32), dtype=np.float32))
+        monkeypatch.setenv("RAFT_TPU_RING_FUSED", "off")
+        va, ia = self._search(idx, q, 8, mesh, merge="allgather",
+                              filter_bitset=bits)
+        monkeypatch.setenv("RAFT_TPU_RING_FUSED", "on")
+        reg = MetricsRegistry()
+        obs.enable(registry=reg, hbm=False)
+        try:
+            vf, iff = self._search(idx, q, 8, mesh, merge="ring",
+                                   filter_bitset=bits)
+            jax.block_until_ready((vf, iff))
+        finally:
+            obs.disable()
+        ia, iff = np.asarray(ia), np.asarray(iff)
+        assert keep[ia[ia >= 0]].all() and keep[iff[iff >= 0]].all()
+        np.testing.assert_array_equal(ia, iff)
+        np.testing.assert_allclose(np.asarray(va), np.asarray(vf),
+                                   rtol=1e-4, atol=1e-4)
+        c = reg.snapshot()["counters"]
+        assert c.get(
+            "ivf_pq.scan.dispatch{filtered=1,impl=ring_lut_fused}",
+            0) == 1.0, c
+        assert c.get("ivf_pq.scan.fallback{reason=filter_bitset}",
+                     0) == 0, c
+
+    def test_fused_filtered_admission(self, pq_sharded, monkeypatch):
+        """_ring_fused_wanted(filtered=True) admits the workhorse shape
+        (the filter slots fit the VMEM model and the byte rows pass
+        filtered_scan_mem_ok) — filtered searches stay on the tier."""
+        from raft_tpu.distance.types import DistanceType
+        from raft_tpu.parallel.ivf import _ring_fused_wanted
+
+        idx, _ = pq_sharded
+        monkeypatch.setenv("RAFT_TPU_RING_FUSED", "on")
+        args = dict(m=64, k=8, n_probes=4, n_dev=N_DEV, whole_mesh=True,
+                    merge="ring", mt=DistanceType.L2Expanded,
+                    lut_dtype="float32", scan_select="pallas")
+        take, reason = _ring_fused_wanted(idx, filtered=True, **args)
+        assert (take, reason) == (True, "")
 
     @pytest.mark.slow  # own sharded build + fused kernel trace
     def test_fused_inner_product(self, mesh, rng, monkeypatch):

@@ -430,6 +430,45 @@ class TestSearchResilient:
         moves = flight._robust_state()["degrade_recent"]
         assert any(s["to"] == "fp8_lut" for s in moves), moves
 
+    def test_filtered_fused_search_walks_the_ladder(self, pq_index,
+                                                    monkeypatch):
+        """ISSUE 12 chaos leg: the degrade ladder still works when the
+        degrading search is a FILTERED FUSED one — an injected OOM on a
+        scan_select="pallas" + filter_bitset search walks halve_batch,
+        recovers, returns exactly the fault-free filtered results, and
+        never leaks a filtered id."""
+        from raft_tpu.core import bitset
+        from raft_tpu.neighbors import ivf_pq
+
+        monkeypatch.setenv("RAFT_TPU_PALLAS_LUTSCAN", "always")
+        idx, x = pq_index
+        rng = np.random.default_rng(3)
+        keep = rng.random(x.shape[0]) < 0.5
+        bits = bitset.from_mask(jnp.asarray(keep))
+        sp = ivf_pq.SearchParams(n_probes=8, scan_select="pallas")
+        d0, i0 = ivf_pq.search(idx, x[:64], 10, sp, filter_bitset=bits)
+        reg = MetricsRegistry()
+        obs.enable(registry=reg, hbm=False)
+        faults.install_plan({"faults": [
+            {"site": "ivf_pq.search", "kind": "oom", "times": 1}]})
+        d1, i1 = ivf_pq.search_resilient(idx, x[:64], 10, sp,
+                                         filter_bitset=bits)
+        np.testing.assert_array_equal(np.asarray(i1), np.asarray(i0))
+        np.testing.assert_allclose(np.asarray(d1), np.asarray(d0),
+                                   rtol=1e-6, atol=1e-6)
+        i1 = np.asarray(i1)
+        assert keep[i1[i1 >= 0]].all()
+        c = _counters(reg)
+        assert c["degrade.steps{from=native,reason=resource_exhausted,"
+                 "site=ivf_pq.search,to=halve_batch}"] == 1.0
+        assert c["degrade.recovered{site=ivf_pq.search}"] == 1.0
+        # the filtered halves re-dispatched the fused tier, and the
+        # retired fallback reason stayed silent
+        assert any(k.startswith("ivf_pq.scan.dispatch{filtered=1,"
+                                "impl=pallas_lut}") for k in c), c
+        assert c.get("ivf_pq.scan.fallback{reason=filter_bitset}",
+                     0) == 0, c
+
     def test_no_fault_means_no_counters(self, pq_index):
         from raft_tpu.neighbors import ivf_pq
 

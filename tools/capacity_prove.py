@@ -17,6 +17,9 @@ even when the narrowing site itself never indexes.
 Proof set (the acceptance list from ISSUE 10):
 
 - ``ivf_pq`` / ``ivf_flat`` / ``brute_force`` / ``cagra`` search
+- the FILTERED ``ivf_pq`` search incl. the fused tiers' packed-byte
+  operand prep (ISSUE 12 — the bitset word-index divide must run in
+  the incoming id width)
 - the sharded cross-shard merge tier (ring + allgather, global-id
   remap included) on the 8-device CPU mesh
 - ``build_chunked``'s assignment/encode pass at the LAST chunk's row
@@ -118,6 +121,38 @@ def prove_ivf_pq(n: int = DEFAULT_N) -> dict:
     return _san.assert_billion_safe(
         fn, index, _sds((_M, _DIM), jnp.float32), _sds((n, 1), jnp.int8),
         what="ivf_pq.search")
+
+
+def prove_filtered_search(n: int = DEFAULT_N) -> dict:
+    """ISSUE 12: the FILTERED search path at n = 2.2e9 — the packed
+    bitset has ceil(n/32) uint32 words, and every word-index divide
+    (``bitset.word_at``'s ``ids // WORD_BITS``, reached through
+    ``sample_filter.passes`` on the scan path and
+    ``sample_filter.list_filter_bytes`` in the fused tiers' host-side
+    operand prep) must run in the INCOMING int64 id width — an int32
+    narrowing anywhere upstream becomes an int32 gather into the
+    ≥ 2³¹-word axis right here (GL11's runtime half)."""
+    import jax.numpy as jnp
+    from raft_tpu.neighbors import ivf_pq as _pq
+    from raft_tpu.neighbors import sample_filter as _sf
+    from raft_tpu.obs import sanitize as _san
+
+    index = _abstract_ivf_pq(n)
+    params = _pq.SearchParams(n_probes=2, scan_mode="per_query")
+    n_words = -(-n // 32)
+
+    def fn(index, q, bits, marker):
+        vals, ids = _pq.search(index, q, _K, params, filter_bitset=bits)
+        # the fused tiers' operand prep over the full id table: one
+        # passes() gather + byte re-pack per list (the [n_lists,
+        # ceil(L/8)] stream the kernels DMA per code tile)
+        fbytes = _sf.list_filter_bytes(bits, index.packed_ids)
+        return vals, ids, fbytes, _address_rows(marker, ids)
+
+    return _san.assert_billion_safe(
+        fn, index, _sds((_M, _DIM), jnp.float32),
+        _sds((n_words,), jnp.uint32), _sds((n, 1), jnp.int8),
+        what="ivf_pq.search[filtered]")
 
 
 def prove_ivf_flat(n: int = DEFAULT_N) -> dict:
@@ -251,6 +286,7 @@ def prove_build_chunked_pass(n: int = DEFAULT_N,
 PROOFS = {
     "brute_force.knn": prove_brute_force,
     "ivf_pq.search": prove_ivf_pq,
+    "ivf_pq.search_filtered": prove_filtered_search,
     "ivf_flat.search": prove_ivf_flat,
     "cagra.search": prove_cagra,
     "merge.ring": lambda n=DEFAULT_N: prove_sharded_merge(n, "ring"),

@@ -111,15 +111,20 @@ latency/bandwidth trade and docs/observability.md for the byte model.
 `search()` picks the scan engine from `SearchParams` + index layout
 (the obs counter `ivf_pq.scan.dispatch{impl=...}` records the pick):
 
-| tier (`impl`) | selected when | scan structure | HBM transients |
-|---|---|---|---|
-| `per_query` | small batches (`B·n_probes < 2·n_lists`) or grouped memory guards decline | per-query candidate gather + query-only-LUT one-hot contraction (or recon-dot) | unpacked codes + `[B, n_probes·L]` tables |
-| `grouped_xla` | batch scans, `scan_select="exact"/"approx"` | segmented list-centric scan, per-chunk one-hot decode (or recon cache) | decoded chunks + `[n_seg, seg, k]` accumulators |
-| `grouped_pallas` | `scan_select="exact"` + recon cache + VMEM fit (TPU) | fused contraction + running top-k per segment chunk | `[n_seg, seg, k]` accumulators |
-| `segk` | `scan_select="approx"` + recon cache + VMEM fit (TPU) | scalar-prefetch DMA kernel over bf16 recon rows | `[n_seg, seg, 256]` bin tables |
-| `pallas_lut` | `scan_select="pallas"`, or `"approx"` auto-upgraded for oversampled shapes (`n_probes ≥ 64` or `k ≥ 400`) with NO recon cache; needs `n_probes·256 ≥ k`, no filter bitset (TPU) | fused LUT-scan over PACKED codes: in-kernel n-bit unpack, on-chip ADC Σ_s QLUT[s, code_s], 2-deep bin top-k | `[n_seg, seg, 256]` bin tables only |
-| `ring_lut_fused` | sharded (`mesh=`) non-refined search where the ring merge would run (see `parallel.merge`'s table) | the scan folded INTO the ring exchange — one persistent kernel per shard from packed codes to the merged top-k | none: chunk candidates live in VMEM only |
-| `staged` | obs stage mode (`RAFT_TPU_OBS_STAGES=1`) | per-stage programs under recording spans | as per_query |
+| tier (`impl`) | selected when | scan structure | `filter_bitset` handling | HBM transients |
+|---|---|---|---|---|
+| `per_query` | small batches (`B·n_probes < 2·n_lists`) or grouped memory guards decline | per-query candidate gather + query-only-LUT one-hot contraction (or recon-dot) | in-scan mask (`sample_filter.passes` over candidate ids) | unpacked codes + `[B, n_probes·L]` tables |
+| `grouped_xla` | batch scans, `scan_select="exact"/"approx"` | segmented list-centric scan, per-chunk one-hot decode (or recon cache) | in-scan mask before selection | decoded chunks + `[n_seg, seg, k]` accumulators |
+| `grouped_pallas` | `scan_select="exact"` + recon cache + VMEM fit (TPU) | fused contraction + running top-k per segment chunk | in-scan mask before selection | `[n_seg, seg, k]` accumulators |
+| `segk` | `scan_select="approx"` + recon cache + VMEM fit (TPU); filtered shapes also pass `filtered_scan_mem_ok(slot_bytes=5)` | scalar-prefetch DMA kernel over bf16 recon rows | sentinel-masked id table: filtered slots become the `-1` invalid id BEFORE the kernel's bin pre-selection | `[n_seg, seg, 256]` bin tables (+ the masked `[n_lists, L]` id table when filtered) |
+| `pallas_lut` | `scan_select="pallas"`, or `"approx"` auto-upgraded for oversampled shapes (`n_probes ≥ 64` or `k ≥ 400`) with NO recon cache; needs `n_probes·256 ≥ k`; filtered shapes also pass `filtered_scan_mem_ok` (TPU) | fused LUT-scan over PACKED codes: in-kernel n-bit unpack, on-chip ADC Σ_s QLUT[s, code_s], 2-deep bin top-k | packed keep bits (`sample_filter.list_filter_bytes`, 1 bit/candidate) streamed beside the codes, unpacked in-kernel, masked to the ±inf/-1 sentinel BEFORE bin selection | `[n_seg, seg, 256]` bin tables (+ `[n_lists, ceil(L/8)]` filter bytes when filtered) |
+| `ring_lut_fused` | sharded (`mesh=`) non-refined search where the ring merge would run (see `parallel.merge`'s table) | the scan folded INTO the ring exchange — one persistent kernel per shard from packed codes to the merged top-k | per-shard byte slice (the replicated global bitset composed with the shard's global-id table) streamed per code tile, same sentinel epilogue | none: chunk candidates live in VMEM only (+ the per-shard filter bytes when filtered) |
+| `staged` | obs stage mode (`RAFT_TPU_OBS_STAGES=1`) | per-stage programs under recording spans | as per_query | as per_query |
+
+Since ISSUE 12, a `filter_bitset` is a streamed per-candidate mask in
+every tier, never a dispatch disqualifier: filtered dispatches count
+`ivf_pq.scan.dispatch{filtered=1,impl=…}` and the old
+`fallback{reason=filter_bitset}` is retired (CI asserts it stays 0).
 
 `lut_dtype` ("auto" | "float32" | "bfloat16" | "float8_e4m3") is the
 reference's fp8-LUT accuracy/footprint trade (`ivf_pq_fp_8bit.cuh`):
@@ -151,17 +156,21 @@ see that module's decision table.
 `ivf_flat.search`) picks the re-rank engine from dataset residency +
 shape (the obs counter `refine.dispatch{impl=...}` records the pick):
 
-| tier (`impl`) | selected when | gather structure | HBM transients |
-|---|---|---|---|
-| `pallas_gather` | device-resident f32/bf16 dataset, `k ≤ 64`, `k_cand ≥ 256`; auto on TPU for oversampled shapes (`k_cand ≥ 400` or a `[m, C, d]` buffer past 1 GB), forced with `RAFT_TPU_PALLAS_REFINE=always` (interpret mode off-TPU) | fused kernel (`ops.pallas_kernels.gather_refine_topk`): candidate ids HBM→SMEM, dataset rows streamed HBM→VMEM row-by-row, exact epilogue + running top-k on-chip | `[m, 128]` result tables only (plus a PER-CALL `[n, ceil(d/128)·128]` pad copy when `d % 128 ≠ 0` — `ivf_common.gather_refine_mem_ok` declines the tier when that copy exceeds the cap or the gather buffer it replaces) |
-| `xla_gather` | device dataset, any other shape | `dataset[cand]` gather + one batched einsum + `select_k` | the `[m, C, d]` f32 gather buffer (7.7 GB at batch 10000 × k_cand 2000 × d 96) |
-| `host_gather` (`refine_gathered`) | host/memmapped base (optionally SQ8 via `dequant=`) | host fancy-index of candidate rows, re-rank on device | `[m, C, d]` host rows + device copy |
-| `provider_regen` (`refine_provider`) | device-chunk provider (synthetic regen, deep-100m) | regenerate blocks on device, scatter candidate rows into one buffer | `[m·C, d]` device buffer (callers chunk queries) |
+| tier (`impl`) | selected when | gather structure | `filter_bits` handling | HBM transients |
+|---|---|---|---|---|
+| `pallas_gather` | device-resident f32/bf16 dataset, `k ≤ 64`, `k_cand ≥ 256`; auto on TPU for oversampled shapes (`k_cand ≥ 400` or a `[m, C, d]` buffer past 1 GB), forced with `RAFT_TPU_PALLAS_REFINE=always` (interpret mode off-TPU) | fused kernel (`ops.pallas_kernels.gather_refine_topk`): candidate ids HBM→SMEM, dataset rows streamed HBM→VMEM row-by-row, exact epilogue + running top-k on-chip | each candidate's bitset WORD rides the row-DMA queue (addressed off the same SMEM id); cleared bits poison rows to ±inf/-1 in the metric epilogue | `[m, 128]` result tables only (plus a PER-CALL `[n, ceil(d/128)·128]` pad copy when `d % 128 ≠ 0` — `ivf_common.gather_refine_mem_ok` declines the tier when that copy exceeds the cap or the gather buffer it replaces) |
+| `xla_gather` | device dataset, any other shape | `dataset[cand]` gather + one batched einsum + `select_k` | candidate table sentinel-masked BEFORE the gather (`sample_filter.passes` → `-1`) | the `[m, C, d]` f32 gather buffer (7.7 GB at batch 10000 × k_cand 2000 × d 96) |
+| `host_gather` (`refine_gathered`) | host/memmapped base (optionally SQ8 via `dequant=`) | host fancy-index of candidate rows, re-rank on device | none — oversampled callers hand these tiers pre-filtered candidates | `[m, C, d]` host rows + device copy |
+| `provider_regen` (`refine_provider`) | device-chunk provider (synthetic regen, deep-100m) | regenerate blocks on device, scatter candidate rows into one buffer | none — same contract as host_gather | `[m·C, d]` device buffer (callers chunk queries) |
 
 All tiers share the metric semantics of the einsum path (l2 / sqrt-l2
 / ip / cosine, invalid ids → ±inf, k ≤ n_candidates validated up
 front), so results cannot drift across tiers beyond dtype-tiered
-rounding.
+rounding. `filter_bits` (ISSUE 12) is defense in depth on the
+oversampled search paths — the scan tiers already exclude filtered
+candidates — and the enforcement site for direct callers re-ranking an
+unfiltered candidate list; filtered dispatches count
+`refine.dispatch{filtered=1,impl=…}`.
 """,
 }
 
