@@ -49,7 +49,7 @@ RAFT_TPU_SANITIZE=1 python -m pytest \
     tests/test_sanitize.py tests/test_graftlint.py tests/test_core.py \
     tests/test_capacity.py \
     tests/test_parallel.py tests/test_parallel_ivf.py \
-    tests/test_ring_topk.py \
+    tests/test_ring_topk.py tests/test_build_distributed.py \
     -q -p no:cacheprovider
 
 echo "== driver contract: entry() compiles, dryrun_multichip(8) executes =="
@@ -82,8 +82,25 @@ for leg in ("strong", "weak"):
     by = {r["merge"]: r["merge_bytes"] for r in rows
           if r["leg"] == leg and r["n_dev"] == 8}
     assert 2 * by["ring"] <= by["allgather"], (leg, by)
-print("dryrun_multichip(8) OK; comms section:", len(comms) - 1,
-      "series;", len(rows), "scaling rows")
+# ISSUE 13: the distributed-build legs — weak+strong build-throughput
+# rows at n_dev ∈ {2,4,8}, every build's comms ALLGATHERV-ONLY (codes/
+# ids never cross shards), overlapped encode wall < serialized
+# copy+encode on every leg (the dryrun itself also asserts this plus
+# distributed == build_chunked sha-identity — a regression fails the
+# run, not just this re-check)
+brows = comms.get("build")
+assert brows, "dryrun returned no MULTICHIP_BUILD rows"
+assert {r["n_dev"] for r in brows} == {2, 4, 8}, brows
+assert all(r["allgatherv_only"] for r in brows), brows
+assert all(r["measured_at"] and r["git_commit"] for r in brows), brows
+assert all(r["vectors_per_s_per_chip"] > 0 for r in brows), brows
+for leg in ("strong", "weak"):
+    for nd in (2, 4, 8):
+        by = {r["impl"]: r["wall_s"] for r in brows
+              if r["leg"] == leg and r["n_dev"] == nd}
+        assert by["prefetch"] < by["serial"], (leg, nd, by)
+print("dryrun_multichip(8) OK; comms section:", len(comms) - 2,
+      "series;", len(rows), "scaling rows;", len(brows), "build rows")
 EOF
 
 echo "== ring top-k exchange kernel smoke (interpret mode, 8-dev mesh) =="
@@ -263,6 +280,79 @@ shutil.rmtree(work)
 print(f"chaos SIGTERM OK: died at chunk {man['chunks_done']}, resumed "
       "sha-identical, resume.* counters recorded")
 EOF
+python - <<'EOF'
+# 2b (ISSUE 13). injected SIGTERM mid-DISTRIBUTED-build, then per-shard
+#    resume=True: the resumed sharded index must be sha-identical to an
+#    uninterrupted distributed build, resume.* counters must record the
+#    per-shard replay — and an IO error injected on a chunk read during
+#    the resumed build must be retried under IO_POLICY
+#    (retry.recovered{site=build.chunk_read} counted).
+import json, os, shutil, subprocess, sys, tempfile
+import numpy as np
+
+work = tempfile.mkdtemp(prefix="raft_chaos_dbuild_")
+data = os.path.join(work, "data.npy")
+np.save(data, np.random.default_rng(17).random((2400, 24),
+                                               dtype=np.float32))
+ck = os.path.join(work, "ckpt")
+child = """
+import os, numpy as np
+os.environ["JAX_PLATFORMS"] = "cpu"
+from raft_tpu.robust import faults
+from raft_tpu.neighbors import ivf_pq
+from raft_tpu.parallel import make_mesh
+faults.install_plan({"faults": [{"site": "build.chunk_encode",
+                                 "kind": "sigterm", "after": 5}]})
+x = np.load(%r, mmap_mode="r")
+ivf_pq.build_distributed(
+    x, ivf_pq.IndexParams(n_lists=16, pq_dim=16, seed=0,
+                          cache_reconstruction="never"),
+    mesh=make_mesh(), chunk_rows=200, checkpoint_dir=%r)
+raise SystemExit("UNREACHABLE: the injected SIGTERM did not fire")
+""" % (data, ck)
+p = subprocess.run([sys.executable, "-c", child], capture_output=True,
+                   text=True)
+assert p.returncode != 0, "child survived the injected SIGTERM"
+man = json.load(open(os.path.join(ck, "manifest.json")))
+assert man["phase"] == "encode" and man["n_shards"] == 8, man
+done = man["shard_chunks_done"]
+assert 0 < sum(done) < man["n_shards"] * 2, man
+assert man.get("fingerprint_s") is not None, man
+
+from raft_tpu import obs
+from raft_tpu.obs.metrics import MetricsRegistry
+from raft_tpu.neighbors import ivf_pq
+from raft_tpu.parallel import index_sha16, make_mesh
+from raft_tpu.robust import faults
+
+x = np.load(data, mmap_mode="r")
+params = ivf_pq.IndexParams(n_lists=16, pq_dim=16, seed=0,
+                            cache_reconstruction="never")
+mesh = make_mesh()
+reg = MetricsRegistry()
+obs.enable(registry=reg, hbm=False)
+faults.install_plan({"faults": [{"site": "build.chunk_read",
+                                 "kind": "error", "times": 1}]})
+try:
+    resumed = ivf_pq.build_distributed(x, params, mesh=mesh,
+                                       chunk_rows=200,
+                                       checkpoint_dir=ck, resume=True)
+finally:
+    faults.clear_plan()
+    obs.disable()
+clean = ivf_pq.build_distributed(x, params, mesh=mesh, chunk_rows=200)
+assert index_sha16(resumed) == index_sha16(clean), \
+    "resumed distributed build differs from an uninterrupted one"
+c = reg.snapshot()["counters"]
+site = "{site=ivf_pq.build_distributed}"
+assert c.get(f"resume.attempts{site}", 0) >= 1, c
+assert c.get(f"resume.chunks_replayed{site}", 0) == sum(done), c
+assert c.get("retry.recovered{site=build.chunk_read}", 0) >= 1, c
+shutil.rmtree(work)
+print(f"chaos distributed-build OK: died with shard chunks {done}, "
+      "per-shard resume sha-identical, injected chunk-read IO error "
+      "retried and recovered")
+EOF
 # 3. injected probe failure: bench.py's robust.retry-backed backend
 #    probe must absorb one injected failure and still produce rows.
 RAFT_TPU_FAULT_PLAN_JSON='{"faults": [{"site": "probe.backend", "kind": "error", "times": 1}]}' \
@@ -406,6 +496,15 @@ python -m tools.benchdiff cpu_smoke /tmp/raft_tpu_obs_bench.json \
 python -m tools.obsdump /tmp/raft_tpu_benchdiff_verdict.json \
   | grep -q "Verdict" || { echo "obsdump failed on the verdict"; exit 1; }
 echo "benchdiff scoreboard artifact: /tmp/raft_tpu_benchdiff_scoreboard.md"
+
+echo "== distributed-build throughput baseline (ISSUE 13): committed"
+echo "   vectors/s/chip rows pass a benchdiff self-compare =="
+# the committed build_cpu_smoke record (tools/record_build_baseline.py:
+# the MULTICHIP_BUILD legs as a bench-shaped record with environment
+# provenance) against itself — proves the record joins, carries the
+# env stamp, and an unchanged record passes the gate (exit 0 blocks)
+python -m tools.benchdiff build_cpu_smoke build_cpu_smoke \
+    --md /tmp/raft_tpu_build_baseline_scoreboard.md | tail -3
 
 echo "== trace export round-trip (instrumented search -> Perfetto JSON) =="
 python - <<'EOF'
@@ -586,6 +685,7 @@ cp /tmp/graftlint_report.json \
    /tmp/capacity_prove_report.json \
    /tmp/raft_tpu_obs_bench.json \
    /tmp/raft_tpu_benchdiff_scoreboard.md \
+   /tmp/raft_tpu_build_baseline_scoreboard.md \
    /tmp/raft_tpu_benchdiff_verdict.json "$ARTIFACTS"/
 ls -l "$ARTIFACTS"
 echo "CI artifacts under $ARTIFACTS"

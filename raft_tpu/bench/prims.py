@@ -392,6 +392,107 @@ def bench_refine(grid=None, iters: int = 3) -> List[PrimResult]:
     return rows
 
 
+# ---------------------------------------------------------------------------
+# build encode throughput: serial build_chunked vs the prefetch-
+# overlapped distributed encode (ISSUE 13)
+# ---------------------------------------------------------------------------
+
+def bench_build_encode(grid=None, iters: int = 1) -> List[PrimResult]:
+    """Serial ``build_chunked`` vs the distributed prefetch-overlapped
+    encode — the measurement behind build-throughput (vectors/s/chip),
+    ROADMAP item 2's first-class build metric. Three rows per config:
+
+    - ``build_chunked``: the single-host serial walk (read → H2D →
+      encode strictly in sequence), vectors/s;
+    - ``distributed_serial``: the sharded walk with ``prefetch=False``
+      (serialized copy-then-encode per shard) — the overlap baseline;
+    - ``distributed_prefetch``: the same walk with the double-buffered
+      host→HBM prefetcher — chunk N+1's read+transfer hidden under
+      chunk N's encode. vectors/s/chip = n / wall / n_dev (the CPU-mesh
+      emulation walks shards sequentially, so total wall ≈ n_dev × the
+      per-shard wall a real pod would pay).
+
+    The distributed rows need a ≥ 2-device mesh; a 1-device host
+    records the skip instead of silently dropping the row. Each row
+    carries the PR-9 roofline columns of the jitted per-chunk encode
+    program (the pass's hot program), attributed from the measured
+    per-chunk encode time."""
+    import jax
+
+    from raft_tpu.neighbors import ivf_pq
+    from raft_tpu.obs import prof as _prof
+
+    n_dev = len(jax.devices())
+    if grid is None:
+        # (n, d, n_lists, chunk_rows)
+        grid = [(60_000, 32, 16, 4096)]
+    rows: List[PrimResult] = []
+    rng = np.random.default_rng(0)
+    for n, d, n_lists, chunk_rows in grid:
+        x = rng.random((n, d), dtype=np.float32)
+        params = ivf_pq.IndexParams(n_lists=n_lists,
+                                    pq_dim=max(8, d // 2 // 8 * 8),
+                                    kmeans_n_iters=4, seed=0,
+                                    cache_reconstruction="never")
+        p = {"n": n, "d": d, "n_lists": n_lists,
+             "chunk_rows": chunk_rows, "n_dev": n_dev}
+
+        # roofline attribution of the per-chunk encode program (the
+        # walk's hot program; cost columns describe what the rows time)
+        idx0 = ivf_pq.build(jnp.asarray(x[:4096]), params)
+        xb = jnp.asarray(x[:chunk_rows])
+        lb = jnp.zeros((chunk_rows,), jnp.int32)
+        t_enc0 = time.perf_counter()
+        jax.block_until_ready(ivf_pq._encode_with_norms(
+            xb @ idx0.rotation.T, idx0.centers_rot, lb, idx0.codebooks,
+            "per_subspace"))
+        enc_s = time.perf_counter() - t_enc0
+        cost = _prof.analyze_jit(
+            lambda xb_, lb_: ivf_pq._encode_with_norms(
+                xb_ @ idx0.rotation.T, idx0.centers_rot, lb_,
+                idx0.codebooks, "per_subspace"),
+            xb, lb, elapsed_s=enc_s)
+        if cost is not None:
+            p.update(flops=cost.flops, bytes_accessed=cost.bytes_accessed,
+                     arith_intensity=cost.arithmetic_intensity,
+                     bound=cost.bound)
+
+        # untimed warm pass over a prefix: the first build at a shape
+        # pays the jit compiles — without a warm-up they land in
+        # whichever row runs first and the serial-vs-prefetch (and
+        # chunked-vs-distributed) comparison measures compile cost
+        warm_n = min(n, 4 * chunk_rows)
+        ivf_pq.build_chunked(x[:warm_n], params, chunk_rows=chunk_rows)
+        t0 = time.perf_counter()
+        ivf_pq.build_chunked(x, params, chunk_rows=chunk_rows)
+        wall = time.perf_counter() - t0
+        rows.append(PrimResult("build_encode", "build_chunked",
+                               wall * 1e3, n / wall, "vectors/s", p))
+        if n_dev < 2:
+            rows.append(PrimResult(
+                "build_encode", "distributed_skipped", 0.0, 0.0,
+                "vectors/s/chip",
+                {**p, "skipped": f"{n_dev} device(s): no mesh axis to "
+                                 "shard the build over"}))
+            continue
+        from raft_tpu.parallel import make_mesh
+
+        mesh = make_mesh()
+        ivf_pq.build_distributed(x, params, mesh=mesh,
+                                 chunk_rows=chunk_rows, prefetch=False)
+        for impl, prefetch in (("distributed_serial", False),
+                               ("distributed_prefetch", True)):
+            t0 = time.perf_counter()
+            ivf_pq.build_distributed(x, params, mesh=mesh,
+                                     chunk_rows=chunk_rows,
+                                     prefetch=prefetch)
+            wall = time.perf_counter() - t0
+            rows.append(PrimResult(
+                "build_encode", impl, wall * 1e3, n / wall / n_dev,
+                "vectors/s/chip", p))
+    return rows
+
+
 def measure_merge_tier(mesh, x, q, k: int, tier: str, iters: int = 3,
                        schedule: Optional[str] = None,
                        with_cost: bool = False):
@@ -525,6 +626,7 @@ BENCHES: Dict[str, Callable[[], List[PrimResult]]] = {
     "pq_scan": bench_pq_scan,
     "refine": bench_refine,
     "ring_merge": bench_ring_merge,
+    "build_encode": bench_build_encode,
 }
 
 

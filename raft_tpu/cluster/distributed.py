@@ -4,6 +4,16 @@ The reference's MNMG kmeans pattern (SURVEY.md §3.5: each worker runs the
 local fused-L2 assign + local centroid sums, then ``allreduce`` merges the
 sums — cuML on raft-dask/NCCL). Here the whole loop is one SPMD program:
 ``shard_map`` over the sample axis, ``lax.psum`` over ICI for the merge.
+
+**Role in the distributed index build** (``parallel.build``): the
+chunked pod builders train their coarse quantizer in one of two modes —
+``coarse="replicated"`` (default) runs the single-host balanced-kmeans
+trainer over the allgatherv'd cross-shard trainset, which keeps the
+built index bit-identical to the single-host ``build_chunked``;
+``coarse="distributed"`` routes HERE (:func:`fit`'s psum Lloyd over the
+*sharded* trainset) when even the trainset is too big to replicate —
+centers then differ from the single-host build (a different, equally
+valid optimum), trading the sha-parity guarantee for trainset scale.
 """
 
 from __future__ import annotations
@@ -31,12 +41,22 @@ def fit(
     mesh: Mesh,
     axis: str = "shard",
     init_centroids: Optional[jax.Array] = None,
+    weights: Optional[jax.Array] = None,
 ) -> Tuple[jax.Array, jax.Array, jax.Array]:
     """Distributed Lloyd fit over a sample-sharded dataset.
 
     ``x`` is [n, d], sharded (or shardable) over ``axis``; rows are padded
     to the device count with zero weights. Returns replicated
     (centroids, inertia, n_iter).
+
+    ``weights`` (optional, [n] f32) weight the samples — the MNMG
+    sample-weight support the reference's cuML kmeans carries. The
+    distributed build's ``coarse="distributed"`` mode uses zero weights
+    to mask the pad rows of its stacked ragged per-shard sample, so the
+    sample never has to be gathered/replicated: each shard's slice stays
+    its own and only the [k, d] centroid sums ride the psum. Zero-weight
+    rows contribute to no centroid and no inertia; random init draws
+    from positive-weight rows only.
     """
     # deferred: parallel.ivf imports this module, so a top-level comms
     # import would be circular
@@ -47,14 +67,23 @@ def fit(
     k = params.n_clusters
     n_dev = mesh.shape[axis]
     padded_n = -(-n // n_dev) * n_dev
-    w = jnp.ones((n,), jnp.float32)
+    w = (jnp.ones((n,), jnp.float32) if weights is None
+         else jnp.asarray(weights, jnp.float32))
     if padded_n != n:
         x = jnp.pad(x, ((0, padded_n - n), (0, 0)))
         w = jnp.pad(w, (0, padded_n - n))
 
     if init_centroids is None:
         key = RngState(params.seed).key()
-        init_centroids = init_random(key, x[:n], k)
+        if weights is None:
+            init_centroids = init_random(key, x[:n], k)
+        else:
+            # draw initial centroids from REAL rows only — a zero-weight
+            # pad row picked as an init would seed a dead centroid at
+            # the origin (weights are concrete here: this runs on the
+            # host before the SPMD program)
+            real = jnp.flatnonzero(w[:n] > 0)
+            init_centroids = init_random(key, x[real], k)
 
     def step(x_shard, w_shard, centroids):
         """One Lloyd iteration: local assign + psum-merged update."""

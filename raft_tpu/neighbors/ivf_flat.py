@@ -290,6 +290,33 @@ def build(dataset: jax.Array, params: Optional[IndexParams] = None) -> IvfFlatIn
                         list_sizes=sizes, metric=mt.value)
 
 
+@traced("raft_tpu.ivf_flat.build_distributed")
+def build_distributed(dataset, params: Optional[IndexParams] = None, *,
+                      mesh, axis: str = "shard",
+                      chunk_rows: int = 1 << 18,
+                      max_train_rows: int = 1 << 21,
+                      prefetch: bool = True,
+                      coarse: str = "replicated",
+                      progress: bool = False):
+    """Distributed chunked build from a host array/memmap — the
+    IVF-Flat twin of :func:`raft_tpu.neighbors.ivf_pq.build_distributed`
+    (see it and :mod:`raft_tpu.parallel.build` for the shard/prefetch/
+    comms structure). Returns a ``parallel.ivf.ShardedIvfFlat`` the
+    sharded searcher consumes directly;
+    ``parallel.build.assemble_ivf_flat`` of the default
+    (``coarse="replicated"``) result is bit-identical to
+    :func:`build` over the same dataset/params while the trainset stays
+    under ``max_train_rows``."""
+    if params is None:
+        params = IndexParams()
+    from raft_tpu.parallel import build as _dbuild
+
+    return _dbuild.build_ivf_flat_distributed(
+        dataset, params, mesh, axis=axis, chunk_rows=chunk_rows,
+        max_train_rows=max_train_rows, prefetch=prefetch, coarse=coarse,
+        progress=progress)
+
+
 @traced("raft_tpu.ivf_flat.extend")
 def extend(index: IvfFlatIndex, new_vectors: jax.Array,  # graftlint: disable-fn=GL01 (host re-pack by design)
            new_ids: Optional[jax.Array] = None) -> IvfFlatIndex:

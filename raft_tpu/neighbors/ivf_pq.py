@@ -644,7 +644,8 @@ def _pack_codes(codes: np.ndarray, labels: np.ndarray, norms: np.ndarray,
 def _train_quantizers(trainset: jax.Array, params: IndexParams, dim: int,
                       pq_dim: int, pq_len: int, K: int, key,
                       km: KMeansBalancedParams,
-                      max_codebook_rows: int = 1 << 16):
+                      max_codebook_rows: int = 1 << 16,
+                      centers: Optional[jax.Array] = None):
     """Coarse centers + rotation + codebooks from a (sub)trainset — the
     quantizer-training block shared by build() and build_chunked()
     (reference: detail/ivf_pq_build.cuh:1511-1621 + :385-492).
@@ -655,10 +656,17 @@ def _train_quantizers(trainset: jax.Array, params: IndexParams, dim: int,
     K=256), this bounds a TPU-specific blowup: the per-subspace sample
     [pq_dim, n, pq_len] lane-pads its tiny minor dim to 128, so an
     uncapped 2M-row trainset at pq_len=2 would demand 64× its logical
-    size in HBM (measured: a 51 GB allocation on a 16 GB chip)."""
+    size in HBM (measured: a 51 GB allocation on a 16 GB chip).
+
+    ``centers`` (optional) skips the coarse fit and trains the
+    rotation/codebooks against the GIVEN coarse centers — the
+    distributed build's ``coarse="distributed"`` mode fits its centers
+    with the psum-Lloyd MNMG trainer first, and the codebooks must see
+    residuals to the centers the index will actually encode against."""
     n_train = trainset.shape[0]
     rot_dim = pq_dim * pq_len
-    centers = kmeans_balanced.fit(trainset, params.n_lists, km)
+    if centers is None:
+        centers = kmeans_balanced.fit(trainset, params.n_lists, km)
     rotation = make_rotation_matrix(jax.random.fold_in(key, 1), rot_dim, dim)
     centers_rot = centers @ rotation.T
     stride = max(1, -(-n_train // max_codebook_rows))
@@ -905,11 +913,17 @@ def build_chunked(dataset, params: Optional[IndexParams] = None,  # graftlint: d
         from raft_tpu.robust import checkpoint as _ckpt
 
         ck = _ckpt.BuildCheckpoint(checkpoint_dir)
-        ds_sha = _ckpt.dataset_fingerprint(dataset)
-        p_sha = _ckpt.params_fingerprint(
-            {**dataclasses.asdict(params), "chunk_rows": chunk_rows,
-             "max_train_rows": max_train_rows})
+        # fingerprint ONCE (timed) and thread the pair through every
+        # manifest write below — a memmap fingerprint samples real
+        # content, so re-fingerprinting per state change would pay the
+        # head/tail reads over and over; the elapsed seconds are
+        # stamped so long builds can see the identity check's cost
+        ds_sha, p_sha, fp_s = _ckpt.fingerprints_once(
+            dataset, {**dataclasses.asdict(params),
+                      "chunk_rows": chunk_rows,
+                      "max_train_rows": max_train_rows})
         base_manifest = {"dataset_sha": ds_sha, "params_sha": p_sha,
+                         "fingerprint_s": round(fp_s, 6),
                          "n": int(n), "dim": int(dim),
                          "chunk_rows": int(chunk_rows),
                          "n_chunks": -(-n // chunk_rows)}
@@ -1164,6 +1178,56 @@ def build_chunked(dataset, params: Optional[IndexParams] = None,  # graftlint: d
     if _want_recon_cache(params, params.n_lists, L, rot_dim):
         index = index.replace(packed_recon=_build_recon_cache(index))
     return index
+
+
+@traced("raft_tpu.ivf_pq.build_distributed")
+def build_distributed(dataset, params: Optional[IndexParams] = None, *,
+                      mesh, axis: str = "shard",
+                      chunk_rows: int = 1 << 18,
+                      max_train_rows: int = 1 << 21,
+                      prefetch: bool = True,
+                      coarse: str = "replicated",
+                      checkpoint_dir: Optional[str] = None,
+                      resume=False, progress: bool = False):
+    """Distributed billion-scale build from a host array/memmap — the
+    pod twin of :func:`build_chunked` (reference: the raft-dask MNMG
+    build lane, SURVEY §2.15; ROADMAP item 2's SIFT-1B path). Returns a
+    :class:`raft_tpu.parallel.ivf.ShardedIvfPq` that the PR-8 sharded
+    searcher (``search``'s ``mesh=`` dispatch, ring merge and fused
+    scan-in-ring included) consumes directly.
+
+    Structure (details: :mod:`raft_tpu.parallel.build`):
+
+    - quantizers trained ONCE from a cross-shard trainset gathered with
+      one ``allgatherv`` — by default (``coarse="replicated"``) the
+      exact single-host trainer over the exact single-host sample, so
+      ``parallel.build.assemble_ivf_pq`` of the result is
+      **bit-identical** to ``build_chunked`` over the same
+      dataset/params; ``coarse="distributed"`` swaps in the psum-Lloyd
+      MNMG trainer (:func:`raft_tpu.cluster.distributed.fit`) when the
+      trainset itself is too big to replicate (parity waived);
+    - each shard walks only its contiguous slice of ``dataset`` in
+      ``chunk_rows`` chunks through a double-buffered host→HBM
+      prefetcher (chunk N+1's read + ``device_put`` hide under chunk
+      N's encode; ``build.prefetch.{hit,stall}`` counters and the
+      ``span.*.encode`` / ``span.*.h2d`` rows prove the overlap;
+      ``prefetch=False`` keeps the serialized copy-then-encode walk for
+      comparison). Reads retry under the PR-7 IO policy;
+    - the only post-train collective is one ``allgatherv`` of per-list
+      counts — encoded codes/ids/norms never cross the interconnect;
+    - ``checkpoint_dir=`` makes the pod build preemption-safe per
+      shard: per-(shard, chunk) encoded shards + a shard-axis manifest,
+      resume replays to a sha-identical sharded index (fingerprints
+      computed once, validated on resume — same refusal matrix as
+      ``build_chunked``)."""
+    if params is None:
+        params = IndexParams()
+    from raft_tpu.parallel import build as _dbuild
+
+    return _dbuild.build_ivf_pq_distributed(
+        dataset, params, mesh, axis=axis, chunk_rows=chunk_rows,
+        max_train_rows=max_train_rows, prefetch=prefetch, coarse=coarse,
+        checkpoint_dir=checkpoint_dir, resume=resume, progress=progress)
 
 
 def _want_recon_cache(params: IndexParams, n_lists: int, L: int,

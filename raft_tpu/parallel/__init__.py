@@ -29,3 +29,12 @@ from raft_tpu.parallel.ivf import (  # noqa: F401
     search_ivf_flat,
     search_ivf_pq,
 )
+from raft_tpu.parallel.build import (  # noqa: F401
+    ChunkPrefetcher,
+    assemble_ivf_flat,
+    assemble_ivf_pq,
+    build_ivf_flat_distributed,
+    build_ivf_pq_distributed,
+    index_sha16,
+    shard_ranges,
+)

@@ -76,6 +76,12 @@ class ShardedIvfPq(flax.struct.PyTreeNode):
     # can validate a caller-passed dataset against the build geometry
     # (0 = unknown, for indexes assembled by hand)
     shard_rows: int = flax.struct.field(pytree_node=False, default=0)
+    # the GLOBAL list capacity a single-host build of the same dataset
+    # would fit (stamped by parallel.build's distributed builders; 0 =
+    # unknown) — parallel.build.assemble_ivf_pq truncates the rank-order
+    # concat of per-shard list prefixes at exactly this capacity to
+    # reproduce the single-host pack bit-identically
+    global_list_cap: int = flax.struct.field(pytree_node=False, default=0)
 
     @property
     def n_shards(self) -> int:
@@ -103,6 +109,8 @@ class ShardedIvfFlat(flax.struct.PyTreeNode):
     packed_norms: jax.Array  # [n_dev, n_lists, L] f32
     list_sizes: jax.Array    # [n_dev, n_lists] i32
     metric: str = flax.struct.field(pytree_node=False, default="sqeuclidean")
+    # see ShardedIvfPq.global_list_cap (parallel.build.assemble_ivf_flat)
+    global_list_cap: int = flax.struct.field(pytree_node=False, default=0)
 
     @property
     def n_lists(self) -> int:

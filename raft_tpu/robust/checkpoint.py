@@ -27,6 +27,16 @@ Resume correctness is deterministic replay: quantizers and labels are
 and remaining chunks re-encode with the loaded quantizers — so an
 interrupted-then-resumed build is bit-identical to an uninterrupted
 one (the chaos CI lane asserts sha equality).
+
+The DISTRIBUTED build (``parallel.build``) reuses the same directory
+with a **shard axis**: its manifest carries ``n_shards`` /
+``shard_rows`` / ``L_shard`` and a per-shard ``shard_chunks_done``
+list, encoded-chunk files carry the data-shard rank in their name
+(:meth:`BuildCheckpoint.shard_name` with ``shard=``), per-shard label
+passes land as ``labels_s%03d.npz``, and the dataset fingerprint is
+computed ONCE per build with its elapsed seconds stamped into the
+manifest (``fingerprint_s``) — a preempted pod build resumes each
+shard from its own last complete chunk.
 """
 
 from __future__ import annotations
@@ -124,6 +134,21 @@ def params_fingerprint(params_dict: Dict[str, Any]) -> str:
     return hashlib.sha256(blob.encode()).hexdigest()
 
 
+def fingerprints_once(dataset, params_dict: Dict[str, Any]):
+    """``(dataset_sha, params_sha, elapsed_s)`` — the ONE fingerprint
+    site per build. Both chunked builders call this exactly once and
+    thread the pair through every manifest write and (distributed) every
+    shard scope; the elapsed seconds land in the manifest as
+    ``fingerprint_s``, so an hour-scale memmap build can see what the
+    identity check cost instead of silently paying it."""
+    import time
+
+    t0 = time.perf_counter()
+    ds_sha = dataset_fingerprint(dataset)
+    p_sha = params_fingerprint(params_dict)
+    return ds_sha, p_sha, time.perf_counter() - t0
+
+
 class BuildCheckpoint:
     """One checkpoint directory: manifest + named array files + chunk
     shards, all written atomically."""
@@ -200,14 +225,24 @@ class BuildCheckpoint:
         with np.load(path) as z:
             return {k: z[k] for k in z.files}
 
-    def shard_name(self, chunk_idx: int) -> str:
-        return f"shard_{chunk_idx:06d}"
+    def shard_name(self, chunk_idx: int,
+                   shard: Optional[int] = None) -> str:
+        """Encoded-chunk file stem. ``shard=None`` keeps the single-host
+        layout (``shard_000003``); the DISTRIBUTED build passes its
+        data-shard rank so the manifest's shard axis has a matching file
+        axis (``s002_shard_000003`` = shard 2, chunk 3) and per-shard
+        resume can replay one shard without touching the others'."""
+        if shard is None:
+            return f"shard_{chunk_idx:06d}"
+        return f"s{shard:03d}_shard_{chunk_idx:06d}"
 
-    def save_shard(self, chunk_idx: int, **arrays: np.ndarray) -> None:
-        self.save_arrays(self.shard_name(chunk_idx), **arrays)
+    def save_shard(self, chunk_idx: int, shard: Optional[int] = None,
+                   **arrays: np.ndarray) -> None:
+        self.save_arrays(self.shard_name(chunk_idx, shard), **arrays)
 
-    def load_shard(self, chunk_idx: int) -> Dict[str, np.ndarray]:
-        name = self.shard_name(chunk_idx)
+    def load_shard(self, chunk_idx: int,
+                   shard: Optional[int] = None) -> Dict[str, np.ndarray]:
+        name = self.shard_name(chunk_idx, shard)
         expects(self.has_arrays(name),
                 "resume checkpoint %s: encoded-list shard %s.npz is "
                 "missing but the manifest records chunk %d as complete "

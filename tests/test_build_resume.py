@@ -213,6 +213,24 @@ class TestCheckpointPrimitives:
         man = ck.load_manifest()
         assert man["schema"] == ckpt.SCHEMA
 
+    def test_manifest_stamps_fingerprint_elapsed(self, data, tmp_path):
+        """ISSUE 13 satellite fix: the dataset/params fingerprint is
+        computed ONCE per build (fingerprints_once) and its elapsed
+        seconds are stamped into every manifest write."""
+        ivf_pq.build_chunked(data, _params(), chunk_rows=CHUNK,
+                             checkpoint_dir=str(tmp_path))
+        man = json.load(open(tmp_path / "manifest.json"))
+        assert man["phase"] == "done"
+        assert isinstance(man["fingerprint_s"], float)
+        assert man["fingerprint_s"] >= 0
+
+    def test_fingerprints_once_matches_parts(self):
+        ds = np.random.default_rng(0).random((64, 8), dtype=np.float32)
+        sha, p_sha, fp_s = ckpt.fingerprints_once(ds, {"x": 1})
+        assert sha == ckpt.dataset_fingerprint(ds)
+        assert p_sha == ckpt.params_fingerprint({"x": 1})
+        assert fp_s >= 0
+
     def test_fingerprints_are_content_sensitive(self):
         rng = np.random.default_rng(0)
         a = rng.random((100, 8), dtype=np.float32)

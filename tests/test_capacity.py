@@ -173,6 +173,13 @@ class TestBillionScaleProofs:
     def test_build_chunked_assign_encode(self):
         assert not cp.prove_build_chunked_pass(N)["violations"]
 
+    def test_build_distributed_assign_encode(self):
+        """ISSUE 13: the distributed build's per-shard assign+encode on
+        the 8-device mesh — the ``rank·shard_rows + local`` global-id
+        stamp at the last chunk's offset plus the per-list-count
+        allgatherv must stay billion-safe."""
+        assert not cp.prove_build_distributed_pass(N)["violations"]
+
     def test_seeded_int32_regression_fails(self):
         """The negative control: the OLD hard-int32 global-id remap
         (pre-core.ids parallel/knn.py) must fail the prover."""

@@ -49,6 +49,29 @@ def test_pq_scan_bench_rows(monkeypatch):
     assert os.environ.get("RAFT_TPU_PALLAS_LUTSCAN") == "always"
 
 
+import pytest
+
+
+@pytest.mark.slow  # three real builds (~11 s); the CI pytest lane runs it
+def test_build_encode_bench_rows():
+    """ISSUE 13 satellite: the build_encode microbench must emit the
+    serial build_chunked row plus, on a multi-device host, the
+    distributed serialized/prefetch pair (vectors/s/chip) — with the
+    roofline columns of the per-chunk encode program attached."""
+    rows = prims.bench_build_encode(grid=[(4000, 16, 8, 512)])
+    impls = {r.impl for r in rows}
+    assert "build_chunked" in impls, impls
+    import jax
+
+    if len(jax.devices()) >= 2:
+        assert {"distributed_serial", "distributed_prefetch"} <= impls
+    else:
+        assert "distributed_skipped" in impls  # skip recorded, not silent
+    measured = [r for r in rows if not r.impl.endswith("skipped")]
+    assert all(r.ms > 0 and np.isfinite(r.throughput) for r in measured)
+    assert all(r.params.get("flops") for r in measured)
+
+
 def test_refine_bench_rows(monkeypatch):
     """The refine microbench must emit an einsum row and, with the
     interpret-mode force on, a pallas_gather row forced through the env

@@ -24,6 +24,9 @@ Proof set (the acceptance list from ISSUE 10):
   remap included) on the 8-device CPU mesh
 - ``build_chunked``'s assignment/encode pass at the LAST chunk's row
   offset (where the ``a + row`` global-id stamp is largest)
+- the DISTRIBUTED build's per-shard assignment/encode pass on the
+  8-device mesh (ISSUE 13): the ``rank·shard_rows + local`` global-id
+  stamp plus the per-list-count allgatherv
 
 Run: ``JAX_PLATFORMS=cpu python -m tools.capacity_prove [--n N]
 [--report PATH]`` — exit 0 when every proof is clean, 1 with the
@@ -283,6 +286,68 @@ def prove_build_chunked_pass(n: int = DEFAULT_N,
         what="ivf_pq.build_chunked[assign+encode]")
 
 
+def prove_build_distributed_pass(n: int = DEFAULT_N,
+                                 chunk: int = 1 << 14) -> dict:
+    """The DISTRIBUTED build's per-shard assignment+encode pass at the
+    LAST chunk's offset on the 8-device mesh (ISSUE 13): coarse
+    assignment, residual encode, the global-id stamp through
+    ``core.ids.global_ids`` (``rank · shard_rows + local`` — the int32
+    overflow site the moment the pod holds ≥ 2³¹ rows), and the build's
+    one collective, the allgatherv of per-list counts. Ends by
+    addressing the global row axis with the stamped ids, so an upstream
+    int32 narrowing surfaces as an int32 gather into the ≥ 2³¹ axis."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from jax.sharding import Mesh, PartitionSpec as P
+    from raft_tpu.cluster import kmeans_balanced
+    from raft_tpu.cluster.kmeans_balanced import KMeansBalancedParams
+    from raft_tpu.core.compat import shard_map
+    from raft_tpu.core import ids as _ids
+    from raft_tpu.neighbors import ivf_pq as _pq
+    from raft_tpu.obs import sanitize as _san
+    from raft_tpu.parallel.comms import Comms
+
+    n_dev = 8
+    n_lists = 64
+    shard_rows = -(-n // n_dev)
+    a = (shard_rows // chunk) * chunk - chunk  # last full in-shard chunk
+    devices = jax.devices()[:n_dev]
+    mesh = Mesh(np.array(devices), ("shard",))
+    comms = Comms("shard")
+    km = KMeansBalancedParams(metric="l2")
+
+    def local(xb, centers, centers_rot, rotation, codebooks, marker):
+        rank = comms.get_rank()
+        labels = kmeans_balanced.predict(centers, xb, km)
+        codes, norms = _pq._encode_with_norms(
+            xb @ rotation.T, centers_rot,
+            jnp.clip(labels, 0, n_lists - 1), codebooks, "per_subspace")
+        # the build's one post-train collective: per-list counts only
+        counts = jax.ops.segment_sum(jnp.ones((chunk,), jnp.float32),
+                                     labels, num_segments=n_lists)
+        g, _ = comms.allgatherv(counts[None], jnp.int32(1),
+                                compact=False)
+        gids = _ids.global_ids(rank, shard_rows,
+                               _ids.make_ids(chunk, start=a,
+                                             n_total=n_dev * shard_rows),
+                               n_total=n_dev * shard_rows)
+        return codes, norms, g, gids, _address_rows(marker, gids)
+
+    out = (P(), P(), P(), P(), P())
+    fn = shard_map(local, mesh=mesh,
+                   in_specs=(P(), P(), P(), P(), P(), P()),
+                   out_specs=out, check_vma=False)
+    return _san.assert_billion_safe(
+        fn, _sds((chunk, _DIM), jnp.float32),
+        _sds((n_lists, _DIM), jnp.float32),
+        _sds((n_lists, _DIM), jnp.float32),
+        _sds((_DIM, _DIM), jnp.float32),
+        _sds((_DIM, 256, 1), jnp.float32),
+        _sds((n, 1), jnp.int8),
+        what="ivf_pq.build_distributed[assign+encode]")
+
+
 PROOFS = {
     "brute_force.knn": prove_brute_force,
     "ivf_pq.search": prove_ivf_pq,
@@ -293,6 +358,7 @@ PROOFS = {
     "merge.allgather": lambda n=DEFAULT_N: prove_sharded_merge(
         n, "allgather"),
     "build_chunked.assign_encode": prove_build_chunked_pass,
+    "build_distributed.assign_encode": prove_build_distributed_pass,
 }
 
 

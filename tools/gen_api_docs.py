@@ -46,6 +46,7 @@ MODULES = [
     "raft_tpu.parallel.mesh", "raft_tpu.parallel.comms",
     "raft_tpu.parallel.merge",
     "raft_tpu.parallel.knn", "raft_tpu.parallel.ivf",
+    "raft_tpu.parallel.build",
     "raft_tpu.ops.pallas_kernels", "raft_tpu.native",
     "raft_tpu.bench.dataset", "raft_tpu.bench.runner",
     "raft_tpu.bench.ingest", "raft_tpu.bench.plot",
@@ -81,6 +82,24 @@ The regression-gate CLI: exit 0 pass / 1 regression / 2 refused
 under `raft_tpu/bench/baselines/` and resolve by bare name. See
 docs/observability.md "Cost attribution & regression gate" for the
 noise model and CI wiring.
+""",
+    "raft_tpu.parallel.build": """\
+### Distributed-build decision summary
+
+`ivf_pq.build_distributed` / `ivf_flat.build_distributed` (ISSUE 13)
+route here. The choices that matter:
+
+| knob | values | effect |
+|---|---|---|
+| `coarse` | `"replicated"` (default) \\| `"distributed"` | replicated = the exact single-host trainer over the exact single-host trainset sample (allgatherv'd from the shards) — `assemble_ivf_pq/_ivf_flat` is then BIT-IDENTICAL to `build_chunked`/`build`; distributed = `cluster.distributed.fit`'s psum Lloyd over the *sharded* sample (scales past a replicable trainset, parity waived) |
+| `prefetch` | `True` (default) \\| `False` | double-buffered host→HBM prefetcher (chunk N+1's read + `device_put` under chunk N's encode; `build.prefetch.{hit,stall}` counters, `span.*.h2d` = un-hidden wait) vs the serialized copy-then-encode walk (the bench comparison leg) |
+| `checkpoint_dir` / `resume` | path, `False`\\|`True`\\|`"auto"` | per-shard preemption safety: shard-axis manifest + per-(shard, chunk) encoded shards; resume replays to a sha-identical sharded index (fingerprints computed once, `fingerprint_s` stamped) |
+
+Comms: one allgatherv of trainset rows (train phase) + one allgatherv
+of per-list counts — codes/ids/norms never cross the interconnect.
+Output: a `ShardedIvfPq`/`ShardedIvfFlat` (global ids = `rank ·
+shard_rows + local` via `core.ids`; `global_list_cap` stamped for
+assembly) that `search_ivf_pq`/`search_ivf_flat` consume directly.
 """,
     "raft_tpu.parallel.merge": """\
 ### Cross-shard merge-tier decision table

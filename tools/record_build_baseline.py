@@ -1,0 +1,98 @@
+"""Record the distributed-build throughput baseline (ISSUE 13).
+
+Runs the MULTICHIP_BUILD scaling legs (``__graft_entry__._build_rows``:
+weak + strong at n_dev ∈ {2,4,8}, prefetch-overlapped vs serialized
+copy+encode on the 8-device CPU mesh) and writes them as a bench-record
+-shaped JSON — build-throughput (vectors/s/chip) as the row ``qps``,
+full environment provenance per row — so build throughput rides the
+PR-9 benchdiff gate like every other perf claim:
+
+    JAX_PLATFORMS=cpu python -m tools.record_build_baseline \
+        [--out raft_tpu/bench/baselines/build_cpu_smoke.json]
+
+CI runs ``python -m tools.benchdiff build_cpu_smoke build_cpu_smoke``
+(the committed record against itself) as the schema/join/provenance
+self-compare, plus an informational fresh-vs-committed diff when the
+dryrun has produced fresh rows. CPU walls vary with machine load —
+cross-machine comparisons should use ``--report-only`` unless the
+environment stamp matches (the cpu_smoke convention).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+_xla_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _xla_flags:
+    os.environ["XLA_FLAGS"] = (
+        _xla_flags + " --xla_force_host_platform_device_count=8").strip()
+
+DEFAULT_OUT = os.path.join(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))), "raft_tpu", "bench", "baselines",
+    "build_cpu_smoke.json")
+
+BASELINE_NOTE = (
+    "Committed distributed-build throughput baseline (ISSUE 13): the "
+    "MULTICHIP_BUILD weak+strong legs at n_dev in {2,4,8} on the "
+    "8-device CPU mesh, prefetch-overlapped vs serialized copy+encode, "
+    "qps = build vectors/s/chip. The dryrun itself asserts overlapped "
+    "wall < serialized wall and allgatherv-only comms per build; this "
+    "record holds the measured rates under the benchdiff gate. CPU "
+    "walls vary with machine load - compare with --report-only unless "
+    "the environment stamp matches AND the machine is quiet.")
+
+
+def build_record() -> dict:
+    import __graft_entry__ as g
+    from raft_tpu.bench.runner import environment_stamp
+
+    rows = g._build_rows(8)
+    env = environment_stamp()
+    detail = []
+    for r in rows:
+        detail.append({
+            "dataset": f"build-synth-{r['n_rows']}x32",
+            "algo": "ivf_pq_build_distributed",
+            "index": "ivf_pq.n16.pq16",
+            "qps": r["vectors_per_s_per_chip"],
+            "recall": None,
+            "build_s": r["wall_s"],
+            "search_param": {"leg": r["leg"], "n_dev": r["n_dev"],
+                             "impl": r["impl"]},
+            "batch_size": r["batch_size"],
+            "measured_at": r["measured_at"],
+            "git_commit": r["git_commit"],
+            "comms_bytes": r["comms_bytes"],
+            "allgatherv_only": r["allgatherv_only"],
+            "prefetch_hits": r["prefetch_hits"],
+            "prefetch_stalls": r["prefetch_stalls"],
+            "read_delay_s": r["read_delay_s"],
+            "env": env,
+        })
+    best = max(r["qps"] for r in detail)
+    return {"metric": "build_vectors_per_s_per_chip_cpu8",
+            "value": best, "unit": "vectors/s/chip",
+            "total_rows": len(detail), "detail": detail,
+            "baseline_note": BASELINE_NOTE}
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="record_build_baseline",
+        description="measure the distributed-build scaling legs and "
+                    "write the benchdiff-consumable baseline record")
+    ap.add_argument("--out", default=DEFAULT_OUT)
+    args = ap.parse_args(argv)
+    record = build_record()
+    with open(args.out, "w", encoding="utf-8") as fh:
+        json.dump(record, fh, indent=1)
+    print(f"wrote {len(record['detail'])} build rows -> {args.out}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
