@@ -50,6 +50,7 @@ RAFT_TPU_SANITIZE=1 python -m pytest \
     tests/test_capacity.py \
     tests/test_parallel.py tests/test_parallel_ivf.py \
     tests/test_ring_topk.py tests/test_build_distributed.py \
+    tests/test_serve.py \
     -q -p no:cacheprovider
 
 echo "== driver contract: entry() compiles, dryrun_multichip(8) executes =="
@@ -506,6 +507,85 @@ echo "   vectors/s/chip rows pass a benchdiff self-compare =="
 python -m tools.benchdiff build_cpu_smoke build_cpu_smoke \
     --md /tmp/raft_tpu_build_baseline_scoreboard.md | tail -3
 
+echo "== serving smoke (ISSUE 14: micro-batch server on the CPU backend,"
+echo "   loadgen burst under recompile_budget(0), typed shedding, ladder"
+echo "   OOM walk; docs/developer_guide.md 'Serving') =="
+python - <<'EOF'
+# start the server (buckets AOT-warmed), drive an open-loop burst whose
+# steady state must trigger ZERO recompiles, then overload it behind a
+# fault-injected stall (typed queue_full shedding) and OOM a batch
+# (degrade-ladder walk) — the acceptance counters all land in one
+# registry snapshot
+import numpy as np
+import jax.numpy as jnp
+
+from raft_tpu import obs, serve
+from raft_tpu.obs import sanitize
+from raft_tpu.obs.metrics import MetricsRegistry
+from raft_tpu.neighbors import ivf_pq
+from raft_tpu.robust import faults
+from raft_tpu.serve import loadgen
+
+rng = np.random.default_rng(0)
+x = rng.random((20_000, 32), dtype=np.float32)
+idx = ivf_pq.build(jnp.asarray(x), ivf_pq.IndexParams(
+    n_lists=64, pq_dim=16, seed=0, cache_reconstruction="never"))
+reg = MetricsRegistry()
+obs.enable(registry=reg, hbm=False)
+registry = serve.IndexRegistry(budget_bytes=4 << 30)
+registry.admit("smoke", idx, params=ivf_pq.SearchParams(
+    n_probes=8, scan_mode="per_query"), default_k=10)
+server = serve.MicroBatchServer(registry, serve.ServerConfig(
+    max_batch=16, queue_depth=64, linger_s=0.002, default_slo_s=1.0))
+with server:
+    for j in range(5):  # settle anything warmup's zero-queries missed
+        server.search("smoke", x[j], 10)
+    # steady state: a 300 qps open-loop burst across every bucket shape
+    # must hold the PR-3 zero-recompile budget
+    with sanitize.recompile_budget(0, what="steady-state serving"):
+        row = loadgen.run_step(server, "smoke", x[:256], 10,
+                               offered_qps=300.0, duration_s=1.5)
+    assert row["completed"] > 200 and row["errors"] == 0, row
+    assert row["latency_p99_s"] is not None, row
+    # overload: every dispatch stalled 0.2 s -> the bounded queue must
+    # shed with the typed queue_full reason, and every accepted request
+    # still terminates (run_step waits on all futures)
+    faults.install_plan({"faults": [{"site": "serve.dispatch",
+                                     "kind": "sleep", "sleep_s": 0.2,
+                                     "times": 0}]})
+    over = loadgen.run_step(server, "smoke", x[:256], 10,
+                            offered_qps=800.0, duration_s=1.0)
+    faults.clear_plan()
+    assert over["shed"] > 0, over
+    assert over["shed_reasons"].get("queue_full", 0) > 0, over
+    # chaos: injected OOM mid-batch walks the degrade ladder and the
+    # served results are EXACT (identical to the fault-free serve)
+    d_c, i_c = server.search("smoke", x[7], 10)
+    faults.install_plan({"faults": [{"site": "ivf_pq.search",
+                                     "kind": "oom", "times": 1}]})
+    d_f, i_f = server.search("smoke", x[7], 10)
+    faults.clear_plan()
+    np.testing.assert_array_equal(i_f, i_c)
+obs.disable()
+c = reg.snapshot()["counters"]
+assert c.get("serve.requests{tenant=smoke}", 0) > 200, c
+assert c.get("serve.shed{reason=queue_full}", 0) > 0, c
+assert any(k.startswith("degrade.steps{") and "site=ivf_pq.search" in k
+           for k in c), c
+assert c.get("serve.registry.admit{tenant=smoke}", 0) == 1, c
+h = reg.snapshot()["histograms"]["serve.latency_s"]
+print(f"serve smoke OK: {row['completed']} steady requests at "
+      f"{row['qps']:.0f} qps (p99 {row['latency_p99_s']*1e3:.1f} ms, "
+      f"0 recompiles), {over['shed']} shed under stall "
+      f"({over['shed_reasons']}), OOM ladder walk exact, "
+      f"{h['count']} latency samples")
+EOF
+# blocking: the committed serving latency-vs-throughput baseline joins
+# and passes the benchdiff self-compare (schema/provenance gate — CPU
+# qps across machines never gates, same convention as cpu_smoke)
+python -m tools.benchdiff serve_cpu_smoke serve_cpu_smoke \
+    --md /tmp/raft_tpu_serve_baseline_scoreboard.md | tail -3
+
 echo "== trace export round-trip (instrumented search -> Perfetto JSON) =="
 python - <<'EOF'
 import json
@@ -686,6 +766,7 @@ cp /tmp/graftlint_report.json \
    /tmp/raft_tpu_obs_bench.json \
    /tmp/raft_tpu_benchdiff_scoreboard.md \
    /tmp/raft_tpu_build_baseline_scoreboard.md \
+   /tmp/raft_tpu_serve_baseline_scoreboard.md \
    /tmp/raft_tpu_benchdiff_verdict.json "$ARTIFACTS"/
 ls -l "$ARTIFACTS"
 echo "CI artifacts under $ARTIFACTS"

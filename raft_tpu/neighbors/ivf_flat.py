@@ -721,23 +721,29 @@ def search(index, queries: jax.Array, k: int,
 def search_resilient(index: IvfFlatIndex, queries: jax.Array, k: int,
                      params: Optional[SearchParams] = None,
                      filter_bitset: Optional[jax.Array] = None,
-                     dataset=None) -> Tuple[jax.Array, jax.Array]:
+                     dataset=None,
+                     deadline=None) -> Tuple[jax.Array, jax.Array]:
     """:func:`search` behind the standard degradation ladder
     (:mod:`raft_tpu.robust.degrade`, same wiring as
     ``ivf_pq.search_resilient`` minus the LUT rung — IVF-Flat has no
     LUT to quantize): RESOURCE_EXHAUSTED walks halve-batch → decline
     fused tier → host gather (then keeps halving), counted in
-    ``degrade.steps{site=ivf_flat.search,...}``."""
+    ``degrade.steps{site=ivf_flat.search,...}``. ``deadline`` (a
+    :class:`raft_tpu.robust.retry.Deadline`) is the request's shared
+    wall-clock budget — the ladder aborts with ``DeadlineExceeded``
+    instead of retrying past it (same contract as
+    ``ivf_pq.search_resilient``)."""
     from raft_tpu.robust import degrade as _dg
 
     if params is None:
         params = SearchParams()
     queries = jnp.asarray(queries)
     return _dg.run_with_degradation(
-        _dg.batched_search_call(search, index, queries, k, filter_bitset),
+        _dg.batched_search_call(search, index, queries, k, filter_bitset,
+                                deadline=deadline, site="ivf_flat.search"),
         {"params": params, "dataset": dataset},
         _dg.standard_search_ladder(queries.shape[0], has_lut=False),
-        site="ivf_flat.search")
+        site="ivf_flat.search", deadline=deadline)
 
 
 def _fit_query_tile(want: int, n_probes: int, index: IvfFlatIndex) -> int:

@@ -2104,7 +2104,8 @@ def search(index, queries: jax.Array, k: int,
 def search_resilient(index: IvfPqIndex, queries: jax.Array, k: int,
                      params: Optional[SearchParams] = None,
                      filter_bitset: Optional[jax.Array] = None,
-                     dataset=None) -> Tuple[jax.Array, jax.Array]:
+                     dataset=None,
+                     deadline=None) -> Tuple[jax.Array, jax.Array]:
     """:func:`search` behind the standard degradation ladder
     (:mod:`raft_tpu.robust.degrade`): a ``RESOURCE_EXHAUSTED`` walks
     halve-batch → bf16 LUT → fp8 LUT → decline fused tier → host
@@ -2115,7 +2116,14 @@ def search_resilient(index: IvfPqIndex, queries: jax.Array, k: int,
     is exact (each query's math is independent); the bf16-LUT and
     declined-tier rungs trade the documented precision/speed margins.
     Serving loops should call this; offline sweeps that prefer a crash
-    to a silently degraded number keep calling :func:`search`."""
+    to a silently degraded number keep calling :func:`search`.
+
+    ``deadline`` (a :class:`raft_tpu.robust.retry.Deadline` — ISSUE 14)
+    is the request's ONE shared wall-clock budget: the ladder checks it
+    before every re-attempt and between split sub-batches, so degraded
+    retries can no longer stack past the SLO the caller promised
+    (:class:`~raft_tpu.robust.retry.DeadlineExceeded` on exhaustion,
+    counted ``degrade.deadline_abort{site=ivf_pq.search}``)."""
     if params is None:
         params = SearchParams()
     if params.lut_dtype == "auto":
@@ -2134,10 +2142,11 @@ def search_resilient(index: IvfPqIndex, queries: jax.Array, k: int,
     queries = jnp.asarray(queries)
     return _degrade.run_with_degradation(
         _degrade.batched_search_call(search, index, queries, k,
-                                     filter_bitset),
+                                     filter_bitset, deadline=deadline,
+                                     site="ivf_pq.search"),
         {"params": params, "dataset": dataset},
         _degrade.standard_search_ladder(queries.shape[0], has_lut=True),
-        site="ivf_pq.search")
+        site="ivf_pq.search", deadline=deadline)
 
 
 # ---------------------------------------------------------------------------

@@ -41,7 +41,13 @@ from raft_tpu.obs import spans as _spans
 from raft_tpu.obs import trace as _trace
 
 SCHEMA = "raft_tpu.flight/1"
-DEFAULT_SIGNALS = ("SIGTERM", "SIGALRM")
+# SIGINT rides beside SIGTERM/SIGALRM (ISSUE 14): a Ctrl-C'd *serving*
+# process previously lost its flight dump — the one run a human was
+# watching closely enough to interrupt is exactly the one whose shed /
+# deadline counters they wanted. Chaining preserves KeyboardInterrupt:
+# the prior handler (Python's default_int_handler unless the app
+# replaced it) still runs after the dump.
+DEFAULT_SIGNALS = ("SIGTERM", "SIGALRM", "SIGINT")
 DEFAULT_LOG_LINES = 200
 
 

@@ -9,7 +9,9 @@ recovery path must be CI-testable instead of outage-tested.
   plan (raise-OOM / SIGTERM-self / sleep / NaN / force-decline);
 - :mod:`raft_tpu.robust.retry`      — the unified retry policy:
   exponential backoff + jitter, deadline budgets,
-  ``retry.attempts{site=}`` counters;
+  ``retry.attempts{site=}`` counters, and the request-scoped
+  :class:`~raft_tpu.robust.retry.Deadline` shared budget that serving
+  threads through queue wait + dispatch + retries (ISSUE 14);
 - :mod:`raft_tpu.robust.degrade`    — the RESOURCE_EXHAUSTED
   degradation ladder (halve batch → bf16 LUT → fp8 LUT → decline fused tier →
   host gather) with ``degrade.steps{from=,to=,reason=}`` counters;

@@ -35,19 +35,27 @@ from __future__ import annotations
 
 import dataclasses
 import sys
+import threading
 import time
 from collections import deque
 from typing import Any, Callable, Dict, List, Optional, Tuple
 
 # one classifier for "is this an OOM": retry uses it to refuse blind
 # re-execution, degrade uses it to trigger the ladder — shared so the
-# two policies can never disagree about the same exception
-from raft_tpu.robust.retry import is_resource_exhausted  # noqa: F401
+# two policies can never disagree about the same exception; Deadline /
+# DeadlineExceeded are retry's request-scoped wall-clock budget (ISSUE
+# 14) that the ladder draws from between rungs
+from raft_tpu.robust.retry import (  # noqa: F401
+    Deadline,
+    DeadlineExceeded,
+    is_resource_exhausted,
+)
 
 __all__ = [
-    "is_resource_exhausted", "Step", "Ladder", "DegradationExhausted",
+    "is_resource_exhausted", "Deadline", "DeadlineExceeded",
+    "Step", "Ladder", "DegradationExhausted",
     "run_with_degradation", "standard_search_ladder", "note_step",
-    "batched_search_call", "recent_steps", "clear_recent",
+    "batched_search_call", "recent_steps", "steps_seen", "clear_recent",
 ]
 
 # Bounded ring of the most recent ladder moves (reactive OOM rungs AND
@@ -57,9 +65,17 @@ __all__ = [
 # atomic under the GIL; no lock needed on this path.
 _RECENT_MAX = 64
 _recent: deque = deque(maxlen=_RECENT_MAX)
+# monotonic per-THREAD count of moves noted — unlike len(recent_steps())
+# it never saturates at the ring capacity, and unlike a process-global
+# counter it cannot be bumped by a concurrent thread's ladder walk: a
+# dispatcher bracketing its own synchronous call sees exactly its own
+# moves (the ladder runs in the caller's stack), so "did MY call
+# degrade?" stays answerable in a multi-threaded serving process
+_steps_tls = threading.local()
 
 
 def _note_recent(site: str, frm: str, to: str, reason: str) -> None:
+    _steps_tls.n = getattr(_steps_tls, "n", 0) + 1
     _recent.append({"ts": round(time.time(), 3), "site": site,
                     "from": frm, "to": to, "reason": reason})
 
@@ -70,8 +86,18 @@ def recent_steps() -> List[Dict[str, Any]]:
     return list(_recent)
 
 
+def steps_seen() -> int:
+    """Monotonic count of every ladder move noted ON THIS THREAD
+    (reactive rungs AND guard declines). Callers bracketing a
+    synchronous call to ask "did the ladder move during it?" must
+    compare THIS, not ``len(recent_steps())`` — the ring saturates at
+    its capacity, and the global ring also collects OTHER threads'
+    moves."""
+    return getattr(_steps_tls, "n", 0)
+
+
 def clear_recent() -> None:
-    """Reset the ring (tests)."""
+    """Reset the ring (tests; the monotonic counter keeps counting)."""
     _recent.clear()
 
 @dataclasses.dataclass
@@ -138,11 +164,18 @@ def note_step(site: str, frm: str, to: str, reason: str) -> None:
 def run_with_degradation(call: Callable[[Dict[str, Any]], Any],
                          knobs: Dict[str, Any],
                          ladder: Ladder,
-                         site: str) -> Any:
+                         site: str,
+                         deadline: Optional[Deadline] = None) -> Any:
     """Run ``call(knobs)``; on RESOURCE_EXHAUSTED advance ``ladder`` one
     rung and retry with the degraded knobs. Non-OOM exceptions propagate
     unchanged. Raises :class:`DegradationExhausted` when no rung is
-    left."""
+    left.
+
+    ``deadline`` (the request's shared :class:`Deadline`) is checked
+    before every re-attempt: a ladder walk cannot stack retries past the
+    request's SLO — once the budget is gone the walk aborts with
+    :class:`DeadlineExceeded` (counted ``degrade.deadline_abort{site=}``)
+    instead of burning chip time on an answer nobody is waiting for."""
     state = "native"
     path: List[str] = []
     while True:
@@ -151,6 +184,9 @@ def run_with_degradation(call: Callable[[Dict[str, Any]], Any],
         except Exception as e:
             if not is_resource_exhausted(e):
                 raise
+            if deadline is not None and deadline.expired:
+                _count("degrade.deadline_abort", {"site": site})
+                raise DeadlineExceeded(site, deadline) from e
             advanced = ladder.advance(knobs)
             if advanced is None:
                 _count("degrade.exhausted", {"site": site})
@@ -173,14 +209,24 @@ def run_with_degradation(call: Callable[[Dict[str, Any]], Any],
 
 
 def batched_search_call(search_fn, index, queries, k: int,
-                        filter_bitset) -> Callable[[Dict[str, Any]], Any]:
+                        filter_bitset,
+                        deadline: Optional[Deadline] = None,
+                        site: str = "batched_search"
+                        ) -> Callable[[Dict[str, Any]], Any]:
     """Build the ladder ``call(knobs)`` for a search entry point (the
     shared body of ``ivf_pq.search_resilient`` /
     ``ivf_flat.search_resilient``): honors the knobs the standard
     ladder mutates — ``params``, ``dataset``, and ``max_batch``
     (splitting the query batch and concatenating per-axis results when
     a halve-batch rung has fired; each query's math is independent, so
-    splitting is exact)."""
+    splitting is exact).
+
+    ``deadline`` (the request's shared :class:`Deadline`) gates each
+    sub-batch of a split walk: once the budget is gone the remaining
+    sub-batches are abandoned with :class:`DeadlineExceeded` — a
+    half-delivered answer after the SLO helps nobody, and the serving
+    layer turns the typed error into a counted shed instead of a hung
+    request."""
     import jax.numpy as jnp
 
     B = queries.shape[0]
@@ -190,10 +236,17 @@ def batched_search_call(search_fn, index, queries, k: int,
         ds = knobs.get("dataset")
         mb = knobs.get("max_batch")
         if not mb or mb >= B:
+            if deadline is not None and deadline.expired:
+                _count("degrade.deadline_abort", {"site": site})
+                raise DeadlineExceeded(site, deadline)
             return search_fn(index, queries, k, p, filter_bitset, ds)
-        outs = [search_fn(index, queries[a:a + mb], k, p, filter_bitset,
-                          ds)
-                for a in range(0, B, mb)]
+        outs = []
+        for a in range(0, B, mb):
+            if deadline is not None and deadline.expired:
+                _count("degrade.deadline_abort", {"site": site})
+                raise DeadlineExceeded(site, deadline)
+            outs.append(search_fn(index, queries[a:a + mb], k, p,
+                                  filter_bitset, ds))
         return (jnp.concatenate([o[0] for o in outs], axis=0),
                 jnp.concatenate([o[1] for o in outs], axis=0))
 

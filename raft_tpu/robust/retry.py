@@ -36,6 +36,7 @@ from typing import Any, Callable, Dict, Optional
 
 __all__ = ["RetryPolicy", "RetryExhausted", "retry_call", "retrying",
            "default_retryable", "is_resource_exhausted",
+           "Deadline", "DeadlineExceeded",
            "DEFAULT_POLICY", "IO_POLICY"]
 
 # Substrings that mark an exception message as a transient transport /
@@ -114,6 +115,73 @@ IO_POLICY = RetryPolicy(max_attempts=3, base_delay_s=0.25,
                         max_delay_s=5.0, jitter=0.25)
 
 
+class Deadline:
+    """One shared wall-clock budget for one request (ISSUE 14).
+
+    ``RetryPolicy.deadline_s`` is a *per-site* budget measured from each
+    site's first attempt — two nested retry sites under one request can
+    therefore stack to ``2 × deadline_s`` of wall time, past any SLO the
+    caller promised. A :class:`Deadline` is the request-scoped
+    alternative: constructed once where the request enters the system
+    (``serve``'s enqueue path) and threaded through every retry / ladder
+    / dispatch site, so queue wait, batching, the search itself, and all
+    nested retries draw down ONE budget.
+
+    ``Deadline(None)`` never expires (the offline default — every
+    ``deadline=`` parameter treats ``None`` the same way).
+    Stdlib-only, monotonic-clock based; ``clock`` is injectable for
+    tests."""
+
+    __slots__ = ("budget_s", "_t0", "_clock")
+
+    def __init__(self, budget_s: Optional[float],
+                 clock: Callable[[], float] = time.monotonic):
+        self.budget_s = None if budget_s is None else float(budget_s)
+        self._clock = clock
+        self._t0 = clock()
+
+    def elapsed(self) -> float:
+        """Seconds since construction."""
+        return self._clock() - self._t0
+
+    def remaining(self) -> float:
+        """Seconds left in the budget (``inf`` for an unbounded
+        deadline; negative once expired — callers comparing a backoff
+        delay against it get the right answer either way)."""
+        if self.budget_s is None:
+            return float("inf")
+        return self.budget_s - self.elapsed()
+
+    @property
+    def expired(self) -> bool:
+        return self.remaining() <= 0.0
+
+    def describe(self) -> str:
+        """One-line state for logs/records."""
+        if self.budget_s is None:
+            return "deadline unbounded"
+        return (f"deadline {self.budget_s:g}s "
+                f"({max(0.0, self.remaining()):.3f}s left)")
+
+    def __repr__(self) -> str:  # debuggability in shed errors/logs
+        return f"<Deadline {self.describe()}>"
+
+
+class DeadlineExceeded(RuntimeError):
+    """A request's shared :class:`Deadline` ran out. ``transient=False``
+    pins the retry classification: the message must never be mistaken
+    for a retryable grpc ``DEADLINE_EXCEEDED`` status (blind-retrying an
+    expired request is exactly the stacking this type exists to end)."""
+
+    transient = False
+
+    def __init__(self, site: str, deadline: Optional[Deadline] = None):
+        state = f" ({deadline.describe()})" if deadline is not None else ""
+        super().__init__(f"deadline exhausted at {site!r}{state}")
+        self.site = site
+        self.deadline = deadline
+
+
 class RetryExhausted(RuntimeError):
     """The policy gave up: attempts or deadline ran out. ``__cause__``
     is the last attempt's exception; ``attempts`` the count made."""
@@ -138,6 +206,7 @@ def _count(name: str, site: str) -> None:
 def retry_call(fn: Callable[..., Any], *args,
                site: str = "unnamed",
                policy: RetryPolicy = DEFAULT_POLICY,
+               deadline: Optional[Deadline] = None,
                stats: Optional[Dict[str, Any]] = None,
                sleep: Callable[[float], None] = time.sleep,
                rng: Optional[random.Random] = None,
@@ -146,16 +215,32 @@ def retry_call(fn: Callable[..., Any], *args,
 
     ``stats`` (optional dict) is filled in place — ``attempts``,
     ``slept_s``, ``errors`` (reprs), ``outcome``
-    (``"ok"``/``"recovered"``/``"exhausted"``/``"fatal"``) — so callers
-    can stamp the retry history into their own records (the bench
-    probe's partial-record note). Raises :class:`RetryExhausted` when
-    the policy gives up on a retryable error; a non-retryable error
-    propagates unchanged (``outcome="fatal"``)."""
+    (``"ok"``/``"recovered"``/``"exhausted"``/``"fatal"``/
+    ``"deadline"``) — so callers can stamp the retry history into their
+    own records (the bench probe's partial-record note). Raises
+    :class:`RetryExhausted` when the policy gives up on a retryable
+    error; a non-retryable error propagates unchanged
+    (``outcome="fatal"``).
+
+    ``deadline`` (a request-scoped :class:`Deadline`) caps the whole
+    call alongside the policy's per-site ``deadline_s``: an
+    already-expired deadline refuses even the first attempt
+    (:class:`DeadlineExceeded`), and a backoff sleep that would outlive
+    the remaining budget gives up as ``exhausted`` instead of sleeping
+    past the request's SLO. Nested retry sites handed the same object
+    share one budget — they can no longer stack per-site deadlines."""
     st: Dict[str, Any] = stats if stats is not None else {}
     st.update(attempts=0, slept_s=0.0, errors=[], outcome=None,
               policy=policy.describe())
     rng = rng or random
     t0 = time.monotonic()
+    if deadline is not None and deadline.expired:
+        # the request's budget is already gone (burned in a queue, by a
+        # sibling site, ...) — starting work that cannot be delivered
+        # in time only deepens the overload
+        st["outcome"] = "deadline"
+        _count("retry.exhausted", site)
+        raise DeadlineExceeded(site, deadline)
     while True:
         st["attempts"] += 1
         _count("retry.attempts", site)
@@ -176,12 +261,24 @@ def retry_call(fn: Callable[..., Any], *args,
             if policy.jitter:
                 delay *= 1.0 + policy.jitter * (2.0 * rng.random() - 1.0)
             delay = max(0.0, delay)
+            remaining = float("inf")
             if policy.deadline_s is not None:
                 remaining = policy.deadline_s - (time.monotonic() - t0)
-                if remaining <= delay:
-                    st["outcome"] = "exhausted"
-                    _count("retry.exhausted", site)
-                    raise RetryExhausted(site, st["attempts"], e) from e
+            if deadline is not None:
+                # the SHARED budget: whatever other sites already spent
+                # is gone from this site's backoff headroom too
+                remaining = min(remaining, deadline.remaining())
+            if remaining <= delay:
+                _count("retry.exhausted", site)
+                if deadline is not None and deadline.remaining() <= delay:
+                    # the REQUEST's budget is what ran out (not merely
+                    # this site's policy): surface the deadline type so
+                    # the serving layer counts an SLO shed, not a
+                    # tenant error
+                    st["outcome"] = "deadline"
+                    raise DeadlineExceeded(site, deadline) from e
+                st["outcome"] = "exhausted"
+                raise RetryExhausted(site, st["attempts"], e) from e
             if delay:
                 sleep(delay)
                 st["slept_s"] += delay

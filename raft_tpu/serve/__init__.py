@@ -1,0 +1,52 @@
+"""raft_tpu.serve — resilient online serving (ISSUE 14).
+
+The request-shaped half of the system: everything upstream measures
+offline batch sweeps; this package serves single-query traffic with
+production manners — the reference's runtime/pylibraft deployment
+story that nothing upstream provides on TPU.
+
+- :mod:`raft_tpu.serve.server`   — micro-batching front end:
+  shape-bucketed coalescing, AOT-warmed buckets (provably zero
+  steady-state recompiles under ``recompile_budget(0)``), bounded
+  queue with typed load shedding;
+- :mod:`raft_tpu.serve.registry` — multi-tenant index registry: N
+  resident indexes under one HBM budget (PR-1 gauges), LRU eviction
+  under pressure, per-tenant health states;
+- :mod:`raft_tpu.serve.dispatch` — SLO-aware dispatch: one
+  :class:`~raft_tpu.robust.retry.Deadline` per request drawn down by
+  queue wait + batching + search + retries + the PR-7 degrade ladder
+  (the overload path);
+- :mod:`raft_tpu.serve.loadgen`  — open-loop (Poisson) load generator
+  recording latency-vs-throughput curves with p50/p99 from the PR-5
+  histogram quantiles;
+- :mod:`raft_tpu.serve.errors`   — the typed refusal surface
+  (``ShedError{reason=}``, ``TenantUnknown``, ``AdmissionError``) —
+  every failure is a type, never a hang.
+
+Counters: ``serve.requests``, ``serve.shed{reason=}``,
+``serve.batch_fill``, ``serve.latency_s``, ``serve.deadline_missed``,
+``serve.registry.{admit,evict}`` — see docs/observability.md; chaos
+coverage in tests/test_serve.py and the CI serve smoke.
+"""
+
+from raft_tpu.serve.dispatch import dispatch_batch  # noqa: F401
+from raft_tpu.serve.errors import (  # noqa: F401
+    AdmissionError,
+    Deadline,
+    DeadlineExceeded,
+    ServeError,
+    ShedError,
+    TenantUnknown,
+)
+from raft_tpu.serve.loadgen import record, run_step, sweep  # noqa: F401
+from raft_tpu.serve.registry import (  # noqa: F401
+    IndexRegistry,
+    Tenant,
+    index_device_bytes,
+)
+from raft_tpu.serve.server import (  # noqa: F401
+    MicroBatchServer,
+    ServerConfig,
+    bucket_for,
+    bucket_sizes,
+)

@@ -1,0 +1,130 @@
+"""SLO-aware dispatch — one deadline budget from queue to result.
+
+The execution half of the serving layer (ISSUE 14): the server hands a
+coalesced micro-batch to :func:`dispatch_batch`, which runs it through
+the tenant's **resilient** search entry — the PR-7 degrade ladder is
+the overload path (halve batch → bf16/fp8 LUT → decline fused → shed)
+— under the request group's shared
+:class:`~raft_tpu.robust.retry.Deadline`:
+
+- an expired deadline is refused BEFORE any chip work
+  (:class:`~raft_tpu.robust.retry.DeadlineExceeded` — the server turns
+  it into a counted shed);
+- transient faults retry via :func:`raft_tpu.robust.retry.retry_call`
+  drawing down the SAME budget (retries can no longer stack past the
+  SLO);
+- a ladder walk that fires marks the tenant ``degraded`` (the
+  registry's health state) so the fleet sees which tenants are serving
+  on the slow path.
+
+Fault point ``serve.dispatch`` lets the chaos lane OOM, stall, or kill
+the dispatch itself.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional, Tuple
+
+from raft_tpu.obs import spans as _spans
+from raft_tpu.robust import degrade as _degrade
+from raft_tpu.robust import faults as _faults
+from raft_tpu.robust import retry as _retry
+from raft_tpu.robust.retry import Deadline, DeadlineExceeded
+from raft_tpu.serve.errors import ShedError
+from raft_tpu.serve.registry import Tenant
+
+__all__ = ["dispatch_batch", "resilient_entry", "DISPATCH_RETRY_POLICY"]
+
+# Transient-fault absorption on the dispatch path: short and fast —
+# serving latency budgets are milliseconds, so backoff starts at 10 ms
+# and the shared Deadline (not the per-site cap) is the real ceiling.
+DISPATCH_RETRY_POLICY = _retry.RetryPolicy(
+    max_attempts=3, base_delay_s=0.01, max_delay_s=0.25, jitter=0.25)
+
+
+def resilient_entry(index: Any):
+    """Resolve the degrade-ladder search entry for an index object by
+    type (IvfPqIndex → ``ivf_pq.search_resilient``, IvfFlatIndex →
+    ``ivf_flat.search_resilient``). Imports lazily so a registry of
+    flat-only tenants never pays the PQ module import."""
+    kind = type(index).__name__
+    if kind == "IvfPqIndex":
+        from raft_tpu.neighbors import ivf_pq
+
+        return ivf_pq.search_resilient
+    if kind == "IvfFlatIndex":
+        from raft_tpu.neighbors import ivf_flat
+
+        return ivf_flat.search_resilient
+    raise TypeError(
+        f"no resilient search entry for index type {kind!r} — the "
+        "serving layer dispatches IvfPqIndex / IvfFlatIndex tenants")
+
+
+def dispatch_batch(tenant: Tenant, queries, k: int,
+                   deadline: Optional[Deadline] = None,
+                   registry: Any = None) -> Tuple[Any, Any]:
+    """Run one micro-batch for ``tenant`` under the shared ``deadline``.
+
+    Returns device arrays ``(distances, ids)`` blocked-until-ready (the
+    server's latency histogram must measure delivered results, not
+    dispatch enqueue). Raises :class:`DeadlineExceeded` when the budget
+    is already gone before any chip work, :class:`ShedError`
+    (``overload``) when even the fully-degraded ladder cannot complete,
+    and propagates anything else as the tenant's failure.
+
+    ``registry`` (the tenant's :class:`~raft_tpu.serve.registry.
+    IndexRegistry`, optional) receives the degraded-health demotion
+    through its lock (``note_degraded``) when the ladder moves — an
+    unlocked write from here could race a concurrent eviction."""
+    import jax
+
+    if deadline is not None and deadline.expired:
+        # refuse doomed work before it costs chip time — queue wait
+        # already spent this request's budget
+        raise DeadlineExceeded("serve.dispatch", deadline)
+    _faults.faultpoint("serve.dispatch")
+    # snapshot the index ONCE: a concurrent pressure eviction sets
+    # tenant.index = None at any time; holding our own reference keeps
+    # the arrays alive for this batch (in-flight work completes) and an
+    # already-gone index is the typed refusal, not a NoneType crash
+    index = tenant.index
+    if index is None:
+        from raft_tpu.serve.errors import TenantUnknown
+
+        raise TenantUnknown(tenant.name, state=tenant.state)
+    search = resilient_entry(index)
+    # per-thread monotonic, NOT len(recent_steps()): the recent ring
+    # saturates at its capacity (which would silently stop
+    # degraded-health marking exactly in the sustained-overload runs it
+    # exists for), and the global ring also collects OTHER threads'
+    # ladder moves — this dispatch's walk runs in THIS stack
+    degrade_mark = _degrade.steps_seen()
+    def attempt():
+        # the deadline reaches BOTH layers: retry_call's backoff clamps
+        # to it, and the ladder inside search_resilient draws from it —
+        # one request, one budget, no per-site stacking
+        return search(index, queries, k, tenant.params,
+                      deadline=deadline)
+
+    with _spans.span("serve.dispatch") as sp:
+        try:
+            dist, ids = _retry.retry_call(
+                attempt, site="serve.dispatch",
+                policy=DISPATCH_RETRY_POLICY, deadline=deadline)
+            jax.block_until_ready((dist, ids))
+        except _degrade.DegradationExhausted as e:
+            # the ladder walked every rung and the batch still cannot
+            # run — the request group is shed, the server backs off
+            raise ShedError("overload", str(e)) from e
+        sp.annotate(tenant=tenant.name, batch=int(queries.shape[0]), k=k)
+    if _degrade.steps_seen() > degrade_mark and registry is not None:
+        # the ladder moved during this dispatch: the tenant is serving,
+        # but on a degraded configuration — surface it as health,
+        # through the registry's lock so a concurrent eviction/failure
+        # is never resurrected into residency
+        registry.note_degraded(tenant.name)
+    # a deadline that expired DURING the work is the server's call, not
+    # ours: results are correct (just late), so the front end delivers
+    # them and counts the miss per request (serve.deadline_missed)
+    return dist, ids

@@ -1,0 +1,72 @@
+"""Typed serving errors — every refusal is a type, never a hang.
+
+The serving contract (ISSUE 14): a request that cannot be served is
+REJECTED with a typed, reasoned error the client can act on — back off
+(:class:`ShedError`), fix the request (:class:`TenantUnknown`), or give
+up (:class:`~raft_tpu.robust.retry.DeadlineExceeded`). No code path may
+leave a future unresolved: the chaos lane kills, OOMs, and stalls the
+server and asserts every submitted request terminates in a result or
+one of these types.
+
+All serve errors carry ``transient = False`` so the in-process retry
+policy (:mod:`raft_tpu.robust.retry`) never blind-retries them — a shed
+under overload retried in-process IS the overload; backoff belongs to
+the *client* side of the queue.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+# the deadline type is defined with the retry policy (stdlib-only) so
+# nested retry sites and the serving layer share one budget object
+from raft_tpu.robust.retry import Deadline, DeadlineExceeded  # noqa: F401
+
+__all__ = ["ServeError", "ShedError", "TenantUnknown", "AdmissionError",
+           "Deadline", "DeadlineExceeded", "SHED_REASONS"]
+
+# The closed set of shed reasons — ``serve.shed{reason=}`` label values
+# (docs/observability.md). A new shed path must add its reason here so
+# the counter family stays enumerable for dashboards and the chaos lane.
+SHED_REASONS = ("queue_full", "deadline", "overload", "draining",
+                "not_running")
+
+
+class ServeError(RuntimeError):
+    """Base of all typed serving refusals (never retried in-process)."""
+
+    transient = False
+
+
+class ShedError(ServeError):
+    """The server declined the request to protect the ones it already
+    holds — the explicit load-shedding rejection. ``reason`` is one of
+    :data:`SHED_REASONS`; clients treat it as a backpressure signal
+    (back off + retry elsewhere/later), never as a server bug."""
+
+    def __init__(self, reason: str, detail: str = ""):
+        assert reason in SHED_REASONS, reason
+        msg = f"request shed ({reason})"
+        if detail:
+            msg += f": {detail}"
+        super().__init__(msg)
+        self.reason = reason
+
+
+class TenantUnknown(ServeError):
+    """No resident index under that tenant name (never admitted,
+    evicted, or failed) — the client addressed the wrong registry or
+    the tenant lost its residency; ``state`` says which."""
+
+    def __init__(self, name: str, state: Optional[str] = None):
+        extra = f" (state={state})" if state else ""
+        super().__init__(f"unknown tenant {name!r}{extra}")
+        self.name = name
+        self.state = state
+
+
+class AdmissionError(ServeError):
+    """The registry could not make room for a new index: the HBM budget
+    is exhausted and every resident tenant is pinned or hotter than the
+    candidate. The caller retries after evicting explicitly or admits
+    to a different chip."""

@@ -1,0 +1,183 @@
+"""Open-loop load generator — real latency-vs-throughput curves.
+
+A closed-loop client (send → wait → send) can never overload a server:
+its arrival rate collapses to the service rate and the latency curve
+flat-lines exactly where production pain begins (coordinated
+omission). This generator is **open-loop**: arrivals follow a Poisson
+process at the OFFERED rate regardless of completions, so queueing
+delay, shedding, and deadline misses show up at the rates they would
+in production.
+
+:func:`run_step` drives one offered-load step and returns a
+bench-shaped row: achieved qps, p50/p99 latency (from the PR-5
+histogram-quantile machinery — the same interpolation the bench's
+latency columns use), shed/miss/error counts. :func:`sweep` walks a
+ladder of offered loads into the latency-vs-throughput curve, and
+:func:`record` wraps rows with environment provenance
+(``runner.environment_stamp()``) so the committed
+``baselines/serve_cpu_smoke.json`` passes the benchdiff gate's
+env-refusal check like every other perf claim in the tree.
+"""
+
+from __future__ import annotations
+
+import random
+import subprocess
+import time
+from concurrent.futures import Future
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from raft_tpu.obs.metrics import Histogram
+from raft_tpu.robust.retry import DeadlineExceeded
+from raft_tpu.serve.errors import ShedError
+from raft_tpu.serve.server import MicroBatchServer, _LATENCY_BUCKETS
+
+__all__ = ["run_step", "sweep", "record"]
+
+
+def _git_commit() -> Optional[str]:
+    try:
+        return subprocess.run(
+            ["git", "rev-parse", "--short", "HEAD"],
+            capture_output=True, text=True, timeout=5,
+            cwd=__file__.rsplit("/", 3)[0]).stdout.strip() or None
+    except Exception:
+        return None
+
+
+def run_step(server: MicroBatchServer, tenant: str,
+             queries: np.ndarray, k: int,
+             offered_qps: float, duration_s: float,
+             seed: int = 0,
+             slo_s: Optional[float] = -1.0) -> Dict[str, Any]:
+    """One offered-load step: submit single-query requests at Poisson
+    arrivals of rate ``offered_qps`` for ``duration_s`` seconds (query
+    vectors cycled from ``queries``), then wait for every future and
+    tally. The arrival clock never waits on completions — that is the
+    point."""
+    rng = random.Random(seed)
+    n = queries.shape[0]
+    lat = Histogram("loadgen.latency_s", buckets=_LATENCY_BUCKETS)
+    sent = shed = missed = errors = 0
+    shed_reasons: Dict[str, int] = {}
+    inflight: List[Tuple[float, Future]] = []
+    # completion times captured by done-callbacks (fired by the
+    # batcher thread the moment the future resolves): the drain loop
+    # below must not masquerade its own pace as request latency
+    done_at: Dict[int, float] = {}
+
+    def _mark_done(fut: Future) -> None:
+        done_at[id(fut)] = time.monotonic()
+
+    t_start = time.monotonic()
+    next_arrival = t_start
+    deadline_end = t_start + duration_s
+    i = 0
+    while True:
+        now = time.monotonic()
+        if now >= deadline_end:
+            break
+        if now < next_arrival:
+            time.sleep(min(next_arrival - now, deadline_end - now))
+            continue
+        # schedule the NEXT arrival off the schedule, not off "now":
+        # submit() overhead must not thin the offered rate
+        next_arrival += rng.expovariate(offered_qps)
+        sent += 1
+        t_submit = time.monotonic()
+        try:
+            fut = server.submit(tenant, queries[i % n], k, slo_s=slo_s)
+        except ShedError as e:
+            shed += 1
+            shed_reasons[e.reason] = shed_reasons.get(e.reason, 0) + 1
+        else:
+            fut.add_done_callback(_mark_done)
+            inflight.append((t_submit, fut))
+        i += 1
+    ok = 0
+    t_last_done = t_start
+    for t_submit, fut in inflight:
+        try:
+            fut.result(timeout=30.0)
+        except DeadlineExceeded:
+            missed += 1
+        except ShedError as e:
+            shed += 1
+            shed_reasons[e.reason] = shed_reasons.get(e.reason, 0) + 1
+        except Exception:
+            errors += 1
+        else:
+            ok += 1
+            t_done = done_at.get(id(fut), time.monotonic())
+            t_last_done = max(t_last_done, t_done)
+            lat.observe(t_done - t_submit)
+    # achieved rate over the window that actually served: arrivals
+    # stopped at duration_s but queued work drains past it
+    wall = max(t_last_done, deadline_end) - t_start
+    return {
+        "offered_qps": offered_qps,
+        "duration_s": round(wall, 4),
+        "sent": sent,
+        "completed": ok,
+        "shed": shed,
+        "shed_reasons": shed_reasons,
+        "deadline_missed": missed,
+        "errors": errors,
+        "qps": round(ok / wall, 2) if wall > 0 else 0.0,
+        "latency_p50_s": lat.quantile(0.5),
+        "latency_p99_s": lat.quantile(0.99),
+        "latency_mean_s": (lat.sum / lat.count) if lat.count else None,
+    }
+
+
+def sweep(server: MicroBatchServer, tenant: str, queries: np.ndarray,
+          k: int, offered_steps: Sequence[float],
+          duration_s: float = 2.0, seed: int = 0,
+          slo_s: Optional[float] = -1.0) -> List[Dict[str, Any]]:
+    """The latency-vs-throughput curve: one :func:`run_step` per
+    offered load, in order (each step inherits the previous step's
+    thermal/queue state the way a ramping production load would)."""
+    return [run_step(server, tenant, queries, k, q, duration_s,
+                     seed=seed + j, slo_s=slo_s)
+            for j, q in enumerate(offered_steps)]
+
+
+def record(rows: List[Dict[str, Any]], dataset: str, tenant: str,
+           k: int, note: str = "") -> Dict[str, Any]:
+    """Wrap sweep rows as a benchdiff-joinable record: each row keyed
+    by (dataset, algo="serve", index=tenant, search_param={offered_qps,
+    k}, batch_size=1) and stamped with ``measured_at`` / ``git_commit``
+    / environment provenance — the same self-stamping protocol every
+    recorded perf row in the tree follows."""
+    from raft_tpu.bench import runner as _runner
+
+    env = _runner.environment_stamp()
+    measured_at = time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime())
+    commit = _git_commit()
+    detail = []
+    for r in rows:
+        detail.append({
+            "dataset": dataset, "algo": "serve", "index": tenant,
+            "search_param": {"offered_qps": r["offered_qps"], "k": k},
+            "batch_size": 1,
+            "qps": r["qps"], "recall": None,
+            "latency_p50_s": r["latency_p50_s"],
+            "latency_p99_s": r["latency_p99_s"],
+            "sent": r["sent"], "completed": r["completed"],
+            "shed": r["shed"], "shed_reasons": r["shed_reasons"],
+            "deadline_missed": r["deadline_missed"],
+            "errors": r["errors"],
+            "measured_at": measured_at, "git_commit": commit,
+            "env": env,
+        })
+    best = max((d["qps"] for d in detail), default=0.0)
+    return {
+        "metric": "serve_qps_cpu",
+        "value": best,
+        "unit": "completed requests/s",
+        "total_rows": len(detail),
+        "baseline_note": note,
+        "detail": detail,
+    }

@@ -1,0 +1,322 @@
+"""Multi-tenant index registry — N resident indexes sharing one chip's HBM.
+
+The serving story's capacity half (ISSUE 14): "millions of users" means
+many *indexes*, not one — per-customer collections, per-language
+shards, staging-vs-prod twins — and one chip's HBM is the scarce thing
+they share. The registry makes residency an explicit, observable
+policy instead of an allocator surprise:
+
+- **admission** — :meth:`IndexRegistry.admit` sizes the candidate
+  (every device-resident pytree leaf) against the HBM budget. The
+  budget comes from the PR-1 HBM gauges (``obs.hbm.bytes_limit``) when
+  the backend reports one, minus a configurable headroom fraction for
+  scan transients; backends that report nothing (the CPU test mesh)
+  take an explicit ``budget_bytes``.
+- **eviction** — when a new tenant doesn't fit, the registry sheds the
+  least-recently-used *cold* resident (never pinned tenants) until it
+  does, or refuses with a typed :class:`~raft_tpu.serve.errors.
+  AdmissionError`. Every move is counted:
+  ``serve.registry.admit{tenant=}`` / ``serve.registry.evict{tenant=,
+  reason=}``, with ``serve.registry.resident_bytes`` gauging the fleet.
+- **health** — each tenant carries an explicit state machine
+  (``warming → serving → degraded``, terminal ``evicted`` / ``failed``)
+  so dispatch can refuse, a dashboard can page, and the chaos lane can
+  assert on the transition instead of inferring it from crashes.
+
+Fault point ``serve.registry.admit`` lets the chaos lane force
+admission-time failures (an OOM while warming a tenant must mark it
+``failed``, not wedge the registry lock).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import threading
+import time
+from typing import Any, Dict, List, Optional
+
+from raft_tpu.core import logging as _log
+from raft_tpu.obs import hbm as _hbm
+from raft_tpu.obs import spans as _spans
+from raft_tpu.robust import faults as _faults
+from raft_tpu.serve.errors import AdmissionError, TenantUnknown
+
+__all__ = ["Tenant", "IndexRegistry", "index_device_bytes",
+           "HEALTH_STATES"]
+
+# The tenant state machine. RESIDENT states hold HBM; terminal states
+# keep the Tenant record (for "why is my tenant gone" forensics) but
+# not the index.
+HEALTH_STATES = ("warming", "serving", "degraded", "evicted", "failed")
+_RESIDENT = ("warming", "serving", "degraded")
+
+# CPU/test-mesh fallback budget when the backend reports no bytes_limit
+# and the caller pins none: generous enough for test tenants, small
+# enough that a runaway admission loop still trips AdmissionError.
+DEFAULT_BUDGET_BYTES = 8 << 30
+
+
+def index_device_bytes(index: Any) -> int:
+    """HBM residency estimate for an index: the sum of every array
+    leaf's ``nbytes`` in the pytree. Host-resident leaves (numpy) count
+    too — an index admitted from host memory lands on device at first
+    dispatch, so admission must budget for where it is *going*."""
+    import jax
+
+    total = 0
+    for leaf in jax.tree_util.tree_leaves(index):
+        nbytes = getattr(leaf, "nbytes", None)
+        if nbytes is not None:
+            total += int(nbytes)
+    return total
+
+
+@dataclasses.dataclass
+class Tenant:
+    """One resident index + its serving policy and health."""
+
+    name: str
+    index: Any
+    params: Any = None            # default SearchParams for dispatch
+    default_k: int = 10
+    # the tenant's CLOSED k surface: the server AOT-warms every
+    # (bucket × k) in this set and submit() rejects anything outside it
+    # — an un-warmed k would recompile on the serving path, a
+    # head-of-line latency spike the recompile_budget(0) contract bans
+    serve_ks: tuple = ()
+    size_bytes: int = 0
+    pinned: bool = False          # never auto-evicted
+    state: str = "warming"
+    admitted_at: float = 0.0
+    last_used: float = 0.0        # monotonic; the LRU eviction key
+    requests: int = 0
+
+    def describe(self) -> Dict[str, Any]:
+        """Registry snapshot row (flight dumps / debugging)."""
+        return {"name": self.name, "state": self.state,
+                "size_bytes": self.size_bytes, "pinned": self.pinned,
+                "requests": self.requests}
+
+
+def _count(name: str, labels: Dict[str, str]) -> None:
+    if _spans.enabled():
+        _spans.registry().inc(name, labels=labels)
+
+
+def _gauge(name: str, value: float) -> None:
+    if _spans.enabled():
+        _spans.registry().gauge(name).set(value)
+
+
+class IndexRegistry:
+    """Thread-safe registry of resident tenants under one HBM budget.
+
+    ``budget_bytes=None`` reads the device's ``bytes_limit`` HBM gauge
+    (PR 1), falling back to :data:`DEFAULT_BUDGET_BYTES` on backends
+    that report nothing; ``headroom_frac`` of the budget is reserved
+    for scan/refine transients (the working set a search needs beyond
+    the index itself)."""
+
+    def __init__(self, budget_bytes: Optional[int] = None,
+                 headroom_frac: float = 0.10):
+        if budget_bytes is None:
+            budget_bytes = _hbm.bytes_limit(default=DEFAULT_BUDGET_BYTES)
+        if not 0.0 <= headroom_frac < 1.0:
+            raise ValueError(f"headroom_frac {headroom_frac} not in [0, 1)")
+        self.budget_bytes = int(budget_bytes)
+        self.headroom_frac = float(headroom_frac)
+        self._tenants: Dict[str, Tenant] = {}
+        self._lock = threading.RLock()
+
+    # -- capacity -----------------------------------------------------------
+    @property
+    def usable_bytes(self) -> int:
+        """The admission ceiling: budget minus transient headroom."""
+        return int(self.budget_bytes * (1.0 - self.headroom_frac))
+
+    def resident_bytes(self) -> int:
+        with self._lock:
+            return sum(t.size_bytes for t in self._tenants.values()
+                       if t.state in _RESIDENT)
+
+    def _evict_candidates(self) -> List[Tenant]:
+        """Evictable residents, coldest first (LRU by last dispatch)."""
+        return sorted((t for t in self._tenants.values()
+                       if t.state in _RESIDENT and not t.pinned),
+                      key=lambda t: t.last_used)
+
+    # -- lifecycle ----------------------------------------------------------
+    def admit(self, name: str, index: Any, *, params: Any = None,
+              default_k: int = 10, ks: Optional[Any] = None,
+              pinned: bool = False,
+              size_bytes: Optional[int] = None) -> Tenant:
+        """Admit ``index`` as tenant ``name``, evicting LRU cold
+        tenants as needed to fit under :attr:`usable_bytes`. Raises
+        :class:`AdmissionError` when the index cannot fit even after
+        shedding every evictable resident (or is alone too big for the
+        budget). ``ks`` enumerates the tenant's served k values
+        (default: just ``default_k``) — the server warms exactly this
+        set and refuses others. Re-admitting a live name replaces it.
+        Admission is
+        all-or-nothing: the eviction set (including a replaced prior)
+        is PLANNED before anything is released, so a refused admission
+        leaves every resident tenant — the prior under this name
+        included — exactly as it was (a failed hot-swap must not
+        destroy the serving tenant)."""
+        _faults.faultpoint("serve.registry.admit")
+        size = index_device_bytes(index) if size_bytes is None \
+            else int(size_bytes)
+        with self._lock:
+            if size > self.usable_bytes:
+                raise AdmissionError(
+                    f"tenant {name!r} needs {size:,} B but the usable "
+                    f"budget is {self.usable_bytes:,} B "
+                    f"({self.budget_bytes:,} B minus "
+                    f"{self.headroom_frac:.0%} headroom)")
+            prior = self._tenants.get(name)
+            replacing = prior is not None and prior.state in _RESIDENT
+            # simulate first: the prior's bytes come back for free, then
+            # LRU victims until the candidate fits — or nobody moves
+            projected = self.resident_bytes()
+            if replacing:
+                projected -= prior.size_bytes
+            victims: List[Tenant] = []
+            for cand in self._evict_candidates():
+                if projected + size <= self.usable_bytes:
+                    break
+                if cand.name == name:
+                    continue  # the prior is accounted above
+                victims.append(cand)
+                projected -= cand.size_bytes
+            if projected + size > self.usable_bytes:
+                raise AdmissionError(
+                    f"tenant {name!r} ({size:,} B) does not fit: "
+                    f"{self.resident_bytes():,} B resident are pinned "
+                    f"or un-evictable under the {self.usable_bytes:,} B "
+                    "usable budget")
+            # commit: the admission is now guaranteed to succeed
+            for victim in victims:
+                self._evict_locked(victim, reason="pressure")
+            if replacing:
+                self._evict_locked(prior, reason="replaced")
+            now = time.monotonic()
+            serve_ks = tuple(sorted({int(k) for k in (ks or [default_k])}
+                                    | {int(default_k)}))
+            tenant = Tenant(name=name, index=index, params=params,
+                            default_k=default_k, serve_ks=serve_ks,
+                            size_bytes=size,
+                            pinned=pinned, state="warming",
+                            admitted_at=now, last_used=now)
+            self._tenants[name] = tenant
+            _count("serve.registry.admit", {"tenant": name})
+            _gauge("serve.registry.resident_bytes", self.resident_bytes())
+            _log.info("registry: admitted %r (%s B, pinned=%s, "
+                      "%d resident)", name, f"{size:,}", pinned,
+                      len(self.resident()))
+            return tenant
+
+    def _evict_locked(self, tenant: Tenant, reason: str) -> None:
+        tenant.state = "evicted"
+        tenant.index = None  # drop the reference; GC frees the HBM
+        _count("serve.registry.evict",
+               {"tenant": tenant.name, "reason": reason})
+        _gauge("serve.registry.resident_bytes", self.resident_bytes())
+        _log.warn("registry: evicted %r (%s)", tenant.name, reason)
+
+    def evict(self, name: str, reason: str = "manual") -> None:
+        """Explicitly release a tenant's residency (idempotent on
+        already-terminal tenants; unknown names raise)."""
+        with self._lock:
+            tenant = self._tenants.get(name)
+            if tenant is None:
+                raise TenantUnknown(name)
+            if tenant.state in _RESIDENT:
+                self._evict_locked(tenant, reason=reason)
+
+    def mark(self, name: str, state: str) -> None:
+        """Health transition (``warming``/``serving``/``degraded``/
+        ``failed``/``evicted``). Terminal states release the index:
+        ``evicted`` routes through the same path as :meth:`evict`
+        (counted, gauge updated) and ``failed`` drops the reference —
+        either way a terminal tenant can never pin HBM that
+        ``resident_bytes()`` no longer counts."""
+        assert state in HEALTH_STATES, state
+        with self._lock:
+            tenant = self._tenants.get(name)
+            if tenant is None:
+                raise TenantUnknown(name)
+            if tenant.state not in _RESIDENT:
+                # terminal states are FINAL: a slow lock-free warmup
+                # finishing with mark("serving") after a concurrent
+                # pressure eviction must not resurrect an index-less
+                # record into residency (phantom resident_bytes + an
+                # untyped NoneType crash at the next dispatch)
+                return
+            if state == "evicted":
+                self._evict_locked(tenant, reason="manual")
+                return
+            tenant.state = state
+            if state == "failed":
+                tenant.index = None
+                _gauge("serve.registry.resident_bytes",
+                       self.resident_bytes())
+
+    def note_degraded(self, name: str) -> None:
+        """Lock-protected health demotion from dispatch: a live tenant
+        whose ladder moved becomes ``degraded``; anything else —
+        terminal states above all — is left alone (an unlocked
+        check-then-set from the batcher could otherwise resurrect a
+        concurrently-evicted record into residency). Unknown names are
+        a no-op: the tenant may have been dropped mid-dispatch."""
+        with self._lock:
+            tenant = self._tenants.get(name)
+            if tenant is not None and tenant.state in ("warming",
+                                                       "serving"):
+                tenant.state = "degraded"
+
+    # -- lookup -------------------------------------------------------------
+    def peek(self, name: str) -> Tenant:
+        """Side-effect-free lookup: resolves a RESIDENT tenant WITHOUT
+        touching its LRU clock. The validation lookup — submit-time
+        checks (and warmup) must not heat a tenant's eviction recency:
+        a flood of shed/invalid traffic would otherwise keep a tenant
+        LRU-hot while quieter tenants actually serving requests get
+        evicted. Unknown or terminal tenants raise
+        :class:`TenantUnknown`."""
+        with self._lock:
+            tenant = self._tenants.get(name)
+            if tenant is None:
+                raise TenantUnknown(name)
+            if tenant.state not in _RESIDENT:
+                raise TenantUnknown(name, state=tenant.state)
+            return tenant
+
+    def get(self, name: str) -> Tenant:
+        """The dispatch lookup: :meth:`peek` + touch the LRU clock
+        (``last_used`` = last *dispatched*, the eviction recency key).
+        ``Tenant.requests`` is accounted by the server per accepted
+        request, not here."""
+        with self._lock:
+            tenant = self.peek(name)
+            tenant.last_used = time.monotonic()
+            return tenant
+
+    def resident(self) -> List[Tenant]:
+        """Resident tenants (any health), admission order."""
+        with self._lock:
+            return [t for t in self._tenants.values()
+                    if t.state in _RESIDENT]
+
+    def tenants(self) -> List[Tenant]:
+        """All tenants including terminal ones (forensics)."""
+        with self._lock:
+            return list(self._tenants.values())
+
+    def describe(self) -> Dict[str, Any]:
+        """Snapshot for flight dumps / logs."""
+        with self._lock:
+            return {"budget_bytes": self.budget_bytes,
+                    "usable_bytes": self.usable_bytes,
+                    "resident_bytes": self.resident_bytes(),
+                    "tenants": [t.describe()
+                                for t in self._tenants.values()]}

@@ -146,6 +146,7 @@ class TestDistributedBuildParity:
         _, ids2 = ivf_pq.search(sharded, q, 1, sp, mesh=mesh)
         assert (np.asarray(ids2)[:, 0] == np.arange(16)).mean() >= 0.8
 
+    @pytest.mark.slow  # own distributed build for a refusal path; CI lanes run it (tier-1 budget)
     def test_assemble_refuses_unknown_capacity(self, mesh, data):
         from raft_tpu.parallel import build_ivf_pq as spmd_build
 

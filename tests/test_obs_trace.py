@@ -268,6 +268,66 @@ class TestFlightRecorder:
         assert signal_dumps, [d["reason"] for d in docs]
         assert signal_dumps[0]["schema"] == flight.SCHEMA
 
+    def test_sigint_leaves_parseable_dump_and_chains(self, tmp_path):
+        """ISSUE 14 satellite, mirroring the SIGTERM test: a Ctrl-C'd
+        serving process must keep its flight dump — SIGINT is now in
+        DEFAULT_SIGNALS — and the prior handler (the app's own, or
+        Python's default KeyboardInterrupt) still runs after it."""
+        assert "SIGINT" in flight.DEFAULT_SIGNALS
+        code = (
+            "import sys, os, signal, time\n"
+            f"sys.path.insert(0, {ROOT!r})\n"
+            "def prior(num, frame):\n"
+            "    print('prior-handler', flush=True)\n"
+            "    os._exit(8)\n"
+            "signal.signal(signal.SIGINT, prior)\n"
+            "from raft_tpu.obs import flight\n"
+            f"flight.install({str(tmp_path)!r}, every_s=0)\n"
+            "print('armed', flush=True)\n"
+            "time.sleep(60)\n"
+        )
+        p = subprocess.Popen([sys.executable, "-c", code],
+                             stdout=subprocess.PIPE, text=True)
+        assert p.stdout.readline().strip() == "armed"
+        p.send_signal(signal.SIGINT)
+        out, _ = p.communicate(timeout=30)
+        assert "prior-handler" in out  # Ctrl-C semantics preserved
+        assert p.returncode == 8
+        docs = []
+        for name in sorted(os.listdir(tmp_path)):
+            if name.startswith("flight_") and name.endswith(".json"):
+                with open(os.path.join(str(tmp_path), name)) as f:
+                    docs.append(json.load(f))
+        signal_dumps = [d for d in docs
+                        if d["reason"].startswith("signal")]
+        assert signal_dumps, [d["reason"] for d in docs]
+        assert signal_dumps[0]["schema"] == flight.SCHEMA
+
+    def test_sigint_default_disposition_raises_keyboardinterrupt(
+            self, tmp_path):
+        """Without an app handler, the chained SIGINT must still land
+        as KeyboardInterrupt (the recorder observes the death, it does
+        not change it)."""
+        code = (
+            "import sys, time\n"
+            f"sys.path.insert(0, {ROOT!r})\n"
+            "from raft_tpu.obs import flight\n"
+            f"flight.install({str(tmp_path)!r}, every_s=0)\n"
+            "print('armed', flush=True)\n"
+            "try:\n"
+            "    time.sleep(60)\n"
+            "except KeyboardInterrupt:\n"
+            "    print('kbd-interrupt', flush=True)\n"
+            "    raise SystemExit(9)\n"
+        )
+        p = subprocess.Popen([sys.executable, "-c", code],
+                             stdout=subprocess.PIPE, text=True)
+        assert p.stdout.readline().strip() == "armed"
+        p.send_signal(signal.SIGINT)
+        out, _ = p.communicate(timeout=30)
+        assert "kbd-interrupt" in out
+        assert p.returncode == 9
+
 
 class TestFlightDumpDurability:
     """ISSUE 7 satellite: the dump path must never expose a partial
@@ -459,3 +519,49 @@ class TestObsdumpFlight:
         assert "2e+09" in out or "2.000e+09" in out or "2e+9" in out
         assert "ivf_flat.search:sleep" in out
         assert "native->halve_batch [mem_guard]" in out
+
+    def test_renders_serve_family_and_shed_tables(self, tmp_path):
+        """ISSUE 14 satellite: a serving run's flight dump leads with
+        the serve.* tables — per-tenant traffic, shed-by-reason +
+        deadline misses, and the served latency quantiles."""
+        from tools import obsdump
+
+        reg = MetricsRegistry()
+        reg.inc("serve.requests", 41, labels={"tenant": "acme"})
+        reg.inc("serve.warmup", 4, labels={"tenant": "acme"})
+        reg.inc("serve.registry.admit", 1, labels={"tenant": "acme"})
+        reg.inc("serve.registry.evict", 1,
+                labels={"tenant": "acme", "reason": "pressure"})
+        reg.inc("serve.shed", 7, labels={"reason": "queue_full"})
+        reg.inc("serve.shed", 2, labels={"reason": "deadline"})
+        reg.inc("serve.deadline_missed", 3)
+        h = reg.histogram("serve.latency_s",
+                          buckets=[0.001, 0.01, 0.1, 1.0])
+        for v in (0.004, 0.006, 0.05):
+            h.observe(v)
+        reg.histogram("serve.batch_fill", buckets=[0.5, 1.0]).observe(0.75)
+        rec = flight.FlightRecorder(str(tmp_path))
+        obs.enable(registry=reg, hbm=False)
+        try:
+            path = rec.dump(reason="serve-render")
+        finally:
+            obs.disable()
+            rec.close()
+        out = obsdump.render(path, top=5)
+        assert "serving (serve.*)" in out
+        assert "acme" in out and "41" in out
+        assert "shed / deadline" in out
+        assert "queue_full" in out and "7" in out
+        assert "deadline_missed" in out and "3" in out
+        assert "0.75" in out  # mean batch fill
+        # a dump with no serve activity renders no serve section
+        reg2 = MetricsRegistry()
+        reg2.histogram("span.x").observe(0.1)
+        rec2 = flight.FlightRecorder(str(tmp_path))
+        obs.enable(registry=reg2, hbm=False)
+        try:
+            path2 = rec2.dump(reason="no-serve")
+        finally:
+            obs.disable()
+            rec2.close()
+        assert "serving (serve.*)" not in obsdump.render(path2, top=5)

@@ -90,6 +90,7 @@ class TestShardedIvfPq:
         shards_hit = np.unique(np.asarray(ids) // shard_n)
         assert len(shards_hit) >= 4
 
+    @pytest.mark.slow  # heavy sharded-build twin; CI lanes run it (tier-1 budget)
     def test_index_size_counts_all_rows(self, mesh, data):
         dataset, _ = data
         params = ivf_pq.IndexParams(n_lists=32, pq_dim=16, kmeans_n_iters=4)
@@ -97,6 +98,7 @@ class TestShardedIvfPq:
         # capacity overflow may drop a few rows; the bulk must be packed
         assert sharded.size >= int(0.98 * len(dataset))
 
+    @pytest.mark.slow  # heavy sharded-build twin; CI lanes run it (tier-1 budget)
     def test_inner_product_metric(self, mesh, data):
         dataset, queries = data
         k = 10
@@ -131,6 +133,7 @@ class TestShardedIvfFlat:
         assert r8 >= r1 - 0.08, f"sharded {r8:.3f} vs single {r1:.3f}"
         assert (np.asarray(ids_8) >= 0).all()
 
+    @pytest.mark.slow  # heavy sharded-build twin; CI lanes run it (tier-1 budget)
     def test_exact_within_probed_lists(self, mesh, data):
         """With n_probes = n_lists the sharded scan is exhaustive → recall
         1.0 (IVF-Flat stores raw vectors; no quantization error)."""
@@ -277,6 +280,7 @@ class TestShardedFusedPipeline:
         d0 = float(((queries[0] - row) ** 2).sum())
         np.testing.assert_allclose(va_np[0, 0], d0, rtol=1e-4)
 
+    @pytest.mark.slow  # heavy sharded-build twin; CI lanes run it (tier-1 budget)
     def test_refined_needs_dataset(self, mesh, data):
         dataset, _ = data
         params = ivf_pq.IndexParams(n_lists=16, pq_dim=16,

@@ -222,6 +222,7 @@ class TestRingFallbackParity:
     """The ppermute fallback inside real sharded searches: identical
     results to the allgather tier (same candidates, same selection)."""
 
+    @pytest.mark.slow  # impl-twin parity; the CI ring smoke + pytest lane re-assert it (tier-1 budget)
     def test_sharded_knn_ring_matches_allgather(self, mesh, rng):
         x = jnp.asarray(rng.random((803, 16), dtype=np.float32))
         q = jnp.asarray(rng.random((27, 16), dtype=np.float32))
@@ -345,6 +346,7 @@ class TestMergeTierDispatch:
         assert ring_auto_wanted(256, 10, 8)
         assert ring_auto_wanted(64, 10, 2)
 
+    @pytest.mark.slow  # full sharded trace for a validation path; CI lanes run it (tier-1 budget)
     def test_sharded_search_validates_queries(self, mesh, rng):
         # the sharded entry keeps the single-chip contract: bad query
         # dims fail the clear expects, not a shape error in shard_map
@@ -380,6 +382,7 @@ class TestRingBytes:
         yield reg
         obs.disable()
 
+    @pytest.mark.slow  # exact hop-byte model; CI lanes + the dryrun byte assertions cover it (tier-1 budget)
     def test_ring_hop_bytes_exact(self, mesh, reg, rng):
         m, k = 27, 10
         x = jnp.asarray(rng.random((803, 16), dtype=np.float32))
@@ -508,6 +511,7 @@ class TestRingFusedScan:
         return search_ivf_pq(sp, idx, q, k, mesh, merge=merge,
                              filter_bitset=filter_bitset)
 
+    @pytest.mark.slow  # parity twin re-asserted by the dryrun fused-identity leg; CI runs it (tier-1 budget)
     def test_fused_matches_unfused(self, mesh, rng, pq_sharded,
                                    monkeypatch):
         idx, _ = pq_sharded

@@ -224,6 +224,154 @@ class TestRetry:
 
 
 # ---------------------------------------------------------------------------
+# the shared request Deadline (ISSUE 14 satellite)
+# ---------------------------------------------------------------------------
+
+class _FakeClock:
+    def __init__(self):
+        self.t = 0.0
+
+    def __call__(self):
+        return self.t
+
+
+class TestDeadline:
+    def test_remaining_and_expiry(self):
+        clk = _FakeClock()
+        d = retry.Deadline(2.0, clock=clk)
+        assert d.remaining() == pytest.approx(2.0) and not d.expired
+        clk.t = 1.5
+        assert d.remaining() == pytest.approx(0.5)
+        clk.t = 2.5
+        assert d.expired and d.remaining() == pytest.approx(-0.5)
+        assert "deadline" in repr(d)
+
+    def test_unbounded_never_expires(self):
+        d = retry.Deadline(None)
+        assert d.remaining() == float("inf") and not d.expired
+        assert "unbounded" in d.describe()
+
+    def test_deadline_exceeded_is_never_retryable(self):
+        # the message must not collide with the grpc DEADLINE_EXCEEDED
+        # transient marker: transient=False pins the classification
+        e = retry.DeadlineExceeded("site", retry.Deadline(0.0))
+        assert e.transient is False
+        assert not retry.default_retryable(e)
+
+    def test_expired_deadline_refuses_first_attempt(self):
+        clk = _FakeClock()
+        d = retry.Deadline(1.0, clock=clk)
+        clk.t = 2.0
+        calls = []
+        st = {}
+        with pytest.raises(retry.DeadlineExceeded) as ei:
+            retry.retry_call(lambda: calls.append(1), site="s",
+                             deadline=d, stats=st, sleep=lambda s: None)
+        assert not calls and st["outcome"] == "deadline"
+        assert ei.value.site == "s" and ei.value.deadline is d
+
+    def test_exhaustion_mid_backoff(self):
+        """The satellite's named case: a backoff sleep that would
+        outlive the shared budget gives up instead of sleeping past
+        the SLO — never actually sleeps, and surfaces the DEADLINE
+        type (the request's budget ran out, not the site's policy) so
+        the serving layer counts an SLO shed, not a tenant error."""
+        clk = _FakeClock()
+        d = retry.Deadline(1.0, clock=clk)
+        clk.t = 0.9  # 0.1 s left; the next backoff wants 5 s
+        fn = _Flaky(9, lambda: OSError("x"))
+        slept = []
+        st = {}
+        policy = retry.RetryPolicy(max_attempts=10, base_delay_s=5.0,
+                                   jitter=0.0)
+        with pytest.raises(retry.DeadlineExceeded) as ei:
+            retry.retry_call(fn, site="s", policy=policy, deadline=d,
+                             stats=st, sleep=slept.append)
+        assert ei.value.deadline is d and not slept
+        assert st["attempts"] == 1 and st["outcome"] == "deadline"
+        # the per-site policy budget alone still reads as exhausted
+        fn2 = _Flaky(9, lambda: OSError("x"))
+        with pytest.raises(retry.RetryExhausted):
+            retry.retry_call(
+                fn2, site="s", sleep=slept.append,
+                policy=retry.RetryPolicy(max_attempts=10,
+                                         base_delay_s=5.0, jitter=0.0,
+                                         deadline_s=4.0))
+
+    def test_shared_budget_spans_sites(self):
+        """Two nested retry sites draw down ONE budget: the first
+        site's backoff spend removes headroom from the second — no
+        per-site deadline stacking."""
+        clk = _FakeClock()
+        d = retry.Deadline(1.0, clock=clk)
+
+        def sleeper(s):
+            clk.t += s
+
+        policy = retry.RetryPolicy(max_attempts=5, base_delay_s=0.4,
+                                   multiplier=1.0, jitter=0.0)
+        # site A: one failure + one 0.4 s backoff, then success
+        assert retry.retry_call(_Flaky(1, lambda: OSError("x")),
+                                site="a", policy=policy, deadline=d,
+                                sleep=sleeper) == "ok"
+        assert d.remaining() == pytest.approx(0.6)
+        # site B alone would retry 4 times under its per-site policy,
+        # but only one more 0.4 s backoff fits the shared budget —
+        # whose exhaustion surfaces as the DEADLINE type
+        fn = _Flaky(9, lambda: OSError("x"))
+        st = {}
+        with pytest.raises(retry.DeadlineExceeded):
+            retry.retry_call(fn, site="b", policy=policy, deadline=d,
+                             stats=st, sleep=sleeper)
+        assert st["attempts"] == 2 and st["outcome"] == "deadline"
+
+    def test_ladder_aborts_on_expired_deadline(self):
+        reg = MetricsRegistry()
+        obs.enable(registry=reg, hbm=False)
+        clk = _FakeClock()
+        d = retry.Deadline(1.0, clock=clk)
+
+        def call(knobs):
+            clk.t += 2.0  # the attempt itself burns the budget
+            raise RuntimeError("RESOURCE_EXHAUSTED: oom")
+
+        ladder = degrade.Ladder([degrade.Step(
+            "shrink", lambda kn: dict(kn, shrunk=True))])
+        with pytest.raises(retry.DeadlineExceeded):
+            degrade.run_with_degradation(call, {}, ladder, site="s",
+                                         deadline=d)
+        c = _counters(reg)
+        assert c["degrade.deadline_abort{site=s}"] == 1.0
+        # the rung was NEVER taken: the budget died first
+        assert "degrade.steps{from=native,reason=resource_exhausted," \
+            "site=s,to=shrink}" not in c
+
+    def test_batched_call_abandons_split_past_deadline(self):
+        clk = _FakeClock()
+        d = retry.Deadline(1.0, clock=clk)
+        seen = []
+
+        def search_fn(index, q, k, p, fb, ds):
+            seen.append(q.shape[0])
+            clk.t += 1.1  # the first sub-batch overruns the budget
+            return jnp.zeros((q.shape[0], k)), jnp.zeros(
+                (q.shape[0], k), jnp.int32)
+
+        queries = jnp.zeros((8, 4))
+        call = degrade.batched_search_call(search_fn, None, queries, 3,
+                                           None, deadline=d, site="s")
+        with pytest.raises(retry.DeadlineExceeded):
+            call({"params": None, "max_batch": 4})
+        assert seen == [4]  # second sub-batch abandoned, not computed
+
+    def test_unbounded_deadline_changes_nothing(self, pq_index=None):
+        fn = _Flaky(1, lambda: OSError("x"))
+        assert retry.retry_call(fn, site="s",
+                                deadline=retry.Deadline(None),
+                                sleep=lambda s: None) == "ok"
+
+
+# ---------------------------------------------------------------------------
 # degrade
 # ---------------------------------------------------------------------------
 

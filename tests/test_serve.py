@@ -1,0 +1,664 @@
+"""raft_tpu.serve — resilient online serving (ISSUE 14 tentpole).
+
+The chaos-lane contract under test: injected OOM mid-batch walks the
+degrade ladder and returns exact results; a full queue rejects with a
+typed shed error (never a hang); registry eviction under synthetic HBM
+pressure picks the LRU cold tenant; an injected SIGTERM leaves a
+parseable flight dump carrying the serve counters; and steady-state
+serving triggers ZERO recompiles under ``recompile_budget(0)``.
+"""
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from raft_tpu import obs
+from raft_tpu.obs import sanitize
+from raft_tpu.obs.metrics import MetricsRegistry
+from raft_tpu.neighbors import ivf_flat, ivf_pq
+from raft_tpu.robust import degrade, faults, retry
+from raft_tpu import serve
+from raft_tpu.serve import loadgen
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+N, DIM = 3000, 32
+
+
+@pytest.fixture(autouse=True)
+def _clean():
+    faults.clear_plan()
+    degrade.clear_recent()
+    yield
+    faults.clear_plan()
+    obs.disable()
+
+
+@pytest.fixture(scope="module")
+def data():
+    rng = np.random.default_rng(0)
+    return rng.random((N, DIM), dtype=np.float32)
+
+
+@pytest.fixture(scope="module")
+def pq_index(data):
+    return ivf_pq.build(jnp.asarray(data), ivf_pq.IndexParams(
+        n_lists=16, pq_dim=16, seed=0, cache_reconstruction="never"))
+
+
+@pytest.fixture(scope="module")
+def flat_index(data):
+    return ivf_flat.build(jnp.asarray(data),
+                          ivf_flat.IndexParams(n_lists=16))
+
+
+PQ_PARAMS = ivf_pq.SearchParams(n_probes=8, scan_mode="per_query")
+
+
+def _registry_with(pq_index, flat_index=None):
+    reg = serve.IndexRegistry(budget_bytes=1 << 30)
+    reg.admit("pq", pq_index, params=PQ_PARAMS, default_k=10)
+    if flat_index is not None:
+        reg.admit("flat", flat_index,
+                  params=ivf_flat.SearchParams(n_probes=8), default_k=10)
+    return reg
+
+
+def _counters(reg):
+    return reg.snapshot()["counters"]
+
+
+# ---------------------------------------------------------------------------
+# buckets
+# ---------------------------------------------------------------------------
+
+class TestBuckets:
+    def test_bucket_sizes_are_powers_of_two(self):
+        assert serve.bucket_sizes(8) == (1, 2, 4, 8)
+        assert serve.bucket_sizes(1) == (1,)
+        assert serve.bucket_sizes(5) == (1, 2, 4, 8)  # rounded up
+
+    def test_bucket_for_picks_smallest_fit(self):
+        b = serve.bucket_sizes(16)
+        assert serve.bucket_for(1, b) == 1
+        assert serve.bucket_for(3, b) == 4
+        assert serve.bucket_for(16, b) == 16
+
+    def test_invalid_max_batch(self):
+        with pytest.raises(ValueError):
+            serve.bucket_sizes(0)
+
+
+# ---------------------------------------------------------------------------
+# registry
+# ---------------------------------------------------------------------------
+
+class TestRegistry:
+    def test_admit_get_touch_and_peek(self):
+        reg = serve.IndexRegistry(budget_bytes=1000)
+        reg.admit("a", object(), size_bytes=100)
+        t = reg.get("a")
+        assert t.state == "warming"
+        before = t.last_used
+        time.sleep(0.005)
+        # peek validates without heating the LRU clock; get touches it
+        assert reg.peek("a").last_used == before
+        assert reg.get("a").last_used > before
+        with pytest.raises(serve.TenantUnknown):
+            reg.peek("nope")
+
+    def test_index_device_bytes_counts_leaves(self, pq_index):
+        nbytes = serve.index_device_bytes(pq_index)
+        # at minimum the packed codes + ids + norms are in there
+        assert nbytes > int(pq_index.packed_codes.nbytes)
+
+    def test_unknown_and_terminal_tenants_are_typed(self):
+        reg = serve.IndexRegistry(budget_bytes=1000)
+        with pytest.raises(serve.TenantUnknown):
+            reg.get("nope")
+        reg.admit("a", object(), size_bytes=10)
+        reg.evict("a")
+        with pytest.raises(serve.TenantUnknown) as ei:
+            reg.get("a")
+        assert ei.value.state == "evicted"
+
+    def test_eviction_under_pressure_picks_lru_cold_tenant(self):
+        """The ISSUE's named chaos case: synthetic HBM pressure (tight
+        byte budget) must evict the LEAST-recently-used tenant, not the
+        hottest one."""
+        mreg = MetricsRegistry()
+        obs.enable(registry=mreg, hbm=False)
+        reg = serve.IndexRegistry(budget_bytes=400, headroom_frac=0.0)
+        for name in ("t1", "t2", "t3"):
+            reg.admit(name, object(), size_bytes=100)
+        time.sleep(0.002)
+        reg.get("t1")  # t1 and t3 are hot, t2 is the cold one
+        reg.get("t3")
+        reg.admit("t4", object(), size_bytes=150)  # needs one eviction
+        states = {t.name: t.state for t in reg.tenants()}
+        assert states == {"t1": "warming", "t2": "evicted",
+                          "t3": "warming", "t4": "warming"}
+        c = _counters(mreg)
+        assert c["serve.registry.evict{reason=pressure,tenant=t2}"] == 1.0
+        assert c["serve.registry.admit{tenant=t4}"] == 1.0
+        assert reg.resident_bytes() == 350
+
+    def test_pinned_tenants_survive_pressure(self):
+        reg = serve.IndexRegistry(budget_bytes=300, headroom_frac=0.0)
+        reg.admit("pinned", object(), size_bytes=200, pinned=True)
+        with pytest.raises(serve.AdmissionError):
+            reg.admit("big", object(), size_bytes=200)
+        assert reg.get("pinned").state == "warming"
+
+    def test_oversized_tenant_refused_outright(self):
+        reg = serve.IndexRegistry(budget_bytes=100, headroom_frac=0.1)
+        with pytest.raises(serve.AdmissionError, match="usable budget"):
+            reg.admit("big", object(), size_bytes=95)
+
+    def test_readmit_replaces(self):
+        mreg = MetricsRegistry()
+        obs.enable(registry=mreg, hbm=False)
+        reg = serve.IndexRegistry(budget_bytes=1000, headroom_frac=0.0)
+        reg.admit("a", object(), size_bytes=900)
+        reg.admit("a", object(), size_bytes=800)  # replaces, must fit
+        assert reg.resident_bytes() == 800
+        c = _counters(mreg)
+        assert c["serve.registry.evict{reason=replaced,tenant=a}"] == 1.0
+
+    def test_failed_hot_swap_keeps_the_serving_tenant(self):
+        """Review hardening: a replacement that cannot fit must refuse
+        WITHOUT destroying the tenant it would have replaced — and
+        without evicting any bystander."""
+        reg = serve.IndexRegistry(budget_bytes=1000, headroom_frac=0.0)
+        prod = object()
+        reg.admit("prod", prod, size_bytes=600)
+        reg.admit("pinned_other", object(), size_bytes=300, pinned=True)
+        with pytest.raises(serve.AdmissionError):
+            reg.admit("prod", object(), size_bytes=1100)  # > budget
+        with pytest.raises(serve.AdmissionError):
+            # fits the budget alone, but not beside the pinned
+            # bystander even after the prior's bytes come back
+            reg.admit("prod", object(), size_bytes=800)
+        t = reg.get("prod")
+        assert t.state in ("warming", "serving") and t.index is prod
+        assert reg.get("pinned_other").state == "warming"
+
+    def test_mark_evicted_releases_residency(self):
+        """Review hardening: mark(name, 'evicted') must drop the index
+        and count the eviction exactly like evict() — a terminal
+        tenant must never pin HBM that resident_bytes() stopped
+        counting."""
+        mreg = MetricsRegistry()
+        obs.enable(registry=mreg, hbm=False)
+        reg = serve.IndexRegistry(budget_bytes=1000, headroom_frac=0.0)
+        t = reg.admit("a", object(), size_bytes=400)
+        reg.mark("a", "evicted")
+        assert t.index is None and t.state == "evicted"
+        assert reg.resident_bytes() == 0
+        c = _counters(mreg)
+        assert c["serve.registry.evict{reason=manual,tenant=a}"] == 1.0
+
+    def test_failed_tenant_drops_index_and_refuses(self):
+        reg = serve.IndexRegistry(budget_bytes=1000)
+        reg.admit("a", object(), size_bytes=10)
+        reg.mark("a", "failed")
+        assert reg.resident_bytes() == 0
+        with pytest.raises(serve.TenantUnknown):
+            reg.get("a")
+
+    def test_admit_faultpoint_is_armed(self):
+        faults.install_plan({"faults": [
+            {"site": "serve.registry.admit", "kind": "error",
+             "times": 1}]})
+        reg = serve.IndexRegistry(budget_bytes=1000)
+        with pytest.raises(faults.FaultInjected):
+            reg.admit("a", object(), size_bytes=10)
+        reg.admit("a", object(), size_bytes=10)  # plan exhausted
+
+    def test_describe_snapshot(self):
+        reg = serve.IndexRegistry(budget_bytes=1000)
+        reg.admit("a", object(), size_bytes=10)
+        d = reg.describe()
+        assert d["resident_bytes"] == 10
+        assert d["tenants"][0]["name"] == "a"
+
+
+# ---------------------------------------------------------------------------
+# server
+# ---------------------------------------------------------------------------
+
+class TestServer:
+    def test_single_query_parity_with_direct_search(self, data, pq_index):
+        """A served result equals the direct search's: padding to a
+        bucket must not change any real row (per-query independence)."""
+        reg = _registry_with(pq_index)
+        srv = serve.MicroBatchServer(reg, serve.ServerConfig(
+            max_batch=8, linger_s=0.001, default_slo_s=None))
+        with srv:
+            d, i = srv.search("pq", data[7], 10)
+        d_ref, i_ref = ivf_pq.search(pq_index, jnp.asarray(data[7:8]),
+                                     10, PQ_PARAMS)
+        np.testing.assert_array_equal(i, np.asarray(i_ref)[0])
+        np.testing.assert_allclose(d, np.asarray(d_ref)[0], rtol=1e-5,
+                                   atol=1e-5)
+        assert reg.get("pq").state == "serving"  # warmup marked it
+
+    def test_coalesced_batch_matches_per_query(self, data, pq_index):
+        mreg = MetricsRegistry()
+        obs.enable(registry=mreg, hbm=False)
+        reg = _registry_with(pq_index)
+        srv = serve.MicroBatchServer(reg, serve.ServerConfig(
+            max_batch=8, linger_s=0.05, default_slo_s=None))
+        with srv:
+            futs = [srv.submit("pq", data[j], 10) for j in range(8)]
+            got = [f.result(timeout=30) for f in futs]
+        d_ref, i_ref = ivf_pq.search(pq_index, jnp.asarray(data[:8]),
+                                     10, PQ_PARAMS)
+        for j, (d, i) in enumerate(got):
+            np.testing.assert_array_equal(i, np.asarray(i_ref)[j])
+        c = _counters(mreg)
+        assert c["serve.requests{tenant=pq}"] == 8.0
+        snap = mreg.snapshot()["histograms"]
+        assert snap["serve.batch_fill"]["count"] >= 1
+        assert snap["serve.latency_s"]["count"] == 8
+
+    def test_full_queue_sheds_typed_never_hangs(self, pq_index):
+        """The load-shedding contract: a bounded queue full of stalled
+        work REJECTS new arrivals with ShedError(queue_full) — and
+        every accepted request still terminates."""
+        mreg = MetricsRegistry()
+        obs.enable(registry=mreg, hbm=False)
+        # stall every dispatch so the queue cannot drain
+        faults.install_plan({"faults": [
+            {"site": "serve.dispatch", "kind": "sleep", "sleep_s": 0.2,
+             "times": 0}]})
+        reg = _registry_with(pq_index)
+        srv = serve.MicroBatchServer(reg, serve.ServerConfig(
+            max_batch=2, queue_depth=4, linger_s=0.0,
+            default_slo_s=None, drain_s=10.0))
+        q = np.zeros(DIM, np.float32)
+        shed = []
+        futs = []
+        with srv:
+            for _ in range(12):
+                try:
+                    futs.append(srv.submit("pq", q, 10))
+                except serve.ShedError as e:
+                    shed.append(e)
+            # accepted work must terminate (results, not hangs)
+            for f in futs:
+                f.result(timeout=30)
+        assert shed and all(e.reason == "queue_full" for e in shed)
+        c = _counters(mreg)
+        assert c["serve.shed{reason=queue_full}"] == len(shed)
+
+    def test_expired_queue_deadline_is_shed_not_dispatched(self, pq_index):
+        mreg = MetricsRegistry()
+        obs.enable(registry=mreg, hbm=False)
+        reg = _registry_with(pq_index)
+        srv = serve.MicroBatchServer(reg, serve.ServerConfig(
+            max_batch=1, linger_s=0.0, default_slo_s=None,
+            drain_s=10.0))
+        q = np.zeros(DIM, np.float32)
+        with srv:
+            # armed AFTER warmup so the one-shot stall hits the first
+            # real dispatch; max_batch=1 serializes the two requests —
+            # the second's 10 ms budget dies in the queue behind it
+            faults.install_plan({"faults": [
+                {"site": "serve.dispatch", "kind": "sleep",
+                 "sleep_s": 0.25, "times": 1}]})
+            slow = srv.submit("pq", q, 10, slo_s=None)
+            doomed = srv.submit("pq", q, 10, slo_s=0.01)
+            with pytest.raises(serve.DeadlineExceeded):
+                doomed.result(timeout=30)
+            slow.result(timeout=30)
+        c = _counters(mreg)
+        assert c["serve.shed{reason=deadline}"] >= 1.0
+        assert c["serve.deadline_missed"] >= 1.0
+
+    def test_injected_oom_mid_batch_walks_ladder_exact_results(
+            self, data, pq_index):
+        """The ISSUE's named chaos case: an OOM mid-batch walks the
+        degrade ladder (halve_batch) and the served results are EXACT
+        — identical to the same batch served without any fault."""
+        reg = _registry_with(pq_index)
+        srv = serve.MicroBatchServer(reg, serve.ServerConfig(
+            max_batch=8, linger_s=0.05, default_slo_s=None))
+        with srv:
+            futs = [srv.submit("pq", data[j], 10) for j in range(8)]
+            clean = [f.result(timeout=30) for f in futs]
+            mreg = MetricsRegistry()
+            obs.enable(registry=mreg, hbm=False)
+            faults.install_plan({"faults": [
+                {"site": "ivf_pq.search", "kind": "oom", "times": 1}]})
+            futs = [srv.submit("pq", data[j], 10) for j in range(8)]
+            degraded = [f.result(timeout=30) for f in futs]
+        for (dc, ic), (dd, idg) in zip(clean, degraded):
+            np.testing.assert_array_equal(ic, idg)
+            np.testing.assert_allclose(dc, dd, rtol=1e-5, atol=1e-5)
+        c = _counters(mreg)
+        assert c.get("degrade.steps{from=native,"
+                     "reason=resource_exhausted,site=ivf_pq.search,"
+                     "to=halve_batch}", 0) >= 1, c
+        assert c.get("faults.fired{kind=oom,site=ivf_pq.search}",
+                     0) >= 1, c
+        # the ladder fired during dispatch: health says so
+        assert reg.get("pq").state == "degraded"
+
+    def test_transient_dispatch_fault_is_retried(self, data, pq_index):
+        mreg = MetricsRegistry()
+        obs.enable(registry=mreg, hbm=False)
+        reg = _registry_with(pq_index)
+        srv = serve.MicroBatchServer(reg, serve.ServerConfig(
+            max_batch=2, linger_s=0.0, default_slo_s=None))
+        with srv:
+            faults.install_plan({"faults": [
+                {"site": "ivf_pq.search", "kind": "error", "times": 1}]})
+            d, i = srv.search("pq", data[0], 10, timeout_s=30)
+        assert i.shape == (10,)
+        c = _counters(mreg)
+        assert c.get("retry.recovered{site=serve.dispatch}", 0) >= 1, c
+
+    def test_unknown_tenant_is_typed(self, pq_index):
+        mreg = MetricsRegistry()
+        obs.enable(registry=mreg, hbm=False)
+        reg = _registry_with(pq_index)
+        srv = serve.MicroBatchServer(reg)
+        with pytest.raises(serve.TenantUnknown):
+            srv.submit("ghost", np.zeros(DIM, np.float32))
+        # review hardening: a bogus client-supplied name must not mint
+        # a permanent labeled counter series (unbounded cardinality)
+        assert "serve.requests{tenant=ghost}" not in _counters(mreg)
+
+    def test_warmup_failure_marks_failed_and_serves_the_rest(
+            self, data, pq_index, flat_index):
+        """Review hardening: one tenant that cannot warm (every dispatch
+        OOMs through an exhausted ladder) is marked failed — residency
+        released, submits typed — while the healthy tenant warms and
+        serves."""
+        reg = _registry_with(pq_index, flat_index)
+        srv = serve.MicroBatchServer(reg, serve.ServerConfig(
+            max_batch=2, linger_s=0.001, default_slo_s=None))
+        faults.install_plan({"faults": [
+            {"site": "ivf_pq.search", "kind": "oom", "times": 0}]})
+        try:
+            srv.start()
+        finally:
+            faults.clear_plan()
+        try:
+            assert reg.tenants()[0].state == "failed"  # pq
+            assert reg.get("flat").state == "serving"
+            with pytest.raises(serve.TenantUnknown) as ei:
+                srv.submit("pq", data[0], 10)
+            assert ei.value.state == "failed"
+            _, ids = srv.search("flat", data[0], 10)
+            assert ids.shape == (10,)
+        finally:
+            srv.stop()
+
+    def test_steps_seen_is_thread_local(self):
+        """Review hardening: another thread's ladder moves must not
+        bump this thread's bracket counter (a concurrent tenant's
+        degradation would falsely mark THIS dispatch's tenant)."""
+        import threading
+
+        before = degrade.steps_seen()
+        t = threading.Thread(target=lambda: degrade.note_step(
+            "other-thread", "native", "halve_batch", "test"))
+        t.start()
+        t.join()
+        assert degrade.steps_seen() == before
+        degrade.note_step("this-thread", "native", "halve_batch", "test")
+        assert degrade.steps_seen() == before + 1
+
+    def test_submit_before_start_sheds_not_running(self, pq_index):
+        reg = _registry_with(pq_index)
+        srv = serve.MicroBatchServer(reg)
+        with pytest.raises(serve.ShedError) as ei:
+            srv.submit("pq", np.zeros(DIM, np.float32))
+        assert ei.value.reason == "not_running"
+
+    def test_stop_sheds_queued_as_draining(self, pq_index):
+        mreg = MetricsRegistry()
+        obs.enable(registry=mreg, hbm=False)
+        faults.install_plan({"faults": [
+            {"site": "serve.dispatch", "kind": "sleep", "sleep_s": 0.3,
+             "times": 0}]})
+        reg = _registry_with(pq_index)
+        srv = serve.MicroBatchServer(reg, serve.ServerConfig(
+            max_batch=1, queue_depth=32, linger_s=0.0,
+            default_slo_s=None, drain_s=0.0))
+        srv.start()
+        q = np.zeros(DIM, np.float32)
+        futs = [srv.submit("pq", q, 10) for _ in range(6)]
+        srv.stop(drain=False)
+        outcomes = []
+        for f in futs:
+            try:
+                f.result(timeout=30)
+                outcomes.append("ok")
+            except serve.ShedError as e:
+                outcomes.append(e.reason)
+        assert "draining" in outcomes  # queued work shed, typed
+        assert all(o in ("ok", "draining") for o in outcomes)
+
+    def test_unwarmed_k_is_rejected_and_declared_ks_serve(self, data,
+                                                          pq_index):
+        """Review hardening: the k surface is closed at admission —
+        submit() with an un-warmed k is a typed client error (it would
+        recompile on the serving path), and every declared k serves."""
+        mreg = MetricsRegistry()
+        obs.enable(registry=mreg, hbm=False)
+        reg = serve.IndexRegistry(budget_bytes=1 << 30)
+        reg.admit("pq", pq_index, params=PQ_PARAMS, default_k=10,
+                  ks=[5, 10])
+        srv = serve.MicroBatchServer(reg, serve.ServerConfig(
+            max_batch=4, linger_s=0.001, default_slo_s=None))
+        with srv:
+            with pytest.raises(ValueError, match="warmed surface"):
+                srv.submit("pq", data[0], k=7)
+            d5, i5 = srv.search("pq", data[0], 5)
+            d10, i10 = srv.search("pq", data[0], 10)
+        assert i5.shape == (5,) and i10.shape == (10,)
+        np.testing.assert_array_equal(i5, i10[:5])
+        # every (bucket x k) shape warmed: 3 buckets x 2 ks
+        c = _counters(mreg)
+        assert c["serve.warmup{tenant=pq}"] == 6.0
+
+    def test_degraded_marking_survives_recent_ring_saturation(
+            self, data, pq_index):
+        """Review hardening: the degraded-health signal compares the
+        MONOTONIC degrade.steps_seen(), not the bounded recent ring —
+        after 64+ process-wide ladder moves the ring saturates, and
+        a dispatch-time walk must still mark the tenant."""
+        for _ in range(70):  # saturate the ≤64-entry recent ring
+            degrade.note_step("sat", "native", "halve_batch", "test")
+        assert len(degrade.recent_steps()) == 64
+        reg = _registry_with(pq_index)
+        srv = serve.MicroBatchServer(reg, serve.ServerConfig(
+            max_batch=4, linger_s=0.01, default_slo_s=None))
+        with srv:
+            faults.install_plan({"faults": [
+                {"site": "ivf_pq.search", "kind": "oom", "times": 1}]})
+            futs = [srv.submit("pq", data[j], 10) for j in range(4)]
+            for f in futs:
+                f.result(timeout=30)
+        assert reg.get("pq").state == "degraded"
+
+    def test_bad_query_shapes_rejected(self, pq_index):
+        reg = _registry_with(pq_index)
+        srv = serve.MicroBatchServer(reg).start(warmup=False)
+        try:
+            with pytest.raises(ValueError, match="one query vector"):
+                srv.submit("pq", np.zeros((2, DIM), np.float32))
+            with pytest.raises(ValueError, match="dim"):
+                srv.submit("pq", np.zeros(DIM + 1, np.float32))
+        finally:
+            srv.stop()
+
+    def test_mixed_tenants_coalesce_separately(self, data, pq_index,
+                                               flat_index):
+        reg = _registry_with(pq_index, flat_index)
+        srv = serve.MicroBatchServer(reg, serve.ServerConfig(
+            max_batch=4, linger_s=0.02, default_slo_s=None))
+        with srv:
+            fp = [srv.submit("pq", data[j], 10) for j in range(4)]
+            ff = [srv.submit("flat", data[j], 10) for j in range(4)]
+            got_p = [f.result(timeout=30) for f in fp]
+            got_f = [f.result(timeout=30) for f in ff]
+        i_ref = np.asarray(ivf_flat.search(
+            flat_index, jnp.asarray(data[:4]), 10,
+            ivf_flat.SearchParams(n_probes=8))[1])
+        for j, (_, i) in enumerate(got_f):
+            np.testing.assert_array_equal(i, i_ref[j])
+        assert all(i.shape == (10,) for _, i in got_p)
+
+
+# ---------------------------------------------------------------------------
+# zero steady-state recompiles (the AOT-warmup contract)
+# ---------------------------------------------------------------------------
+
+class TestSteadyStateCompiles:
+    def test_steady_state_is_recompile_free(self, data, pq_index):
+        """After start(warmup=True), serving traffic across every
+        bucket shape triggers ZERO backend compiles — the PR-3
+        sanitizer turns an accidental retrace into a failure."""
+        reg = _registry_with(pq_index)
+        srv = serve.MicroBatchServer(reg, serve.ServerConfig(
+            max_batch=8, linger_s=0.01, default_slo_s=None))
+        with srv:
+            # one extra settling pass: anything warmup's zeros-shaped
+            # queries missed compiles here, outside the budget scope
+            for j in range(3):
+                srv.search("pq", data[j], 10)
+            with sanitize.recompile_budget(0, what="steady-state serve"):
+                for size in (1, 3, 8, 5, 2):
+                    futs = [srv.submit("pq", data[j], 10)
+                            for j in range(size)]
+                    for f in futs:
+                        f.result(timeout=30)
+
+
+# ---------------------------------------------------------------------------
+# loadgen
+# ---------------------------------------------------------------------------
+
+class TestLoadgen:
+    def test_open_loop_step_records_curve_row(self, data, pq_index):
+        reg = _registry_with(pq_index)
+        srv = serve.MicroBatchServer(reg, serve.ServerConfig(
+            max_batch=8, linger_s=0.002, default_slo_s=5.0))
+        with srv:
+            rows = loadgen.sweep(srv, "pq", data[:64], 10,
+                                 offered_steps=[40.0], duration_s=0.4)
+        (row,) = rows
+        assert row["sent"] > 0 and row["completed"] > 0
+        assert row["qps"] > 0
+        assert row["latency_p50_s"] is not None
+        assert row["latency_p99_s"] >= row["latency_p50_s"]
+        assert row["errors"] == 0
+
+    def test_record_stamps_provenance(self, data, pq_index):
+        reg = _registry_with(pq_index)
+        srv = serve.MicroBatchServer(reg, serve.ServerConfig(
+            max_batch=8, default_slo_s=5.0))
+        with srv:
+            rows = loadgen.sweep(srv, "pq", data[:32], 10, [30.0],
+                                 duration_s=0.3)
+        rec = loadgen.record(rows, dataset="serve-test", tenant="pq",
+                             k=10)
+        (d,) = rec["detail"]
+        assert d["dataset"] == "serve-test" and d["algo"] == "serve"
+        assert d["search_param"] == {"offered_qps": 30.0, "k": 10}
+        assert d["batch_size"] == 1
+        assert d["env"]["jax"] and d["measured_at"]
+        # benchdiff must be able to key the rows (the self-compare gate
+        # in CI joins the committed baseline on exactly this)
+        from tools import benchdiff
+
+        keys = {benchdiff.row_key(r) for r in rec["detail"]}
+        assert len(keys) == len(rec["detail"])
+
+    def test_overload_step_sheds_and_says_so(self, data, pq_index):
+        """Offered load far past capacity: the open-loop generator must
+        SEE the shedding (a closed-loop one never would)."""
+        reg = _registry_with(pq_index)
+        srv = serve.MicroBatchServer(reg, serve.ServerConfig(
+            max_batch=4, queue_depth=8, linger_s=0.0,
+            default_slo_s=None, drain_s=10.0))
+        faults.install_plan({"faults": [
+            {"site": "serve.dispatch", "kind": "sleep", "sleep_s": 0.05,
+             "times": 0}]})
+        with srv:
+            row = loadgen.run_step(srv, "pq", data[:32], 10,
+                                   offered_qps=500.0, duration_s=0.4)
+        assert row["shed"] > 0
+        assert row["shed_reasons"].get("queue_full", 0) > 0
+        assert row["sent"] >= row["completed"] + row["shed"]
+
+
+# ---------------------------------------------------------------------------
+# flight-dump chaos (SIGTERM mid-serving)
+# ---------------------------------------------------------------------------
+
+class TestServeFlightDump:
+    @pytest.mark.slow  # subprocess builds its own index (~7 s); the CI
+    # pytest + sanitize lanes run it — tier-1 keeps its 870 s headroom
+    def test_sigterm_leaves_dump_with_serve_counters(self, tmp_path):
+        """The ISSUE's named chaos case: a SIGTERM'd serving process
+        leaves a parseable flight dump whose metrics snapshot carries
+        the serve.* counter family."""
+        code = f"""
+import os, sys, time
+sys.path.insert(0, {ROOT!r})
+os.environ["JAX_PLATFORMS"] = "cpu"
+import numpy as np, jax.numpy as jnp
+from raft_tpu import obs, serve
+from raft_tpu.obs import flight
+from raft_tpu.neighbors import ivf_pq
+
+obs.enable(hbm=False)
+flight.install({str(tmp_path)!r}, every_s=0)
+rng = np.random.default_rng(0)
+x = rng.random((800, 16), dtype=np.float32)
+idx = ivf_pq.build(jnp.asarray(x), ivf_pq.IndexParams(
+    n_lists=8, pq_dim=8, seed=0, cache_reconstruction="never"))
+reg = serve.IndexRegistry(budget_bytes=1 << 30)
+reg.admit("t", idx, params=ivf_pq.SearchParams(
+    n_probes=4, scan_mode="per_query"), default_k=5)
+srv = serve.MicroBatchServer(reg, serve.ServerConfig(
+    max_batch=4, linger_s=0.001, default_slo_s=5.0)).start()
+srv.search("t", x[0], 5)
+print("armed", flush=True)
+while True:
+    srv.search("t", x[0], 5)
+    time.sleep(0.005)
+"""
+        p = subprocess.Popen([sys.executable, "-c", code],
+                             stdout=subprocess.PIPE, text=True)
+        assert p.stdout.readline().strip() == "armed"
+        time.sleep(0.3)
+        p.send_signal(signal.SIGTERM)
+        p.wait(timeout=60)
+        docs = []
+        for name in sorted(os.listdir(tmp_path)):
+            if name.startswith("flight_") and name.endswith(".json"):
+                with open(os.path.join(str(tmp_path), name)) as f:
+                    docs.append(json.load(f))
+        dumps = [d for d in docs if d["reason"].startswith("signal")]
+        assert dumps, [d["reason"] for d in docs]
+        counters = dumps[0]["metrics"]["counters"]
+        req = [k for k in counters if k.startswith("serve.requests")]
+        assert req and counters[req[0]] >= 1, sorted(counters)
+        assert any(k.startswith("serve.registry.admit")
+                   for k in counters), sorted(counters)
+        hists = dumps[0]["metrics"]["histograms"]
+        assert "serve.latency_s" in hists

@@ -47,6 +47,9 @@ MODULES = [
     "raft_tpu.parallel.merge",
     "raft_tpu.parallel.knn", "raft_tpu.parallel.ivf",
     "raft_tpu.parallel.build",
+    "raft_tpu.serve.server", "raft_tpu.serve.registry",
+    "raft_tpu.serve.dispatch", "raft_tpu.serve.loadgen",
+    "raft_tpu.serve.errors",
     "raft_tpu.ops.pallas_kernels", "raft_tpu.native",
     "raft_tpu.bench.dataset", "raft_tpu.bench.runner",
     "raft_tpu.bench.ingest", "raft_tpu.bench.plot",
@@ -100,6 +103,23 @@ of per-list counts — codes/ids/norms never cross the interconnect.
 Output: a `ShardedIvfPq`/`ShardedIvfFlat` (global ids = `rank ·
 shard_rows + local` via `core.ids`; `global_list_cap` stamped for
 assembly) that `search_ivf_pq`/`search_ivf_flat` consume directly.
+""",
+    "raft_tpu.serve.server": """\
+### Serving decision summary
+
+The request path (ISSUE 14; docs/developer_guide.md "Serving" has the
+full policy):
+
+| stage | policy | refusal / signal |
+|---|---|---|
+| `submit()` | bounded queue keyed `(tenant, k)`; the request's `Deadline(slo_s)` starts here | `ShedError(queue_full\\|not_running)`, `TenantUnknown`; `serve.requests{tenant=}` |
+| batcher | drain ≤ `max_batch` within `linger_s`, pad to the next power-of-two bucket (`bucket_sizes`) | queue-expired budgets shed (`reason=deadline`) without chip work; `serve.batch_fill` |
+| dispatch | `dispatch_batch` → tenant's `search_resilient` under the group deadline + `DISPATCH_RETRY_POLICY`; the PR-7 ladder is the overload path | `ShedError(overload)` on ladder exhaustion; ladder moves mark the tenant `degraded` |
+| completion | per-request slicing, latency into `serve.latency_s` (the p50/p99 source) | late-but-correct results delivered + `serve.deadline_missed` |
+
+Steady state after `start(warmup=True)` holds `recompile_budget(0)` —
+asserted in tests and the CI serve smoke; `compile_cache_dir` persists
+the XLA compilation cache across restarts (bounded cold start).
 """,
     "raft_tpu.parallel.merge": """\
 ### Cross-shard merge-tier decision table

@@ -287,6 +287,69 @@ def prof_table(snap: Dict[str, Any], top: int) -> str:
                    "bw_frac", "flops_frac"], [r for _, r in rows[:top]])
 
 
+def _has_serve(snap: Dict[str, Any]) -> bool:
+    return any(parse_key(k)[0].startswith("serve.")
+               for m in ("counters", "gauges", "histograms")
+               for k in snap.get(m, {}))
+
+
+def serve_tables(snap: Dict[str, Any]) -> str:
+    """The ``serve.*`` family (ISSUE 14): per-tenant request/registry
+    traffic, the shed-by-reason + deadline table, and the served
+    latency p50/p99 — so a killed serving run's flight dump says what
+    it was shedding and why."""
+    counters, hists = snap["counters"], snap["histograms"]
+    per: Dict[str, Dict[str, float]] = {}
+    shed: Dict[str, float] = {}
+    scalars: Dict[str, float] = {}
+    for key, v in counters.items():
+        name, labels = parse_key(key)
+        if not name.startswith("serve."):
+            continue
+        if name == "serve.shed":
+            reason = labels.get("reason", "?")
+            shed[reason] = shed.get(reason, 0.0) + v
+        elif "tenant" in labels:
+            slot = per.setdefault(labels["tenant"], {})
+            slot[name] = slot.get(name, 0.0) + v
+        else:
+            scalars[name] = scalars.get(name, 0.0) + v
+    out = []
+    if per:
+        rows = [[t,
+                 f"{int(st.get('serve.requests', 0))}",
+                 f"{int(st.get('serve.warmup', 0))}",
+                 f"{int(st.get('serve.registry.admit', 0))}",
+                 f"{int(st.get('serve.registry.evict', 0))}",
+                 f"{int(st.get('serve.errors', 0))}"]
+                for t, st in sorted(
+                    per.items(),
+                    key=lambda kv: -kv[1].get("serve.requests", 0))]
+        out.append(_table(["tenant", "requests", "warmup_buckets",
+                           "admits", "evicts", "errors"], rows))
+    total_shed = sum(shed.values())
+    missed = scalars.get("serve.deadline_missed", 0.0)
+    if shed or missed:
+        rows = [[reason, f"{int(n)}"]
+                for reason, n in sorted(shed.items(),
+                                        key=lambda kv: -kv[1])]
+        rows.append(["(total shed)", f"{int(total_shed)}"])
+        rows.append(["deadline_missed", f"{int(missed)}"])
+        out.append("-- shed / deadline --")
+        out.append(_table(["reason", "requests"], rows))
+    lat = hists.get("serve.latency_s")
+    if lat and lat.get("count"):
+        fill = hists.get("serve.batch_fill") or {}
+        out.append(_table(
+            ["served", "latency_p50", "latency_p99", "mean_batch_fill"],
+            [[str(lat["count"]),
+              _ms(quantile_from_state(lat, 0.5)),
+              _ms(quantile_from_state(lat, 0.99)),
+              "-" if not fill.get("count")
+              else f"{fill['sum'] / fill['count']:.2f}"]]))
+    return "\n".join(out) if out else "  (no serve activity)"
+
+
 def benchdiff_section(doc: Dict[str, Any]) -> str:
     """Render a benchdiff JSON verdict via the scoreboard renderer
     (``tools.benchdiff.render_markdown`` — also stdlib-only)."""
@@ -335,6 +398,11 @@ def render(path: str, top: int) -> str:
                 out.append("  degrade steps: " + "; ".join(
                     f"{s.get('site')} {s.get('from')}->{s.get('to')} "
                     f"[{s.get('reason')}]" for s in steps[-8:]))
+    if _has_serve(snap):
+        # the serving header rides FIRST (ISSUE 14): a killed serving
+        # run's dump leads with what it was shedding and why
+        out.append("-- serving (serve.*) --")
+        out.append(serve_tables(snap))
     out.append("-- top spans by total time --")
     out.append(spans_table(snap, top))
     if any(parse_key(k)[0].startswith("prof.")

@@ -1,0 +1,119 @@
+"""Record the serving latency-vs-throughput baseline (ISSUE 14).
+
+Builds two tiny synthetic tenants (IVF-PQ + IVF-Flat), starts the
+micro-batch server on the CPU backend (buckets AOT-warmed), and drives
+the open-loop load generator up a ladder of offered loads — the
+latency-vs-throughput curve, p50/p99 per step from the PR-5 histogram
+quantiles — then writes the rows as a bench-record-shaped JSON with
+full environment provenance, so the serving numbers ride the PR-9
+benchdiff gate like every other perf claim:
+
+    JAX_PLATFORMS=cpu python -m tools.record_serve_baseline \
+        [--out raft_tpu/bench/baselines/serve_cpu_smoke.json]
+
+CI runs ``python -m tools.benchdiff serve_cpu_smoke serve_cpu_smoke``
+(the committed record against itself) as the schema/join/provenance
+self-compare. CPU qps varies with machine load — cross-machine
+comparisons should use ``--report-only`` unless the environment stamp
+matches (the cpu_smoke convention).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+_xla_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _xla_flags:
+    os.environ["XLA_FLAGS"] = (
+        _xla_flags + " --xla_force_host_platform_device_count=8").strip()
+
+DEFAULT_OUT = os.path.join(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))), "raft_tpu", "bench", "baselines",
+    "serve_cpu_smoke.json")
+
+N, DIM = 20_000, 32
+K = 10
+OFFERED_STEPS = (25.0, 100.0, 400.0)
+STEP_S = 2.0
+
+BASELINE_NOTE = (
+    "Committed serving latency-vs-throughput baseline (ISSUE 14): the "
+    "micro-batch server on the CPU backend, two resident tenants "
+    "(ivf_pq.n64.pq16 + ivf_flat.n64), open-loop Poisson arrivals at "
+    "offered loads of 25/100/400 qps for 2 s each, qps = completed "
+    "requests/s with p50/p99 from the serve latency histogram. Steps "
+    "sit comfortably under the batched CPU capacity (~3k qps at "
+    "max_batch=16) so the committed rows stay stable for the "
+    "self-compare gate; the overload/shed behavior is exercised "
+    "deterministically by the CI serve smoke's fault-injected stall, "
+    "not by this record. CPU qps varies with machine load - compare "
+    "with --report-only unless the environment stamp matches AND the "
+    "machine is quiet.")
+
+
+def serve_record() -> dict:
+    import numpy as np
+    import jax.numpy as jnp
+
+    from raft_tpu import serve
+    from raft_tpu.neighbors import ivf_flat, ivf_pq
+    from raft_tpu.serve import loadgen
+
+    rng = np.random.default_rng(0)
+    x = rng.random((N, DIM), dtype=np.float32)
+    xd = jnp.asarray(x)
+    idx_pq = ivf_pq.build(xd, ivf_pq.IndexParams(
+        n_lists=64, pq_dim=16, seed=0, cache_reconstruction="never"))
+    idx_flat = ivf_flat.build(xd, ivf_flat.IndexParams(n_lists=64))
+    registry = serve.IndexRegistry(budget_bytes=4 << 30)
+    registry.admit("ivf_pq.n64.pq16", idx_pq,
+                   params=ivf_pq.SearchParams(n_probes=8,
+                                              scan_mode="per_query"),
+                   default_k=K)
+    registry.admit("ivf_flat.n64", idx_flat,
+                   params=ivf_flat.SearchParams(n_probes=8), default_k=K)
+    server = serve.MicroBatchServer(registry, serve.ServerConfig(
+        max_batch=16, queue_depth=128, linger_s=0.002,
+        default_slo_s=1.0))
+    detail = []
+    with server:
+        for tenant in ("ivf_pq.n64.pq16", "ivf_flat.n64"):
+            rows = loadgen.sweep(server, tenant, x[:512], K,
+                                 OFFERED_STEPS, duration_s=STEP_S)
+            rec = loadgen.record(rows, dataset=f"serve-synth-{N}x{DIM}",
+                                 tenant=tenant, k=K)
+            detail.extend(rec["detail"])
+    best = max(r["qps"] for r in detail)
+    return {"metric": "serve_completed_qps_cpu",
+            "value": best, "unit": "completed requests/s",
+            "total_rows": len(detail), "detail": detail,
+            "baseline_note": BASELINE_NOTE}
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="record_serve_baseline",
+        description="measure the serving latency-vs-throughput curve "
+                    "and write the benchdiff-consumable baseline record")
+    ap.add_argument("--out", default=DEFAULT_OUT)
+    args = ap.parse_args(argv)
+    record = serve_record()
+    with open(args.out, "w", encoding="utf-8") as fh:
+        json.dump(record, fh, indent=1)
+    for r in record["detail"]:
+        p99 = r["latency_p99_s"]
+        offered = r["search_param"]["offered_qps"]
+        print(f"  {r['index']:<16} offered {offered:>6.0f} -> "
+              f"qps {r['qps']:>7.1f} "
+              f"p99 {p99 if p99 is None else round(p99, 4)} "
+              f"shed {r['shed']} missed {r['deadline_missed']}")
+    print(f"wrote {len(record['detail'])} serve rows -> {args.out}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
