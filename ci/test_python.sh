@@ -100,8 +100,23 @@ for leg in ("strong", "weak"):
         by = {r["impl"]: r["wall_s"] for r in brows
               if r["leg"] == leg and r["n_dev"] == nd}
         assert by["prefetch"] < by["serial"], (leg, nd, by)
-print("dryrun_multichip(8) OK; comms section:", len(comms) - 2,
-      "series;", len(rows), "scaling rows;", len(brows), "build rows")
+# ISSUE 15: the fleet-aggregation leg — per-host flight dumps merged
+# into ONE clock-aligned view (shared run_id) whose per-collective
+# straggler table names the injected straggler rank (the dryrun itself
+# also asserts alignment ordering + skew; this re-checks the record)
+fleet = comms.get("fleet")
+assert fleet, "dryrun returned no MULTICHIP_FLEET view"
+assert fleet["aligned_ok"] and fleet["merged_events"] > 0, fleet
+assert len(fleet["hosts"]) == fleet["n_hosts"] == 4, fleet["hosts"]
+ag = [s for s in fleet["stragglers"]
+      if s["collective"] == "comms.allgatherv"]
+assert ag, fleet["stragglers"]
+assert ag[0]["slowest"] == f"rank{fleet['straggler_rank']}", ag[0]
+assert ag[0]["skew_frac"] > 0.10, ag[0]
+print("dryrun_multichip(8) OK; comms section:", len(comms) - 3,
+      "series;", len(rows), "scaling rows;", len(brows), "build rows;",
+      "fleet:", len(fleet["hosts"]), "hosts,",
+      f"straggler {ag[0]['slowest']} at {ag[0]['skew_frac']:+.0%} skew")
 EOF
 
 echo "== ring top-k exchange kernel smoke (interpret mode, 8-dev mesh) =="
@@ -507,21 +522,27 @@ echo "   vectors/s/chip rows pass a benchdiff self-compare =="
 python -m tools.benchdiff build_cpu_smoke build_cpu_smoke \
     --md /tmp/raft_tpu_build_baseline_scoreboard.md | tail -3
 
-echo "== serving smoke (ISSUE 14: micro-batch server on the CPU backend,"
-echo "   loadgen burst under recompile_budget(0), typed shedding, ladder"
-echo "   OOM walk; docs/developer_guide.md 'Serving') =="
+echo "== serving smoke (ISSUE 14/15: micro-batch server on the CPU backend,"
+echo "   loadgen burst under recompile_budget(0) with request tracing AND"
+echo "   the exposition endpoint live, tracing-overhead gate, mid-load"
+echo "   /metrics scrape, exemplar -> obsdump --slowest drill-down, typed"
+echo "   shedding, ladder OOM walk; docs/developer_guide.md 'Serving') =="
 python - <<'EOF'
-# start the server (buckets AOT-warmed), drive an open-loop burst whose
-# steady state must trigger ZERO recompiles, then overload it behind a
-# fault-injected stall (typed queue_full shedding) and OOM a batch
-# (degrade-ladder walk) — the acceptance counters all land in one
-# registry snapshot
+# start the server (buckets AOT-warmed, /metrics endpoint live), drive
+# an open-loop burst tracing-OFF then the same burst tracing-ON (events
+# + request contexts + exemplars) — BOTH under the PR-3 zero-recompile
+# budget, with the ON step's p50 within the documented overhead bar —
+# then scrape the endpoint mid-load, resolve the p99's exemplar trace
+# ids through obsdump --slowest, overload behind a fault-injected stall
+# (typed queue_full shedding) and OOM a batch (degrade-ladder walk)
+import json, os, shutil, subprocess, sys, threading, urllib.request
 import numpy as np
 import jax.numpy as jnp
 
 from raft_tpu import obs, serve
-from raft_tpu.obs import sanitize
-from raft_tpu.obs.metrics import MetricsRegistry
+from raft_tpu.obs import flight, sanitize
+from raft_tpu.obs.expo import parse_prometheus
+from raft_tpu.obs.metrics import MetricsRegistry, exemplars_for_quantile
 from raft_tpu.neighbors import ivf_pq
 from raft_tpu.robust import faults
 from raft_tpu.serve import loadgen
@@ -536,17 +557,65 @@ registry = serve.IndexRegistry(budget_bytes=4 << 30)
 registry.admit("smoke", idx, params=ivf_pq.SearchParams(
     n_probes=8, scan_mode="per_query"), default_k=10)
 server = serve.MicroBatchServer(registry, serve.ServerConfig(
-    max_batch=16, queue_depth=64, linger_s=0.002, default_slo_s=1.0))
+    max_batch=16, queue_depth=64, linger_s=0.002, default_slo_s=1.0,
+    expo_port=0))
 with server:
     for j in range(5):  # settle anything warmup's zero-queries missed
         server.search("smoke", x[j], 10)
-    # steady state: a 300 qps open-loop burst across every bucket shape
-    # must hold the PR-3 zero-recompile budget
+    # steady state, tracing OFF: the overhead baseline; a 300 qps
+    # open-loop burst across every bucket shape, zero recompiles
     with sanitize.recompile_budget(0, what="steady-state serving"):
+        row_off = loadgen.run_step(server, "smoke", x[:256], 10,
+                                   offered_qps=300.0, duration_s=1.5)
+    assert row_off["completed"] > 200 and row_off["errors"] == 0, row_off
+    # steady state, tracing ON (events + request contexts + exemplars)
+    # with the exposition endpoint scraped MID-load — still zero
+    # recompiles: tracing is host-side only
+    obs.enable(registry=reg, hbm=False, events=True)
+    scrape = {}
+    def _scrape():
+        # any failure is CAPTURED, not swallowed: a dead scraper thread
+        # must surface as the real HTTP/timeout error, not a bare
+        # KeyError('metrics') downstream
+        try:
+            import time as _t
+            _t.sleep(0.5)  # land mid-burst
+            url = server.expo.url
+            scrape["metrics"] = urllib.request.urlopen(
+                url + "/metrics", timeout=10).read().decode()
+            scrape["healthz"] = json.loads(urllib.request.urlopen(
+                url + "/healthz", timeout=10).read())
+        except Exception as e:
+            scrape["error"] = repr(e)
+    scraper = threading.Thread(target=_scrape)
+    scraper.start()
+    with sanitize.recompile_budget(0, what="traced+scraped serving"):
         row = loadgen.run_step(server, "smoke", x[:256], 10,
                                offered_qps=300.0, duration_s=1.5)
+    scraper.join(timeout=15)
+    assert "error" not in scrape, f"mid-load scrape failed: {scrape['error']}"
     assert row["completed"] > 200 and row["errors"] == 0, row
     assert row["latency_p99_s"] is not None, row
+    # the tracing-overhead bar (ISSUE 15 acceptance): enabled tracing
+    # costs <= 5% on the serve p50, with a 0.25 ms absolute floor for
+    # CPU-CI scheduler jitter (the p50 itself is ~linger-dominated)
+    p50_off, p50_on = row_off["latency_p50_s"], row["latency_p50_s"]
+    assert p50_on <= max(p50_off * 1.05, p50_off + 2.5e-4), (
+        f"tracing overhead too high: p50 {p50_off*1e3:.3f} ms off -> "
+        f"{p50_on*1e3:.3f} ms on")
+    # the mid-load scrape parses as Prometheus text format with the
+    # serve.* and hbm.* families labeled
+    fams = parse_prometheus(scrape["metrics"])
+    assert any(f.startswith("raft_tpu_serve_") for f in fams), sorted(fams)
+    req = fams.get("raft_tpu_serve_requests")
+    assert req and any(s["labels"].get("tenant") == "smoke"
+                       for s in req), req
+    assert "raft_tpu_hbm_bytes_limit" in fams, sorted(fams)
+    lat_series = fams.get("raft_tpu_serve_latency_s")
+    assert lat_series and any(s["series"].endswith("_bucket")
+                              for s in lat_series), "no histogram buckets"
+    assert scrape["healthz"]["tenants"].get("smoke") in (
+        "serving", "degraded"), scrape["healthz"]
     # overload: every dispatch stalled 0.2 s -> the bounded queue must
     # shed with the typed queue_full reason, and every accepted request
     # still terminates (run_step waits on all futures)
@@ -566,19 +635,41 @@ with server:
     d_f, i_f = server.search("smoke", x[7], 10)
     faults.clear_plan()
     np.testing.assert_array_equal(i_f, i_c)
+    # exemplar acceptance (ISSUE 15): the p99 resolves to >= 1 concrete
+    # trace id, and that request's full timeline renders in
+    # obsdump --slowest from a live flight dump (tenant health header
+    # included — the registry section rides every dump)
+    lat = reg.snapshot()["histograms"]["serve.latency_s"]
+    ex = exemplars_for_quantile(lat, 0.99)
+    assert ex, "serve.latency_s p99 resolved to no exemplars"
+    shutil.rmtree("/tmp/raft_tpu_serve_flight", ignore_errors=True)
+    dump_path = flight.dump_now("ci-serve",
+                                dump_dir="/tmp/raft_tpu_serve_flight")
+    assert dump_path, "flight dump failed"
 obs.disable()
+p = subprocess.run([sys.executable, "-m", "tools.obsdump", dump_path,
+                    "--slowest", "3"], capture_output=True, text=True)
+assert p.returncode == 0, p.stderr
+assert ex[0]["trace_id"] in p.stdout, (
+    f"exemplar {ex[0]['trace_id']} missing from obsdump --slowest:\n"
+    + p.stdout)
+assert "serve.request" in p.stdout and "serve.dispatch" in p.stdout, \
+    p.stdout
+assert "tenants: smoke=" in p.stdout, p.stdout  # health header
 c = reg.snapshot()["counters"]
-assert c.get("serve.requests{tenant=smoke}", 0) > 200, c
+assert c.get("serve.requests{tenant=smoke}", 0) > 400, c
 assert c.get("serve.shed{reason=queue_full}", 0) > 0, c
 assert any(k.startswith("degrade.steps{") and "site=ivf_pq.search" in k
            for k in c), c
 assert c.get("serve.registry.admit{tenant=smoke}", 0) == 1, c
 h = reg.snapshot()["histograms"]["serve.latency_s"]
-print(f"serve smoke OK: {row['completed']} steady requests at "
-      f"{row['qps']:.0f} qps (p99 {row['latency_p99_s']*1e3:.1f} ms, "
-      f"0 recompiles), {over['shed']} shed under stall "
+print(f"serve smoke OK: {row['completed']} traced requests at "
+      f"{row['qps']:.0f} qps (p50 {p50_off*1e3:.2f} -> {p50_on*1e3:.2f} "
+      f"ms traced, p99 {row['latency_p99_s']*1e3:.1f} ms, 0 recompiles, "
+      f"endpoint scraped mid-load), {over['shed']} shed under stall "
       f"({over['shed_reasons']}), OOM ladder walk exact, "
-      f"{h['count']} latency samples")
+      f"{len(ex)} p99 exemplars -> obsdump --slowest renders "
+      f"{ex[0]['trace_id']}, {h['count']} latency samples")
 EOF
 # blocking: the committed serving latency-vs-throughput baseline joins
 # and passes the benchdiff self-compare (schema/provenance gate — CPU

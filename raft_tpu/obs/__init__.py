@@ -17,6 +17,12 @@ this package makes the same attribution available *in process*:
   Chrome-trace/Perfetto export (``obs.enable(events=True)``);
 - :mod:`raft_tpu.obs.flight`  — flight recorder: crash-surviving dumps
   of events + metrics + logs on signals/atexit/periodically;
+- :mod:`raft_tpu.obs.expo`    — live telemetry exposition: stdlib HTTP
+  endpoint serving Prometheus text-format ``/metrics``, ``/healthz``,
+  and on-demand ``/flightz`` dumps;
+- :mod:`raft_tpu.obs.fleet`   — pod-wide aggregation: merges per-host
+  flight dumps (shared run_id, clock alignment) and attributes
+  collective-timing stragglers;
 - :mod:`raft_tpu.obs.sanitize` — runtime sanitizer harness
   (``RAFT_TPU_SANITIZE=1``): rank-promotion/NaN config, transfer-guard
   scopes, and a jit-cache-miss counter with budget assertions.
@@ -31,10 +37,17 @@ from raft_tpu.obs.metrics import (  # noqa: F401
     Gauge,
     Histogram,
     MetricsRegistry,
+    exemplars_for_quantile,
     get_registry,
     load_jsonl,
     quantile_from_state,
     set_registry,
+)
+from raft_tpu.obs.trace import (  # noqa: F401
+    RequestContext,
+    current_request,
+    new_trace_id,
+    use_request,
 )
 from raft_tpu.obs.spans import (  # noqa: F401
     count_dispatch,
@@ -55,4 +68,6 @@ from raft_tpu.obs import hbm  # noqa: F401
 from raft_tpu.obs import prof  # noqa: F401
 from raft_tpu.obs import trace  # noqa: F401
 from raft_tpu.obs import flight  # noqa: F401
+from raft_tpu.obs import expo  # noqa: F401
+from raft_tpu.obs import fleet  # noqa: F401
 from raft_tpu.obs import sanitize  # noqa: F401

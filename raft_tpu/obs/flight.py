@@ -36,6 +36,7 @@ import time
 from collections import deque
 from typing import Any, Dict, List, Optional, Sequence
 
+from raft_tpu.obs import fleet as _fleet
 from raft_tpu.obs import metrics as _metrics
 from raft_tpu.obs import spans as _spans
 from raft_tpu.obs import trace as _trace
@@ -109,6 +110,41 @@ def _robust_state() -> Dict[str, Any]:
     return out
 
 
+# Pluggable dump sections (ISSUE 15): long-lived subsystems register a
+# snapshot callable (the serving layer registers its IndexRegistry's
+# describe() under "serve_registry") so every dump — crash, periodic,
+# /flightz — carries their state without flight knowing their types.
+_sections: Dict[str, Any] = {}
+_sections_lock = threading.Lock()
+
+
+def set_section(name: str, provider) -> None:
+    """Register ``provider()`` (a zero-arg callable returning JSON-able
+    data) to be folded into every dump under key ``name``. Re-setting a
+    name replaces it. Providers run on the (possibly dying) dump path:
+    they must be host-only and fast; any failure is swallowed."""
+    with _sections_lock:
+        _sections[name] = provider
+
+
+def clear_section(name: str) -> None:
+    """Remove a registered section (idempotent)."""
+    with _sections_lock:
+        _sections.pop(name, None)
+
+
+def _section_snapshots() -> Dict[str, Any]:
+    with _sections_lock:
+        providers = dict(_sections)
+    out: Dict[str, Any] = {}
+    for name, fn in providers.items():
+        try:
+            out[name] = fn()
+        except Exception:
+            pass  # a sick provider must never cost the dump
+    return out
+
+
 def _resolve_signals(signals: Sequence) -> List[int]:
     out = []
     for s in signals:
@@ -155,11 +191,17 @@ class FlightRecorder:
             "argv": list(sys.argv),
             "time": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
             "uptime_s": round(time.time() - self._t0, 3),
+            # fleet identity (ISSUE 15): run_id + host/pid/rank + the
+            # clock anchor pair, so obs.fleet.aggregate can merge this
+            # dump with its pod siblings on one aligned timeline
+            "fleet": _fleet.identity(),
             "metrics": metrics,
             "events": buf.snapshot(),
             "dropped_events": buf.dropped,
             "logs": list(self._log_tail.lines),
         }
+        for name, body in _section_snapshots().items():
+            out.setdefault(name, body)  # core keys are not overridable
         watchdog = _watchdog_kill_info()
         if watchdog is not None:
             # why an external supervisor killed us (tools/run_watchdog.sh

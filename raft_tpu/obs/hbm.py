@@ -83,6 +83,28 @@ def _record(registry, stats: Dict[str, int], labels: Optional[Dict],
         registry.gauge("hbm.bytes_limit", labels).set(stats["bytes_limit"])
 
 
+def note_budget(budget_bytes: int, registry=None) -> None:
+    """Mirror an externally-resolved HBM budget into the
+    ``hbm.bytes_limit`` gauge family, under its OWN labeled series
+    ``{source=admission}``.
+
+    Backends that report no allocator stats (the CPU test mesh) leave
+    the ``hbm.*`` family empty — but a serving process still HAS an
+    authoritative limit: the one its :class:`~raft_tpu.serve.registry.
+    IndexRegistry` admits against. Recording it keeps the exposition
+    endpoint's ``hbm_*`` families populated on every backend. The
+    distinct label matters on real devices: the unlabeled and
+    ``{device=i}`` series belong to :func:`sample`'s allocator
+    readings, and a capacity-capped registry (``budget_bytes`` <
+    the chip's limit) must not flip-flop those between two meanings."""
+    if registry is None:
+        from raft_tpu.obs import metrics as _metrics
+
+        registry = _metrics.get_registry()
+    registry.gauge("hbm.bytes_limit", {"source": "admission"}).set(
+        int(budget_bytes))
+
+
 def sample(registry=None, device: Optional[Any] = None,
            events=None) -> Dict[str, int]:
     """Record current HBM gauges into ``registry`` (default: the global

@@ -27,6 +27,7 @@ A process-global default registry backs the module-level helpers;
 from __future__ import annotations
 
 import json
+import os
 import threading
 from typing import Any, Dict, Iterable, List, Optional, Tuple
 
@@ -35,6 +36,11 @@ from typing import Any, Dict, Iterable, List, Optional, Tuple
 # chunked 100M-row build stage).
 DEFAULT_BUCKETS: Tuple[float, ...] = (
     1e-5, 1e-4, 1e-3, 1e-2, 0.1, 1.0, 10.0, 60.0, 600.0)
+
+#: exemplar reservoir bound per histogram bucket: enough to name a few
+#: concrete offenders per latency band, small enough that a long-lived
+#: serving histogram stays O(buckets × this) no matter the traffic
+EXEMPLARS_PER_BUCKET = 4
 
 
 def _label_key(labels: Optional[Dict[str, str]]) -> Tuple[Tuple[str, str], ...]:
@@ -110,7 +116,7 @@ class Histogram:
     """
 
     __slots__ = ("name", "labels", "buckets", "_bucket_counts", "_count",
-                 "_sum", "_min", "_max", "_lock")
+                 "_sum", "_min", "_max", "_exemplars", "_lock")
 
     def __init__(self, name: str, labels: Optional[Dict[str, str]] = None,
                  buckets: Optional[Iterable[float]] = None):
@@ -122,9 +128,19 @@ class Histogram:
         self._sum = 0.0
         self._min = float("inf")
         self._max = float("-inf")
+        # per-bucket exemplar reservoirs: {bucket_index: [(value, id)]},
+        # lazily created — an exemplar-less histogram pays nothing
+        self._exemplars: Optional[Dict[int, List[Tuple[float, str]]]] = None
         self._lock = threading.RLock()  # signal-snapshot path, see Counter
 
-    def observe(self, value: float) -> None:
+    def observe(self, value: float,
+                exemplar: Optional[str] = None) -> None:
+        """Record one sample. ``exemplar`` (ISSUE 15) attaches an
+        identity — a request trace id — to the sample: each bucket
+        retains a bounded reservoir of its LARGEST exemplared values
+        (:data:`EXEMPLARS_PER_BUCKET`), so a latency histogram's p99
+        links directly to concrete slow requests instead of an
+        anonymous bucket count."""
         value = float(value)
         with self._lock:
             self._count += 1
@@ -133,11 +149,27 @@ class Histogram:
                 self._min = value
             if value > self._max:
                 self._max = value
+            idx = len(self.buckets)
             for i, ub in enumerate(self.buckets):
                 if value <= ub:
-                    self._bucket_counts[i] += 1
-                    return
-            self._bucket_counts[-1] += 1
+                    idx = i
+                    break
+            self._bucket_counts[idx] += 1
+            if exemplar is None:
+                return
+            if self._exemplars is None:
+                self._exemplars = {}
+            res = self._exemplars.setdefault(idx, [])
+            if len(res) < EXEMPLARS_PER_BUCKET:
+                res.append((value, str(exemplar)))
+            else:
+                # keep the worst offenders: replace the reservoir's
+                # smallest value when the new sample exceeds it —
+                # within a bucket the largest values are the ones a
+                # tail drill-down wants named
+                j = min(range(len(res)), key=lambda jj: res[jj][0])
+                if value > res[j][0]:
+                    res[j] = (value, str(exemplar))
 
     @property
     def count(self) -> int:
@@ -162,7 +194,7 @@ class Histogram:
             for c in self._bucket_counts:
                 cum += c
                 counts.append(cum)
-            return {
+            out = {
                 "count": self._count,
                 "sum": self._sum,
                 "min": self._min if self._count else None,
@@ -174,6 +206,17 @@ class Histogram:
                     "+inf": counts[-1],
                 },
             }
+            if self._exemplars:
+                # keyed like buckets (upper-bound repr / "+inf") so
+                # JSONL rows and flight dumps round-trip alongside the
+                # cumulative counts
+                out["exemplars"] = {
+                    ("+inf" if i >= len(self.buckets)
+                     else repr(self.buckets[i])):
+                    [{"value": v, "trace_id": t}
+                     for v, t in sorted(res, reverse=True)]
+                    for i, res in sorted(self._exemplars.items())}
+            return out
 
 
 class MetricsRegistry:
@@ -224,10 +267,29 @@ class MetricsRegistry:
         self.gauge(name, labels).set(value)
 
     def observe(self, name: str, value: float,
-                labels: Optional[Dict[str, str]] = None) -> None:
-        self.histogram(name, labels).observe(value)
+                labels: Optional[Dict[str, str]] = None,
+                exemplar: Optional[str] = None) -> None:
+        self.histogram(name, labels).observe(value, exemplar=exemplar)
 
     # -- export -------------------------------------------------------------
+    def collect(self) -> List[Dict[str, Any]]:
+        """Structured series list — one self-describing dict per series
+        (``{"kind", "name", "labels", ...value/state}``), the shape
+        ``dump_jsonl`` writes and the exposition endpoint
+        (:mod:`raft_tpu.obs.expo`) renders. Unlike :meth:`snapshot`,
+        labels stay structured instead of rendered into the key."""
+        with self._lock:
+            rows: List[Dict[str, Any]] = []
+            for (n, lk), c in self._counters.items():
+                rows.append({"kind": "counter", "name": n,
+                             "labels": dict(lk), "value": c.value})
+            for (n, lk), g in self._gauges.items():
+                rows.append({"kind": "gauge", "name": n,
+                             "labels": dict(lk), "value": g.value})
+            for (n, lk), h in self._histograms.items():
+                rows.append({"kind": "histogram", "name": n,
+                             "labels": dict(lk), **h.state()})
+            return rows
     def snapshot(self) -> Dict[str, Any]:
         """Plain-dict view: ``{"counters": {key: v}, "gauges": {key: v},
         "histograms": {key: state}}`` with ``name{k=v}`` rendered keys."""
@@ -241,25 +303,32 @@ class MetricsRegistry:
             "histograms": {_render(n, lk): h.state() for (n, lk), h in hists},
         }
 
-    def dump_jsonl(self, path: str, extra: Optional[Dict[str, Any]] = None
-                   ) -> int:
+    def dump_jsonl(self, path: str, extra: Optional[Dict[str, Any]] = None,
+                   max_mb: Optional[float] = None,
+                   keep: Optional[int] = None) -> int:
         """Append one JSON line per series to ``path``; returns the number
         of lines written. ``extra`` keys are merged into every line
-        (the bench runner stamps dataset/index/search_param context)."""
-        with self._lock:
-            rows: List[Dict[str, Any]] = []
-            for (n, lk), c in self._counters.items():
-                rows.append({"kind": "counter", "name": n,
-                             "labels": dict(lk), "value": c.value})
-            for (n, lk), g in self._gauges.items():
-                rows.append({"kind": "gauge", "name": n,
-                             "labels": dict(lk), "value": g.value})
-            for (n, lk), h in self._histograms.items():
-                rows.append({"kind": "histogram", "name": n,
-                             "labels": dict(lk), **h.state()})
+        (the bench runner stamps dataset/index/search_param context).
+
+        **Rotation** (ISSUE 15): an always-on serving process dumping
+        periodically would otherwise grow the sidecar file without
+        bound. When the file already holds ≥ ``max_mb`` MB (default:
+        ``RAFT_TPU_OBS_JSONL_MAX_MB``; unset/0 = unbounded — the
+        one-shot bench behavior, unchanged), it is rotated
+        ``path → path.1 → path.2 …`` keeping ``keep`` rotated files
+        (default ``RAFT_TPU_OBS_JSONL_KEEP`` or 3, oldest dropped),
+        each move an atomic ``os.replace`` so a reader never sees a
+        torn file."""
+        rows = self.collect()
         if extra:
             for r in rows:
                 r.update(extra)
+        if max_mb is None:
+            max_mb = _env_float("RAFT_TPU_OBS_JSONL_MAX_MB", 0.0)
+        if max_mb and max_mb > 0:
+            _rotate_jsonl(path, max_mb,
+                          keep if keep is not None
+                          else int(_env_float("RAFT_TPU_OBS_JSONL_KEEP", 3)))
         with open(path, "a") as f:
             for r in rows:
                 f.write(json.dumps(r) + "\n")
@@ -270,6 +339,75 @@ class MetricsRegistry:
             self._counters.clear()
             self._gauges.clear()
             self._histograms.clear()
+
+
+def _env_float(name: str, default: float) -> float:
+    """Numeric env knob (a value, not a boolean flag — GL02 covers flag
+    parsing; unparseable values fall back to the default)."""
+    raw = os.environ.get(name, "")  # numeric value, not a flag
+    try:
+        return float(raw) if raw.strip() else default
+    except ValueError:
+        return default
+
+
+def _rotate_jsonl(path: str, max_mb: float, keep: int) -> None:
+    """Size-capped rotation ``path → path.1 → … → path.keep`` (atomic
+    renames, oldest dropped). No-op while ``path`` is under the cap or
+    absent; never raises — a rotation hiccup must not cost the dump."""
+    try:
+        if not os.path.exists(path) or \
+                os.path.getsize(path) < max_mb * (1 << 20):
+            return
+        keep = max(int(keep), 1)
+        oldest = f"{path}.{keep}"
+        if os.path.exists(oldest):
+            os.unlink(oldest)
+        for i in range(keep - 1, 0, -1):
+            src = f"{path}.{i}"
+            if os.path.exists(src):
+                os.replace(src, f"{path}.{i + 1}")
+        os.replace(path, f"{path}.1")
+    except OSError:
+        pass
+
+
+def exemplars_for_quantile(state: Dict[str, Any], q: float
+                           ) -> List[Dict[str, Any]]:
+    """The exemplars nearest quantile ``q`` of a ``Histogram.state()``
+    dict: the reservoir of the bucket holding the q-th sample, falling
+    back outward (higher buckets first — a tail query wants the worst
+    offenders) when that bucket recorded none. Returns
+    ``[{"value", "trace_id"}, ...]`` sorted worst-first; ``[]`` when
+    the histogram holds no exemplars at all. This is how a reported
+    p99 resolves to concrete slow-request trace ids (ISSUE 15)."""
+    ex = state.get("exemplars") or {}
+    if not ex or not state.get("count"):
+        return []
+
+    def _ub(key: str) -> float:
+        return float("inf") if key == "+inf" else float(key)
+
+    entries = sorted(((_ub(k), cum) for k, cum in
+                      (state.get("buckets") or {}).items()))
+    rank = min(max(float(q), 0.0), 1.0) * state["count"]
+    target_keys = [k for k, _ in sorted(
+        ((k, _ub(k)) for k in ex), key=lambda kv: kv[1])]
+    # the bucket holding the q-th sample
+    prev_cum, q_ub = 0, float("inf")
+    for ub, cum in entries:
+        if cum >= rank and cum - prev_cum > 0:
+            q_ub = ub
+            break
+        prev_cum = cum
+    # exact bucket first, then above (worse), then below
+    above = [k for k in target_keys if _ub(k) >= q_ub]
+    below = [k for k in reversed(target_keys) if _ub(k) < q_ub]
+    for key in above + below:
+        res = ex.get(key)
+        if res:
+            return sorted(res, key=lambda e: -float(e.get("value", 0.0)))
+    return []
 
 
 def quantile_from_state(state: Dict[str, Any], q: float

@@ -261,10 +261,20 @@ class span:
                     from raft_tpu.obs import trace as _trace
 
                     events = _trace.get_buffer()
+                    args = self._labels
+                    # request-scoped propagation (ISSUE 15): a span
+                    # recorded while a RequestContext is installed on
+                    # this thread carries the request's trace id(s) —
+                    # the stage emits its usual event, the identity
+                    # rides along, and obsdump --slowest can reassemble
+                    # one request's full timeline
+                    ctx = _trace.current_request()
+                    if ctx is not None:
+                        args = {**(args or {}), **ctx.event_labels()}
                     # wall-clock begin reconstructed from the monotonic
                     # duration: one clock read per exit, none per enter
                     events.record_span(dotted, time.time() - dt, dt,
-                                       args=self._labels)
+                                       args=args)
                 # sample HBM only at ROOT-span exit: memory_stats() is a
                 # transport round-trip on tunnel-attached devices, and
                 # at a child-span exit every ancestor's clock is still

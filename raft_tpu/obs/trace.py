@@ -41,6 +41,123 @@ DEFAULT_CAPACITY = 65536
 PRODUCER = "raft_tpu.obs.trace"
 
 
+# ---------------------------------------------------------------------------
+# request-scoped trace propagation (ISSUE 15)
+# ---------------------------------------------------------------------------
+
+def new_trace_id() -> str:
+    """A fresh 16-hex request trace id (64 random bits — collision-safe
+    for any realistic retention window, short enough to grep)."""
+    return os.urandom(8).hex()
+
+
+class RequestContext:
+    """One request's identity, carried through the serving pipeline.
+
+    Minted where the request enters the system (``MicroBatchServer.
+    submit()``) and installed — via :func:`use_request` — around every
+    stage that works on the request's behalf (batcher, dispatch, retry,
+    the degrade ladder, ``search_resilient``). While installed, every
+    span event recorded on the thread is stamped with the context's
+    labels, so ``obsdump --slowest`` can reassemble one request's full
+    timeline from the shared event ring.
+
+    ``trace_ids`` covers the coalesced case: a micro-batch dispatch
+    works for MANY requests at once — its context carries every
+    member's trace id, and a timeline query for any one of them matches
+    the batch's spans too. ``tenant`` rides as a label; ``deadline``
+    (a :class:`raft_tpu.robust.retry.Deadline`) rides as plain state
+    for stages that draw down the budget. Stdlib-only, immutable after
+    construction."""
+
+    __slots__ = ("trace_id", "trace_ids", "tenant", "deadline")
+
+    def __init__(self, tenant: Optional[str] = None,
+                 deadline: Optional[Any] = None,
+                 trace_id: Optional[str] = None,
+                 trace_ids: Optional[List[str]] = None):
+        self.trace_id = trace_id or new_trace_id()
+        self.trace_ids = list(trace_ids) if trace_ids else None
+        self.tenant = tenant
+        self.deadline = deadline
+
+    def event_labels(self) -> Dict[str, Any]:
+        """The labels stamped into span events recorded under this
+        context (the batch form carries the member list)."""
+        out: Dict[str, Any] = {}
+        if self.trace_ids is not None:
+            out["trace_ids"] = list(self.trace_ids)
+        else:
+            out["trace_id"] = self.trace_id
+        if self.tenant is not None:
+            out["tenant"] = self.tenant
+        return out
+
+    def matches(self, trace_id: str) -> bool:
+        """True when this context works (at least partly) for
+        ``trace_id`` — the single id or any coalesced member."""
+        return (trace_id == self.trace_id
+                or (self.trace_ids is not None
+                    and trace_id in self.trace_ids))
+
+    def __repr__(self) -> str:
+        n = f" +{len(self.trace_ids)} coalesced" if self.trace_ids else ""
+        return f"<RequestContext {self.trace_id}{n} tenant={self.tenant}>"
+
+
+_request_tls = threading.local()
+
+
+def current_request() -> Optional[RequestContext]:
+    """The request context installed on THIS thread (None outside any
+    request scope). One TLS read — cheap enough for span-exit paths."""
+    return getattr(_request_tls, "ctx", None)
+
+
+def set_request(ctx: Optional[RequestContext]
+                ) -> Optional[RequestContext]:
+    """Install ``ctx`` as the thread's current request; returns the
+    previous one (low-level — prefer :func:`use_request`)."""
+    prev = getattr(_request_tls, "ctx", None)
+    _request_tls.ctx = ctx
+    return prev
+
+
+class use_request:
+    """Context manager installing a :class:`RequestContext` for the
+    covered block (nesting restores the outer context on exit)::
+
+        with use_request(RequestContext(tenant="acme", deadline=dl)):
+            dispatch(...)   # spans recorded here carry the trace id
+    """
+
+    __slots__ = ("ctx", "_prev")
+
+    def __init__(self, ctx: Optional[RequestContext]):
+        self.ctx = ctx
+        self._prev = None
+
+    def __enter__(self) -> Optional[RequestContext]:
+        self._prev = set_request(self.ctx)
+        return self.ctx
+
+    def __exit__(self, *exc) -> bool:
+        set_request(self._prev)
+        return False
+
+
+def event_matches_trace(event: Dict[str, Any], trace_id: str) -> bool:
+    """True when a buffer/flight event belongs to ``trace_id``'s
+    timeline: its args carry the id directly or in a coalesced
+    ``trace_ids`` list (the shared filter obsdump's ``--slowest``
+    drill-down and the tests use)."""
+    args = event.get("args") or {}
+    if args.get("trace_id") == trace_id:
+        return True
+    ids = args.get("trace_ids")
+    return isinstance(ids, (list, tuple)) and trace_id in ids
+
+
 class EventBuffer:
     """Bounded thread-safe ring buffer of span/counter events.
 

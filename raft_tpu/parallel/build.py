@@ -253,7 +253,15 @@ def gather_trainset_rows(stacked: jax.Array, counts: jax.Array,
     fn = shard_map(body, mesh=mesh,
                    in_specs=(P(axis, None, None), P(axis)),
                    out_specs=P(), check_vma=False)
-    return fn(stacked, counts)[:n_rows]
+    # host-side collective timing (ISSUE 15): the dispatch runs under a
+    # comms.allgatherv span (sync mode blocks on the gathered result),
+    # so per-host flight dumps carry timed collective events the fleet
+    # aggregator's straggler table compares across the pod
+    with span("comms.allgatherv", labels={"op": "allgatherv",
+                                          "axis": axis}) as sp:
+        out = fn(stacked, counts)[:n_rows]
+        sp.attach(out)
+    return out
 
 
 def gather_list_counts(local_counts, mesh: Mesh, axis: str) -> jax.Array:
@@ -272,7 +280,13 @@ def gather_list_counts(local_counts, mesh: Mesh, axis: str) -> jax.Array:
 
     fn = shard_map(body, mesh=mesh, in_specs=(P(axis, None),),
                    out_specs=P(), check_vma=False)
-    return fn(jnp.asarray(local_counts, jnp.int32))
+    # timed like gather_trainset_rows: the straggler table wants every
+    # host-driven collective dispatch comparable across the pod
+    with span("comms.allgatherv", labels={"op": "allgatherv",
+                                          "axis": axis}) as sp:
+        out = fn(jnp.asarray(local_counts, jnp.int32))
+        sp.attach(out)
+    return out
 
 
 # ---------------------------------------------------------------------------

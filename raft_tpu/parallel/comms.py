@@ -58,6 +58,7 @@ from jax import lax
 
 from raft_tpu.core.compat import axis_size as _axis_size
 from raft_tpu.core.tracing import annotate as _annotate
+from raft_tpu.obs import fleet as _fleet
 from raft_tpu.obs import sanitize as _sanitize
 from raft_tpu.obs import spans as _obs
 from raft_tpu.robust import faults as _faults
@@ -173,6 +174,14 @@ class Comms:
         if not counting:
             return
         labels = {"op": op_name, "axis": _axis_label(self.axis_name)}
+        # host identity (ISSUE 15): in a launcher-ranked pod process
+        # (RAFT_TPU_RANK set) every comms series carries the host's
+        # rank, so per-host flight/JSONL dumps merged by obs.fleet
+        # attribute collective traffic to the process that issued it.
+        # One extra label per process (its own rank) — cardinality 1.
+        rank = _fleet.rank()
+        if rank is not None:
+            labels["rank"] = str(rank)
         reg = _obs.registry()
         reg.inc("comms.ops", 1.0, labels=labels)
         reg.inc("comms.bytes", float(nbytes), labels=labels)

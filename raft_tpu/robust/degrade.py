@@ -76,8 +76,30 @@ _steps_tls = threading.local()
 
 def _note_recent(site: str, frm: str, to: str, reason: str) -> None:
     _steps_tls.n = getattr(_steps_tls, "n", 0) + 1
-    _recent.append({"ts": round(time.time(), 3), "site": site,
-                    "from": frm, "to": to, "reason": reason})
+    entry = {"ts": round(time.time(), 3), "site": site,
+             "from": frm, "to": to, "reason": reason}
+    # request-scoped attribution (ISSUE 15): a ladder move made while a
+    # RequestContext is installed on this thread names the request(s)
+    # it degraded for — the flight dump's degrade_recent (and obsdump's
+    # --slowest timeline) can then say WHICH request walked the ladder.
+    # sys.modules lookup, not an import: this module stays loadable
+    # standalone and the counter labels stay low-cardinality (ids ride
+    # only in the bounded ring, never as label values)
+    trace_mod = sys.modules.get("raft_tpu.obs.trace")
+    if trace_mod is not None:
+        ctx = trace_mod.current_request()
+        if ctx is not None:
+            entry.update(ctx.event_labels())
+    _recent.append(entry)
+    # when event recording is on, the move also lands in the span-event
+    # ring (zero-duration marker) so a request's exported timeline shows
+    # its ladder moves inline with the stage spans
+    spans_mod = sys.modules.get("raft_tpu.obs.spans")
+    if (trace_mod is not None and spans_mod is not None
+            and spans_mod.events_enabled()):
+        args = {k: v for k, v in entry.items() if k != "ts"}
+        trace_mod.get_buffer().record_span(
+            "degrade.step", entry["ts"], 0.0, args=args)
 
 
 def recent_steps() -> List[Dict[str, Any]]:

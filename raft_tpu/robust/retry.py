@@ -203,6 +203,24 @@ def _count(name: str, site: str) -> None:
         spans.registry().inc(name, labels={"site": site})
 
 
+def _attempt_event(site: str, attempt: int) -> None:
+    """Timeline marker for a RE-attempt (never the first try — a clean
+    call leaves no retry trace): a zero-duration event stamped with the
+    current request context, so ``obsdump --slowest`` shows a slow
+    request's retry storm inline with its stage spans (ISSUE 15).
+    sys.modules only — this module stays stdlib-importable."""
+    spans = sys.modules.get("raft_tpu.obs.spans")
+    trace = sys.modules.get("raft_tpu.obs.trace")
+    if spans is None or trace is None or not spans.events_enabled():
+        return
+    args: Dict[str, Any] = {"site": site, "attempt": attempt}
+    ctx = trace.current_request()
+    if ctx is not None:
+        args.update(ctx.event_labels())
+    trace.get_buffer().record_span("retry.attempt", time.time(), 0.0,
+                                   args=args)
+
+
 def retry_call(fn: Callable[..., Any], *args,
                site: str = "unnamed",
                policy: RetryPolicy = DEFAULT_POLICY,
@@ -244,6 +262,8 @@ def retry_call(fn: Callable[..., Any], *args,
     while True:
         st["attempts"] += 1
         _count("retry.attempts", site)
+        if st["attempts"] > 1:
+            _attempt_event(site, st["attempts"])
         try:
             out = fn(*args, **kwargs)
         except BaseException as e:  # noqa: B036 — classified below

@@ -107,17 +107,23 @@ def dispatch_batch(tenant: Tenant, queries, k: int,
         return search(index, queries, k, tenant.params,
                       deadline=deadline)
 
+    retry_stats: dict = {}
     with _spans.span("serve.dispatch") as sp:
         try:
             dist, ids = _retry.retry_call(
                 attempt, site="serve.dispatch",
-                policy=DISPATCH_RETRY_POLICY, deadline=deadline)
+                policy=DISPATCH_RETRY_POLICY, deadline=deadline,
+                stats=retry_stats)
             jax.block_until_ready((dist, ids))
         except _degrade.DegradationExhausted as e:
             # the ladder walked every rung and the batch still cannot
             # run — the request group is shed, the server backs off
             raise ShedError("overload", str(e)) from e
-        sp.annotate(tenant=tenant.name, batch=int(queries.shape[0]), k=k)
+        # the request context installed by the batcher stamps this
+        # span's event with the batch's trace ids; attempts rides too
+        # so a drill-down sees retry pressure without counting markers
+        sp.annotate(tenant=tenant.name, batch=int(queries.shape[0]), k=k,
+                    attempts=retry_stats.get("attempts", 1))
     if _degrade.steps_seen() > degrade_mark and registry is not None:
         # the ladder moved during this dispatch: the tenant is serving,
         # but on a degraded configuration — surface it as health,

@@ -29,7 +29,7 @@ from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
-from raft_tpu.obs.metrics import Histogram
+from raft_tpu.obs.metrics import Histogram, exemplars_for_quantile
 from raft_tpu.robust.retry import DeadlineExceeded
 from raft_tpu.serve.errors import ShedError
 from raft_tpu.serve.server import MicroBatchServer, _LATENCY_BUCKETS
@@ -112,10 +112,16 @@ def run_step(server: MicroBatchServer, tenant: str,
             ok += 1
             t_done = done_at.get(id(fut), time.monotonic())
             t_last_done = max(t_last_done, t_done)
-            lat.observe(t_done - t_submit)
+            # the future knows its request's trace id (stamped by
+            # submit): the step's latency histogram retains the slowest
+            # requests' ids as exemplars, so a regressed baseline names
+            # reproducible offender requests (ISSUE 15)
+            lat.observe(t_done - t_submit,
+                        exemplar=getattr(fut, "trace_id", None))
     # achieved rate over the window that actually served: arrivals
     # stopped at duration_s but queued work drains past it
     wall = max(t_last_done, deadline_end) - t_start
+    slow = exemplars_for_quantile(lat.state(), 0.99)
     return {
         "offered_qps": offered_qps,
         "duration_s": round(wall, 4),
@@ -129,6 +135,9 @@ def run_step(server: MicroBatchServer, tenant: str,
         "latency_p50_s": lat.quantile(0.5),
         "latency_p99_s": lat.quantile(0.99),
         "latency_mean_s": (lat.sum / lat.count) if lat.count else None,
+        # the p99 bucket's worst offenders, worst first — joinable back
+        # to their timelines via obsdump --slowest on the server's dump
+        "slow_trace_ids": [e["trace_id"] for e in slow],
     }
 
 
@@ -169,15 +178,28 @@ def record(rows: List[Dict[str, Any]], dataset: str, tenant: str,
             "shed": r["shed"], "shed_reasons": r["shed_reasons"],
             "deadline_missed": r["deadline_missed"],
             "errors": r["errors"],
+            "slow_trace_ids": r.get("slow_trace_ids", []),
             "measured_at": measured_at, "git_commit": commit,
             "env": env,
         })
     best = max((d["qps"] for d in detail), default=0.0)
+    # name the offenders (ISSUE 15): the worst-p99 step's exemplar
+    # trace ids ride the record's notes, so a benchdiff regression on
+    # this baseline points at reproducible requests, not just a number
+    worst = max((d for d in detail if d["latency_p99_s"] is not None),
+                key=lambda d: d["latency_p99_s"], default=None)
+    notes = note
+    if worst is not None and worst.get("slow_trace_ids"):
+        tail = (f"worst p99 step offered_qps="
+                f"{worst['search_param']['offered_qps']}: "
+                f"p99={worst['latency_p99_s']:.4f}s, slow traces "
+                + ",".join(worst["slow_trace_ids"]))
+        notes = f"{note}; {tail}" if note else tail
     return {
         "metric": "serve_qps_cpu",
         "value": best,
         "unit": "completed requests/s",
         "total_rows": len(detail),
-        "baseline_note": note,
+        "baseline_note": notes,
         "detail": detail,
     }

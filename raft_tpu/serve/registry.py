@@ -127,6 +127,12 @@ class IndexRegistry:
         self.headroom_frac = float(headroom_frac)
         self._tenants: Dict[str, Tenant] = {}
         self._lock = threading.RLock()
+        if _spans.enabled():
+            # mirror the admission budget into the hbm.bytes_limit
+            # family (its own {source=admission} series — never the
+            # allocator's readings) so the exposition endpoint's hbm_*
+            # families stay populated even on allocator-less backends
+            _hbm.note_budget(self.budget_bytes, _spans.registry())
 
     # -- capacity -----------------------------------------------------------
     @property

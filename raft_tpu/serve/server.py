@@ -51,6 +51,7 @@ import numpy as np
 
 from raft_tpu.core import logging as _log
 from raft_tpu.obs import spans as _spans
+from raft_tpu.obs import trace as _trace
 from raft_tpu.robust import faults as _faults
 from raft_tpu.robust.retry import Deadline, DeadlineExceeded
 from raft_tpu.serve import dispatch as _dispatch
@@ -108,10 +109,17 @@ class ServerConfig:
     default_slo_s: Optional[float] = 1.0
     compile_cache_dir: Optional[str] = None
     drain_s: float = 5.0
+    # live telemetry exposition (ISSUE 15): a port arms an
+    # obs.expo.ExpoServer for the server's lifetime (/metrics /healthz
+    # /flightz). None = off (the offline default); 0 = ephemeral port
+    # (tests/CI read it back from server.expo.port)
+    expo_port: Optional[int] = None
+    expo_host: str = "127.0.0.1"
 
 
 class _Request:
-    __slots__ = ("tenant", "query", "k", "deadline", "future", "enqueued")
+    __slots__ = ("tenant", "query", "k", "deadline", "future", "enqueued",
+                 "ctx")
 
     def __init__(self, tenant: str, query: np.ndarray, k: int,
                  deadline: Optional[Deadline]):
@@ -121,6 +129,11 @@ class _Request:
         self.deadline = deadline
         self.future: Future = Future()
         self.enqueued = time.monotonic()
+        # request-scoped trace identity (ISSUE 15): minted at submit,
+        # carried through queue → batcher → dispatch → retry/degrade →
+        # search_resilient; stamped on every span event those stages
+        # emit and retained as the latency histogram's exemplar
+        self.ctx = _trace.RequestContext(tenant=tenant, deadline=deadline)
 
 
 def _count(name: str, **labels: str) -> None:
@@ -128,9 +141,11 @@ def _count(name: str, **labels: str) -> None:
         _spans.registry().inc(name, labels=labels or None)
 
 
-def _observe(name: str, value: float, buckets) -> None:
+def _observe(name: str, value: float, buckets,
+             exemplar: Optional[str] = None) -> None:
     if _spans.enabled():
-        _spans.registry().histogram(name, buckets=buckets).observe(value)
+        _spans.registry().histogram(name, buckets=buckets).observe(
+            value, exemplar=exemplar)
 
 
 class MicroBatchServer:
@@ -150,6 +165,9 @@ class MicroBatchServer:
         self._cond = threading.Condition()
         self._running = False
         self._thread: Optional[threading.Thread] = None
+        #: the live exposition endpoint (obs.expo.ExpoServer) while
+        #: running with ``config.expo_port`` set, else None
+        self.expo = None
 
     # -- lifecycle ----------------------------------------------------------
     def start(self, warmup: bool = True) -> "MicroBatchServer":
@@ -180,6 +198,39 @@ class MicroBatchServer:
                                         name="raft-tpu-serve-batcher",
                                         daemon=True)
         self._thread.start()
+        # live exposition (ISSUE 15): scrapable /metrics + /healthz +
+        # /flightz for the server's lifetime; the registry's tenant
+        # health also rides every flight dump as "serve_registry"
+        from raft_tpu.obs import flight as _flight
+
+        _flight.set_section("serve_registry", self.registry.describe)
+        if _spans.enabled():
+            # re-mirror the admission budget into hbm.bytes_limit at
+            # START (the registry's __init__ mirror only fires when obs
+            # was already enabled at construction — callers that enable
+            # obs or swap registries afterwards would otherwise serve
+            # an hbm-less /metrics on allocator-less backends)
+            from raft_tpu.obs import hbm as _hbm
+
+            _hbm.note_budget(self.registry.budget_bytes,
+                             _spans.registry())
+        if self.config.expo_port is not None:
+            from raft_tpu.obs import expo as _expo
+
+            try:
+                self.expo = _expo.ExpoServer(
+                    port=self.config.expo_port,
+                    host=self.config.expo_host,
+                    health=self.registry.describe).start()
+            except Exception:
+                # a failed bind (port taken, privileged port) must not
+                # leave a half-started server: the batcher thread is
+                # already live and a second start() would early-return
+                # on _running forever — tear back down to "stopped" so
+                # the caller can fix the port and start() again
+                self.stop(drain=False)
+                raise
+            _log.info("serve: exposition endpoint at %s", self.expo.url)
         return self
 
     @staticmethod
@@ -247,7 +298,14 @@ class MicroBatchServer:
             self._total = 0
         for r in shed:
             _count("serve.shed", reason="draining")
+            self._request_event(r, outcome="shed_draining")
             r.future.set_exception(ShedError("draining"))
+        if self.expo is not None:
+            self.expo.stop()
+            self.expo = None
+        from raft_tpu.obs import flight as _flight
+
+        _flight.clear_section("serve_registry")
 
     def __enter__(self) -> "MicroBatchServer":
         return self.start()
@@ -300,9 +358,18 @@ class MicroBatchServer:
         budget = self.config.default_slo_s if slo_s == -1.0 else slo_s
         req = _Request(tenant, q, kk,
                        None if budget is None else Deadline(budget))
+        # the client's handle to the trace: a returned future knows its
+        # request's trace id, so load generators / clients can join a
+        # slow result back to its timeline (loadgen stamps these into
+        # its benchdiff rows)
+        req.future.trace_id = req.ctx.trace_id
         with self._cond:
             if not self._running:
                 _count("serve.shed", reason="not_running")
+                # same anchor-event contract as every other shed path:
+                # a drill-down for this trace id must find the request
+                # marked shed, not simply missing
+                self._request_event(req, outcome="shed_not_running")
                 raise ShedError("not_running", "server not started")
             if self._total >= self.config.queue_depth:
                 # the explicit load-shed: a bounded queue full of work
@@ -310,6 +377,8 @@ class MicroBatchServer:
                 # capacity — reject NOW so the client can back off,
                 # instead of queueing into certain deadline misses
                 _count("serve.shed", reason="queue_full")
+                self._request_event(req, outcome="shed_queue_full",
+                                    depth=self._total)
                 raise ShedError(
                     "queue_full",
                     f"{self._total} queued >= depth "
@@ -370,9 +439,25 @@ class MicroBatchServer:
                 if not isinstance(e, Exception):
                     raise
 
+    def _request_event(self, r: _Request, outcome: str,
+                       **extra: Any) -> None:
+        """One ``serve.request`` timeline event spanning the request's
+        whole life (enqueue → now), stamped with its trace id — the
+        anchor row ``obsdump --slowest`` renders a drill-down around.
+        Free when event recording is off."""
+        if not _spans.events_enabled():
+            return
+        dur = time.monotonic() - r.enqueued
+        args = {"trace_id": r.ctx.trace_id, "tenant": r.tenant,
+                "k": r.k, "outcome": outcome, **extra}
+        _trace.get_buffer().record_span("serve.request",
+                                        time.time() - dur, dur,
+                                        args=args)
+
     def _run_batch(self, key: Tuple[str, int], reqs: List[_Request]
                    ) -> None:
         tenant_name, k = key
+        t_take = time.monotonic()  # queue wait ends here
         try:
             tenant = self.registry.get(tenant_name)  # touches LRU
             tenant.requests += len(reqs)  # accepted-request forensics
@@ -380,6 +465,7 @@ class MicroBatchServer:
             # evicted/failed between enqueue and dispatch: typed error,
             # never a crash into a dropped index reference
             for r in reqs:
+                self._request_event(r, outcome="tenant_unknown")
                 r.future.set_exception(e)
             return
         live: List[_Request] = []
@@ -388,6 +474,8 @@ class MicroBatchServer:
                 # budget burned in the queue — shed without chip work
                 _count("serve.shed", reason="deadline")
                 _count("serve.deadline_missed")
+                self._request_event(r, outcome="shed_deadline",
+                                    queue_s=round(t_take - r.enqueued, 6))
                 r.future.set_exception(
                     DeadlineExceeded("serve.queue", r.deadline))
             else:
@@ -406,28 +494,42 @@ class MicroBatchServer:
         group = None
         if deadlines and len(deadlines) == len(live):
             group = max(deadlines, key=lambda d: d.remaining())
+        # the batch's RequestContext carries EVERY member's trace id:
+        # the dispatch/search/retry spans (and any ladder move) below
+        # are work done for all of them at once, and a drill-down for
+        # any one member must find those shared stages
+        batch_ctx = _trace.RequestContext(
+            tenant=tenant_name, deadline=group,
+            trace_ids=[r.ctx.trace_id for r in live])
+        fill = len(live) / bucket
         import jax.numpy as jnp
 
         try:
-            dist, ids = _dispatch.dispatch_batch(
-                tenant, jnp.asarray(batch), k, deadline=group,
-                registry=self.registry)
+            with _trace.use_request(batch_ctx):
+                dist, ids = _dispatch.dispatch_batch(
+                    tenant, jnp.asarray(batch), k, deadline=group,
+                    registry=self.registry)
         except TenantUnknown as e:
             # evicted between our registry.get and the dispatch's index
             # snapshot: the same typed refusal as the lookup path —
             # routine evictions must not read as tenant errors
             for r in live:
+                self._request_event(r, outcome="tenant_unknown")
                 r.future.set_exception(e)
             return
         except DeadlineExceeded as e:
             for r in live:
                 _count("serve.shed", reason="deadline")
                 _count("serve.deadline_missed")
+                self._request_event(r, outcome="shed_deadline",
+                                    bucket=bucket)
                 r.future.set_exception(e)
             return
         except ShedError as e:
             for r in live:
                 _count("serve.shed", reason=e.reason)
+                self._request_event(r, outcome=f"shed_{e.reason}",
+                                    bucket=bucket)
                 r.future.set_exception(e)
             return
         except Exception as e:
@@ -437,16 +539,27 @@ class MicroBatchServer:
             _log.warn("serve: batch failed for %r: %r", tenant_name, e)
             for r in live:
                 _count("serve.errors", tenant=tenant_name)
+                self._request_event(r, outcome="error", bucket=bucket)
                 r.future.set_exception(e)
             return
         d_np = np.asarray(dist)[:len(live)]
         i_np = np.asarray(ids)[:len(live)]
         now = time.monotonic()
         for j, r in enumerate(live):
-            _observe("serve.latency_s", now - r.enqueued,
-                     _LATENCY_BUCKETS)
-            if r.deadline is not None and r.deadline.expired:
+            latency = now - r.enqueued
+            # the exemplar (ISSUE 15): the latency histogram's buckets
+            # retain concrete (value, trace_id) pairs, so a reported
+            # p99 resolves to real requests whose timelines render in
+            # obsdump --slowest
+            _observe("serve.latency_s", latency, _LATENCY_BUCKETS,
+                     exemplar=r.ctx.trace_id)
+            missed = r.deadline is not None and r.deadline.expired
+            if missed:
                 # completed, but late: deliver the (correct) result and
                 # count the SLO miss — the curve's p99 tells the story
                 _count("serve.deadline_missed")
+            self._request_event(
+                r, outcome="late" if missed else "ok",
+                queue_s=round(t_take - r.enqueued, 6),
+                bucket=bucket, fill=round(fill, 4))
             r.future.set_result((d_np[j], i_np[j]))

@@ -1,0 +1,226 @@
+"""obs.expo — live telemetry exposition (ISSUE 15 tentpole b).
+
+The exposition contract under test: the full registry renders as
+parseable Prometheus text format (HELP/TYPE per family, labeled
+counters/gauges, cumulative histogram buckets), the stdlib HTTP server
+serves it on an ephemeral port, /healthz reflects serving-registry
+tenant health (200 while anything is resident, 503 when everything is
+terminal), and /flightz triggers an on-demand flight dump. Device-free
+— nothing here touches jax.
+"""
+
+import json
+import os
+import urllib.error
+import urllib.request
+
+import pytest
+
+from raft_tpu.obs import hbm
+from raft_tpu.obs.expo import (ExpoServer, parse_prometheus, prom_name,
+                               render_prometheus)
+from raft_tpu.obs.metrics import MetricsRegistry
+
+
+def _reg():
+    reg = MetricsRegistry()
+    reg.inc("serve.requests", 3, labels={"tenant": "acme"})
+    reg.inc("serve.requests", 1, labels={"tenant": "other"})
+    reg.set("serve.queue_depth", 7)
+    reg.observe("serve.latency_s", 0.004, labels=None, exemplar="t1")
+    reg.observe("serve.latency_s", 0.2, labels=None, exemplar="t2")
+    return reg
+
+
+class TestRender:
+    def test_name_sanitization(self):
+        assert prom_name("serve.latency_s") == "raft_tpu_serve_latency_s"
+        assert prom_name("a.b-c d") == "raft_tpu_a_b_c_d"
+
+    def test_families_help_type_and_labels(self):
+        text = render_prometheus(_reg().collect())
+        assert "# HELP raft_tpu_serve_requests" in text
+        assert "# TYPE raft_tpu_serve_requests counter" in text
+        assert 'raft_tpu_serve_requests{tenant="acme"} 3' in text
+        assert "# TYPE raft_tpu_serve_queue_depth gauge" in text
+        assert "# TYPE raft_tpu_serve_latency_s histogram" in text
+
+    def test_histogram_buckets_cumulative_and_closed(self):
+        fams = parse_prometheus(render_prometheus(_reg().collect()))
+        lat = fams["raft_tpu_serve_latency_s"]
+        buckets = [s for s in lat if s["series"].endswith("_bucket")]
+        assert buckets, lat
+        # cumulative: values never decrease with rising le, +Inf == count
+        les = [(float("inf") if s["labels"]["le"] == "+Inf"
+                else float(s["labels"]["le"]), s["value"])
+               for s in buckets]
+        les.sort()
+        vals = [v for _, v in les]
+        assert vals == sorted(vals)
+        count = [s for s in lat if s["series"].endswith("_count")][0]
+        assert les[-1][1] == count["value"] == 2
+
+    def test_parse_rejects_malformed(self):
+        with pytest.raises(ValueError):
+            parse_prometheus("this is { not a metric line")
+
+    def test_help_carries_original_dotted_name(self):
+        text = render_prometheus(_reg().collect())
+        assert "serve.latency_s" in text  # the HELP line names the source
+
+    def test_label_escaping(self):
+        reg = MetricsRegistry()
+        reg.inc("x", labels={"p": 'say "hi"\nthere'})
+        text = render_prometheus(reg.collect())
+        assert r'\"hi\"' in text and r"\n" in text
+        fams = parse_prometheus(text)  # still parses
+        (series,) = fams["raft_tpu_x"]
+        assert series["labels"]["p"] == 'say "hi"\nthere'  # round-trips
+
+    def test_label_values_with_commas_round_trip(self):
+        # a comma (or brace) inside a quoted label VALUE must not be
+        # split into bogus extra labels by the parser
+        reg = MetricsRegistry()
+        reg.inc("y", labels={"t": 'a,b"q', "u": "c{d}e"})
+        fams = parse_prometheus(render_prometheus(reg.collect()))
+        (series,) = fams["raft_tpu_y"]
+        assert series["labels"] == {"t": 'a,b"q', "u": "c{d}e"}
+
+    def test_malformed_label_body_raises(self):
+        with pytest.raises(ValueError):
+            parse_prometheus('m{bad-key="v"} 1')
+        with pytest.raises(ValueError):
+            parse_prometheus('m{k="v" extra} 1')
+
+
+class TestServer:
+    def _get(self, url, timeout=10):
+        with urllib.request.urlopen(url, timeout=timeout) as r:
+            return r.status, r.read()
+
+    def test_metrics_roundtrip_on_ephemeral_port(self):
+        with ExpoServer(port=0, registry=_reg()) as expo:
+            assert expo.port and expo.port > 0
+            status, body = self._get(expo.url + "/metrics")
+            assert status == 200
+            fams = parse_prometheus(body.decode())
+            assert "raft_tpu_serve_requests" in fams
+        assert expo.port is None  # stopped
+
+    def test_healthz_without_provider_is_ok(self):
+        with ExpoServer(port=0, registry=_reg()) as expo:
+            status, body = self._get(expo.url + "/healthz")
+            assert status == 200
+            assert json.loads(body)["status"] == "ok"
+
+    def test_healthz_reflects_tenant_states(self):
+        desc = {"tenants": [{"name": "a", "state": "serving"},
+                            {"name": "b", "state": "evicted"}],
+                "resident_bytes": 10, "budget_bytes": 100}
+        with ExpoServer(port=0, registry=_reg(),
+                        health=lambda: desc) as expo:
+            status, body = self._get(expo.url + "/healthz")
+            doc = json.loads(body)
+            assert status == 200
+            assert doc["tenants"] == {"a": "serving", "b": "evicted"}
+            # everything terminal -> 503
+            desc["tenants"] = [{"name": "a", "state": "failed"},
+                               {"name": "b", "state": "evicted"}]
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                self._get(expo.url + "/healthz")
+            assert ei.value.code == 503
+            assert json.loads(ei.value.read())["status"] == "unavailable"
+
+    def test_flightz_triggers_dump(self, tmp_path):
+        marker = tmp_path / "dumped.json"
+
+        def fake_dump():
+            marker.write_text("{}")
+            return str(marker)
+
+        with ExpoServer(port=0, registry=_reg(),
+                        flight_dump=fake_dump) as expo:
+            status, body = self._get(expo.url + "/flightz")
+            assert status == 200
+            assert json.loads(body)["path"] == str(marker)
+            assert marker.exists()
+
+    def test_unknown_path_404(self):
+        with ExpoServer(port=0, registry=_reg()) as expo:
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                self._get(expo.url + "/nope")
+            assert ei.value.code == 404
+
+    def test_callable_registry_resolves_per_scrape(self):
+        regs = {"cur": MetricsRegistry()}
+        regs["cur"].inc("gen", 1)
+        with ExpoServer(port=0, registry=lambda: regs["cur"]) as expo:
+            _, body = self._get(expo.url + "/metrics")
+            assert "raft_tpu_gen 1" in body.decode()
+            regs["cur"] = MetricsRegistry()
+            regs["cur"].inc("gen", 5)
+            _, body = self._get(expo.url + "/metrics")
+            assert "raft_tpu_gen 5" in body.decode()
+
+
+class TestNoteBudget:
+    def test_budget_mirrors_into_hbm_family(self):
+        reg = MetricsRegistry()
+        hbm.note_budget(1 << 20, reg)
+        g = reg.snapshot()["gauges"]
+        # its OWN labeled series: the allocator's unlabeled/{device=i}
+        # readings (hbm.sample) must never be clobbered by a
+        # capacity-capped admission budget
+        assert g["hbm.bytes_limit{source=admission}"] == float(1 << 20)
+        assert "hbm.bytes_limit" not in g
+        assert "hbm.bytes_limit{device=0}" not in g
+
+
+class TestJsonlRotation:
+    def _fill(self, reg, n=40):
+        for i in range(n):
+            reg.inc(f"series.{i}", i + 1, labels={"idx": str(i)})
+
+    def test_unbounded_by_default(self, tmp_path):
+        path = str(tmp_path / "m.jsonl")
+        reg = MetricsRegistry()
+        self._fill(reg)
+        for _ in range(5):
+            reg.dump_jsonl(path)
+        assert not os.path.exists(path + ".1")
+
+    def test_rotates_at_cap_and_keeps_n(self, tmp_path):
+        path = str(tmp_path / "m.jsonl")
+        reg = MetricsRegistry()
+        self._fill(reg)
+        one_dump = reg.dump_jsonl(path)
+        assert one_dump == 40
+        size = os.path.getsize(path)
+        cap_mb = (size * 2) / (1 << 20)  # rotate every ~2 dumps
+        for _ in range(12):
+            reg.dump_jsonl(path, max_mb=cap_mb, keep=2)
+        assert os.path.exists(path + ".1")
+        assert os.path.exists(path + ".2")
+        assert not os.path.exists(path + ".3")  # keep=2 prunes
+        # every retained file is valid JSONL (atomic renames: a reader
+        # never sees a torn file)
+        from raft_tpu.obs.metrics import load_jsonl
+
+        for p in (path, path + ".1", path + ".2"):
+            rows = load_jsonl(p)
+            assert rows and all("kind" in r for r in rows)
+        # the live file stays under ~cap + one dump
+        assert os.path.getsize(path) <= size * 3
+
+    def test_env_knobs(self, tmp_path, monkeypatch):
+        path = str(tmp_path / "m.jsonl")
+        reg = MetricsRegistry()
+        self._fill(reg)
+        reg.dump_jsonl(path)
+        cap_mb = os.path.getsize(path) / (1 << 20)
+        monkeypatch.setenv("RAFT_TPU_OBS_JSONL_MAX_MB", repr(cap_mb))
+        monkeypatch.setenv("RAFT_TPU_OBS_JSONL_KEEP", "1")
+        reg.dump_jsonl(path)  # at cap -> rotates
+        reg.dump_jsonl(path)
+        assert os.path.exists(path + ".1")
+        assert not os.path.exists(path + ".2")

@@ -1,0 +1,294 @@
+"""obs.fleet — pod-wide aggregation & straggler attribution (ISSUE 15
+tentpole c).
+
+The aggregation contract under test: per-host flight dumps carry fleet
+identity (shared run_id, rank, clock anchor); ``aggregate`` merges them
+onto one run-relative, pid-collision-free timeline; the straggler table
+names the slowest host per collective with the right skew fraction; and
+``obsdump --fleet`` / ``--slowest`` render it all. Synthetic dumps —
+device-free and fast; the real end-to-end (subprocess-per-host over a
+live distributed build) runs in the dryrun's MULTICHIP fleet leg.
+"""
+
+import json
+import os
+
+import pytest
+
+from raft_tpu.obs import fleet
+
+
+def _span(name, ts, dur, args=None, tid=1):
+    e = {"ph": "X", "name": name, "ts": ts, "dur": dur, "tid": tid,
+         "tname": "MainThread"}
+    if args:
+        e["args"] = args
+    return e
+
+
+def _dump(path, rank, pid, anchor, t0, comms_dur, run_id="runA",
+          extra_events=(), counters=None):
+    """One synthetic per-host flight dump: a couple of comms.allgatherv
+    spans at host-local wall times (anchor + t0 …) plus extras."""
+    events = [
+        _span("ivf_pq.build_distributed.comms.allgatherv",
+              anchor + t0, comms_dur, {"op": "allgatherv"}),
+        _span("ivf_pq.build_distributed.comms.allgatherv",
+              anchor + t0 + 1.0, comms_dur, {"op": "allgatherv"}),
+        _span("ivf_pq.build_distributed.encode", anchor + t0 + 2.0, 0.5),
+    ] + list(extra_events)
+    doc = {
+        "schema": "raft_tpu.flight/1",
+        "reason": "fleet-test",
+        "pid": pid,
+        "host": f"host{rank}",
+        "uptime_s": 5.0,
+        "fleet": {"run_id": run_id, "host": f"host{rank}", "pid": pid,
+                  "rank": rank, "anchor_wall_s": anchor,
+                  "wall_s": anchor + 10.0, "mono_s": 1000.0 + rank},
+        "metrics": {"counters": counters or
+                    {"comms.ops{axis=shard,op=allgatherv,rank=%d}" % rank:
+                     2.0},
+                    "gauges": {}, "histograms": {}},
+        "events": events,
+        "dropped_events": 0,
+        "logs": [],
+    }
+    with open(path, "w") as f:
+        json.dump(doc, f)
+    return path
+
+
+@pytest.fixture()
+def dumps(tmp_path):
+    anchor = 1_000_000.0
+    paths = []
+    for rank in range(3):
+        dur = 0.9 if rank == 2 else 0.3  # rank2 is the straggler
+        paths.append(_dump(str(tmp_path / f"flight_r{rank}.json"),
+                           rank, pid=500, anchor=anchor,
+                           t0=0.5 + rank * 0.01, comms_dur=dur))
+    return paths
+
+
+class TestIdentity:
+    def test_run_id_env_wins(self, monkeypatch):
+        monkeypatch.setenv(fleet.RUN_ID_ENV, "shared-42")
+        assert fleet.run_id() == "shared-42"
+        ident = fleet.identity()
+        assert ident["run_id"] == "shared-42"
+        assert ident["pid"] == os.getpid()
+
+    def test_run_id_minted_once_per_process(self, monkeypatch):
+        monkeypatch.delenv(fleet.RUN_ID_ENV, raising=False)
+        assert fleet.run_id() == fleet.run_id()
+
+    def test_rank_and_anchor_parse(self, monkeypatch):
+        monkeypatch.setenv(fleet.RANK_ENV, "3")
+        monkeypatch.setenv(fleet.ANCHOR_ENV, "123.5")
+        assert fleet.rank() == 3
+        assert fleet.anchor_wall_s() == 123.5
+        monkeypatch.setenv(fleet.RANK_ENV, "junk")
+        assert fleet.rank() is None
+
+    def test_host_tag(self):
+        assert fleet.host_tag({"rank": 2}) == "rank2"
+        assert fleet.host_tag({"host": "h", "pid": 9}) == "h:9"
+
+    def test_flight_dump_carries_identity(self, tmp_path, monkeypatch):
+        from raft_tpu.obs import flight
+
+        monkeypatch.setenv(fleet.RUN_ID_ENV, "dump-id-1")
+        flight.uninstall()
+        try:
+            p = flight.dump_now("t", dump_dir=str(tmp_path))
+            doc = json.load(open(p))
+            assert doc["fleet"]["run_id"] == "dump-id-1"
+            assert doc["fleet"]["pid"] == os.getpid()
+            assert doc["fleet"]["mono_s"] > 0
+        finally:
+            flight.uninstall()
+
+
+class TestCollectiveFamily:
+    def test_suffix_from_dotted_stack(self):
+        assert fleet.collective_family(
+            "ivf_pq.build_distributed.comms.allgatherv") \
+            == "comms.allgatherv"
+        assert fleet.collective_family("comms.ring_topk") \
+            == "comms.ring_topk"
+
+    def test_non_collectives_skipped(self):
+        assert fleet.collective_family("serve.dispatch") is None
+        assert fleet.collective_family("telecomms.foo") is None
+
+
+class TestAggregate:
+    def test_one_run_clock_aligned(self, dumps):
+        view = fleet.aggregate(dumps)
+        assert view["run_id"] == "runA"
+        assert {h["tag"] for h in view["hosts"]} == \
+            {"rank0", "rank1", "rank2"}
+        ts = [e["ts"] for e in view["events"]]
+        assert ts == sorted(ts)
+        # anchor-relative: events land at ~0.5..3s, not at wall epoch
+        assert all(0.0 <= t < 10.0 for t in ts), (min(ts), max(ts))
+
+    def test_pid_collisions_remapped(self, dumps):
+        view = fleet.aggregate(dumps)  # all three dumps claim pid 500
+        merged = {h["merged_pid"] for h in view["hosts"]}
+        assert len(merged) == 3
+        assert 500 in merged
+
+    def test_counters_sum_and_per_host_preserved(self, dumps):
+        view = fleet.aggregate(dumps)
+        assert sum(v for k, v in view["counters"].items()
+                   if k.startswith("comms.ops")) == 6.0
+        r2 = [h for h in view["hosts"] if h["tag"] == "rank2"][0]
+        assert any("rank=2" in k for k in r2["counters"])
+
+    def test_straggler_table_names_slowest(self, dumps):
+        view = fleet.aggregate(dumps)
+        rows = view["stragglers"]
+        assert rows
+        ag = rows[0]
+        assert ag["collective"] == "comms.allgatherv"
+        assert ag["slowest"] == "rank2"
+        assert ag["hosts"] == 3 and ag["count"] == 6
+        # means: (0.3, 0.3, 0.9) -> fleet 0.5, skew (0.9-0.5)/0.5 = 0.8
+        assert ag["slowest_mean_s"] == pytest.approx(0.9)
+        assert ag["fleet_mean_s"] == pytest.approx(0.5)
+        assert ag["skew_frac"] == pytest.approx(0.8, abs=1e-3)
+
+    def test_same_host_multiple_dumps_extend_not_replace(
+            self, dumps, tmp_path):
+        """A process that dumped more than once (periodic checkpoints +
+        final dump) contributes ALL its events to the straggler
+        computation — the second file must not replace the first."""
+        extra = _dump(str(tmp_path / "flight_r2_again.json"), 2,
+                      pid=501, anchor=1_000_000.0, t0=3.5,
+                      comms_dur=0.9)
+        view = fleet.aggregate(dumps + [extra])
+        ag = view["stragglers"][0]
+        assert ag["count"] == 8  # 2 per original dump x3 + 2 extra
+        # rank2's mean still reflects BOTH its dumps (all 0.9s)
+        assert ag["per_host_mean_s"]["rank2"] == pytest.approx(0.9)
+        assert len(view["hosts"]) == 4  # one row per dump file
+
+    def test_same_process_cumulative_dumps_dedupe(self, dumps,
+                                                  tmp_path):
+        """Periodic + final dumps of ONE process are cumulative
+        snapshots of the same registry and ring: overlapping events
+        count once, the process keeps one merged pid, and the LATEST
+        counters stand in for the process (no ~2x fleet totals)."""
+        # rank0's "final" dump: same host/pid as dumps[0], a superset
+        # ring (its 3 events again + 1 newer) and grown counters
+        anchor = 1_000_000.0
+        later = str(tmp_path / "flight_r0_final.json")
+        _dump(later, 0, pid=500, anchor=anchor, t0=0.5,
+              comms_dur=0.3,
+              extra_events=[_span(
+                  "ivf_pq.build_distributed.comms.allgatherv",
+                  anchor + 4.0, 0.3, {"op": "allgatherv"})],
+              counters={"comms.ops{axis=shard,op=allgatherv,rank=0}":
+                        3.0})
+        doc = json.load(open(later))
+        doc["fleet"]["wall_s"] = anchor + 20.0  # later than dumps[0]
+        json.dump(doc, open(later, "w"))
+        view = fleet.aggregate(dumps + [later])
+        # events: 3 hosts x 3 + 1 genuinely-new = 10 (no duplicates)
+        assert len(view["events"]) == 10
+        r0 = [h for h in view["hosts"] if h["tag"] == "rank0"]
+        assert len(r0) == 2
+        assert r0[0]["merged_pid"] == r0[1]["merged_pid"]
+        # counters: rank0 contributes its LATEST snapshot (3.0), not
+        # the 2.0 + 3.0 double count
+        assert view["counters"][
+            "comms.ops{axis=shard,op=allgatherv,rank=0}"] == 3.0
+        # straggler means fold the extra (deduped) allgatherv span
+        ag = view["stragglers"][0]
+        assert ag["count"] == 7  # 2+2+2 originals + 1 new
+
+    def test_mixed_run_ids_surface(self, dumps, tmp_path):
+        other = _dump(str(tmp_path / "flight_other.json"), 7, 900,
+                      anchor=1_000_000.0, t0=0.1, comms_dur=0.1,
+                      run_id="runB")
+        view = fleet.aggregate(dumps + [other])
+        assert view["run_id"] is None
+        assert view["run_ids"] == ["runA", "runB"]
+
+    def test_fleetless_dump_merges_without_skewing_origin(self, tmp_path):
+        """A pre-ISSUE-15 dump (no fleet stamp) must neither crash the
+        merge nor shift its siblings' fallback origin: the (wall −
+        uptime) pairing is per dump, never positional across a
+        filtered list."""
+        anchor = 3_000_000.0
+        new = _dump(str(tmp_path / "new.json"), 0, 1, anchor=anchor,
+                    t0=0.5, comms_dur=0.2)
+        doc = json.load(open(new))
+        doc["fleet"]["anchor_wall_s"] = None
+        doc["uptime_s"] = 1.0
+        json.dump(doc, open(new, "w"))
+        old = str(tmp_path / "old.json")
+        json.dump({"schema": "raft_tpu.flight/1", "reason": "legacy",
+                   "pid": 77, "host": "oldhost", "uptime_s": 500.0,
+                   "metrics": {"counters": {}, "gauges": {},
+                               "histograms": {}},
+                   "events": [], "dropped_events": 0, "logs": []},
+                  open(old, "w"))
+        view = fleet.aggregate([old, new])
+        assert len(view["hosts"]) == 2
+        ts = [e["ts"] for e in view["events"]]
+        # origin = new dump's (wall − uptime) = anchor + 9; events at
+        # anchor + 0.5.. land slightly NEGATIVE of it — never ~500 s
+        # off (the mismatched-zip bug this guards against)
+        assert all(abs(t) < 30.0 for t in ts), ts
+
+    def test_anchorless_dump_falls_back(self, tmp_path):
+        p = _dump(str(tmp_path / "f.json"), 0, 1, anchor=2_000_000.0,
+                  t0=0.5, comms_dur=0.2)
+        doc = json.load(open(p))
+        doc["fleet"]["anchor_wall_s"] = None
+        json.dump(doc, open(p, "w"))
+        view = fleet.aggregate([p])
+        ts = [e["ts"] for e in view["events"]]
+        # aligned against (wall - uptime): small nonnegative offsets
+        assert all(-10.0 <= t <= 20.0 for t in ts), ts
+
+    def test_empty(self):
+        view = fleet.aggregate([])
+        assert view["hosts"] == [] and view["stragglers"] == []
+
+
+class TestExportChrome:
+    def test_perfetto_loadable(self, dumps, tmp_path):
+        view = fleet.aggregate(dumps)
+        out = str(tmp_path / "pod.json")
+        n = fleet.export_chrome(view, out)
+        doc = json.load(open(out))
+        assert n == len(doc["traceEvents"])
+        names = {e["args"]["name"] for e in doc["traceEvents"]
+                 if e.get("ph") == "M" and e["name"] == "process_name"}
+        assert names == {"rank0", "rank1", "rank2"}
+        assert all("ts" in e for e in doc["traceEvents"]
+                   if e.get("ph") == "X")
+
+
+class TestObsdumpFleet:
+    def test_fleet_render_and_merge(self, dumps, tmp_path, capsys):
+        from tools import obsdump
+
+        out = str(tmp_path / "merged.json")
+        rc = obsdump.main(["--fleet", *dumps, "--merge", out])
+        assert rc == 0
+        text = capsys.readouterr().out
+        assert "stragglers" in text
+        assert "rank2" in text and "comms.allgatherv" in text
+        assert os.path.exists(out)
+
+    def test_flight_header_shows_fleet_identity(self, dumps, capsys):
+        from tools import obsdump
+
+        assert obsdump.main([dumps[2]]) == 0
+        text = capsys.readouterr().out
+        assert "run_id=runA" in text and "rank=2" in text

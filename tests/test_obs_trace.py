@@ -565,3 +565,274 @@ class TestObsdumpFlight:
             obs.disable()
             rec2.close()
         assert "serving (serve.*)" not in obsdump.render(path2, top=5)
+
+
+# ---------------------------------------------------------------------------
+# ISSUE 15: request-scoped trace propagation + exemplars
+# ---------------------------------------------------------------------------
+
+class TestRequestContext:
+    def test_trace_ids_are_unique_hex(self):
+        ids = {trace.new_trace_id() for _ in range(64)}
+        assert len(ids) == 64
+        assert all(len(i) == 16 and int(i, 16) >= 0 for i in ids)
+
+    def test_event_labels_single_and_batch(self):
+        ctx = trace.RequestContext(tenant="acme")
+        assert ctx.event_labels() == {"trace_id": ctx.trace_id,
+                                      "tenant": "acme"}
+        batch = trace.RequestContext(tenant="acme",
+                                     trace_ids=["a", "b", "c"])
+        assert batch.event_labels()["trace_ids"] == ["a", "b", "c"]
+        assert batch.matches("b") and not batch.matches("z")
+
+    def test_use_request_nests_and_restores(self):
+        assert trace.current_request() is None
+        outer = trace.RequestContext()
+        inner = trace.RequestContext()
+        with trace.use_request(outer):
+            assert trace.current_request() is outer
+            with trace.use_request(inner):
+                assert trace.current_request() is inner
+            assert trace.current_request() is outer
+        assert trace.current_request() is None
+
+    def test_context_is_thread_local(self):
+        ctx = trace.RequestContext()
+        seen = {}
+
+        def other():
+            seen["ctx"] = trace.current_request()
+
+        with trace.use_request(ctx):
+            t = threading.Thread(target=other)
+            t.start()
+            t.join()
+        assert seen["ctx"] is None
+
+    def test_spans_stamp_current_request(self):
+        reg = MetricsRegistry()
+        obs.enable(registry=reg, hbm=False, events=True)
+        ctx = trace.RequestContext(tenant="t9")
+        with trace.use_request(ctx):
+            with tracing.span("stagex", labels={"k": 1}):
+                pass
+        with tracing.span("unstamped"):
+            pass
+        events = {e["name"]: e for e in trace.get_buffer().snapshot()}
+        assert events["stagex"]["args"] == {
+            "k": 1, "trace_id": ctx.trace_id, "tenant": "t9"}
+        assert "args" not in events["unstamped"]
+        assert trace.event_matches_trace(events["stagex"], ctx.trace_id)
+
+    def test_batch_context_matches_every_member(self):
+        reg = MetricsRegistry()
+        obs.enable(registry=reg, hbm=False, events=True)
+        ctx = trace.RequestContext(trace_ids=["m1", "m2"])
+        with trace.use_request(ctx):
+            with tracing.span("batchstage"):
+                pass
+        (ev,) = [e for e in trace.get_buffer().snapshot()
+                 if e["name"] == "batchstage"]
+        assert trace.event_matches_trace(ev, "m1")
+        assert trace.event_matches_trace(ev, "m2")
+        assert not trace.event_matches_trace(ev, "m3")
+
+    def test_degrade_steps_carry_trace_ids(self):
+        from raft_tpu.robust import degrade
+
+        degrade.clear_recent()
+        reg = MetricsRegistry()
+        obs.enable(registry=reg, hbm=False, events=True)
+        ctx = trace.RequestContext(tenant="t1")
+        with trace.use_request(ctx):
+            degrade.note_step("site.x", "native", "bf16_lut", "test")
+        degrade.note_step("site.y", "native", "fp8_lut", "test")
+        steps = degrade.recent_steps()
+        assert steps[-2]["trace_id"] == ctx.trace_id
+        assert "trace_id" not in steps[-1]
+        # the move also landed in the event ring as a zero-dur marker
+        markers = [e for e in trace.get_buffer().snapshot()
+                   if e["name"] == "degrade.step"]
+        assert any(trace.event_matches_trace(e, ctx.trace_id)
+                   for e in markers)
+        degrade.clear_recent()
+
+    def test_retry_attempts_land_in_timeline(self):
+        from raft_tpu.robust import retry
+
+        reg = MetricsRegistry()
+        obs.enable(registry=reg, hbm=False, events=True)
+        ctx = trace.RequestContext()
+        calls = {"n": 0}
+
+        def flaky():
+            calls["n"] += 1
+            if calls["n"] < 3:
+                raise OSError("transient")
+            return "ok"
+
+        with trace.use_request(ctx):
+            out = retry.retry_call(flaky, site="test.site",
+                                   policy=retry.RetryPolicy(
+                                       max_attempts=5, base_delay_s=0.0,
+                                       jitter=0.0))
+        assert out == "ok"
+        markers = [e for e in trace.get_buffer().snapshot()
+                   if e["name"] == "retry.attempt"]
+        assert len(markers) == 2  # attempts 2 and 3, never the first
+        assert all(trace.event_matches_trace(e, ctx.trace_id)
+                   for e in markers)
+        assert [e["args"]["attempt"] for e in markers] == [2, 3]
+
+
+class TestExemplars:
+    def test_reservoir_bounded_and_keeps_worst(self):
+        from raft_tpu.obs import metrics as m
+
+        h = m.Histogram("h", buckets=[1.0, 10.0])
+        for i in range(50):
+            h.observe(0.1 + i * 0.01, exemplar=f"t{i}")
+        st = h.state()
+        res = st["exemplars"]["1.0"]
+        assert len(res) == m.EXEMPLARS_PER_BUCKET
+        # the largest values in the bucket are retained, worst first
+        vals = [e["value"] for e in res]
+        assert vals == sorted(vals, reverse=True)
+        assert res[0]["trace_id"] == "t49"
+
+    def test_no_exemplars_no_state_key(self):
+        from raft_tpu.obs import metrics as m
+
+        h = m.Histogram("h")
+        h.observe(0.5)
+        assert "exemplars" not in h.state()
+
+    def test_exemplars_for_quantile_picks_right_bucket(self):
+        from raft_tpu.obs import metrics as m
+
+        h = m.Histogram("h", buckets=[0.01, 0.1, 1.0])
+        for i in range(99):
+            h.observe(0.005, exemplar=f"fast{i}")
+        h.observe(0.5, exemplar="slow")
+        ex99 = m.exemplars_for_quantile(h.state(), 0.997)
+        assert ex99[0]["trace_id"] == "slow"
+        ex50 = m.exemplars_for_quantile(h.state(), 0.5)
+        assert ex50 and ex50[0]["trace_id"].startswith("fast")
+
+    def test_quantile_falls_back_to_nearest_bucket(self):
+        from raft_tpu.obs import metrics as m
+
+        h = m.Histogram("h", buckets=[0.01, 0.1, 1.0])
+        # samples land in the tail bucket WITHOUT exemplars; exemplars
+        # exist only below — the p99 must still resolve
+        for i in range(5):
+            h.observe(0.005, exemplar=f"e{i}")
+        for _ in range(95):
+            h.observe(0.5)  # no exemplar
+        ex = m.exemplars_for_quantile(h.state(), 0.99)
+        assert ex and ex[0]["trace_id"].startswith("e")
+
+    def test_empty_histogram(self):
+        from raft_tpu.obs import metrics as m
+
+        assert m.exemplars_for_quantile(m.Histogram("h").state(),
+                                        0.99) == []
+
+    def test_exemplars_roundtrip_jsonl(self, tmp_path):
+        from raft_tpu.obs import metrics as m
+
+        reg = MetricsRegistry()
+        reg.observe("lat", 0.2, exemplar="tid0")
+        path = str(tmp_path / "x.jsonl")
+        reg.dump_jsonl(path)
+        (row,) = [r for r in m.load_jsonl(path)
+                  if r["kind"] == "histogram"]
+        assert row["exemplars"]["1.0"][0]["trace_id"] == "tid0"
+
+
+class TestExportUnderConcurrentLoad:
+    """ISSUE 15 satellite: export_chrome racing ring eviction and a
+    mid-export dump_now must produce schema-valid output — no torn
+    events, eviction accounting consistent."""
+
+    def test_export_races_eviction_and_flight_dump(self, tmp_path):
+        buf = trace.EventBuffer(capacity=512)  # small ring: constant
+        trace.set_buffer(buf)                  # eviction under load
+        reg = MetricsRegistry()
+        obs.enable(registry=reg, hbm=False, events=True)
+        stop = threading.Event()
+        errors = []
+
+        def hammer(tag):
+            i = 0
+            while not stop.is_set():
+                buf.record_span(f"load.{tag}", ts=time.time(),
+                                dur=0.001, args={"i": i})
+                i += 1
+
+        def dumper():
+            while not stop.is_set():
+                p = flight.dump_now("race",
+                                    dump_dir=str(tmp_path / "flight"))
+                if p is None:
+                    errors.append("dump_now failed")
+
+        writers = [threading.Thread(target=hammer, args=(t,))
+                   for t in range(4)]
+        dump_thread = threading.Thread(target=dumper)
+        for t in writers:
+            t.start()
+        dump_thread.start()
+        export_paths = []
+        try:
+            for j in range(10):
+                p = str(tmp_path / f"trace_{j}.json")
+                trace.export_chrome(p, buf)
+                export_paths.append(p)
+        finally:
+            stop.set()
+            for t in writers:
+                t.join()
+            dump_thread.join()
+        assert not errors
+        for p in export_paths:
+            doc = json.load(open(p))  # parses: no torn file
+            evs = doc["traceEvents"]
+            assert all(
+                {"name", "ph", "pid", "tid", "ts"} <= set(e) or
+                e["ph"] in ("M", "C") for e in evs), "torn event"
+            xs = [e for e in evs if e["ph"] == "X"]
+            assert all("dur" in e and "ts" in e for e in xs)
+            # eviction accounting: dropped is reported and consistent
+            # with a bounded ring (retained <= capacity)
+            assert len(xs) <= 512
+            assert doc["otherData"]["dropped_events"] >= 0
+        # the racing flight dumps are each valid JSON with event lists
+        fdir = tmp_path / "flight"
+        dumps = list(fdir.glob("flight_*.json")) if fdir.exists() else []
+        for p in dumps:
+            doc = json.load(open(p))
+            assert isinstance(doc["events"], list)
+            assert doc["dropped_events"] >= 0
+
+    def test_eviction_counter_monotonic_under_race(self):
+        buf = trace.EventBuffer(capacity=64)
+        stop = threading.Event()
+
+        def hammer():
+            while not stop.is_set():
+                buf.record_span("x", ts=0.0, dur=0.0)
+
+        t = threading.Thread(target=hammer)
+        t.start()
+        try:
+            last = 0
+            for _ in range(200):
+                d = buf.dropped
+                assert d >= last
+                last = d
+        finally:
+            stop.set()
+            t.join()
+        assert buf.dropped + len(buf) == buf._total

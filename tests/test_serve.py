@@ -662,3 +662,329 @@ while True:
                    for k in counters), sorted(counters)
         hists = dumps[0]["metrics"]["histograms"]
         assert "serve.latency_s" in hists
+
+
+# ---------------------------------------------------------------------------
+# ISSUE 15: request-scoped tracing + exposition through the server
+# ---------------------------------------------------------------------------
+
+class TestRequestTracing:
+    def test_future_carries_trace_id(self, pq_index):
+        from raft_tpu.obs import trace
+
+        reg = MetricsRegistry()
+        obs.enable(registry=reg, hbm=False)
+        server = serve.MicroBatchServer(
+            _registry_with(pq_index),
+            serve.ServerConfig(max_batch=4, linger_s=0.001))
+        with server:
+            fut = server.submit("pq", np.zeros(DIM, np.float32), 10)
+            fut.result(timeout=30)
+        assert isinstance(fut.trace_id, str) and len(fut.trace_id) == 16
+
+    def test_latency_exemplars_resolve_to_timelines(self, pq_index, data):
+        from raft_tpu.obs import trace
+        from raft_tpu.obs.metrics import exemplars_for_quantile
+
+        prev = trace.set_buffer(trace.EventBuffer())
+        reg = MetricsRegistry()
+        obs.enable(registry=reg, hbm=False, events=True)
+        server = serve.MicroBatchServer(
+            _registry_with(pq_index),
+            serve.ServerConfig(max_batch=4, linger_s=0.001))
+        try:
+            with server:
+                for j in range(12):
+                    server.search("pq", data[j], 10)
+            lat = reg.snapshot()["histograms"]["serve.latency_s"]
+            assert lat["count"] == 12
+            ex = exemplars_for_quantile(lat, 0.99)
+            assert ex, "p99 resolved to no exemplars"
+            events = trace.get_buffer().snapshot()
+            for e in ex:
+                tid = e["trace_id"]
+                mine = [ev for ev in events
+                        if trace.event_matches_trace(ev, tid)]
+                names = {ev["name"] for ev in mine}
+                # the anchor event + the coalesced dispatch stages
+                assert "serve.request" in names, names
+                assert "serve.dispatch" in names, names
+        finally:
+            trace.set_buffer(prev)
+
+    def test_request_event_details(self, pq_index, data):
+        from raft_tpu.obs import trace
+
+        prev = trace.set_buffer(trace.EventBuffer())
+        reg = MetricsRegistry()
+        obs.enable(registry=reg, hbm=False, events=True)
+        server = serve.MicroBatchServer(
+            _registry_with(pq_index),
+            serve.ServerConfig(max_batch=4, linger_s=0.001))
+        try:
+            with server:
+                fut = server.submit("pq", data[0], 10)
+                fut.result(timeout=30)
+            (ev,) = [e for e in trace.get_buffer().snapshot()
+                     if e["name"] == "serve.request"
+                     and e.get("args", {}).get("trace_id")
+                     == fut.trace_id]
+            args = ev["args"]
+            assert args["outcome"] == "ok"
+            assert args["tenant"] == "pq" and args["k"] == 10
+            assert args["bucket"] >= 1 and 0 < args["fill"] <= 1.0
+            assert args["queue_s"] >= 0.0
+            assert ev["dur"] > 0
+        finally:
+            trace.set_buffer(prev)
+
+    def test_ladder_walk_attributed_to_request(self, pq_index, data):
+        from raft_tpu.obs import trace
+
+        prev = trace.set_buffer(trace.EventBuffer())
+        degrade.clear_recent()
+        reg = MetricsRegistry()
+        obs.enable(registry=reg, hbm=False, events=True)
+        server = serve.MicroBatchServer(
+            _registry_with(pq_index),
+            serve.ServerConfig(max_batch=4, linger_s=0.001))
+        try:
+            with server:
+                faults.install_plan({"faults": [
+                    {"site": "ivf_pq.search", "kind": "oom",
+                     "times": 1}]})
+                fut = server.submit("pq", data[0], 10)
+                fut.result(timeout=30)
+                faults.clear_plan()
+            steps = [s for s in degrade.recent_steps()
+                     if s.get("site") == "ivf_pq.search"]
+            assert steps, "no ladder move recorded"
+            assert fut.trace_id in steps[-1].get("trace_ids", []), steps
+            # and the zero-dur marker joined the request's timeline
+            markers = [e for e in trace.get_buffer().snapshot()
+                       if e["name"] == "degrade.step"
+                       and trace.event_matches_trace(e, fut.trace_id)]
+            assert markers
+        finally:
+            trace.set_buffer(prev)
+
+    def test_shed_deadline_records_event(self, pq_index, data):
+        from raft_tpu.obs import trace
+        from raft_tpu.robust.retry import Deadline
+
+        prev = trace.set_buffer(trace.EventBuffer())
+        reg = MetricsRegistry()
+        obs.enable(registry=reg, hbm=False, events=True)
+        server = serve.MicroBatchServer(
+            _registry_with(pq_index),
+            serve.ServerConfig(max_batch=4, linger_s=0.001))
+        try:
+            with server:
+                fut = server.submit("pq", data[0], 10, slo_s=1e-9)
+                with pytest.raises(retry.DeadlineExceeded):
+                    fut.result(timeout=30)
+            evs = [e for e in trace.get_buffer().snapshot()
+                   if e["name"] == "serve.request"
+                   and trace.event_matches_trace(e, fut.trace_id)]
+            assert evs and evs[0]["args"]["outcome"] == "shed_deadline"
+        finally:
+            trace.set_buffer(prev)
+
+
+class TestServerExposition:
+    def test_endpoint_lives_and_dies_with_server(self, pq_index, data):
+        import urllib.request
+
+        from raft_tpu.obs.expo import parse_prometheus
+
+        reg = MetricsRegistry()
+        obs.enable(registry=reg, hbm=False)
+        server = serve.MicroBatchServer(
+            _registry_with(pq_index),
+            serve.ServerConfig(max_batch=4, linger_s=0.001,
+                               expo_port=0))
+        with server:
+            assert server.expo is not None and server.expo.port > 0
+            url = server.expo.url
+            for j in range(3):
+                server.search("pq", data[j], 10)
+            text = urllib.request.urlopen(
+                url + "/metrics", timeout=10).read().decode()
+            fams = parse_prometheus(text)
+            assert "raft_tpu_serve_requests" in fams
+            assert "raft_tpu_serve_latency_s" in fams
+            assert "raft_tpu_hbm_bytes_limit" in fams
+            health = json.loads(urllib.request.urlopen(
+                url + "/healthz", timeout=10).read())
+            assert health["tenants"]["pq"] == "serving"
+        assert server.expo is None  # stopped with the server
+        with pytest.raises(Exception):
+            urllib.request.urlopen(url + "/metrics", timeout=2)
+
+    def test_budget_mirrored_even_when_obs_enabled_late(self, pq_index):
+        """Registry built BEFORE obs.enable (the reverse of the CI
+        smoke's order) must still expose hbm.bytes_limit once the
+        server starts — the mirror re-fires at start()."""
+        obs.disable()
+        registry = _registry_with(pq_index)  # obs off: no init mirror
+        reg = MetricsRegistry()
+        obs.enable(registry=reg, hbm=False)
+        server = serve.MicroBatchServer(
+            registry, serve.ServerConfig(max_batch=4, linger_s=0.001))
+        with server:
+            pass
+        g = reg.snapshot()["gauges"]
+        assert g.get("hbm.bytes_limit{source=admission}") == \
+            float(registry.budget_bytes)
+
+    def test_not_running_shed_records_anchor_event(self, pq_index,
+                                                   data):
+        from raft_tpu.obs import trace
+
+        prev = trace.set_buffer(trace.EventBuffer())
+        reg = MetricsRegistry()
+        obs.enable(registry=reg, hbm=False, events=True)
+        server = serve.MicroBatchServer(
+            _registry_with(pq_index),
+            serve.ServerConfig(max_batch=4, linger_s=0.001))
+        try:
+            with pytest.raises(serve.ShedError):
+                server.submit("pq", data[0], 10)  # never started
+            evs = [e for e in trace.get_buffer().snapshot()
+                   if e["name"] == "serve.request"]
+            assert evs and evs[-1]["args"]["outcome"] == \
+                "shed_not_running"
+        finally:
+            trace.set_buffer(prev)
+
+    def test_failed_bind_leaves_server_stopped(self, pq_index):
+        """An expo port already in use must not leave a half-started
+        server (live batcher, registered flight section, no endpoint,
+        unrestartable) — start() tears back down and raises."""
+        import socket
+
+        from raft_tpu.obs import flight
+
+        taken = socket.socket()
+        taken.bind(("127.0.0.1", 0))
+        port = taken.getsockname()[1]
+        reg = MetricsRegistry()
+        obs.enable(registry=reg, hbm=False)
+        server = serve.MicroBatchServer(
+            _registry_with(pq_index),
+            serve.ServerConfig(max_batch=4, linger_s=0.001,
+                               expo_port=port))
+        try:
+            with pytest.raises(OSError):
+                server.start()
+            assert server.expo is None
+            assert not server._running
+            rec = flight.FlightRecorder("/tmp/raft_tpu_test_bind")
+            body = rec.payload("test")
+            rec.close()
+            assert "serve_registry" not in body  # section cleared
+            # the port freed -> the SAME server starts cleanly
+            taken.close()
+            with server:
+                assert server.expo is not None
+                assert server.expo.port == port
+        finally:
+            taken.close()
+            flight.uninstall()
+
+    def test_no_port_no_endpoint(self, pq_index):
+        reg = MetricsRegistry()
+        obs.enable(registry=reg, hbm=False)
+        server = serve.MicroBatchServer(
+            _registry_with(pq_index),
+            serve.ServerConfig(max_batch=4, linger_s=0.001))
+        with server:
+            assert server.expo is None
+
+    def test_flight_section_registered_while_serving(self, pq_index):
+        from raft_tpu.obs import flight
+
+        reg = MetricsRegistry()
+        obs.enable(registry=reg, hbm=False)
+        server = serve.MicroBatchServer(
+            _registry_with(pq_index),
+            serve.ServerConfig(max_batch=4, linger_s=0.001))
+        flight.uninstall()
+        try:
+            with server:
+                rec = flight.FlightRecorder("/tmp/raft_tpu_test_sect")
+                body = rec.payload("test")
+                rec.close()
+                tenants = {t["name"]: t["state"]
+                           for t in body["serve_registry"]["tenants"]}
+                assert tenants == {"pq": "serving"}
+            rec = flight.FlightRecorder("/tmp/raft_tpu_test_sect")
+            body = rec.payload("test")
+            rec.close()
+            assert "serve_registry" not in body  # cleared on stop
+        finally:
+            flight.uninstall()
+
+
+class TestLoadgenExemplars:
+    def test_run_step_returns_slow_trace_ids(self, pq_index, data):
+        reg = MetricsRegistry()
+        obs.enable(registry=reg, hbm=False)
+        server = serve.MicroBatchServer(
+            _registry_with(pq_index),
+            serve.ServerConfig(max_batch=8, linger_s=0.001))
+        with server:
+            row = loadgen.run_step(server, "pq", data[:64], 10,
+                                   offered_qps=200.0, duration_s=0.5)
+        assert row["completed"] > 0
+        assert row["slow_trace_ids"], row
+        assert all(len(t) == 16 for t in row["slow_trace_ids"])
+
+    def test_record_notes_name_worst_p99_offenders(self):
+        rows = [
+            {"offered_qps": 100.0, "duration_s": 1.0, "sent": 10,
+             "completed": 10, "shed": 0, "shed_reasons": {},
+             "deadline_missed": 0, "errors": 0, "qps": 10.0,
+             "latency_p50_s": 0.002, "latency_p99_s": 0.004,
+             "latency_mean_s": 0.002, "slow_trace_ids": ["a" * 16]},
+            {"offered_qps": 400.0, "duration_s": 1.0, "sent": 40,
+             "completed": 40, "shed": 0, "shed_reasons": {},
+             "deadline_missed": 0, "errors": 0, "qps": 40.0,
+             "latency_p50_s": 0.004, "latency_p99_s": 0.090,
+             "latency_mean_s": 0.01,
+             "slow_trace_ids": ["b" * 16, "c" * 16]},
+        ]
+        rec = loadgen.record(rows, "ds", "pq", 10, note="base")
+        assert "offered_qps=400.0" in rec["baseline_note"]
+        assert "b" * 16 in rec["baseline_note"]
+        assert rec["detail"][1]["slow_trace_ids"] == ["b" * 16, "c" * 16]
+
+    def test_obsdump_slowest_renders_loadgen_offender(
+            self, pq_index, data, tmp_path):
+        from raft_tpu.obs import flight, trace
+
+        prev = trace.set_buffer(trace.EventBuffer())
+        reg = MetricsRegistry()
+        obs.enable(registry=reg, hbm=False, events=True)
+        server = serve.MicroBatchServer(
+            _registry_with(pq_index),
+            serve.ServerConfig(max_batch=8, linger_s=0.001))
+        flight.uninstall()
+        try:
+            with server:
+                row = loadgen.run_step(server, "pq", data[:64], 10,
+                                       offered_qps=200.0,
+                                       duration_s=0.5)
+                rec = flight.FlightRecorder(str(tmp_path))
+                path = rec.dump("test")
+                rec.close()
+            from tools import obsdump
+
+            out = obsdump.render(path, top=5, slowest=3)
+            assert "slowest 3 requests" in out
+            assert "serve.request" in out
+            # the loadgen's named offenders appear in the drill-down
+            assert any(t in out for t in row["slow_trace_ids"])
+        finally:
+            trace.set_buffer(prev)
+            flight.uninstall()

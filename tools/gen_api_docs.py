@@ -20,7 +20,8 @@ MODULES = [
     "raft_tpu.core.serialize", "raft_tpu.core.ids",
     "raft_tpu.obs.metrics", "raft_tpu.obs.spans", "raft_tpu.obs.hbm",
     "raft_tpu.obs.prof",
-    "raft_tpu.obs.trace", "raft_tpu.obs.flight", "raft_tpu.obs.sanitize",
+    "raft_tpu.obs.trace", "raft_tpu.obs.flight", "raft_tpu.obs.expo",
+    "raft_tpu.obs.fleet", "raft_tpu.obs.sanitize",
     "raft_tpu.robust.faults", "raft_tpu.robust.retry",
     "raft_tpu.robust.degrade", "raft_tpu.robust.checkpoint",
     "raft_tpu.linalg.blas", "raft_tpu.linalg.solvers",

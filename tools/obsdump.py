@@ -24,9 +24,19 @@ one Perfetto-loadable timeline.
 Usage::
 
     python -m tools.obsdump flight_20260803-120000_123.json
+    python -m tools.obsdump flight_*.json --slowest 5   # exemplar drill-down
+    python -m tools.obsdump --fleet host0.json host1.json --merge pod.json
     python -m tools.obsdump trace_host0.json trace_host1.json --merge all.json
     python -m tools.obsdump bench_obs.jsonl --top 30
     python -m tools.obsdump benchdiff_verdict.json
+
+``--slowest N`` (ISSUE 15) resolves the ``serve.latency_s`` histogram's
+exemplar trace ids to the N slowest concrete requests and renders each
+one's full timeline (queue wait, bucket fill, dispatch, search stages,
+retry attempts, ladder moves) from the dump's event ring. ``--fleet``
+merges one pod run's per-host dumps (shared run_id, clock-aligned) via
+:mod:`raft_tpu.obs.fleet` and renders the per-collective straggler
+table.
 
 Stdlib + raft_tpu.obs only — runs device-free (no jax import needed to
 read a dump).
@@ -66,7 +76,12 @@ def _load_obs_module(name: str):
         return mod
 
 
-quantile_from_state = _load_obs_module("metrics").quantile_from_state
+_metrics_mod = _load_obs_module("metrics")
+quantile_from_state = _metrics_mod.quantile_from_state
+exemplars_for_quantile = _metrics_mod.exemplars_for_quantile
+# the one trace-id↔event filter (obs.trace defines it; --slowest and
+# the tests must agree on coalesced trace_ids semantics)
+_event_matches = _load_obs_module("trace").event_matches_trace
 
 _KEY_RE = re.compile(r"^(?P<name>[^{]+)(?:\{(?P<labels>.*)\})?$")
 
@@ -350,6 +365,109 @@ def serve_tables(snap: Dict[str, Any]) -> str:
     return "\n".join(out) if out else "  (no serve activity)"
 
 
+def _all_exemplars(hists: Dict[str, Any], family: str
+                   ) -> List[Tuple[float, str]]:
+    """Every (value, trace_id) exemplar across all label variants of
+    one histogram family, worst first."""
+    out: List[Tuple[float, str]] = []
+    for key, st in hists.items():
+        if parse_key(key)[0] != family:
+            continue
+        for res in (st.get("exemplars") or {}).values():
+            for e in res:
+                tid = e.get("trace_id")
+                if tid:
+                    out.append((float(e.get("value", 0.0)), tid))
+    out.sort(reverse=True)
+    return out
+
+
+def slowest_table(raw: Dict[str, Any], n: int,
+                  family: str = "serve.latency_s") -> str:
+    """The ``--slowest N`` drill-down (ISSUE 15): resolve the latency
+    histogram's retained exemplars to concrete requests, then render
+    each one's full timeline — every event (queue wait, bucket fill,
+    dispatch, search stages, retry attempts, ladder moves) stamped with
+    its trace id — from the dump's event ring + degrade history."""
+    hists = (raw.get("metrics") or {}).get("histograms", {})
+    exemplars = _all_exemplars(hists, family)
+    if not exemplars:
+        return ("  (no exemplars retained — is the latency histogram "
+                "recording with trace-id exemplars?)\n")
+    events = raw.get("events", [])
+    degrade = (raw.get("robust") or {}).get("degrade_recent", [])
+    out: List[str] = []
+    for rank, (value, tid) in enumerate(exemplars[:n], 1):
+        out.append(f"  #{rank} trace {tid}  latency "
+                   f"{value * 1e3:,.2f} ms")
+        timeline: List[Tuple[float, str, Optional[float], str]] = []
+        for e in events:
+            if e.get("ph") != "X" or not _event_matches(e, tid):
+                continue
+            args = dict(e.get("args") or {})
+            args.pop("trace_id", None)
+            args.pop("trace_ids", None)
+            detail = " ".join(f"{k}={v}" for k, v in sorted(args.items()))
+            timeline.append((float(e.get("ts", 0.0)), e.get("name", "?"),
+                             float(e.get("dur", 0.0)), detail))
+        # degrade history fills in only when the ring lost (or never
+        # recorded) the move — an evicted ring must not hide a walk
+        have_ring_steps = any(name == "degrade.step"
+                              for _, name, _, _ in timeline)
+        for s in [] if have_ring_steps else degrade:
+            if s.get("trace_id") == tid or (
+                    isinstance(s.get("trace_ids"), list)
+                    and tid in s["trace_ids"]):
+                timeline.append((float(s.get("ts", 0.0)),
+                                 "degrade.step", None,
+                                 f"{s.get('site')} {s.get('from')}->"
+                                 f"{s.get('to')} [{s.get('reason')}]"))
+        if not timeline:
+            out.append("    (no timeline events — was event recording "
+                       "on? obs.enable(events=True))")
+            continue
+        timeline.sort(key=lambda t: (t[0], t[1]))  # dur may be None
+        t0 = timeline[0][0]
+        rows = [[f"+{(ts - t0) * 1e3:,.2f}", name, _ms(dur), detail]
+                for ts, name, dur, detail in timeline]
+        out.append(_table(["t_ms", "event", "dur_ms", "detail"], rows))
+    return "\n".join(out) + "\n"
+
+
+def fleet_section(view: Dict[str, Any]) -> str:
+    """Render an ``obs.fleet.aggregate`` view: per-host identity/clock
+    table + the per-collective straggler table (slowest host, skew)."""
+    out = [f"== fleet view (run_id={view.get('run_id') or view.get('run_ids')}, "
+           f"{len(view.get('hosts', []))} hosts, "
+           f"{len(view.get('events', []))} events) ==",
+           "-- hosts --"]
+    # offsets render RELATIVE to the earliest host (the absolute value
+    # is a wall epoch — meaningless to a human; the spread between
+    # hosts is the alignment signal); clock_drift_s is the
+    # stepped-clock indicator: (wall − mono) movement between two
+    # dumps of one process (0 = steady clock)
+    offsets = [h.get("offset_s", 0.0) for h in view.get("hosts", [])]
+    base = min(offsets, default=0.0)
+    rows = [[h.get("tag", "?"), str(h.get("host", "-")),
+             str(h.get("pid", "-")), str(h.get("events", 0)),
+             f"{h.get('offset_s', 0.0) - base:+,.3f}",
+             "-" if h.get("clock_drift_s") is None
+             else f"{h['clock_drift_s']:+,.3f}",
+             str(h.get("reason", "-"))]
+            for h in view.get("hosts", [])]
+    out.append(_table(["host", "hostname", "pid", "events",
+                       "rel_offset_s", "clock_drift_s", "reason"], rows))
+    out.append("-- stragglers (per-collective timing imbalance) --")
+    rows = [[s["collective"], str(s["hosts"]), str(s["count"]),
+             s["slowest"], _ms(s["slowest_mean_s"]),
+             _ms(s["fleet_mean_s"]), f"{s['skew_frac']:+.1%}"]
+            for s in view.get("stragglers", [])]
+    out.append(_table(["collective", "hosts", "ops", "slowest",
+                       "slowest_mean_ms", "fleet_mean_ms", "skew"],
+                      rows))
+    return "\n".join(out)
+
+
 def benchdiff_section(doc: Dict[str, Any]) -> str:
     """Render a benchdiff JSON verdict via the scoreboard renderer
     (``tools.benchdiff.render_markdown`` — also stdlib-only)."""
@@ -369,19 +487,37 @@ def hbm_table(snap: Dict[str, Any]) -> str:
     return _table(["gauge", "device", "value"], rows)
 
 
-def render(path: str, top: int) -> str:
+def render(path: str, top: int, slowest: int = 0) -> str:
     kind, snap, raw = load_any(path)
     out = [f"== {path} ({kind}) =="]
     if kind == "benchdiff":
         out.append(benchdiff_section(raw))
         return "\n".join(out)
     if kind == "flight":
+        fleet_id = raw.get("fleet") or {}
+        run = f" run_id={fleet_id.get('run_id')}" if fleet_id else ""
+        rank = (f" rank={fleet_id.get('rank')}"
+                if fleet_id.get("rank") is not None else "")
         out.append(f"  reason={raw.get('reason')} pid={raw.get('pid')} "
-                   f"host={raw.get('host')} time={raw.get('time')} "
+                   f"host={raw.get('host')}{run}{rank} "
+                   f"time={raw.get('time')} "
                    f"uptime={raw.get('uptime_s')}s "
                    f"events={len(raw.get('events', []))} "
                    f"(+{raw.get('dropped_events', 0)} dropped) "
                    f"log_lines={len(raw.get('logs', []))}")
+        sreg = raw.get("serve_registry")
+        if sreg:
+            # per-tenant health at dump time (ISSUE 15): the dump can
+            # now say WHICH tenants were degraded/evicted at death, not
+            # just how many admits/evicts happened
+            states = ", ".join(
+                f"{t.get('name')}={t.get('state')}"
+                + (" [pinned]" if t.get("pinned") else "")
+                for t in sreg.get("tenants", []))
+            out.append(
+                f"  tenants: {states or '(none)'}  "
+                f"(resident {_human_bytes(sreg.get('resident_bytes', 0))}"
+                f" / budget {_human_bytes(sreg.get('budget_bytes', 0))})")
         robust = raw.get("robust")
         if robust:
             # what the chaos lane injected + how the run degraded —
@@ -403,6 +539,10 @@ def render(path: str, top: int) -> str:
         # run's dump leads with what it was shedding and why
         out.append("-- serving (serve.*) --")
         out.append(serve_tables(snap))
+    if slowest:
+        out.append(f"-- slowest {slowest} requests "
+                   "(exemplar drill-down) --")
+        out.append(slowest_table(raw, slowest))
     out.append("-- top spans by total time --")
     out.append(spans_table(snap, top))
     if any(parse_key(k)[0].startswith("prof.")
@@ -426,8 +566,30 @@ def main(argv: Optional[List[str]] = None) -> int:
     ap.add_argument("--merge", metavar="OUT",
                     help="merge the inputs as Chrome traces into OUT "
                          "(pid-remapped, Perfetto-loadable) instead of "
-                         "rendering tables")
+                         "rendering tables; with --fleet, export the "
+                         "aggregated fleet timeline instead")
+    ap.add_argument("--slowest", type=int, default=0, metavar="N",
+                    help="drill into the N slowest requests: resolve "
+                         "serve.latency_s exemplar trace ids and render "
+                         "each request's full timeline (flight dumps)")
+    ap.add_argument("--fleet", action="store_true",
+                    help="treat the inputs as one pod run's per-host "
+                         "flight dumps: merge them (shared run_id, "
+                         "clock-aligned) and render the per-collective "
+                         "straggler table")
     args = ap.parse_args(argv)
+    if args.fleet:
+        _fleet = _load_obs_module("fleet")
+        view = _fleet.aggregate(args.paths)
+        try:
+            print(fleet_section(view))
+        except BrokenPipeError:
+            devnull = os.open(os.devnull, os.O_WRONLY)
+            os.dup2(devnull, sys.stdout.fileno())
+        if args.merge:
+            n = _fleet.export_chrome(view, args.merge)
+            print(f"fleet timeline ({n} events) -> {args.merge}")
+        return 0
     if args.merge:
         _trace = _load_obs_module("trace")
         doc = _trace.merge(args.paths, out_path=args.merge)
@@ -436,7 +598,7 @@ def main(argv: Optional[List[str]] = None) -> int:
         return 0
     try:
         for p in args.paths:
-            print(render(p, args.top))
+            print(render(p, args.top, slowest=args.slowest))
     except BrokenPipeError:  # downstream `| head` closed the pipe
         devnull = os.open(os.devnull, os.O_WRONLY)
         os.dup2(devnull, sys.stdout.fileno())
