@@ -677,6 +677,202 @@ EOF
 python -m tools.benchdiff serve_cpu_smoke serve_cpu_smoke \
     --md /tmp/raft_tpu_serve_baseline_scoreboard.md | tail -3
 
+echo "== quality plane (ISSUE 16: online recall verifier overhead gate,"
+echo "   recall-fault chaos -> floor breach -> quality-gated ladder ->"
+echo "   recovery, /indexz + obsdump index-health introspection) =="
+python - <<'EOF'
+# Part 1 — verifier overhead gate: the shadow verifier (sampled replay
+# on a background thread) must not move the serving p50 by more than
+# the documented bar (5% or 0.25 ms, whichever is larger) — the same
+# bar the tracing-overhead gate uses.
+import json, shutil, subprocess, sys, time, urllib.request
+import numpy as np
+import jax.numpy as jnp
+
+from raft_tpu import obs, serve
+from raft_tpu.obs import flight
+from raft_tpu.obs.metrics import MetricsRegistry
+from raft_tpu.neighbors import ivf_flat, ivf_pq
+from raft_tpu.robust import faults
+from raft_tpu.serve import loadgen
+from raft_tpu.serve.errors import ShedError
+
+rng = np.random.default_rng(0)
+x = rng.random((8_000, 32), dtype=np.float32)
+flat = ivf_flat.build(jnp.asarray(x), ivf_flat.IndexParams(n_lists=16))
+rows = {}
+for verify in (0.0, 0.25):
+    reg = MetricsRegistry()
+    obs.enable(registry=reg, hbm=False)
+    registry = serve.IndexRegistry(budget_bytes=2 << 30)
+    registry.admit("t", flat, params=ivf_flat.SearchParams(n_probes=8),
+                   default_k=10, dataset=x)
+    server = serve.MicroBatchServer(registry, serve.ServerConfig(
+        max_batch=16, linger_s=0.002, verify_sample=verify,
+        verify_rate_per_s=50.0))
+    with server:
+        # offered load well under the CPU backend's capacity: p50 then
+        # measures service latency, not queue depth — the verifier's
+        # background replay must not move it
+        loadgen.run_step(server, "t", x[:256], 10,
+                         offered_qps=50.0, duration_s=0.4)  # warm
+        rows[verify] = loadgen.run_step(server, "t", x[:256], 10,
+                                        offered_qps=50.0,
+                                        duration_s=1.5)
+        if verify:
+            assert server.verifier is not None
+            assert server.verifier.state()["verified_total"] > 0, \
+                "verifier sampled nothing during the on-step"
+    obs.disable()
+p50_off = rows[0.0]["latency_p50_s"]
+p50_on = rows[0.25]["latency_p50_s"]
+assert p50_on <= max(p50_off * 1.05, p50_off + 2.5e-4), (
+    f"verifier overhead too high: p50 {p50_off*1e3:.3f} ms off -> "
+    f"{p50_on*1e3:.3f} ms on")
+print(f"verifier overhead OK: p50 {p50_off*1e3:.3f} -> "
+      f"{p50_on*1e3:.3f} ms with shadow verification on")
+
+# Part 2 — recall-fault chaos: clustered vectors make the fp8 LUT rung
+# genuinely lossy (~0.9 -> ~0.2 recall@10 measured on this config), so
+# forcing the ladder onto fp8 via injected OOMs while the verifier
+# samples every request drives the measured recall below the tenant's
+# floor: the monitor must breach (healthz "degraded"), arm the quality
+# gate (faulted requests now SHED instead of serving fp8 answers,
+# counted degrade.refused{reason=recall_floor}), and recover once the
+# faults stop and fresh verdicts refill the window.
+xc = (rng.normal(0, 0.02, (4_000, 64)) +
+      rng.random((40, 64))[rng.integers(0, 40, 4_000)]).astype(np.float32)
+pq = ivf_pq.build(jnp.asarray(xc), ivf_pq.IndexParams(
+    n_lists=16, pq_dim=64, seed=0, cache_reconstruction="never"))
+reg = MetricsRegistry()
+obs.enable(registry=reg, hbm=False, events=True)
+registry = serve.IndexRegistry(budget_bytes=2 << 30)
+registry.admit("acme", pq, params=ivf_pq.SearchParams(
+    n_probes=16, lut_dtype="float32", scan_mode="per_query"),
+    default_k=10, dataset=xc, recall_floor=0.6)
+# a deliberately skewed flat tenant rides along for the /indexz smoke
+skew = (np.concatenate([rng.normal(0.5, 0.01, (1_800, 64)),
+                        rng.random((200, 64))])).astype(np.float32)
+registry.admit("skewed", ivf_flat.build(
+    jnp.asarray(skew), ivf_flat.IndexParams(n_lists=16)),
+    params=ivf_flat.SearchParams(n_probes=8), default_k=10,
+    dataset=skew)
+server = serve.MicroBatchServer(registry, serve.ServerConfig(
+    max_batch=4, linger_s=0.001, verify_sample=1.0,
+    verify_rate_per_s=1e9, expo_port=0))
+
+OOM2 = {"faults": [{"site": "ivf_pq.search", "kind": "oom",
+                    "times": 2}]}
+
+
+def healthz(url):
+    return json.loads(urllib.request.urlopen(
+        url + "/healthz", timeout=10).read())
+
+
+def wait(pred, what, timeout=60.0):
+    t0 = time.monotonic()
+    while time.monotonic() - t0 < timeout:
+        if pred():
+            return
+        time.sleep(0.05)
+    raise AssertionError(f"timed out waiting for {what}")
+
+
+with server:
+    url = server.expo.url
+    # healthy phase: self-queries over the admitted dataset at
+    # exhaustive n_probes -> near-perfect verified recall
+    for j in range(16):
+        server.search("acme", xc[j], 10)
+    wait(lambda: reg.snapshot()["gauges"].get(
+        "quality.samples{k=10,tenant=acme}", 0) >= 8,
+        "healthy recall verdicts")
+    g = reg.snapshot()["gauges"]
+    assert g["quality.recall{k=10,tenant=acme}"] > 0.8, g
+    assert healthz(url)["status"] == "ok"
+    # fault phase: every request OOMs twice -> ladder lands on fp8_lut
+    # -> verifier scores the served (lossy) ids against exact truth.
+    # The breach trips on the WILSON LOWER BOUND crossing the floor —
+    # and once it trips, the gate sheds further faulted requests, so
+    # bad verdicts stop arriving and the point estimate freezes; the
+    # assertable signal is the bound, not the mean.
+    for j in range(150):
+        faults.install_plan(OOM2)
+        try:
+            server.search("acme", xc[j % 1000], 10)
+        except ShedError:
+            pass  # gate may already be up mid-loop
+        if server.slo.breached():
+            break
+    faults.clear_plan()
+    wait(lambda: server.slo.breached() == ["acme"], "recall-floor breach")
+    g = reg.snapshot()["gauges"]
+    assert g["quality.recall_ci_low{k=10,tenant=acme}"] < 0.6, g
+    assert g["slo.recall_floor_ok{tenant=acme}"] == 0.0, g
+    doc = healthz(url)
+    assert doc["status"] == "degraded", doc
+    assert doc["slo"]["recall_floor_breached"] == ["acme"], doc
+    c = reg.snapshot()["counters"]
+    assert c.get("slo.recall_floor_breach{tenant=acme}", 0) >= 1, c
+    # gate phase: with the breach armed, a faulted request must SHED
+    # (quality rungs refused; ladder exhausts) instead of serving fp8
+    shed = 0
+    for _ in range(3):
+        faults.install_plan(OOM2)
+        try:
+            server.search("acme", xc[0], 10)
+        except ShedError as e:
+            shed += 1
+            assert "overload" in str(e), e
+    faults.clear_plan()
+    assert shed == 3, f"gated+faulted requests served anyway ({shed}/3)"
+    c = reg.snapshot()["counters"]
+    for rung in ("bf16_lut", "fp8_lut", "decline_fused"):
+        key = f"degrade.refused{{reason=recall_floor,rung={rung}}}"
+        assert c.get(key, 0) >= 3, (key, c)
+    assert c.get("serve.shed{reason=overload}", 0) >= 3, c
+    # recovery phase: clean traffic refills the verdict window with
+    # good recall -> the monitor promotes the tenant back
+    for j in range(220):
+        server.search("acme", xc[j % 1000], 10)
+        if not server.slo.breached():
+            break
+    wait(lambda: not server.slo.breached(), "recall-floor recovery",
+         timeout=90.0)
+    c = reg.snapshot()["counters"]
+    assert c.get("slo.recall_floor_recovered{tenant=acme}", 0) >= 1, c
+    doc = healthz(url)
+    assert doc["status"] == "ok", doc
+    # Part 3 — introspection: /indexz serves live per-tenant index
+    # health (the skewed tenant shows its skew), and the flight dump's
+    # quality section + index gauges render through obsdump
+    idxz = json.loads(urllib.request.urlopen(
+        url + "/indexz", timeout=30).read())
+    sk = idxz["tenants"]["skewed"]["stats"]["lists"]
+    assert sk["n_lists"] == 16 and sk["cv"] > 0.5, sk
+    assert idxz["tenants"]["acme"]["recall_floor"] == 0.6, idxz
+    assert idxz["tenants"]["acme"]["stats"]["pq"]["rel_error"] > 0, idxz
+    shutil.rmtree("/tmp/raft_tpu_quality_flight", ignore_errors=True)
+    dump_path = flight.dump_now("ci-quality",
+                                dump_dir="/tmp/raft_tpu_quality_flight")
+    assert dump_path, "flight dump failed"
+obs.disable()
+p = subprocess.run([sys.executable, "-m", "tools.obsdump", dump_path,
+                    "--worst-recall", "2"], capture_output=True,
+                   text=True)
+assert p.returncode == 0, p.stderr
+assert "quality:" in p.stdout, p.stdout            # flight header
+assert "index health" in p.stdout, p.stdout        # introspection table
+assert "recall verdicts" in p.stdout, p.stdout     # drill-down section
+assert "serve.request" in p.stdout, p.stdout       # resolved timeline
+print("quality chaos OK: breach -> degraded healthz -> "
+      f"{int(c['degrade.refused{reason=recall_floor,rung=fp8_lut}'])} "
+      "refused fp8 rungs -> shed -> recovery; /indexz cv "
+      f"{sk['cv']:.2f} on the skewed tenant; obsdump renders the "
+      "quality header, index-health table and worst-recall timelines")
+EOF
+
 echo "== trace export round-trip (instrumented search -> Perfetto JSON) =="
 python - <<'EOF'
 import json

@@ -44,6 +44,7 @@ from raft_tpu.cluster import kmeans_balanced
 from raft_tpu.cluster.kmeans_balanced import KMeansBalancedParams
 from raft_tpu.distance.types import DistanceType, resolve_metric
 from raft_tpu.matrix import select_k as _select_k
+from raft_tpu.obs import index_stats as _istats
 from raft_tpu.robust import faults as _faults
 from raft_tpu.utils.precision import get_precision
 
@@ -285,9 +286,11 @@ def build(dataset: jax.Array, params: Optional[IndexParams] = None) -> IvfFlatIn
                   "list_size_cap_factor%s)", n_drop,
                   "" if params.spill else " or set spill=True")
     norms = jnp.sum(packed.astype(jnp.float32) ** 2, axis=-1)
-    return IvfFlatIndex(centers=centers, packed_data=packed,
-                        packed_ids=ids, packed_norms=norms,
-                        list_sizes=sizes, metric=mt.value)
+    index = IvfFlatIndex(centers=centers, packed_data=packed,
+                         packed_ids=ids, packed_norms=norms,
+                         list_sizes=sizes, metric=mt.value)
+    _istats.note_index_stats(index, name="ivf_flat.build", cheap=True)
+    return index
 
 
 @traced("raft_tpu.ivf_flat.build_distributed")
@@ -359,10 +362,12 @@ def extend(index: IvfFlatIndex, new_vectors: jax.Array,  # graftlint: disable-fn
     ids[sorted_l[keep], slot[keep]] = ni[order[keep]]
     fill = np.minimum(need, new_L)
     packed_j = jnp.asarray(packed)
-    return IvfFlatIndex(
+    out = IvfFlatIndex(
         centers=index.centers, packed_data=packed_j, packed_ids=jnp.asarray(ids),
         packed_norms=jnp.sum(packed_j.astype(jnp.float32) ** 2, axis=-1),
         list_sizes=jnp.asarray(fill.astype(np.int32)), metric=index.metric)
+    _istats.note_index_stats(out, name="ivf_flat.extend", cheap=True)
+    return out
 
 
 # ---------------------------------------------------------------------------

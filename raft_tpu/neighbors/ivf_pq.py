@@ -47,6 +47,7 @@ from raft_tpu.core.errors import expects
 from raft_tpu.core.tracing import traced, span
 from raft_tpu.core import ids as _ids
 from raft_tpu.core import serialize as ser
+from raft_tpu.obs import index_stats as _istats
 from raft_tpu.obs import spans as _obs_spans
 from raft_tpu.robust import degrade as _degrade
 from raft_tpu.robust import faults as _faults
@@ -841,6 +842,7 @@ def build(dataset: jax.Array, params: Optional[IndexParams] = None) -> IvfPqInde
             recon = _build_recon_cache(index)
             _sp.attach(recon)
             index = index.replace(packed_recon=recon)
+    _istats.note_index_stats(index, name="ivf_pq.build", cheap=True)
     return index
 
 
@@ -1177,6 +1179,8 @@ def build_chunked(dataset, params: Optional[IndexParams] = None,  # graftlint: d
         pq_bits=params.pq_bits, pq_dim_static=pq_dim, codes_folded=fold)
     if _want_recon_cache(params, params.n_lists, L, rot_dim):
         index = index.replace(packed_recon=_build_recon_cache(index))
+    _istats.note_index_stats(index, name="ivf_pq.build_chunked",
+                             cheap=True)
     return index
 
 
@@ -1350,6 +1354,7 @@ def extend(index: IvfPqIndex, new_vectors: jax.Array,  # graftlint: disable-fn=G
         codes_folded=index.codes_folded and (new_L * S) % 128 == 0)
     if index.packed_recon is not None:
         out = out.replace(packed_recon=_build_recon_cache(out))
+    _istats.note_index_stats(out, name="ivf_pq.extend", cheap=True)
     return out
 
 

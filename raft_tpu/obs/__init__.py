@@ -19,7 +19,14 @@ this package makes the same attribution available *in process*:
   of events + metrics + logs on signals/atexit/periodically;
 - :mod:`raft_tpu.obs.expo`    — live telemetry exposition: stdlib HTTP
   endpoint serving Prometheus text-format ``/metrics``, ``/healthz``,
-  and on-demand ``/flightz`` dumps;
+  on-demand ``/flightz`` dumps, and ``/indexz`` index health;
+- :mod:`raft_tpu.obs.quality` — online recall estimation: a shadow
+  verifier reservoir-samples live requests and replays them through
+  exact brute force on host, publishing ``quality.recall`` gauges with
+  Wilson confidence intervals;
+- :mod:`raft_tpu.obs.index_stats` — index-health introspection:
+  list-size skew, dead centroids, centroid drift, PQ quantization
+  error, tombstone density, as ``index.*`` gauges + ``/indexz``;
 - :mod:`raft_tpu.obs.fleet`   — pod-wide aggregation: merges per-host
   flight dumps (shared run_id, clock alignment) and attributes
   collective-timing stragglers;
@@ -69,5 +76,7 @@ from raft_tpu.obs import prof  # noqa: F401
 from raft_tpu.obs import trace  # noqa: F401
 from raft_tpu.obs import flight  # noqa: F401
 from raft_tpu.obs import expo  # noqa: F401
+from raft_tpu.obs import quality  # noqa: F401
+from raft_tpu.obs import index_stats  # noqa: F401
 from raft_tpu.obs import fleet  # noqa: F401
 from raft_tpu.obs import sanitize  # noqa: F401

@@ -20,6 +20,10 @@ is the zero-dependency answer: a stdlib ``http.server`` endpoint
 - ``GET /flightz``  — triggers an on-demand flight dump
   (:func:`raft_tpu.obs.flight.dump_now`) and returns its path: the
   "dump the black box NOW" button, no signal required.
+- ``GET /indexz``   — JSON index-health introspection (ISSUE 16):
+  per-tenant list-size skew, dead centroids, centroid drift, PQ
+  quantization error, and tombstone density, computed on demand by the
+  serving layer and cached on the tenant.
 
 :class:`ExpoServer` is started/stopped by
 :class:`raft_tpu.serve.server.MicroBatchServer` when
@@ -63,9 +67,18 @@ def prom_name(name: str) -> str:
 
 
 def _esc(value: Any) -> str:
-    """Escape a label value per the text-format rules."""
+    """Escape a label value per the text-format rules (backslash,
+    newline, and double-quote — the value sits inside quotes)."""
     return (str(value).replace("\\", r"\\").replace("\n", r"\n")
             .replace('"', r'\"'))
+
+
+def _esc_help(value: Any) -> str:
+    """Escape HELP text per the text-format spec: ONLY backslash and
+    newline — unlike label values, HELP is unquoted, so a ``\\"``
+    there would be a literal backslash-quote to a spec-compliant
+    parser (promtool flags it)."""
+    return str(value).replace("\\", r"\\").replace("\n", r"\n")
 
 
 def _labels_str(labels: Dict[str, str],
@@ -114,7 +127,7 @@ def render_prometheus(rows: List[Dict[str, Any]]) -> str:
         kind = kinds[fam]
         first = rows_f[0]
         out.append(f"# HELP {fam} raft_tpu series "
-                   f"{_esc(first.get('name', fam))}")
+                   f"{_esc_help(first.get('name', fam))}")
         if kind == "histogram":
             out.append(f"# TYPE {fam} histogram")
             for r in rows_f:
@@ -206,17 +219,21 @@ class ExpoServer:
     registry's ``describe()`` dict; drives ``/healthz``.
     ``flight_dump`` — optional zero-arg callable returning a dump path;
     default :func:`raft_tpu.obs.flight.dump_now`.
+    ``indexz`` — optional zero-arg callable returning the per-tenant
+    index-health dict (ISSUE 16); drives ``GET /indexz``.
     """
 
     def __init__(self, port: int = 0, host: str = "127.0.0.1",
                  registry: Any = None,
                  health: Optional[Callable[[], Dict[str, Any]]] = None,
-                 flight_dump: Optional[Callable[[], Optional[str]]] = None):
+                 flight_dump: Optional[Callable[[], Optional[str]]] = None,
+                 indexz: Optional[Callable[[], Dict[str, Any]]] = None):
         self._port_req = int(port)
         self.host = host
         self._registry = registry
         self._health = health
         self._flight_dump = flight_dump
+        self._indexz = indexz
         self._httpd: Optional[ThreadingHTTPServer] = None
         self._thread: Optional[threading.Thread] = None
 
@@ -251,13 +268,26 @@ class ExpoServer:
         resident = [n for n, s in tenants.items()
                     if s in ("warming", "serving", "degraded")]
         ok = bool(resident) or not tenants
-        return (200 if ok else 503), {
-            "status": "ok" if ok else "unavailable",
+        # the quality plane (ISSUE 16): a recall-floor breach or a
+        # degraded tenant keeps serving (HTTP 200 — results still flow)
+        # but the status string flips to "degraded" so orchestration
+        # that reads the body sees quality trouble before users do
+        slo = desc.get("slo") or {}
+        degraded = (bool(slo.get("recall_floor_breached"))
+                    or any(s == "degraded" for s in tenants.values()))
+        status = "ok" if ok else "unavailable"
+        if ok and degraded:
+            status = "degraded"
+        body: Dict[str, Any] = {
+            "status": status,
             "tenants": tenants,
             "resident": len(resident),
             "resident_bytes": desc.get("resident_bytes"),
             "budget_bytes": desc.get("budget_bytes"),
         }
+        if slo:
+            body["slo"] = slo
+        return (200 if ok else 503), body
 
     def flight_payload(self) -> (int, Dict[str, Any]):
         try:
@@ -273,6 +303,19 @@ class ExpoServer:
             return 500, {"status": "error",
                          "error": "flight dump unavailable"}
         return 200, {"status": "ok", "path": path}
+
+    def indexz_payload(self) -> (int, Dict[str, Any]):
+        """(status_code, body) for ``/indexz`` — the serving layer's
+        per-tenant index-health dict. 404 when no provider is wired
+        (standalone expo around a non-serving loop), 500 when the
+        provider itself throws."""
+        if self._indexz is None:
+            return 404, {"status": "error",
+                         "error": "no indexz provider wired"}
+        try:
+            return 200, (self._indexz() or {})
+        except Exception as e:
+            return 500, {"status": "error", "error": repr(e)}
 
     # -- lifecycle ----------------------------------------------------------
     @property
@@ -312,6 +355,10 @@ class ExpoServer:
                                    "application/json")
                     elif path == "/flightz":
                         code, doc = expo.flight_payload()
+                        self._send(code, json.dumps(doc).encode(),
+                                   "application/json")
+                    elif path == "/indexz":
+                        code, doc = expo.indexz_payload()
                         self._send(code, json.dumps(doc).encode(),
                                    "application/json")
                     else:

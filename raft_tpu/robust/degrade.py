@@ -56,7 +56,61 @@ __all__ = [
     "Step", "Ladder", "DegradationExhausted",
     "run_with_degradation", "standard_search_ladder", "note_step",
     "batched_search_call", "recent_steps", "steps_seen", "clear_recent",
+    "QUALITY_RUNGS", "quality_gate",
 ]
+
+#: rungs that trade RECALL (not just latency) for staying up: LUT
+#: precision cuts and the fused-tier decline change which neighbors
+#: come back, unlike halve_batch/host_gather which only change cost.
+#: The SLO monitor's quality gate refuses exactly these for a tenant
+#: already serving below its recall floor (ISSUE 16).
+QUALITY_RUNGS = ("bf16_lut", "fp8_lut", "decline_fused")
+
+_gate_tls = threading.local()
+
+
+class quality_gate:
+    """Context manager installing a per-thread rung gate for the ladder
+    walk it brackets: ``refuse(rung_name) -> bool`` — True refuses a
+    :data:`QUALITY_RUNGS` rung (counted ``degrade.refused{reason=
+    recall_floor,rung=}``), so an overloaded tenant below its recall
+    floor sheds instead of silently serving worse answers. ``None``
+    makes the bracket a no-op (the un-gated common case pays only the
+    TLS save/restore). Thread-local, like the ladder walk itself: the
+    gate a dispatch installs can never leak into another tenant's
+    batch on a different thread."""
+
+    __slots__ = ("_refuse", "_prev")
+
+    def __init__(self, refuse: Optional[Callable[[str], bool]]):
+        self._refuse = refuse
+        self._prev = None
+
+    def __enter__(self) -> "quality_gate":
+        self._prev = getattr(_gate_tls, "refuse", None)
+        _gate_tls.refuse = self._refuse
+        return self
+
+    def __exit__(self, *exc) -> None:
+        _gate_tls.refuse = self._prev
+
+
+def _rung_refused(name: str) -> bool:
+    """True when the installed gate refuses this quality rung. A gate
+    that RAISES does not refuse — a broken policy callback must fail
+    open (degraded answers beat a crashed dispatch)."""
+    if name not in QUALITY_RUNGS:
+        return False
+    refuse = getattr(_gate_tls, "refuse", None)
+    if refuse is None:
+        return False
+    try:
+        if not refuse(name):
+            return False
+    except Exception:  # noqa: BLE001 — fail open
+        return False
+    _count("degrade.refused", {"reason": "recall_floor", "rung": name})
+    return True
 
 # Bounded ring of the most recent ladder moves (reactive OOM rungs AND
 # note_step guard declines), kept regardless of whether obs recording
@@ -146,6 +200,11 @@ class Ladder:
                 ) -> Optional[Tuple[Step, Dict[str, Any]]]:
         for i in range(self._cursor, len(self.steps)):
             step = self.steps[i]
+            if _rung_refused(step.name):
+                # the quality gate (ISSUE 16): a recall-trading rung is
+                # refused for this walk — cursor untouched, so the rung
+                # comes back once the tenant's floor breach clears
+                continue
             new = step.apply(dict(knobs))
             if new is not None:
                 self._cursor = i if step.repeatable else i + 1

@@ -21,7 +21,11 @@ story that nothing upstream provides on TPU.
   histogram quantiles;
 - :mod:`raft_tpu.serve.errors`   — the typed refusal surface
   (``ShedError{reason=}``, ``TenantUnknown``, ``AdmissionError``) —
-  every failure is a type, never a hang.
+  every failure is a type, never a hang;
+- :mod:`raft_tpu.serve.slo`      — SLO guardrails (ISSUE 16):
+  multi-window burn rates over the latency/shed series, and per-tenant
+  recall floors closing the loop from the shadow verifier's confidence
+  intervals to health state and the degrade-ladder quality gate.
 
 Counters: ``serve.requests``, ``serve.shed{reason=}``,
 ``serve.batch_fill``, ``serve.latency_s``, ``serve.deadline_missed``,
@@ -49,4 +53,10 @@ from raft_tpu.serve.server import (  # noqa: F401
     ServerConfig,
     bucket_for,
     bucket_sizes,
+)
+from raft_tpu.serve.slo import (  # noqa: F401
+    SLOMonitor,
+    SLOPolicy,
+    get_monitor,
+    set_monitor,
 )

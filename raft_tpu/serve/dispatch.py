@@ -30,6 +30,7 @@ from raft_tpu.robust import degrade as _degrade
 from raft_tpu.robust import faults as _faults
 from raft_tpu.robust import retry as _retry
 from raft_tpu.robust.retry import Deadline, DeadlineExceeded
+from raft_tpu.serve import slo as _slo
 from raft_tpu.serve.errors import ShedError
 from raft_tpu.serve.registry import Tenant
 
@@ -108,12 +109,20 @@ def dispatch_batch(tenant: Tenant, queries, k: int,
                       deadline=deadline)
 
     retry_stats: dict = {}
+    # the quality gate (ISSUE 16): a tenant the SLO monitor holds
+    # recall-floor-breached must not walk recall-trading rungs — the
+    # gate brackets the whole retry+ladder region, thread-locally. The
+    # un-breached common case gets gate=None (a no-op bracket).
+    monitor = _slo.get_monitor()
+    gate = (monitor.quality_gate_for(tenant.name)
+            if monitor is not None else None)
     with _spans.span("serve.dispatch") as sp:
         try:
-            dist, ids = _retry.retry_call(
-                attempt, site="serve.dispatch",
-                policy=DISPATCH_RETRY_POLICY, deadline=deadline,
-                stats=retry_stats)
+            with _degrade.quality_gate(gate):
+                dist, ids = _retry.retry_call(
+                    attempt, site="serve.dispatch",
+                    policy=DISPATCH_RETRY_POLICY, deadline=deadline,
+                    stats=retry_stats)
             jax.block_until_ready((dist, ids))
         except _degrade.DegradationExhausted as e:
             # the ladder walked every rung and the batch still cannot

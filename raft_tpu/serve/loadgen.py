@@ -51,18 +51,26 @@ def run_step(server: MicroBatchServer, tenant: str,
              queries: np.ndarray, k: int,
              offered_qps: float, duration_s: float,
              seed: int = 0,
-             slo_s: Optional[float] = -1.0) -> Dict[str, Any]:
+             slo_s: Optional[float] = -1.0,
+             ground_truth: Optional[np.ndarray] = None) -> Dict[str, Any]:
     """One offered-load step: submit single-query requests at Poisson
     arrivals of rate ``offered_qps`` for ``duration_s`` seconds (query
     vectors cycled from ``queries``), then wait for every future and
     tally. The arrival clock never waits on completions — that is the
-    point."""
+    point.
+
+    ``ground_truth`` (optional, ``[n_queries, ≥k]`` exact neighbor ids
+    per query row, ISSUE 16) turns the step's quality column on: every
+    completed request's served ids are scored against the truth row and
+    the step reports mean ``recall`` — so a latency-vs-throughput curve
+    that cheats (sheds into a degraded rung trading recall for speed)
+    can no longer look like a win."""
     rng = random.Random(seed)
     n = queries.shape[0]
     lat = Histogram("loadgen.latency_s", buckets=_LATENCY_BUCKETS)
     sent = shed = missed = errors = 0
     shed_reasons: Dict[str, int] = {}
-    inflight: List[Tuple[float, Future]] = []
+    inflight: List[Tuple[float, Future, int]] = []
     # completion times captured by done-callbacks (fired by the
     # batcher thread the moment the future resolves): the drain loop
     # below must not masquerade its own pace as request latency
@@ -94,13 +102,15 @@ def run_step(server: MicroBatchServer, tenant: str,
             shed_reasons[e.reason] = shed_reasons.get(e.reason, 0) + 1
         else:
             fut.add_done_callback(_mark_done)
-            inflight.append((t_submit, fut))
+            inflight.append((t_submit, fut, i % n))
         i += 1
     ok = 0
+    recall_sum = 0.0
+    recall_n = 0
     t_last_done = t_start
-    for t_submit, fut in inflight:
+    for t_submit, fut, qi in inflight:
         try:
-            fut.result(timeout=30.0)
+            _, served_ids = fut.result(timeout=30.0)
         except DeadlineExceeded:
             missed += 1
         except ShedError as e:
@@ -110,6 +120,12 @@ def run_step(server: MicroBatchServer, tenant: str,
             errors += 1
         else:
             ok += 1
+            if ground_truth is not None:
+                from raft_tpu.obs.quality import recall_at_k
+
+                recall_sum += recall_at_k(np.asarray(served_ids),
+                                          ground_truth[qi], k)
+                recall_n += 1
             t_done = done_at.get(id(fut), time.monotonic())
             t_last_done = max(t_last_done, t_done)
             # the future knows its request's trace id (stamped by
@@ -135,6 +151,10 @@ def run_step(server: MicroBatchServer, tenant: str,
         "latency_p50_s": lat.quantile(0.5),
         "latency_p99_s": lat.quantile(0.99),
         "latency_mean_s": (lat.sum / lat.count) if lat.count else None,
+        # measured quality (None without ground truth): mean recall@k
+        # over the completed requests of this step
+        "recall": (round(recall_sum / recall_n, 6)
+                   if recall_n else None),
         # the p99 bucket's worst offenders, worst first — joinable back
         # to their timelines via obsdump --slowest on the server's dump
         "slow_trace_ids": [e["trace_id"] for e in slow],
@@ -144,12 +164,15 @@ def run_step(server: MicroBatchServer, tenant: str,
 def sweep(server: MicroBatchServer, tenant: str, queries: np.ndarray,
           k: int, offered_steps: Sequence[float],
           duration_s: float = 2.0, seed: int = 0,
-          slo_s: Optional[float] = -1.0) -> List[Dict[str, Any]]:
+          slo_s: Optional[float] = -1.0,
+          ground_truth: Optional[np.ndarray] = None
+          ) -> List[Dict[str, Any]]:
     """The latency-vs-throughput curve: one :func:`run_step` per
     offered load, in order (each step inherits the previous step's
     thermal/queue state the way a ramping production load would)."""
     return [run_step(server, tenant, queries, k, q, duration_s,
-                     seed=seed + j, slo_s=slo_s)
+                     seed=seed + j, slo_s=slo_s,
+                     ground_truth=ground_truth)
             for j, q in enumerate(offered_steps)]
 
 
@@ -171,7 +194,7 @@ def record(rows: List[Dict[str, Any]], dataset: str, tenant: str,
             "dataset": dataset, "algo": "serve", "index": tenant,
             "search_param": {"offered_qps": r["offered_qps"], "k": k},
             "batch_size": 1,
-            "qps": r["qps"], "recall": None,
+            "qps": r["qps"], "recall": r.get("recall"),
             "latency_p50_s": r["latency_p50_s"],
             "latency_p99_s": r["latency_p99_s"],
             "sent": r["sent"], "completed": r["completed"],

@@ -90,12 +90,24 @@ class Tenant:
     admitted_at: float = 0.0
     last_used: float = 0.0        # monotonic; the LRU eviction key
     requests: int = 0
+    # the quality plane (ISSUE 16): ``dataset`` is the exact ground the
+    # shadow verifier replays against (no dataset → no verification for
+    # this tenant — counted, never an error); ``recall_floor`` arms the
+    # SLO monitor's closed loop (CI lower bound below it → degraded
+    # health + quality-rung gate); ``index_stats`` caches the
+    # admission-time health introspection for /indexz
+    dataset: Any = None
+    recall_floor: Optional[float] = None
+    index_stats: Optional[Dict[str, Any]] = None
 
     def describe(self) -> Dict[str, Any]:
         """Registry snapshot row (flight dumps / debugging)."""
-        return {"name": self.name, "state": self.state,
-                "size_bytes": self.size_bytes, "pinned": self.pinned,
-                "requests": self.requests}
+        out = {"name": self.name, "state": self.state,
+               "size_bytes": self.size_bytes, "pinned": self.pinned,
+               "requests": self.requests}
+        if self.recall_floor is not None:
+            out["recall_floor"] = self.recall_floor
+        return out
 
 
 def _count(name: str, labels: Dict[str, str]) -> None:
@@ -155,14 +167,21 @@ class IndexRegistry:
     def admit(self, name: str, index: Any, *, params: Any = None,
               default_k: int = 10, ks: Optional[Any] = None,
               pinned: bool = False,
-              size_bytes: Optional[int] = None) -> Tenant:
+              size_bytes: Optional[int] = None,
+              dataset: Any = None,
+              recall_floor: Optional[float] = None) -> Tenant:
         """Admit ``index`` as tenant ``name``, evicting LRU cold
         tenants as needed to fit under :attr:`usable_bytes`. Raises
         :class:`AdmissionError` when the index cannot fit even after
         shedding every evictable resident (or is alone too big for the
         budget). ``ks`` enumerates the tenant's served k values
         (default: just ``default_k``) — the server warms exactly this
-        set and refuses others. Re-admitting a live name replaces it.
+        set and refuses others. ``dataset`` (optional) is the tenant's
+        source rows — the shadow verifier's exact ground truth — and
+        ``recall_floor`` its quality SLO (ISSUE 16): a tenant whose
+        live recall CI falls below the floor is demoted and its
+        recall-trading ladder rungs gated. Re-admitting a live name
+        replaces it.
         Admission is
         all-or-nothing: the eviction set (including a replaced prior)
         is PLANNED before anything is released, so a refused admission
@@ -212,8 +231,26 @@ class IndexRegistry:
                             default_k=default_k, serve_ks=serve_ks,
                             size_bytes=size,
                             pinned=pinned, state="warming",
-                            admitted_at=now, last_used=now)
+                            admitted_at=now, last_used=now,
+                            dataset=dataset,
+                            recall_floor=(None if recall_floor is None
+                                          else float(recall_floor)))
             self._tenants[name] = tenant
+            # admission-time health introspection (ISSUE 16): list skew
+            # always (one [n_lists] transfer); drift + PQ quantization
+            # error only when the caller handed a dataset (the quality-
+            # plane serving path) — kept off the plain admit so tests
+            # and verification-less serving pay nothing new. Cached on
+            # the tenant for /indexz; gauges land as index.*{index=}.
+            from raft_tpu.obs import index_stats as _istats
+
+            if dataset is not None:
+                stats = _istats.describe_index(index, dataset)
+                _istats.note_index_stats(index, name=name, stats=stats)
+                tenant.index_stats = stats
+            elif _spans.enabled():
+                tenant.index_stats = _istats.note_index_stats(
+                    index, name=name, cheap=True)
             _count("serve.registry.admit", {"tenant": name})
             _gauge("serve.registry.resident_bytes", self.resident_bytes())
             _log.info("registry: admitted %r (%s B, pinned=%s, "
@@ -279,6 +316,19 @@ class IndexRegistry:
             if tenant is not None and tenant.state in ("warming",
                                                        "serving"):
                 tenant.state = "degraded"
+
+    def note_recovered(self, name: str) -> None:
+        """Lock-protected promotion back to ``serving`` — the closed
+        half of the quality loop (ISSUE 16): the SLO monitor calls this
+        when a tenant it demoted for a recall-floor breach shows fresh
+        evidence above the floor. Only ``degraded`` promotes — terminal
+        states stay final (same resurrection hazard as
+        :meth:`note_degraded`) and ``warming`` stays the server's to
+        finish. Unknown names are a no-op."""
+        with self._lock:
+            tenant = self._tenants.get(name)
+            if tenant is not None and tenant.state == "degraded":
+                tenant.state = "serving"
 
     # -- lookup -------------------------------------------------------------
     def peek(self, name: str) -> Tenant:

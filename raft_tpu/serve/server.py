@@ -115,6 +115,19 @@ class ServerConfig:
     # (tests/CI read it back from server.expo.port)
     expo_port: Optional[int] = None
     expo_host: str = "127.0.0.1"
+    # the quality plane (ISSUE 16): ``verify_sample`` > 0 arms the
+    # shadow recall verifier — that fraction of completed requests is
+    # replayed exactly (host-side, off the hot path, rate-limited to
+    # ``verify_rate_per_s`` per tenant) against each tenant's admitted
+    # dataset, feeding quality.recall{tenant=,k=} gauges with Wilson
+    # CIs and the SLO monitor's recall floors. 0.0 = off (the default:
+    # verification-less serving pays nothing new).
+    verify_sample: float = 0.0
+    verify_rate_per_s: float = 50.0
+    verify_seed: int = 0
+    #: an :class:`raft_tpu.serve.slo.SLOPolicy` (None → defaults) —
+    #: burn-rate windows/targets and the recall-floor evidence bar
+    slo: Optional[Any] = None
 
 
 class _Request:
@@ -168,6 +181,11 @@ class MicroBatchServer:
         #: the live exposition endpoint (obs.expo.ExpoServer) while
         #: running with ``config.expo_port`` set, else None
         self.expo = None
+        #: the shadow recall verifier (obs.quality.RecallVerifier)
+        #: while running with ``config.verify_sample`` > 0, else None
+        self.verifier = None
+        #: the SLO monitor (serve.slo.SLOMonitor) while running
+        self.slo = None
 
     # -- lifecycle ----------------------------------------------------------
     def start(self, warmup: bool = True) -> "MicroBatchServer":
@@ -204,6 +222,27 @@ class MicroBatchServer:
         from raft_tpu.obs import flight as _flight
 
         _flight.set_section("serve_registry", self.registry.describe)
+        # the quality plane (ISSUE 16): shadow verifier (when sampling
+        # is on) + SLO monitor (always — burn rates need no verifier).
+        # The monitor registers process-globally so dispatch can fetch
+        # the quality gate; verdicts drive its floor evaluation.
+        from raft_tpu.serve import slo as _slo
+
+        if self.config.verify_sample > 0.0:
+            from raft_tpu.obs import quality as _quality
+
+            self.verifier = _quality.RecallVerifier(
+                self.registry,
+                _quality.VerifierConfig(
+                    sample_fraction=self.config.verify_sample,
+                    rate_limit_per_s=self.config.verify_rate_per_s,
+                    seed=self.config.verify_seed)).start()
+            _flight.set_section("quality", self.verifier.state)
+        self.slo = _slo.SLOMonitor(self.registry, verifier=self.verifier,
+                                   policy=self.config.slo)
+        if self.verifier is not None:
+            self.verifier.on_verdict = self.slo.evaluate
+        _slo.set_monitor(self.slo)
         if _spans.enabled():
             # re-mirror the admission budget into hbm.bytes_limit at
             # START (the registry's __init__ mirror only fires when obs
@@ -221,7 +260,8 @@ class MicroBatchServer:
                 self.expo = _expo.ExpoServer(
                     port=self.config.expo_port,
                     host=self.config.expo_host,
-                    health=self.registry.describe).start()
+                    health=self._health_payload,
+                    indexz=self._indexz_payload).start()
             except Exception:
                 # a failed bind (port taken, privileged port) must not
                 # leave a half-started server: the batcher thread is
@@ -306,6 +346,52 @@ class MicroBatchServer:
         from raft_tpu.obs import flight as _flight
 
         _flight.clear_section("serve_registry")
+        if self.verifier is not None:
+            self.verifier.stop()
+            self.verifier = None
+            _flight.clear_section("quality")
+        if self.slo is not None:
+            from raft_tpu.serve import slo as _slo
+
+            # clear only OUR monitor: a stop() racing a newer server's
+            # start() must not strip that server's gate
+            _slo.clear_monitor(self.slo)
+            self.slo = None
+
+    # -- exposition payloads (ISSUE 16) -------------------------------------
+    def _health_payload(self) -> Dict[str, Any]:
+        """/healthz body: the registry describe + the SLO section
+        (burn rates, floor-breached tenants). The scrape itself drives
+        an SLO evaluation, so health is current even on an idle
+        verifier."""
+        desc = self.registry.describe()
+        if self.slo is not None:
+            try:
+                desc["slo"] = self.slo.healthz()
+            except Exception:  # noqa: BLE001 — health must render
+                pass
+        return desc
+
+    def _indexz_payload(self) -> Dict[str, Any]:
+        """/indexz body: per-tenant index-health introspection
+        (admission-time stats, computed on first demand for tenants
+        admitted before the quality plane or without a dataset)."""
+        from raft_tpu.obs import index_stats as _istats
+
+        out: Dict[str, Any] = {}
+        for t in self.registry.tenants():
+            entry: Dict[str, Any] = {"state": t.state,
+                                     "requests": t.requests}
+            if t.recall_floor is not None:
+                entry["recall_floor"] = t.recall_floor
+            stats = t.index_stats
+            if stats is None and t.index is not None:
+                stats = _istats.describe_index(t.index, t.dataset)
+                t.index_stats = stats
+            if stats:
+                entry["stats"] = stats
+            out[t.name] = entry
+        return {"tenants": out}
 
     def __enter__(self) -> "MicroBatchServer":
         return self.start()
@@ -563,3 +649,9 @@ class MicroBatchServer:
                 queue_s=round(t_take - r.enqueued, 6),
                 bucket=bucket, fill=round(fill, 4))
             r.future.set_result((d_np[j], i_np[j]))
+            if self.verifier is not None:
+                # the shadow-verifier tap (ISSUE 16): AFTER the future
+                # resolves, so the client's latency never includes the
+                # sample offer (an RNG draw + bounded copy when taken)
+                self.verifier.maybe_sample(tenant_name, r.query, k,
+                                           i_np[j], r.ctx.trace_id)

@@ -32,6 +32,25 @@ import jax  # noqa: E402
 jax.config.update("jax_platforms", "cpu")
 jax.config.update("jax_enable_x64", False)
 
+# Persistent XLA compile cache: the suite is compile-bound on a 1-core
+# CI box, and most of the wall clock is backend_compile of the same
+# programs every run. A warm on-disk cache skips only the XLA compile —
+# tracing still happens (span/comms counters are trace-time) and the
+# recompile_budget listener counts backend compiles, so a cache hit can
+# only relax an upper-bound budget, never break one. Respect an
+# explicit JAX_COMPILATION_CACHE_DIR; default to a repo-local dir so a
+# wiped /tmp cannot silently turn every CI run cold.
+if "JAX_COMPILATION_CACHE_DIR" not in os.environ:
+    try:
+        jax.config.update(
+            "jax_compilation_cache_dir",
+            os.path.join(os.path.dirname(os.path.dirname(
+                os.path.abspath(__file__))), ".cache", "jax"))
+        jax.config.update("jax_persistent_cache_min_compile_time_secs",
+                          0.5)
+    except Exception:  # pragma: no cover - jax without the cache knobs
+        pass
+
 from raft_tpu.obs import sanitize as _sanitize  # noqa: E402
 
 if _sanitize.sanitize_enabled():

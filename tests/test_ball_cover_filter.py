@@ -153,6 +153,7 @@ def test_filter_ivf_pq(rng):
     assert not np.isin(ids[ids >= 0], removed).any()
 
 
+@pytest.mark.slow  # filter semantics proved on brute/ivf_flat/ivf_pq above; CI lanes run the cagra leg (tier-1 budget)
 def test_filter_cagra(rng):
     x = _data(rng, n=2000, d=8)
     q = _data(rng, n=10, d=8)

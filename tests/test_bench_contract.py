@@ -171,6 +171,7 @@ class TestGistConf:
         assert forced[0]["leg_env"] == {
             "RAFT_TPU_PALLAS_LUTSCAN": "never"}, forced
 
+    @pytest.mark.slow  # full runner pass over every conf entry; the CI bench legs run the same smoke (tier-1 budget)
     def test_cpu_shaped_smoke(self):
         """Run the conf's index entries through the real runner on a
         tiny 960-d synthetic (the dataset dir is absent on CI): every

@@ -79,6 +79,7 @@ def _assert_identical(a, b):
 
 
 class TestDistributedBuildParity:
+    @pytest.mark.slow  # the flat bit-identical twin stays tier-1; CI distributed legs run this one (tier-1 budget)
     def test_ivf_pq_bit_identical_to_build_chunked(self, mesh, data):
         """The acceptance bar: 8-shard distributed build, assembled,
         equals the single-host build_chunked byte for byte — even with

@@ -165,6 +165,7 @@ class TestCagraSearch:
 
 
 class TestClusterKnnGraph:
+    @pytest.mark.slow  # the overflow-rows twin keeps cluster-graph parity tier-1 (tier-1 budget)
     def test_matches_exact_graph(self):
         """Cluster-blocked graph (n>16384 path) edges vs exact 32-NN."""
         from scipy.spatial.distance import cdist

@@ -224,3 +224,91 @@ class TestJsonlRotation:
         reg.dump_jsonl(path)
         assert os.path.exists(path + ".1")
         assert not os.path.exists(path + ".2")
+
+
+class TestHelpEscaping:
+    """Text-format 0.0.4 compliance (ISSUE 16 satellite): HELP escapes
+    ONLY backslash and newline; label values additionally escape the
+    double quote. A ``\\"`` in HELP would be a literal
+    backslash-quote to a compliant parser — promtool flags it."""
+
+    def test_help_keeps_quotes_but_escapes_backslash_newline(self):
+        reg = MetricsRegistry()
+        reg.inc('weird"name\\x\ny')
+        text = render_prometheus(reg.collect())
+        help_line = [l for l in text.splitlines()
+                     if l.startswith("# HELP")][0]
+        assert '"' in help_line          # quote NOT escaped in HELP
+        assert r"\\x" in help_line       # backslash doubled
+        assert r"\ny" in help_line       # newline escaped
+        assert "\n" not in help_line     # one physical line
+
+    def test_bench_param_repr_label_round_trips(self):
+        # the bench harness labels series with search-param dict reprs
+        # — quotes, commas, braces and backslashes all at once
+        tricky = repr({"n_probes": 32, "lut": "fp8", "p": "a\\b"})
+        reg = MetricsRegistry()
+        reg.inc("bench.qps", 7, labels={"params": tricky})
+        text = render_prometheus(reg.collect())
+        fams = parse_prometheus(text)
+        (series,) = fams["raft_tpu_bench_qps"]
+        assert series["labels"]["params"] == tricky
+        assert series["value"] == 7
+
+    def test_label_backslash_alone_survives(self):
+        reg = MetricsRegistry()
+        reg.set("g", 1, labels={"path": "C:\\tmp\\x"})
+        fams = parse_prometheus(render_prometheus(reg.collect()))
+        (series,) = fams["raft_tpu_g"]
+        assert series["labels"]["path"] == "C:\\tmp\\x"
+
+
+class TestIndexz:
+    def _get(self, url, timeout=10):
+        with urllib.request.urlopen(url, timeout=timeout) as r:
+            return r.status, r.read()
+
+    def test_indexz_serves_provider_payload(self):
+        doc = {"tenants": {"acme": {"lists": {"cv": 0.5, "dead": 1}}}}
+        with ExpoServer(port=0, registry=_reg(),
+                        indexz=lambda: doc) as expo:
+            status, body = self._get(expo.url + "/indexz")
+            assert status == 200
+            assert json.loads(body) == doc
+
+    def test_indexz_404_without_provider(self):
+        with ExpoServer(port=0, registry=_reg()) as expo:
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                self._get(expo.url + "/indexz")
+            assert ei.value.code == 404
+
+    def test_indexz_500_when_provider_throws(self):
+        def boom():
+            raise RuntimeError("stats race")
+
+        with ExpoServer(port=0, registry=_reg(), indexz=boom) as expo:
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                self._get(expo.url + "/indexz")
+            assert ei.value.code == 500
+            assert "stats race" in json.loads(ei.value.read())["error"]
+
+    def test_healthz_degraded_on_recall_floor_breach(self):
+        # quality trouble flips the STATUS STRING but keeps HTTP 200 —
+        # results still flow; orchestration reads the body
+        desc = {"tenants": [{"name": "a", "state": "serving"}],
+                "slo": {"recall_floor_breached": ["a"],
+                        "burn_rates": {"30s": 0.0},
+                        "burn_threshold": 2.0}}
+        with ExpoServer(port=0, registry=_reg(),
+                        health=lambda: desc) as expo:
+            status, body = self._get(expo.url + "/healthz")
+            doc = json.loads(body)
+            assert status == 200
+            assert doc["status"] == "degraded"
+            assert doc["slo"]["recall_floor_breached"] == ["a"]
+            # breach clears -> plain ok again
+            desc["slo"] = {"recall_floor_breached": [],
+                           "burn_rates": {"30s": 0.0},
+                           "burn_threshold": 2.0}
+            _, body = self._get(expo.url + "/healthz")
+            assert json.loads(body)["status"] == "ok"

@@ -1,0 +1,196 @@
+"""obs.index_stats — index-health introspection (ISSUE 16 tentpole b).
+
+The structural-quality contract under test: list skew / dead-centroid
+stats from a size vector, the host code unpack agrees bit-for-bit with
+the build's ``pack_bits_np`` layout, centroid drift is ~zero right
+after a build and grows when centers are displaced, the PQ
+per-subspace error is computed through the index's own
+rotation/codebooks and bounded by the residual energy, ``describe_index``
+never raises, and ``note_index_stats`` publishes ``index.*{index=}``
+gauges only while obs is recording.
+"""
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from raft_tpu import obs
+from raft_tpu.obs import index_stats
+from raft_tpu.obs.metrics import MetricsRegistry
+from raft_tpu.neighbors import ivf_flat, ivf_pq
+
+
+@pytest.fixture(autouse=True)
+def _quiet_obs():
+    yield
+    obs.disable()
+
+
+@pytest.fixture(scope="module")
+def data():
+    rng = np.random.default_rng(0)
+    return rng.random((2000, 16), dtype=np.float32)
+
+
+@pytest.fixture(scope="module")
+def skewed_data():
+    # deliberately skewed: a dense blob plus a thin uniform background,
+    # so k-means lists end up visibly uneven
+    rng = np.random.default_rng(1)
+    blob = rng.normal(0.5, 0.01, size=(1800, 16)).astype(np.float32)
+    bg = rng.random((200, 16), dtype=np.float32)
+    return np.concatenate([blob, bg])
+
+
+@pytest.fixture(scope="module")
+def flat_index(data):
+    return ivf_flat.build(jnp.asarray(data),
+                          ivf_flat.IndexParams(n_lists=16))
+
+
+@pytest.fixture(scope="module")
+def pq_index(data):
+    return ivf_pq.build(jnp.asarray(data), ivf_pq.IndexParams(
+        n_lists=16, pq_dim=8, seed=0, cache_reconstruction="never"))
+
+
+class TestListStats:
+    def test_known_vector(self):
+        st = index_stats.list_stats([4, 0, 8, 4])
+        assert st["n_lists"] == 4 and st["size"] == 16
+        assert st["dead"] == 1 and st["max"] == 8
+        assert st["max_mean"] == pytest.approx(2.0)
+        assert st["cv"] == pytest.approx(np.std([4, 0, 8, 4]) / 4.0)
+
+    def test_uniform_has_zero_skew(self):
+        st = index_stats.list_stats([5, 5, 5, 5])
+        assert st["cv"] == 0.0 and st["max_mean"] == 1.0
+        assert st["dead"] == 0
+
+    def test_empty(self):
+        st = index_stats.list_stats(np.zeros((0,), np.int32))
+        assert st["n_lists"] == 0 and st["size"] == 0
+
+    def test_skewed_build_shows_skew(self, skewed_data, data):
+        skewed = ivf_flat.build(jnp.asarray(skewed_data),
+                                ivf_flat.IndexParams(n_lists=16))
+        even = ivf_flat.build(jnp.asarray(data),
+                              ivf_flat.IndexParams(n_lists=16))
+        st_skew = index_stats.list_stats(skewed.list_sizes)
+        st_even = index_stats.list_stats(even.list_sizes)
+        assert st_skew["cv"] > st_even["cv"]
+
+
+class TestUnpack:
+    @pytest.mark.parametrize("pq_bits", [4, 5, 8])
+    def test_roundtrips_pack_bits_np(self, pq_bits):
+        rng = np.random.default_rng(pq_bits)
+        codes = rng.integers(0, 1 << pq_bits,
+                             size=(192, 10)).astype(np.uint8)
+        packed = ivf_pq.pack_bits_np(codes, pq_bits)
+        got = index_stats._unpack_codes_np(packed, 10, pq_bits)
+        np.testing.assert_array_equal(got, codes)
+        # and through an extra leading (list) axis, the layout the
+        # introspection actually reads
+        stacked = packed.reshape(6, 32, -1)
+        got3 = index_stats._unpack_codes_np(stacked, 10, pq_bits)
+        np.testing.assert_array_equal(got3.reshape(192, 10), codes)
+
+
+class TestDrift:
+    def test_fresh_flat_build_low_drift(self, flat_index):
+        d = index_stats.centroid_drift(flat_index)
+        assert d["lists_sampled"] > 0
+        # k-means centers ARE (near) their members' means
+        assert d["rel_mean"] < 0.25
+
+    def test_displaced_centers_raise_drift(self, flat_index):
+        base = index_stats.centroid_drift(flat_index)
+        shifted = flat_index.replace(
+            centers=flat_index.centers + 0.5)
+        moved = index_stats.centroid_drift(shifted)
+        assert moved["mean"] > base["mean"] * 2
+
+    def test_pq_drift_from_decoded_residuals(self, pq_index):
+        d = index_stats.centroid_drift(pq_index)
+        assert d is not None and d["lists_sampled"] > 0
+        assert np.isfinite(d["mean"]) and d["mean"] >= 0.0
+
+    def test_non_index_object_is_none(self):
+        class Bare:
+            list_sizes = np.array([1, 1])
+
+        assert index_stats.centroid_drift(Bare()) is None
+
+
+class TestPqError:
+    def test_error_bounded_by_residual_energy(self, pq_index, data):
+        st = index_stats.pq_subspace_error(pq_index, data, sample_rows=512)
+        assert st["rows_sampled"] == 512
+        assert len(st["per_subspace_mse"]) == pq_index.pq_dim
+        assert all(e >= 0.0 for e in st["per_subspace_mse"])
+        # quantization can only lose a FRACTION of residual energy
+        assert 0.0 < st["rel_error"] < 1.0
+
+    def test_flat_index_is_none(self, flat_index, data):
+        assert index_stats.pq_subspace_error(flat_index, data) is None
+
+    def test_no_dataset_is_none(self, pq_index):
+        assert index_stats.pq_subspace_error(pq_index, None) is None
+
+
+class TestDescribe:
+    def test_full_snapshot(self, pq_index, data):
+        st = index_stats.describe_index(pq_index, data, sample_rows=256)
+        assert st["kind"] == "IvfPqIndex"
+        assert st["lists"]["n_lists"] == 16
+        assert st["tombstone_density"] == 0.0
+        assert st["drift"]["lists_sampled"] > 0
+        assert st["pq"]["rows_sampled"] == 256
+        assert "error" not in st
+
+    def test_never_raises_on_garbage(self):
+        st = index_stats.describe_index(object())
+        assert "error" in st and st["kind"] == "object"
+
+
+class TestNoteIndexStats:
+    def test_publishes_gauges_when_recording(self, flat_index):
+        obs.enable(registry=MetricsRegistry(), hbm=False)
+        st = index_stats.note_index_stats(flat_index, name="acme",
+                                          cheap=True)
+        assert st is not None
+        g = obs.registry().snapshot()["gauges"]
+        assert g["index.n_lists{index=acme}"] == 16.0
+        assert g["index.size{index=acme}"] == 2000.0
+        assert "index.tombstone_density{index=acme}" in g
+        assert "index.list_cv{index=acme}" in g
+
+    def test_noop_when_obs_off(self, flat_index):
+        obs.disable()
+        assert index_stats.note_index_stats(flat_index, name="acme",
+                                            cheap=True) is None
+
+    def test_precomputed_stats_publish_even_with_full_describe(
+            self, pq_index, data):
+        obs.enable(registry=MetricsRegistry(), hbm=False)
+        stats = index_stats.describe_index(pq_index, data,
+                                           sample_rows=128)
+        index_stats.note_index_stats(pq_index, name="pq", stats=stats)
+        g = obs.registry().snapshot()["gauges"]
+        assert "index.pq_err_rel{index=pq}" in g
+        assert "index.drift_rel{index=pq}" in g
+
+    def test_build_paths_emit_gauges(self, data):
+        obs.enable(registry=MetricsRegistry(), hbm=False)
+        ivf_flat.build(jnp.asarray(data),
+                       ivf_flat.IndexParams(n_lists=8))
+        g = obs.registry().snapshot()["gauges"]
+        assert g["index.n_lists{index=ivf_flat.build}"] == 8.0
+
+    def test_extend_emits_gauges(self, data, flat_index):
+        obs.enable(registry=MetricsRegistry(), hbm=False)
+        ivf_flat.extend(flat_index, jnp.asarray(data[:64]))
+        g = obs.registry().snapshot()["gauges"]
+        assert g["index.size{index=ivf_flat.extend}"] == 2064.0

@@ -269,6 +269,7 @@ class TestApproxScanSelect:
         assert same >= 0.9, same
 
 
+    @pytest.mark.slow  # interpret-mode kernel trace; the pq segk twin stays tier-1 (tier-1 budget)
     def test_segk_kernel_path_interpret(self, corpus, monkeypatch):
         """End-to-end through the scalar-prefetch kernel path (interpret
         mode off-TPU via RAFT_TPU_PALLAS_GROUPED=always), including a

@@ -313,8 +313,12 @@ class TestFlightRecorder:
             f"sys.path.insert(0, {ROOT!r})\n"
             "from raft_tpu.obs import flight\n"
             f"flight.install({str(tmp_path)!r}, every_s=0)\n"
-            "print('armed', flush=True)\n"
+            # 'armed' is printed INSIDE the try: the parent fires
+            # SIGINT the moment it reads the line, and under load the
+            # interrupt can land before the child reaches the sleep —
+            # any point after the print must already be covered.
             "try:\n"
+            "    print('armed', flush=True)\n"
             "    time.sleep(60)\n"
             "except KeyboardInterrupt:\n"
             "    print('kbd-interrupt', flush=True)\n"

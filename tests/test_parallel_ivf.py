@@ -51,6 +51,7 @@ def data():
 
 
 class TestShardedIvfPq:
+    @pytest.mark.slow  # heaviest sharded-pq twin; all_shards_contribute keeps the class tier-1 (tier-1 budget)
     def test_recall_matches_single_device(self, mesh, data):
         """Sharded recall ≈ single-device recall on the same data."""
         dataset, queries = data

@@ -207,6 +207,7 @@ class TestRecord:
 
 
 class TestCapture:
+    @pytest.mark.slow  # full capture bracket; double_start keeps a tier-1 capture arm (tier-1 budget)
     def test_bracket_runs_and_degrades(self, tmp_path):
         cap = prof.capture(str(tmp_path / "xprof"))
         assert not cap.active

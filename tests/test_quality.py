@@ -1,0 +1,323 @@
+"""obs.quality — online recall estimation (ISSUE 16 tentpole a).
+
+The shadow-verifier contract under test: Wilson intervals behave at the
+edges, the exact host replay agrees with brute force per metric,
+half-filled answers count against recall, the sampling pattern replays
+deterministically from the seed (crc32 tenant seeding — never salted
+str hash), a burst hits the token bucket and the bounded reservoir
+instead of growing memory, an admission-declined replay NEVER touches
+the dataset, and the verifier's ``state()`` feeds the flight dump's
+``"quality"`` section with trace-id-carrying verdicts. Device-free —
+nothing here imports jax.
+"""
+
+import threading
+
+import numpy as np
+import pytest
+
+from raft_tpu import obs
+from raft_tpu.obs.metrics import MetricsRegistry
+from raft_tpu.obs.quality import (RecallVerifier, VerifierConfig,
+                                  exact_topk_ids, recall_at_k,
+                                  wilson_interval)
+
+
+class _FakeTenant:
+    def __init__(self, name, dataset, metric="sqeuclidean",
+                 recall_floor=None):
+        self.name = name
+        self.dataset = dataset
+        self.index = type("I", (), {"metric": metric})()
+        self.recall_floor = recall_floor
+
+
+class _FakeRegistry:
+    """Duck-typed stand-in: peek / usable_bytes / resident_bytes."""
+
+    def __init__(self, tenants, usable=1 << 30, resident=0):
+        self._tenants = {t.name: t for t in tenants}
+        self.usable_bytes = usable
+        self._resident = resident
+
+    def peek(self, name):
+        if name not in self._tenants:
+            raise KeyError(name)
+        return self._tenants[name]
+
+    def resident_bytes(self):
+        return self._resident
+
+    def resident(self):
+        return list(self._tenants.values())
+
+
+@pytest.fixture(autouse=True)
+def _quiet_obs():
+    yield
+    obs.disable()
+
+
+class TestWilson:
+    def test_degenerate_total(self):
+        assert wilson_interval(0, 0) == (0.0, 1.0)
+
+    def test_contains_p_hat_and_stays_in_unit(self):
+        lo, hi = wilson_interval(9, 10)
+        assert 0.0 <= lo < 0.9 < hi <= 1.0
+
+    def test_perfect_recall_interval_below_one(self):
+        # the reason for Wilson over normal approx: p̂=1 still yields a
+        # non-degenerate lower bound that tightens with n
+        lo10, hi10 = wilson_interval(10, 10)
+        lo100, _ = wilson_interval(100, 100)
+        assert hi10 == 1.0 and 0.0 < lo10 < 1.0
+        assert lo100 > lo10
+
+    def test_more_evidence_tightens(self):
+        lo1, hi1 = wilson_interval(8, 10)
+        lo2, hi2 = wilson_interval(80, 100)
+        assert (hi2 - lo2) < (hi1 - lo1)
+
+
+class TestExactTopK:
+    @pytest.fixture(scope="class")
+    def data(self):
+        rng = np.random.default_rng(7)
+        return rng.normal(size=(500, 16)).astype(np.float32)
+
+    def test_l2_matches_bruteforce(self, data):
+        q = data[3] + 0.01
+        d = np.sum((data - q) ** 2, axis=1)
+        expect = np.argsort(d, kind="stable")[:10]
+        got = exact_topk_ids(data, q, 10, "sqeuclidean")
+        assert set(got.tolist()) == set(expect.tolist())
+        assert got[0] == 3  # the (near-)self row wins
+
+    def test_l2_flavors_share_ordering(self, data):
+        q = data[11]
+        a = exact_topk_ids(data, q, 8, "sqeuclidean")
+        b = exact_topk_ids(data, q, 8, "l2_expanded")
+        c = exact_topk_ids(data, q, 8, "l2_sqrt_expanded")
+        np.testing.assert_array_equal(a, b)
+        np.testing.assert_array_equal(a, c)
+
+    def test_inner_product_maximizes(self, data):
+        q = data[0]
+        scores = data @ q
+        expect = np.argsort(-scores, kind="stable")[:5]
+        got = exact_topk_ids(data, q, 5, "inner_product")
+        np.testing.assert_array_equal(got, expect)
+
+    def test_cosine_normalizes_rows(self, data):
+        # scale one row hugely: inner product would rank it first,
+        # cosine must not care
+        x = data.copy()
+        x[42] *= 1e4
+        q = data[17]
+        ip = exact_topk_ids(x, q, 5, "inner_product")
+        cos = exact_topk_ids(x, q, 5, "cosine")
+        norm = x / np.linalg.norm(x, axis=1, keepdims=True)
+        expect = np.argsort(-(norm @ q), kind="stable")[:5]
+        assert 42 == ip[0]
+        np.testing.assert_array_equal(cos, expect)
+
+    def test_k_clamped_to_rows(self, data):
+        got = exact_topk_ids(data[:3], data[0], 10, "sqeuclidean")
+        assert got.shape == (3,)
+
+
+class TestRecallAtK:
+    def test_exact_match(self):
+        assert recall_at_k(np.array([1, 2, 3]), np.array([3, 2, 1]), 3) \
+            == 1.0
+
+    def test_partial_overlap(self):
+        assert recall_at_k(np.array([1, 2, 9]), np.array([1, 2, 3]), 3) \
+            == pytest.approx(2 / 3)
+
+    def test_pad_counts_against_recall(self):
+        # a half-filled answer IS a quality failure: -1 pads never match
+        assert recall_at_k(np.array([1, -1, -1]),
+                           np.array([1, 2, 3]), 3) == pytest.approx(1 / 3)
+
+    def test_served_longer_than_k_is_truncated(self):
+        # only the first k served ids count: 9, 8, 1 vs {1, 2, 3}
+        assert recall_at_k(np.array([9, 8, 1, 2, 3]),
+                           np.array([1, 2, 3]), 3) == pytest.approx(1 / 3)
+
+
+class TestSampling:
+    def _pattern(self, seed, n=200, fraction=0.25):
+        reg = _FakeRegistry([])
+        v = RecallVerifier(reg, VerifierConfig(
+            sample_fraction=fraction, rate_limit_per_s=0.0,
+            reservoir_depth=1 << 20, seed=seed))
+        q = np.zeros(4, np.float32)
+        ids = np.arange(3)
+        return [v.maybe_sample("acme", q, 3, ids, f"t{i}")
+                for i in range(n)]
+
+    def test_deterministic_per_seed(self):
+        # crc32 tenant seeding: the accept pattern replays exactly —
+        # str hash() is process-salted and would break this
+        a = self._pattern(seed=5)
+        b = self._pattern(seed=5)
+        assert a == b
+        assert any(a) and not all(a)
+
+    def test_different_seed_different_pattern(self):
+        assert self._pattern(seed=5) != self._pattern(seed=6)
+
+    def test_zero_fraction_never_samples(self):
+        assert not any(self._pattern(seed=0, fraction=0.0))
+
+    def test_rate_limit_bounds_a_burst(self):
+        obs.enable(registry=MetricsRegistry(), hbm=False)
+        v = RecallVerifier(_FakeRegistry([]), VerifierConfig(
+            sample_fraction=1.0, rate_limit_per_s=1.0,
+            reservoir_depth=1 << 20, seed=0))
+        q = np.zeros(4, np.float32)
+        taken = sum(v.maybe_sample("acme", q, 3, np.arange(3), f"t{i}")
+                    for i in range(100))
+        # one token of burst capacity, negligible refill in-loop
+        assert taken <= 2
+        c = obs.registry().snapshot()["counters"]
+        assert c["quality.skipped{reason=rate_limit,tenant=acme}"] >= 98
+
+    def test_reservoir_bounds_memory_under_burst(self):
+        obs.enable(registry=MetricsRegistry(), hbm=False)
+        v = RecallVerifier(_FakeRegistry([]), VerifierConfig(
+            sample_fraction=1.0, rate_limit_per_s=0.0,
+            reservoir_depth=8, seed=0))
+        q = np.zeros(4, np.float32)
+        for i in range(100):
+            v.maybe_sample("acme", q, 3, np.arange(3), f"t{i}")
+        assert len(v._pending) == 8
+        c = obs.registry().snapshot()["counters"]
+        assert c["quality.skipped{reason=reservoir,tenant=acme}"] == 92
+
+    def test_sample_copies_not_views(self):
+        # the serving loop reuses its buffers: the sample must hold its
+        # own copies, not views that mutate under the worker
+        v = RecallVerifier(_FakeRegistry([]), VerifierConfig(
+            sample_fraction=1.0, rate_limit_per_s=0.0, seed=0))
+        q = np.ones(4, np.float32)
+        ids = np.arange(3)
+        assert v.maybe_sample("acme", q, 3, ids, "t0")
+        q[:] = -1.0
+        ids[:] = -1
+        item = v._pending[0]
+        assert item["query"].tolist() == [1.0] * 4
+        assert item["ids"].tolist() == [0, 1, 2]
+
+
+class _Poison:
+    """A dataset stand-in that explodes if anything materializes it."""
+
+    nbytes = 1 << 40
+
+    def __array__(self, *a, **kw):
+        raise AssertionError("admission-declined replay touched the "
+                             "dataset")
+
+
+class TestVerify:
+    def _mk(self, dataset, usable=1 << 30, resident=0, metric="sqeuclidean"):
+        tenant = _FakeTenant("acme", dataset, metric=metric)
+        reg = _FakeRegistry([tenant], usable=usable, resident=resident)
+        return RecallVerifier(reg, VerifierConfig(
+            sample_fraction=1.0, rate_limit_per_s=0.0, seed=0))
+
+    def test_verify_publishes_gauges_and_verdicts(self):
+        obs.enable(registry=MetricsRegistry(), hbm=False)
+        rng = np.random.default_rng(0)
+        x = rng.normal(size=(200, 8)).astype(np.float32)
+        v = self._mk(x)
+        true = exact_topk_ids(x, x[5], 4, "sqeuclidean")
+        served = true.copy()
+        served[-1] = 199 if true[-1] != 199 else 198  # one wrong answer
+        v._verify({"tenant": "acme", "k": 4, "query": x[5],
+                   "ids": served, "trace_id": "trace-1"})
+        g = obs.registry().snapshot()["gauges"]
+        assert g["quality.recall{k=4,tenant=acme}"] == pytest.approx(0.75)
+        assert g["quality.recall_ci_low{k=4,tenant=acme}"] < 0.75
+        assert g["quality.recall_ci_high{k=4,tenant=acme}"] > 0.75
+        assert g["quality.samples{k=4,tenant=acme}"] == 1.0
+        # the worst-recall exemplar ride: the loss histogram retains
+        # the verdict's trace id
+        h = obs.registry().snapshot()["histograms"][
+            "quality.recall_loss{tenant=acme}"]
+        tids = [e["trace_id"] for res in h["exemplars"].values()
+                for e in res]
+        assert "trace-1" in tids
+        assert v.recall_summary("acme")[4]["n"] == 1.0
+
+    def test_admission_declined_never_touches_dataset(self):
+        obs.enable(registry=MetricsRegistry(), hbm=False)
+        v = self._mk(_Poison(), usable=1 << 20, resident=0)
+        v._verify({"tenant": "acme", "k": 3,
+                   "query": np.zeros(4, np.float32),
+                   "ids": np.arange(3), "trace_id": "t"})
+        c = obs.registry().snapshot()["counters"]
+        assert c["quality.skipped{reason=admission,tenant=acme}"] == 1.0
+        assert v.recall_summary("acme") == {}
+
+    def test_numpy_dataset_needs_no_headroom(self):
+        # host-resident datasets transfer nothing: a full chip must not
+        # block their replays
+        obs.enable(registry=MetricsRegistry(), hbm=False)
+        x = np.zeros((50, 4), np.float32)
+        v = self._mk(x, usable=0, resident=0)
+        v._verify({"tenant": "acme", "k": 3, "query": x[0],
+                   "ids": np.array([0, 1, 2]), "trace_id": "t"})
+        assert v.recall_summary("acme")[3]["n"] == 1.0
+
+    def test_missing_tenant_and_dataset_count_skips(self):
+        obs.enable(registry=MetricsRegistry(), hbm=False)
+        v = self._mk(None)
+        v._verify({"tenant": "ghost", "k": 3,
+                   "query": np.zeros(4, np.float32),
+                   "ids": np.arange(3), "trace_id": "t"})
+        v._verify({"tenant": "acme", "k": 3,
+                   "query": np.zeros(4, np.float32),
+                   "ids": np.arange(3), "trace_id": "t"})
+        c = obs.registry().snapshot()["counters"]
+        assert c["quality.skipped{reason=tenant_gone,tenant=ghost}"] == 1.0
+        assert c["quality.skipped{reason=no_dataset,tenant=acme}"] == 1.0
+
+    def test_worker_drains_in_background(self):
+        obs.enable(registry=MetricsRegistry(), hbm=False)
+        rng = np.random.default_rng(1)
+        x = rng.normal(size=(100, 8)).astype(np.float32)
+        v = self._mk(x)
+        v.start()
+        try:
+            done = threading.Event()
+            v.on_verdict = lambda t: done.set()
+            assert v.maybe_sample(
+                "acme", x[3], 5,
+                exact_topk_ids(x, x[3], 5, "sqeuclidean"), "t0")
+            assert done.wait(timeout=5.0), "worker never verified"
+        finally:
+            v.stop()
+        assert v.recall_summary("acme")[5]["recall"] == 1.0
+
+    def test_state_feeds_flight_section(self):
+        obs.enable(registry=MetricsRegistry(), hbm=False)
+        rng = np.random.default_rng(2)
+        x = rng.normal(size=(80, 8)).astype(np.float32)
+        v = self._mk(x)
+        for i in range(3):
+            v._verify({"tenant": "acme", "k": 4, "query": x[i],
+                       "ids": exact_topk_ids(x, x[i], 4, "sqeuclidean"),
+                       "trace_id": f"trace-{i}"})
+        st = v.state()
+        assert st["verified_total"] == 3
+        assert st["tenants"]["acme"]["4"]["recall"] == 1.0
+        assert [d["trace_id"] for d in st["verdicts"]] \
+            == ["trace-0", "trace-1", "trace-2"]
+        assert st["config"]["sample_fraction"] == 1.0
+        import json
+
+        json.dumps(st)  # flight dumps serialize it verbatim

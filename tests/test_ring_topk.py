@@ -82,6 +82,7 @@ class TestRingKernelParity:
         gv, gi = fn(jnp.asarray(vals), jnp.asarray(ids))
         return np.asarray(gv)[:m], np.asarray(gi)[:m]
 
+    @pytest.mark.slow  # k1/max_select keep kernel parity tier-1 (tier-1 budget)
     def test_ragged_m_min_select(self, mesh, rng):
         # m=27: chunks pad to 8 sublane rows, pad rows must not leak
         vals, ids = make_tables(rng, 27, 10, True)
@@ -98,6 +99,7 @@ class TestRingKernelParity:
         np.testing.assert_array_equal(gv, rv)
         np.testing.assert_array_equal(gi, ri)
 
+    @pytest.mark.slow  # k1/max_select keep kernel parity tier-1 (tier-1 budget)
     def test_duplicate_ids_and_sentinels(self, mesh, rng):
         vals, ids = make_tables(rng, 8, 6, True, dup_ids=True,
                                 sentinels=True)
@@ -406,6 +408,7 @@ class TestRingBytes:
         assert c["comms.bytes{axis=shard,op=allgather}"] == \
             N_DEV * m * k * 4 * 2, c
 
+    @pytest.mark.slow  # ratio re-proved by the dryrun byte model + exact-byte twins above; CI lanes run it (tier-1 budget)
     def test_ring_beats_allgather_2x(self, mesh, reg, rng):
         # the ISSUE 8 acceptance ratio at n_dev=8, in the counters
         m, k = 256, 10
@@ -565,6 +568,7 @@ class TestRingFusedScan:
         assert c.get("ivf_pq.scan.fallback{reason=filter_bitset}",
                      0) == 0, c
 
+    @pytest.mark.slow  # sole tier-1 user of the pq_sharded build; the fused CI legs exercise admission (tier-1 budget)
     def test_fused_filtered_admission(self, pq_sharded, monkeypatch):
         """_ring_fused_wanted(filtered=True) admits the workhorse shape
         (the filter slots fit the VMEM model and the byte rows pass

@@ -988,3 +988,120 @@ class TestLoadgenExemplars:
         finally:
             trace.set_buffer(prev)
             flight.uninstall()
+
+
+class TestQualityPlane:
+    """ISSUE 16: the online recall verifier wired through the server —
+    sampled replays feed quality gauges off the hot path, the flight
+    dump grows a "quality" section, /healthz carries the SLO doc, and
+    /indexz serves per-tenant index health."""
+
+    def _quality_server(self, flat_index, data, **cfg):
+        reg = MetricsRegistry()
+        obs.enable(registry=reg, hbm=False)
+        registry = serve.IndexRegistry(budget_bytes=1 << 30)
+        registry.admit("flat", flat_index,
+                       params=ivf_flat.SearchParams(n_probes=16),
+                       default_k=10, dataset=data, recall_floor=0.2)
+        server = serve.MicroBatchServer(
+            registry, serve.ServerConfig(
+                max_batch=8, linger_s=0.001, verify_sample=1.0,
+                verify_rate_per_s=1e9, **cfg))
+        return server, reg
+
+    def _wait_gauge(self, reg, key, timeout=15.0):
+        t0 = time.monotonic()
+        while time.monotonic() - t0 < timeout:
+            g = reg.snapshot()["gauges"]
+            if key in g:
+                return g
+            time.sleep(0.02)
+        raise AssertionError(
+            f"{key} never appeared; gauges: "
+            f"{sorted(reg.snapshot()['gauges'])}")
+
+    def test_verifier_feeds_recall_gauges(self, flat_index, data):
+        server, reg = self._quality_server(flat_index, data)
+        with server:
+            assert server.verifier is not None
+            for j in range(24):
+                server.search("flat", data[j], 10)
+            g = self._wait_gauge(reg, "quality.recall{k=10,tenant=flat}")
+            recall = g["quality.recall{k=10,tenant=flat}"]
+            lo = g["quality.recall_ci_low{k=10,tenant=flat}"]
+            hi = g["quality.recall_ci_high{k=10,tenant=flat}"]
+            assert 0.0 <= lo <= recall <= hi <= 1.0
+            # exact self-queries over the admitted dataset: n_probes=16
+            # of 16 lists is exhaustive, recall must be perfect
+            assert recall == pytest.approx(1.0)
+            snap = reg.snapshot()
+            assert snap["counters"][
+                "quality.verified{tenant=flat}"] >= 1.0
+            hkey = [k for k in snap["histograms"]
+                    if k.startswith("quality.recall_loss{")]
+            assert hkey, sorted(snap["histograms"])
+        assert server.verifier is None  # stopped with the server
+
+    def test_flight_quality_section_while_serving(self, flat_index,
+                                                  data):
+        from raft_tpu.obs import flight
+
+        server, reg = self._quality_server(flat_index, data)
+        flight.uninstall()
+        try:
+            with server:
+                for j in range(8):
+                    server.search("flat", data[j], 10)
+                self._wait_gauge(reg,
+                                 "quality.recall{k=10,tenant=flat}")
+                rec = flight.FlightRecorder("/tmp/raft_tpu_test_qsect")
+                body = rec.payload("test")
+                rec.close()
+                q = body["quality"]
+                assert q["verified_total"] >= 1
+                assert "flat" in q["tenants"]
+                assert q["verdicts"][0]["trace_id"]
+            rec = flight.FlightRecorder("/tmp/raft_tpu_test_qsect")
+            body = rec.payload("test")
+            rec.close()
+            assert "quality" not in body  # cleared on stop
+        finally:
+            flight.uninstall()
+
+    def test_healthz_and_indexz_over_http(self, flat_index, data):
+        import urllib.request
+
+        server, reg = self._quality_server(flat_index, data,
+                                           expo_port=0)
+        with server:
+            for j in range(8):
+                server.search("flat", data[j], 10)
+            self._wait_gauge(reg, "quality.recall{k=10,tenant=flat}")
+            url = server.expo.url
+            health = json.loads(urllib.request.urlopen(
+                url + "/healthz", timeout=10).read())
+            assert health["status"] == "ok"       # floor 0.2 well met
+            assert "recall_floor_breached" in health["slo"]
+            assert health["slo"]["recall_floor_breached"] == []
+            idx = json.loads(urllib.request.urlopen(
+                url + "/indexz", timeout=10).read())
+            ten = idx["tenants"]["flat"]
+            assert ten["recall_floor"] == 0.2
+            assert ten["stats"]["lists"]["n_lists"] == 16
+            assert "cv" in ten["stats"]["lists"]
+
+    def test_no_verify_sample_no_verifier(self, flat_index, data):
+        reg = MetricsRegistry()
+        obs.enable(registry=reg, hbm=False)
+        registry = serve.IndexRegistry(budget_bytes=1 << 30)
+        registry.admit("flat", flat_index,
+                       params=ivf_flat.SearchParams(n_probes=8),
+                       default_k=10, dataset=data)
+        server = serve.MicroBatchServer(
+            registry, serve.ServerConfig(max_batch=8, linger_s=0.001))
+        with server:
+            assert server.verifier is None
+            assert server.slo is not None   # guardrails run regardless
+            server.search("flat", data[0], 10)
+        assert "quality.recall{k=10,tenant=flat}" not in \
+            reg.snapshot()["gauges"]

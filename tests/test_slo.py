@@ -1,0 +1,313 @@
+"""serve.slo — SLO guardrails (ISSUE 16 tentpole c).
+
+The policy contract under test: multi-window burn rates computed from
+snapshot deltas (not lifetime totals), the latency-over-SLO resolution
+against cumulative histogram buckets, the recall-floor state machine
+(insufficient evidence holds state; breach demotes + arms the quality
+gate; fresh evidence recovers + disarms), the degrade ladder actually
+skipping refused quality rungs with the ``degrade.refused`` counter,
+and the process-global monitor install/clear discipline dispatch relies
+on. Device-free — no jax import.
+"""
+
+import dataclasses
+
+import pytest
+
+from raft_tpu import obs
+from raft_tpu.obs.metrics import MetricsRegistry
+from raft_tpu.robust import degrade
+from raft_tpu.serve import slo
+from raft_tpu.serve.slo import SLOMonitor, SLOPolicy
+
+
+class _FakeTenant:
+    def __init__(self, name, recall_floor=None):
+        self.name = name
+        self.recall_floor = recall_floor
+
+
+class _FakeRegistry:
+    def __init__(self, tenants):
+        self._tenants = tenants
+        self.degraded = []
+        self.recovered = []
+
+    def resident(self):
+        return list(self._tenants)
+
+    def note_degraded(self, name):
+        self.degraded.append(name)
+
+    def note_recovered(self, name):
+        self.recovered.append(name)
+
+
+class _FakeVerifier:
+    """recall_summary is the only surface the monitor reads."""
+
+    def __init__(self):
+        self.summaries = {}
+
+    def recall_summary(self, tenant):
+        return self.summaries.get(tenant, {})
+
+
+def _summary(recall, n, z=1.96):
+    from raft_tpu.obs.quality import wilson_interval
+
+    lo, hi = wilson_interval(recall * n, n, z)
+    return {10: {"recall": recall, "ci_low": lo, "ci_high": hi,
+                 "n": float(n)}}
+
+
+@pytest.fixture(autouse=True)
+def _clean():
+    slo.clear_monitor()
+    yield
+    slo.clear_monitor()
+    obs.disable()
+
+
+class TestBurnRates:
+    def _mk(self, policy=None):
+        obs.enable(registry=MetricsRegistry(), hbm=False)
+        clock = {"t": 0.0}
+        mon = SLOMonitor(_FakeRegistry([]), policy=policy or SLOPolicy(
+            windows_s=(30.0, 300.0), availability_target=0.999),
+            clock=lambda: clock["t"])
+        return mon, clock, obs.registry()
+
+    def test_no_traffic_is_zero_burn(self):
+        mon, _, _ = self._mk()
+        assert mon.burn_rates() == {30.0: 0.0, 300.0: 0.0}
+
+    def test_burn_from_deltas_not_lifetime(self):
+        mon, clock, reg = self._mk()
+        # a historic bad period outside the window must not burn now
+        reg.inc("serve.requests", 1000, labels={"tenant": "a"})
+        reg.inc("serve.shed", 500, labels={"reason": "queue_full"})
+        mon.tick()
+        clock["t"] = 1000.0                     # old snap pruned
+        mon.tick()
+        clock["t"] = 1010.0
+        reg.inc("serve.requests", 100, labels={"tenant": "a"})
+        burns = mon.burn_rates()
+        assert burns[30.0] == 0.0
+
+    def test_bad_fraction_over_budget(self):
+        mon, clock, reg = self._mk()
+        reg.inc("serve.requests", 100, labels={"tenant": "a"})
+        mon.tick()
+        clock["t"] = 10.0
+        reg.inc("serve.requests", 100, labels={"tenant": "a"})
+        reg.inc("serve.shed", 30, labels={"reason": "queue_full"})
+        burns = mon.burn_rates()
+        # 30 bad / 100 total over a 0.001 budget = 300x burn
+        assert burns[30.0] == pytest.approx(300.0)
+        snap = obs.registry().snapshot()
+        assert snap["gauges"]["slo.burn_rate{window=30s}"] \
+            == pytest.approx(300.0)
+        assert snap["counters"]["slo.burn_alert{window=30s}"] >= 1.0
+
+    def test_latency_slo_counts_slow_completions(self):
+        obs.enable(registry=MetricsRegistry(), hbm=False)
+        clock = {"t": 0.0}
+        mon = SLOMonitor(_FakeRegistry([]), policy=SLOPolicy(
+            windows_s=(30.0,), availability_target=0.9,
+            latency_slo_s=0.1), clock=lambda: clock["t"])
+        reg = obs.registry()
+        mon.tick()
+        clock["t"] = 5.0
+        for v in (0.01, 0.02, 0.5, 0.9):  # 2 of 4 over the 0.1 s SLO
+            reg.observe("serve.latency_s", v)
+        reg.inc("serve.requests", 4, labels={"tenant": "a"})
+        burns = mon.burn_rates()
+        # 2 slow / 4 requests over a 0.1 budget = 5x burn
+        assert burns[30.0] == pytest.approx(5.0)
+
+
+class TestRecallFloor:
+    def _mk(self, floor=0.8, min_samples=8):
+        obs.enable(registry=MetricsRegistry(), hbm=False)
+        tenant = _FakeTenant("acme", recall_floor=floor)
+        registry = _FakeRegistry([tenant, _FakeTenant("other")])
+        verifier = _FakeVerifier()
+        mon = SLOMonitor(registry, verifier=verifier,
+                         policy=SLOPolicy(min_samples=min_samples))
+        return mon, registry, verifier
+
+    def test_insufficient_evidence_holds_state(self):
+        mon, registry, verifier = self._mk()
+        verifier.summaries["acme"] = _summary(0.1, n=3)  # n < min_samples
+        mon.evaluate()
+        assert mon.breached() == [] and registry.degraded == []
+
+    def test_breach_demotes_and_arms_gate(self):
+        mon, registry, verifier = self._mk()
+        verifier.summaries["acme"] = _summary(0.3, n=20)
+        mon.evaluate()
+        assert mon.breached() == ["acme"]
+        assert registry.degraded == ["acme"]
+        gate = mon.quality_gate_for("acme")
+        assert gate is not None and gate("fp8_lut")
+        assert mon.quality_gate_for("other") is None
+        c = obs.registry().snapshot()["counters"]
+        assert c["slo.recall_floor_breach{tenant=acme}"] == 1.0
+        g = obs.registry().snapshot()["gauges"]
+        assert g["slo.recall_floor_ok{tenant=acme}"] == 0.0
+        # re-evaluating an unchanged breach is idempotent
+        mon.evaluate()
+        assert registry.degraded == ["acme"]
+
+    def test_recovery_promotes_and_disarms(self):
+        mon, registry, verifier = self._mk()
+        verifier.summaries["acme"] = _summary(0.3, n=20)
+        mon.evaluate()
+        verifier.summaries["acme"] = _summary(1.0, n=50)
+        mon.evaluate()
+        assert mon.breached() == []
+        assert registry.recovered == ["acme"]
+        assert mon.quality_gate_for("acme") is None
+        snap = obs.registry().snapshot()
+        assert snap["counters"][
+            "slo.recall_floor_recovered{tenant=acme}"] == 1.0
+        assert snap["gauges"]["slo.recall_floor_ok{tenant=acme}"] == 1.0
+
+    def test_floorless_tenant_never_breaches(self):
+        mon, registry, verifier = self._mk(floor=None)
+        verifier.summaries["acme"] = _summary(0.0, n=50)
+        mon.evaluate()
+        assert mon.breached() == []
+
+    def test_marginal_recall_breaches_via_ci_not_point(self):
+        # point estimate ABOVE the floor but CI lower bound below it
+        # with thin evidence: the floor trips on the bound — the SLO is
+        # about what we can PROVE, not the lucky sample mean
+        mon, registry, verifier = self._mk(floor=0.8, min_samples=8)
+        verifier.summaries["acme"] = _summary(0.85, n=10)
+        from raft_tpu.obs.quality import wilson_interval
+
+        assert wilson_interval(8.5, 10)[0] < 0.8
+        mon.evaluate()
+        assert mon.breached() == ["acme"]
+
+    def test_healthz_payload(self):
+        mon, registry, verifier = self._mk()
+        verifier.summaries["acme"] = _summary(0.2, n=20)
+        doc = mon.healthz()
+        assert doc["recall_floor_breached"] == ["acme"]
+        assert "30s" in doc["burn_rates"]
+        assert doc["burn_threshold"] == 2.0
+
+
+@dataclasses.dataclass
+class _Params:
+    # the knob surface the standard ladder's rungs mutate
+    lut_dtype: str = "float32"
+    scan_select: str = "pallas"
+    scan_mode: str = "grouped"
+    refine: str = "none"
+
+
+def _knobs():
+    return {"params": _Params()}
+
+
+class TestQualityGateLadder:
+    def test_refused_rungs_skipped_and_counted(self):
+        obs.enable(registry=MetricsRegistry(), hbm=False)
+        ladder = degrade.standard_search_ladder(batch=1, has_lut=True)
+        with degrade.quality_gate(lambda rung: True):
+            taken = []
+            knobs = _knobs()
+            while True:
+                step = ladder.advance(knobs)
+                if step is None:
+                    break
+                taken.append(step[0].name)
+                knobs = step[1]
+        assert "bf16_lut" not in taken and "fp8_lut" not in taken
+        assert "decline_fused" not in taken
+        c = obs.registry().snapshot()["counters"]
+        assert c["degrade.refused{reason=recall_floor,rung=bf16_lut}"] \
+            >= 1.0
+        assert c["degrade.refused{reason=recall_floor,rung=fp8_lut}"] \
+            >= 1.0
+
+    def test_ungated_walk_takes_quality_rungs(self):
+        ladder = degrade.standard_search_ladder(batch=1, has_lut=True)
+        taken = []
+        knobs = _knobs()
+        while True:
+            step = ladder.advance(knobs)
+            if step is None:
+                break
+            taken.append(step[0].name)
+            knobs = step[1]
+        assert "bf16_lut" in taken and "fp8_lut" in taken
+
+    def test_cursor_untouched_by_refusal(self):
+        # a refused rung must come back after the gate drops: refuse
+        # everything once, then walk un-gated — quality rungs reappear
+        ladder = degrade.standard_search_ladder(batch=2, has_lut=True)
+        step = ladder.advance(_knobs())       # halve_batch applies
+        assert step[0].name == "halve_batch"
+        with degrade.quality_gate(lambda rung: True):
+            nxt = ladder.advance(step[1])
+        # gated: bf16/fp8/decline refused; host_gather (or the terminal
+        # halve) taken instead
+        assert nxt is None or nxt[0].name not in degrade.QUALITY_RUNGS
+        ladder2 = degrade.standard_search_ladder(batch=2, has_lut=True)
+        with degrade.quality_gate(lambda rung: True):
+            s = ladder2.advance(_knobs())
+        nxt2 = ladder2.advance(s[1])          # un-gated follow-up
+        assert nxt2[0].name == "bf16_lut"
+
+    def test_raising_gate_fails_open(self):
+        def boom(rung):
+            raise RuntimeError("policy backend down")
+
+        ladder = degrade.standard_search_ladder(batch=1, has_lut=True)
+        with degrade.quality_gate(boom):
+            step = ladder.advance(_knobs())
+        assert step[0].name == "bf16_lut"  # degraded answers beat a crash
+
+    def test_none_gate_is_noop(self):
+        with degrade.quality_gate(None):
+            ladder = degrade.standard_search_ladder(batch=1, has_lut=True)
+            step = ladder.advance(_knobs())
+            assert step[0].name == "bf16_lut"
+
+    def test_gate_is_thread_local(self):
+        import threading
+
+        seen = {}
+
+        def other_thread():
+            ladder = degrade.standard_search_ladder(batch=1, has_lut=True)
+            step = ladder.advance(_knobs())
+            seen["name"] = step[0].name
+
+        with degrade.quality_gate(lambda rung: True):
+            t = threading.Thread(target=other_thread)
+            t.start()
+            t.join()
+        assert seen["name"] == "bf16_lut"  # gate never leaked across
+
+
+class TestGlobalMonitor:
+    def test_install_and_clear(self):
+        mon = SLOMonitor(_FakeRegistry([]))
+        assert slo.set_monitor(mon) is None
+        assert slo.get_monitor() is mon
+        slo.clear_monitor(mon)
+        assert slo.get_monitor() is None
+
+    def test_stale_clear_keeps_newer_monitor(self):
+        old = SLOMonitor(_FakeRegistry([]))
+        new = SLOMonitor(_FakeRegistry([]))
+        slo.set_monitor(old)
+        slo.set_monitor(new)
+        slo.clear_monitor(old)  # a stop() racing a newer start()
+        assert slo.get_monitor() is new

@@ -28,6 +28,7 @@ def _two_cliques(n_per=15, bridge=1):
     return sops.symmetrize(coo, mode="max"), n_per
 
 
+@pytest.mark.slow  # modularity twin on the same cliques stays tier-1 (tier-1 budget)
 def test_partition_two_cliques():
     adj, n_per = _two_cliques()
     labels, evals, evecs = spectral.partition(adj, 2, seed=1)

@@ -22,6 +22,7 @@ MODULES = [
     "raft_tpu.obs.prof",
     "raft_tpu.obs.trace", "raft_tpu.obs.flight", "raft_tpu.obs.expo",
     "raft_tpu.obs.fleet", "raft_tpu.obs.sanitize",
+    "raft_tpu.obs.quality", "raft_tpu.obs.index_stats",
     "raft_tpu.robust.faults", "raft_tpu.robust.retry",
     "raft_tpu.robust.degrade", "raft_tpu.robust.checkpoint",
     "raft_tpu.linalg.blas", "raft_tpu.linalg.solvers",
@@ -50,7 +51,7 @@ MODULES = [
     "raft_tpu.parallel.build",
     "raft_tpu.serve.server", "raft_tpu.serve.registry",
     "raft_tpu.serve.dispatch", "raft_tpu.serve.loadgen",
-    "raft_tpu.serve.errors",
+    "raft_tpu.serve.slo", "raft_tpu.serve.errors",
     "raft_tpu.ops.pallas_kernels", "raft_tpu.native",
     "raft_tpu.bench.dataset", "raft_tpu.bench.runner",
     "raft_tpu.bench.ingest", "raft_tpu.bench.plot",

@@ -25,6 +25,7 @@ Usage::
 
     python -m tools.obsdump flight_20260803-120000_123.json
     python -m tools.obsdump flight_*.json --slowest 5   # exemplar drill-down
+    python -m tools.obsdump flight_*.json --worst-recall 3  # quality drill-down
     python -m tools.obsdump --fleet host0.json host1.json --merge pod.json
     python -m tools.obsdump trace_host0.json trace_host1.json --merge all.json
     python -m tools.obsdump bench_obs.jsonl --top 30
@@ -383,23 +384,30 @@ def _all_exemplars(hists: Dict[str, Any], family: str
 
 
 def slowest_table(raw: Dict[str, Any], n: int,
-                  family: str = "serve.latency_s") -> str:
+                  family: str = "serve.latency_s",
+                  value_fmt=None) -> str:
     """The ``--slowest N`` drill-down (ISSUE 15): resolve the latency
     histogram's retained exemplars to concrete requests, then render
     each one's full timeline — every event (queue wait, bucket fill,
     dispatch, search stages, retry attempts, ladder moves) stamped with
-    its trace id — from the dump's event ring + degrade history."""
+    its trace id — from the dump's event ring + degrade history.
+
+    ``--worst-recall`` (ISSUE 16) reuses this machinery with
+    ``family="quality.recall_loss"`` — the verifier's loss histogram
+    retains its LARGEST losses (worst recalls) as exemplars, so the
+    same drill-down names the requests that served the worst answers."""
     hists = (raw.get("metrics") or {}).get("histograms", {})
     exemplars = _all_exemplars(hists, family)
     if not exemplars:
-        return ("  (no exemplars retained — is the latency histogram "
-                "recording with trace-id exemplars?)\n")
+        return (f"  (no exemplars retained for {family} — is the "
+                "histogram recording with trace-id exemplars?)\n")
+    if value_fmt is None:
+        value_fmt = lambda v: f"latency {v * 1e3:,.2f} ms"  # noqa: E731
     events = raw.get("events", [])
     degrade = (raw.get("robust") or {}).get("degrade_recent", [])
     out: List[str] = []
     for rank, (value, tid) in enumerate(exemplars[:n], 1):
-        out.append(f"  #{rank} trace {tid}  latency "
-                   f"{value * 1e3:,.2f} ms")
+        out.append(f"  #{rank} trace {tid}  {value_fmt(value)}")
         timeline: List[Tuple[float, str, Optional[float], str]] = []
         for e in events:
             if e.get("ph") != "X" or not _event_matches(e, tid):
@@ -476,6 +484,61 @@ def benchdiff_section(doc: Dict[str, Any]) -> str:
     return _benchdiff.render_markdown(doc)
 
 
+def index_table(snap: Dict[str, Any]) -> str:
+    """The ``index.*`` gauge family (ISSUE 16): per-index structural
+    health — list skew, dead lists, centroid drift, PQ quantization
+    error, tombstone density — one row per ``{index=}`` label."""
+    per: Dict[str, Dict[str, float]] = {}
+    for key, v in snap["gauges"].items():
+        name, labels = parse_key(key)
+        if not name.startswith("index."):
+            continue
+        per.setdefault(labels.get("index", "-"),
+                       {})[name[len("index."):]] = v
+    def _f(st, k, digits=4):
+        return "-" if st.get(k) is None else f"{st[k]:.{digits}f}"
+    rows = [[idx,
+             "-" if st.get("n_lists") is None else str(int(st["n_lists"])),
+             "-" if st.get("size") is None else str(int(st["size"])),
+             _f(st, "list_cv", 3),
+             _f(st, "list_max_mean", 2),
+             "-" if st.get("dead_lists") is None
+             else str(int(st["dead_lists"])),
+             _f(st, "drift_rel"),
+             _f(st, "pq_err_rel"),
+             _f(st, "tombstone_density", 3)]
+            for idx, st in sorted(per.items())]
+    return _table(["index", "lists", "size", "cv", "max/mean", "dead",
+                   "drift_rel", "pq_err_rel", "tombstones"], rows)
+
+
+def quality_header(raw: Dict[str, Any]) -> List[str]:
+    """Flight-header lines from the dump's ``"quality"`` section (the
+    shadow verifier's state): per-tenant recall estimates with Wilson
+    CIs + the tail of the verdict log with trace ids."""
+    q = raw.get("quality")
+    if not q:
+        return []
+    out = [f"  quality: {int(q.get('verified_total', 0))} verified "
+           f"(sample_fraction="
+           f"{(q.get('config') or {}).get('sample_fraction')})"]
+    for tenant, per_k in sorted((q.get("tenants") or {}).items()):
+        for k, est in sorted(per_k.items(), key=lambda kv: int(kv[0])):
+            if not est:
+                continue
+            out.append(
+                f"    {tenant} k={k}: recall {est.get('recall', 0):.4f} "
+                f"[{est.get('ci_low', 0):.4f}, "
+                f"{est.get('ci_high', 0):.4f}] n={int(est.get('n', 0))}")
+    verdicts = q.get("verdicts") or []
+    if verdicts:
+        worst = min(verdicts, key=lambda v: v.get("recall", 1.0))
+        out.append(f"    worst recent verdict: {worst.get('tenant')} "
+                   f"k={worst.get('k')} recall={worst.get('recall')} "
+                   f"trace {worst.get('trace_id')}")
+    return out
+
+
 def hbm_table(snap: Dict[str, Any]) -> str:
     rows = []
     for key, v in sorted(snap["gauges"].items()):
@@ -487,7 +550,8 @@ def hbm_table(snap: Dict[str, Any]) -> str:
     return _table(["gauge", "device", "value"], rows)
 
 
-def render(path: str, top: int, slowest: int = 0) -> str:
+def render(path: str, top: int, slowest: int = 0,
+           worst_recall: int = 0) -> str:
     kind, snap, raw = load_any(path)
     out = [f"== {path} ({kind}) =="]
     if kind == "benchdiff":
@@ -534,6 +598,10 @@ def render(path: str, top: int, slowest: int = 0) -> str:
                 out.append("  degrade steps: " + "; ".join(
                     f"{s.get('site')} {s.get('from')}->{s.get('to')} "
                     f"[{s.get('reason')}]" for s in steps[-8:]))
+        # the quality plane (ISSUE 16): the dump's online recall
+        # evidence rides the header — a killed run says what quality it
+        # was serving, not just how fast
+        out.extend(quality_header(raw))
     if _has_serve(snap):
         # the serving header rides FIRST (ISSUE 14): a killed serving
         # run's dump leads with what it was shedding and why
@@ -543,6 +611,17 @@ def render(path: str, top: int, slowest: int = 0) -> str:
         out.append(f"-- slowest {slowest} requests "
                    "(exemplar drill-down) --")
         out.append(slowest_table(raw, slowest))
+    if worst_recall:
+        out.append(f"-- worst {worst_recall} recall verdicts "
+                   "(exemplar drill-down) --")
+        out.append(slowest_table(
+            raw, worst_recall, family="quality.recall_loss",
+            value_fmt=lambda v: f"recall {1.0 - v:.4f} "
+                                f"(loss {v:.4f})"))
+    if any(parse_key(k)[0].startswith("index.")
+           for k in snap["gauges"]):
+        out.append("-- index health (index.*) --")
+        out.append(index_table(snap))
     out.append("-- top spans by total time --")
     out.append(spans_table(snap, top))
     if any(parse_key(k)[0].startswith("prof.")
@@ -572,6 +651,11 @@ def main(argv: Optional[List[str]] = None) -> int:
                     help="drill into the N slowest requests: resolve "
                          "serve.latency_s exemplar trace ids and render "
                          "each request's full timeline (flight dumps)")
+    ap.add_argument("--worst-recall", type=int, default=0, metavar="N",
+                    help="drill into the N worst-recall verified "
+                         "requests: resolve quality.recall_loss "
+                         "exemplar trace ids and render each request's "
+                         "full timeline (flight dumps)")
     ap.add_argument("--fleet", action="store_true",
                     help="treat the inputs as one pod run's per-host "
                          "flight dumps: merge them (shared run_id, "
@@ -598,7 +682,8 @@ def main(argv: Optional[List[str]] = None) -> int:
         return 0
     try:
         for p in args.paths:
-            print(render(p, args.top, slowest=args.slowest))
+            print(render(p, args.top, slowest=args.slowest,
+                         worst_recall=args.worst_recall))
     except BrokenPipeError:  # downstream `| head` closed the pipe
         devnull = os.open(os.devnull, os.O_WRONLY)
         os.dup2(devnull, sys.stdout.fileno())

@@ -50,7 +50,10 @@ BASELINE_NOTE = (
     "max_batch=16) so the committed rows stay stable for the "
     "self-compare gate; the overload/shed behavior is exercised "
     "deterministically by the CI serve smoke's fault-injected stall, "
-    "not by this record. CPU qps varies with machine load - compare "
+    "not by this record. Each row also carries measured recall@10 "
+    "against exact brute-force ground truth over the query slice "
+    "(ISSUE 16) - the quality column a recall-trading degrade walk "
+    "would move. CPU qps varies with machine load - compare "
     "with --report-only unless the environment stamp matches AND the "
     "machine is quiet.")
 
@@ -79,11 +82,19 @@ def serve_record() -> dict:
     server = serve.MicroBatchServer(registry, serve.ServerConfig(
         max_batch=16, queue_depth=128, linger_s=0.002,
         default_slo_s=1.0))
+    # exact ground truth over the query slice (ISSUE 16): brute-force
+    # top-K on host gives every sweep row a measured recall column
+    from raft_tpu.obs import quality as _quality
+
+    queries = x[:512]
+    gt = np.stack([_quality.exact_topk_ids(x, q, K, "sqeuclidean")
+                   for q in queries])
     detail = []
     with server:
         for tenant in ("ivf_pq.n64.pq16", "ivf_flat.n64"):
-            rows = loadgen.sweep(server, tenant, x[:512], K,
-                                 OFFERED_STEPS, duration_s=STEP_S)
+            rows = loadgen.sweep(server, tenant, queries, K,
+                                 OFFERED_STEPS, duration_s=STEP_S,
+                                 ground_truth=gt)
             rec = loadgen.record(rows, dataset=f"serve-synth-{N}x{DIM}",
                                  tenant=tenant, k=K)
             detail.extend(rec["detail"])
@@ -110,6 +121,7 @@ def main(argv=None) -> int:
         print(f"  {r['index']:<16} offered {offered:>6.0f} -> "
               f"qps {r['qps']:>7.1f} "
               f"p99 {p99 if p99 is None else round(p99, 4)} "
+              f"recall {r['recall']} "
               f"shed {r['shed']} missed {r['deadline_missed']}")
     print(f"wrote {len(record['detail'])} serve rows -> {args.out}")
     return 0
