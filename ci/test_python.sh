@@ -677,6 +677,115 @@ EOF
 python -m tools.benchdiff serve_cpu_smoke serve_cpu_smoke \
     --md /tmp/raft_tpu_serve_baseline_scoreboard.md | tail -3
 
+echo "== memory-tiered serving smoke (ISSUE 17: host-resident raw vectors"
+echo "   with candidate-row prefetch under the scan — host tenant bit-equal"
+echo "   to its HBM twin under recompile_budget(0); chaos: HBM pressure"
+echo "   demotes raw vectors BEFORE any eviction, /indexz shows raw=host,"
+echo "   demoted tenant serves exact, re-promoted when pressure clears) =="
+python - <<'EOF'
+# Leg 1 — the twins: the same index admitted twice, raw vectors on
+# device vs placed on host. The host twin's exact re-rank runs through
+# the tiered candidate-row prefetch (pipeline sub-batch pinned to 4 so
+# 16-query dispatches split into 4 overlapping stages) and every
+# served batch must be BIT-EQUAL to the device twin — under the PR-3
+# zero-recompile budget at steady state.
+import os
+os.environ["RAFT_TPU_TIERED_BATCH"] = "4"
+
+import numpy as np
+import jax.numpy as jnp
+
+from raft_tpu import obs, serve
+from raft_tpu.obs import sanitize
+from raft_tpu.obs.metrics import MetricsRegistry
+from raft_tpu.neighbors import ivf_pq
+from raft_tpu.serve.dispatch import dispatch_batch
+
+rng = np.random.default_rng(0)
+x = rng.random((20_000, 32), dtype=np.float32)
+xd = jnp.asarray(x)
+idx = ivf_pq.build(xd, ivf_pq.IndexParams(
+    n_lists=64, pq_dim=16, seed=0, cache_reconstruction="never"))
+params = ivf_pq.SearchParams(n_probes=8, scan_mode="per_query",
+                             refine="f32_regen", refine_ratio=4.0,
+                             lut_dtype="float32")
+reg = MetricsRegistry()
+obs.enable(registry=reg, hbm=False)
+registry = serve.IndexRegistry(budget_bytes=4 << 30)
+registry.admit("hbm_twin", idx, params=params, default_k=10, dataset=xd)
+registry.admit("host_twin", idx, params=params, default_k=10,
+               dataset=xd, placement=serve.Placement(raw="host"))
+assert isinstance(registry.peek("host_twin").dataset, np.ndarray)
+# warm the one serving shape, then steady state must not recompile
+q0 = jnp.asarray(x[:16])
+dispatch_batch(registry.get("hbm_twin"), q0, 10)
+dispatch_batch(registry.get("host_twin"), q0, 10)
+with sanitize.recompile_budget(0, what="tiered steady-state serving"):
+    for a in range(0, 128, 16):
+        q = jnp.asarray(x[a:a + 16])
+        d_h, i_h = dispatch_batch(registry.get("hbm_twin"), q, 10)
+        d_t, i_t = dispatch_batch(registry.get("host_twin"), q, 10)
+        np.testing.assert_array_equal(np.asarray(i_t), np.asarray(i_h))
+        np.testing.assert_array_equal(np.asarray(d_t), np.asarray(d_h))
+c = reg.snapshot()["counters"]
+hits = sum(v for k, v in c.items()
+           if k.startswith("serve.prefetch.hit") and "host_twin" in k)
+stalls = sum(v for k, v in c.items()
+             if k.startswith("serve.prefetch.stall") and "host_twin" in k)
+assert hits + stalls == 9 * 4, (hits, stalls)  # 9 batches x 4 stages
+assert any(k.startswith("refine.dispatch") and "tiered_prefetch" in k
+           for k in c), sorted(k for k in c if "refine" in k)
+
+# Leg 2 — chaos: synthetic HBM pressure. Two resident tenants with
+# device-resident raw vectors; a third admit that would not fit must
+# DEMOTE their raw tiers to host (counted degrade.steps to=demote_raw)
+# instead of evicting anyone; /indexz shows raw=host + demoted; the
+# demoted twin keeps serving bit-equal; evicting the newcomer
+# re-promotes the demoted raw tiers to HBM.
+reg2 = MetricsRegistry()
+obs.enable(registry=reg2, hbm=False)
+pressure = serve.IndexRegistry(budget_bytes=300_000, headroom_frac=0.0)
+pressure.admit("t1", object(), dataset=jnp.ones((1000, 32), jnp.float32))
+pressure.admit("t2", object(), dataset=jnp.ones((1000, 32), jnp.float32))
+pressure.admit("big", object(),
+               dataset=jnp.ones((2000, 32), jnp.float32))
+c2 = reg2.snapshot()["counters"]
+for name in ("t1", "t2"):
+    t = pressure.peek(name)
+    assert t.state != "evicted" and t.demoted, (name, t.state)
+    assert t.placement.raw == "host", t.placement
+assert not any(k.startswith("serve.registry.evict") for k in c2), c2
+assert sum(v for k, v in c2.items()
+           if k.startswith("degrade.steps") and "to=demote_raw" in k) == 2
+assert sum(v for k, v in c2.items()
+           if k.startswith("serve.registry.demote")) == 2
+ten = serve.MicroBatchServer(pressure)._indexz_payload()["tenants"]["t1"]
+assert ten["placement"]["raw"] == "host" and ten["demoted"] is True, ten
+g2 = reg2.snapshot()["gauges"]
+assert g2.get("index.bytes{index=t1,tier=host}") == 128_000, g2
+# pressure clears: the evict of the newcomer re-promotes both
+pressure.evict("big")
+for name in ("t1", "t2"):
+    t = pressure.peek(name)
+    assert not t.demoted and t.placement.raw == "hbm", (name, t.placement)
+assert sum(v for k, v in reg2.snapshot()["counters"].items()
+           if k.startswith("serve.registry.promote")) == 2
+
+# the demoted REAL tenant serves bit-equal through dispatch: demote the
+# host twin's registry sibling and re-compare one batch
+registry.demote_raw("hbm_twin", reason="ci-chaos")
+q = jnp.asarray(x[:16])
+d_a, i_a = dispatch_batch(registry.get("host_twin"), q, 10)
+d_b, i_b = dispatch_batch(registry.get("hbm_twin"), q, 10)
+np.testing.assert_array_equal(np.asarray(i_b), np.asarray(i_a))
+np.testing.assert_array_equal(np.asarray(d_b), np.asarray(d_a))
+obs.disable()
+print(f"tiered smoke OK: 9 host-twin batches bit-equal to HBM twin at 0 "
+      f"recompiles ({int(hits)} prefetch hits / {int(stalls)} stalls), "
+      f"pressure demoted 2 raw tiers before any eviction (/indexz "
+      f"raw=host), re-promoted on clear, demoted tenant serves exact")
+EOF
+
 echo "== quality plane (ISSUE 16: online recall verifier overhead gate,"
 echo "   recall-fault chaos -> floor breach -> quality-gated ladder ->"
 echo "   recovery, /indexz + obsdump index-health introspection) =="

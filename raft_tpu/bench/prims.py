@@ -393,6 +393,96 @@ def bench_refine(grid=None, iters: int = 3) -> List[PrimResult]:
 
 
 # ---------------------------------------------------------------------------
+# tiered refine: HBM-resident vs host-prefetched vs serialized (ISSUE 17)
+# ---------------------------------------------------------------------------
+
+def bench_tiered_refine(grid=None, iters: int = 3) -> List[PrimResult]:
+    """The memory-tiered refined search, three residency legs per
+    config (reference claim: the host→HBM candidate-row fetch hides
+    under the LUT scan):
+
+    - ``hbm_resident``: the raw vectors live on device — the refine
+      dispatch tiers run without any transfer (the ceiling);
+    - ``tiered_prefetch``: host-resident base, candidate rows fetched
+      by the :class:`~raft_tpu.neighbors.tiered.RowPrefetcher` pipeline
+      overlapped under the scan (``refine_transfer="tiered"``);
+    - ``serialized_gather``: the same host base through the serialized
+      host gather (``refine_transfer="serial"``) — what the fetch costs
+      when nothing hides it.
+
+    Params carry the roofline context: ``h2d_gib`` (candidate rows
+    crossing host→HBM per search) and, for the host legs, the
+    effective ``h2d_gibps`` that wall implies, plus the tiered leg's
+    hit/stall split (hits ≫ stalls is the overlap working). A config
+    the mem guard declines records a ``tiered_skipped`` param instead
+    of a silent hole."""
+    import dataclasses
+
+    from raft_tpu import obs
+    from raft_tpu.neighbors import ivf_pq, tiered
+    from raft_tpu.ops.pallas_kernels import _on_tpu
+
+    on_tpu = _on_tpu()
+    if grid is None:
+        # (n, d, m, k)
+        grid = ([(500_000, 96, 1024, 10)] if on_tpu
+                else [(20_000, 32, 256, 10)])
+    rows: List[PrimResult] = []
+    rng = np.random.default_rng(0)
+    for n, d, m, k in grid:
+        x = rng.random((n, d), dtype=np.float32)
+        x_dev = jnp.asarray(x)
+        q = jnp.asarray(rng.random((m, d), dtype=np.float32))
+        idx = ivf_pq.build(x_dev, ivf_pq.IndexParams(
+            n_lists=64 if on_tpu else 16, pq_dim=min(d, 32), seed=0,
+            cache_reconstruction="never"))
+        base = ivf_pq.SearchParams(n_probes=16, refine="f32_regen",
+                                   refine_ratio=4.0,
+                                   lut_dtype="float32")
+        k_cand = int(k * base.refine_ratio)
+        h2d_gib = m * k_cand * d * 4 / 2**30
+        p = {"n": n, "d": d, "m": m, "k": k, "k_cand": k_cand,
+             "h2d_gib": round(h2d_gib, 4), "on_tpu": on_tpu,
+             "pipeline_batch": tiered.pipeline_batch(m)}
+        tiered_params = dataclasses.replace(base,
+                                            refine_transfer="tiered")
+        legs = [("hbm_resident", x_dev, base),
+                ("serialized_gather", x,
+                 dataclasses.replace(base, refine_transfer="serial"))]
+        if tiered.tiered_refine_wanted(x, m, k_cand, d, tiered_params):
+            legs.insert(1, ("tiered_prefetch", x, tiered_params))
+        else:
+            p["tiered_skipped"] = ("mem guard or shape declined the "
+                                   "prefetch pipeline")
+        for name, base_ds, params in legs:
+            lp = dict(p)
+            if name == "tiered_prefetch":
+                # one un-timed pass with recording on: the hit/stall
+                # split is the overlap evidence riding next to the wall
+                reg = obs.MetricsRegistry()
+                obs.enable(registry=reg, hbm=False)
+                try:
+                    ivf_pq.search(idx, q, k, params, dataset=base_ds)
+                finally:
+                    obs.disable()
+                c = reg.snapshot()["counters"]
+                lp["prefetch_hits"] = int(sum(
+                    v for key, v in c.items()
+                    if key.startswith("serve.prefetch.hit")))
+                lp["prefetch_stalls"] = int(sum(
+                    v for key, v in c.items()
+                    if key.startswith("serve.prefetch.stall")))
+            ms = _time(lambda: ivf_pq.search(idx, q, k, params,
+                                             dataset=base_ds),
+                       iters=iters, warmup=1)
+            if name != "hbm_resident":
+                lp["h2d_gibps"] = round(h2d_gib / (ms / 1e3), 3)
+            rows.append(PrimResult("tiered_refine", name, ms,
+                                   m * 1e3 / ms, "queries/s", lp))
+    return rows
+
+
+# ---------------------------------------------------------------------------
 # build encode throughput: serial build_chunked vs the prefetch-
 # overlapped distributed encode (ISSUE 13)
 # ---------------------------------------------------------------------------
@@ -625,6 +715,7 @@ BENCHES: Dict[str, Callable[[], List[PrimResult]]] = {
     "ivf_scan": bench_ivf_scan,
     "pq_scan": bench_pq_scan,
     "refine": bench_refine,
+    "tiered_refine": bench_tiered_refine,
     "ring_merge": bench_ring_merge,
     "build_encode": bench_build_encode,
 }

@@ -298,6 +298,19 @@ def gather_refine_mem_ok(n: int, d: int, itemsize: int = 4,
     return True
 
 
+def tiered_refine_mem_ok(m_b: int, C: int, d: int,
+                         depth: int = 2) -> bool:
+    """HBM guard for the tiered prefetch-refine pipeline
+    (neighbors.tiered): up to ``depth`` landed ``[m_b, C, d]`` f32
+    candidate-row blocks parked in the prefetch queue plus the one
+    being re-ranked live at once — the tier's whole HBM footprint (the
+    base itself stays on the host, that is the point). Shares
+    GROUPED_BYTES_CAP with the scan transients. Declining here is
+    always serviceable: the serialized host gather (refine_gathered)
+    holds exactly one block."""
+    return (depth + 1) * m_b * C * d * 4 <= GROUPED_BYTES_CAP
+
+
 def fit_seg_chunk(seg: int, L: int, d: int, want: int) -> int:
     """Largest segment chunk ≤ ``want`` whose per-step transients — the
     [chunk·seg, L] f32 distance block and the gathered [chunk, L, d]

@@ -99,6 +99,11 @@ class SearchParams:
     # hardware top-k trades away on oversampled configs
     refine: str = "none"  # | "f32_regen"
     refine_ratio: float = 2.0
+    # host-resident re-rank bases (ISSUE 17): same knob as ivf_pq —
+    # "auto" takes the tiered candidate-row prefetch pipeline when
+    # eligible, "tiered" forces it, "serial" pins the serialized host
+    # gather (the ladder's last-resort host_gather rung)
+    refine_transfer: str = "auto"  # | "tiered" | "serial"
 
 
 class IvfFlatIndex(flax.struct.PyTreeNode):
@@ -631,6 +636,17 @@ def _route_refined(index: IvfFlatIndex, queries: jax.Array, k: int,
             "refine_ratio must be >= 1 (got %s)", params.refine_ratio)
     k_cand = max(k, int(round(k * params.refine_ratio)))
     scan_params = dataclasses.replace(params, refine="none")
+    # host-resident base → the memory tier (ISSUE 17): decided BEFORE
+    # the scan, same routing as ivf_pq's refined path
+    if (not isinstance(dataset, jax.Array)
+            and not hasattr(dataset, "_block")):
+        from raft_tpu.neighbors import tiered as _tiered
+
+        if _tiered.tiered_refine_wanted(dataset, queries.shape[0],
+                                        k_cand, index.dim, params):
+            return _tiered.search_refined_tiered(
+                search, index, queries, k, k_cand, scan_params,
+                filter_bitset, dataset, index.metric)
     _, i0 = search(index, queries, k_cand, scan_params, filter_bitset)
     if hasattr(dataset, "_block") and hasattr(dataset, "chunk_rows"):
         return _refine.refine_provider(dataset, queries, i0, k,

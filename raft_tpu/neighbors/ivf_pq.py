@@ -150,6 +150,15 @@ class SearchParams:
     # ``_block``/``chunk_rows`` (provider-regen tier).
     refine: str = "none"  # | "f32_regen"
     refine_ratio: float = 2.0
+    # host-resident re-rank bases only (ISSUE 17): "auto" routes
+    # through the tiered candidate-row prefetch pipeline
+    # (neighbors.tiered — the host fetch overlapped under the scan)
+    # when eligible, falling back to the serialized host gather;
+    # "tiered" forces the pipeline (mem guard still applies);
+    # "serial" pins refine_gathered — the degrade ladder's last-resort
+    # host_gather rung and the bench's comparison leg. Device-resident
+    # bases ignore this knob (the fused/XLA tiers need no transfer).
+    refine_transfer: str = "auto"  # | "tiered" | "serial"
 
 
 _LUT_DTYPES = {"float32": jnp.float32, "bfloat16": jnp.bfloat16,
@@ -1868,6 +1877,18 @@ def _route_refined(index: IvfPqIndex, queries: jax.Array, k: int,
             "refine_ratio must be >= 1 (got %s)", params.refine_ratio)
     k_cand = max(k, int(round(k * params.refine_ratio)))
     scan_params = dataclasses.replace(params, refine="none")
+    # host-resident base → the memory tier (ISSUE 17): decide BEFORE
+    # the scan — the tiered pipeline runs its own sub-batch scans so
+    # each batch's candidate-row fetch can overlap the next scan
+    if (not isinstance(dataset, jax.Array)
+            and not hasattr(dataset, "_block")):
+        from raft_tpu.neighbors import tiered as _tiered
+
+        if _tiered.tiered_refine_wanted(dataset, queries.shape[0],
+                                        k_cand, index.dim, params):
+            return _tiered.search_refined_tiered(
+                search, index, queries, k, k_cand, scan_params,
+                filter_bitset, dataset, index.metric)
     _, i0 = search(index, queries, k_cand, scan_params, filter_bitset)
     if hasattr(dataset, "_block") and hasattr(dataset, "chunk_rows"):
         return _refine.refine_provider(dataset, queries, i0, k,
@@ -1878,7 +1899,8 @@ def _route_refined(index: IvfPqIndex, queries: jax.Array, k: int,
         # (the fused kernel folds the bit test into its row-DMA queue)
         return _refine.refine(dataset, queries, i0, k, metric=index.metric,
                               filter_bits=filter_bitset)
-    # host array / memmap: gather only candidate rows on the host
+    # host array / memmap, tiered declined or pinned "serial": the
+    # serialized candidate-row gather
     return _refine.refine_gathered(dataset, queries, i0, k,
                                    metric=index.metric)
 

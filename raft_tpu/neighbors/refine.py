@@ -22,6 +22,12 @@ table in docs/api_reference.md):
   (:func:`refine_gathered`, :func:`refine_provider`): the gather runs
   on the host / regenerates device blocks BY DESIGN (memmap bases that
   do not fit HBM), so the fused device tier does not apply.
+- ``tiered_prefetch`` — the memory-tier pipeline (ISSUE 17,
+  :mod:`raft_tpu.neighbors.tiered`): host-resident bases whose
+  candidate rows are fetched host→HBM by a background reader
+  overlapped under the next sub-batch's scan; :func:`refine_landed` is
+  its re-rank entry (rows already on device — same exact epilogue,
+  zero extra gather).
 """
 
 from __future__ import annotations
@@ -225,6 +231,35 @@ def refine(
     else:
         _obs_spans.count_dispatch("refine", "xla_gather")
     return _refine_impl(dataset, queries, candidates, k, mt.value)
+
+
+@traced("raft_tpu.refine_landed")
+def refine_landed(
+    cand_rows: jax.Array,
+    queries: jax.Array,
+    candidates: jax.Array,
+    k: int,
+    metric="sqeuclidean",
+) -> Tuple[jax.Array, jax.Array]:
+    """Re-rank against candidate rows ALREADY LANDED on device — the
+    tiered prefetch pipeline's re-rank entry (ISSUE 17,
+    :mod:`raft_tpu.neighbors.tiered`): the ``[m, C, d]`` f32 rows were
+    gathered host-side by the background reader (bit-identical to
+    :func:`refine_gathered`'s gather) and device_put ahead of time, so
+    this entry runs only the exact epilogue (same jitted
+    ``_refine_rows`` program as every other tier — same results)."""
+    _check_candidates(queries, candidates, k)
+    shape = getattr(cand_rows, "shape", None)
+    expects(shape is not None and len(shape) == 3
+            and tuple(shape[:2]) == tuple(candidates.shape)
+            and shape[2] == queries.shape[1],
+            "cand_rows shape %s does not match candidates %s × dim %d",
+            tuple(shape) if shape else None, tuple(candidates.shape),
+            queries.shape[1])
+    _obs_spans.count_dispatch("refine", "tiered_prefetch")
+    mt = resolve_metric(metric)
+    return _refine_rows(cand_rows, queries, jnp.asarray(candidates), k,
+                        mt.value)
 
 
 @partial(jax.jit, donate_argnums=(0,))

@@ -1,0 +1,386 @@
+"""Tiered IVF search — host-resident raw vectors, prefetched under the scan.
+
+The serving-path memory tier (ISSUE 17). An IVF-PQ tenant's scan
+structures (packed codes, centroids, norms) are small and touched by
+every query — they stay HBM-resident. Its raw vectors are ~10-30×
+bigger and touched only at the exact re-rank, for ``k_cand`` rows per
+query — they can live in HOST memory (numpy array or memmap) with only
+the candidate rows crossing host→HBM per batch. This module makes that
+hop free at steady state by running it UNDER the scan:
+
+- the query batch is split into pipeline sub-batches
+  (:func:`pipeline_batch`);
+- sub-batch *i*'s oversampled scan is dispatched, its candidate ids
+  submitted to a :class:`RowPrefetcher` — a background reader thread
+  resolves the ids (the only device sync, off the main thread), gathers
+  the rows from the host base under the PR-7 ``IO_POLICY`` retry
+  (fault point ``serve.row_read``), and lands them on device;
+- while the reader fetches batch *i*'s rows, the main thread dispatches
+  batch *i+1*'s scan and re-ranks batch *i−1*'s already-landed rows
+  (``refine.refine_landed`` — the exact epilogue), so the host transfer
+  hides under scan + refine compute exactly like the distributed
+  build's chunk reads hide under encode (PR-13 ``ChunkPrefetcher``,
+  whose counter/error/close contract this mirrors).
+
+Accounting: ``serve.prefetch.hit{tenant=}`` (rows were already landed
+when the consumer asked — the transfer fully hid) vs
+``serve.prefetch.stall{tenant=}`` (the consumer waited; the un-hidden
+wait runs under a ``span("h2d")``). ``prefetch=False`` degenerates to a
+serialized inline fetch per get — the bench's comparison leg.
+
+Results are BIT-EQUAL to the HBM-resident path: the row gather
+reproduces ``refine.refine_gathered``'s host-side semantics (clip +
+f32 gather) and the re-rank is the same jitted ``_refine_rows``
+program; each query's math is independent, so the sub-batch split is
+exact (the ``halve_batch`` precedent).
+
+Dispatch: ``SearchParams.refine_transfer`` ("auto" | "tiered" |
+"serial") and the ``RAFT_TPU_TIERED_REFINE`` tri-state env override;
+:func:`tiered_refine_wanted` is the guard (``ivf_common.
+tiered_refine_mem_ok`` bounds the in-flight landed-row buffers; a
+decline is a counted ``degrade.steps`` move to the serialized host
+gather, per the GL15 convention).
+"""
+
+from __future__ import annotations
+
+import os
+import queue
+import threading
+from collections import deque
+from typing import Any, Callable, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from raft_tpu.core.tracing import span
+from raft_tpu.obs import spans as _obs_spans
+from raft_tpu.robust import degrade as _degrade
+from raft_tpu.robust import faults as _faults
+from raft_tpu.robust import retry as _retry
+
+__all__ = [
+    "RowPrefetcher", "host_row_reader", "pipeline_batch",
+    "tiered_refine_wanted", "search_refined_tiered",
+    "serving_tenant", "current_tenant", "PREFETCH_DEPTH",
+]
+
+#: in-flight landed-row buffers the prefetch pipeline may hold: the
+#: done-queue depth. One being consumed + ``PREFETCH_DEPTH`` parked is
+#: the HBM bound ``ivf_common.tiered_refine_mem_ok`` sizes against.
+PREFETCH_DEPTH = 2
+
+# Per-thread serving-tenant attribution for the prefetch counters:
+# dispatch_batch brackets its search with serving_tenant(name), so the
+# serve.prefetch.{hit,stall} series carry tenant= labels without
+# plumbing a name through SearchParams. Thread-local like the degrade
+# quality gate — one tenant's dispatch can never label another's.
+_tenant_tls = threading.local()
+
+
+class serving_tenant:
+    """Context manager naming the tenant whose dispatch brackets this
+    thread's tiered searches (``None``/missing → ``"-"``)."""
+
+    __slots__ = ("_name", "_prev")
+
+    def __init__(self, name: Optional[str]):
+        self._name = name
+        self._prev = None
+
+    def __enter__(self) -> "serving_tenant":
+        self._prev = getattr(_tenant_tls, "name", None)
+        _tenant_tls.name = self._name
+        return self
+
+    def __exit__(self, *exc) -> None:
+        _tenant_tls.name = self._prev
+
+
+def current_tenant() -> str:
+    """The tenant label for this thread's prefetch counters."""
+    return getattr(_tenant_tls, "name", None) or "-"
+
+
+def pipeline_batch(m: int) -> int:
+    """Pipeline sub-batch size for an ``m``-query search: the explicit
+    ``RAFT_TPU_TIERED_BATCH`` when set, else ``max(32, ceil(m/4))`` —
+    at least 4 sub-batches on real serving batches (enough stages for
+    the overlap to bite) without shrinking below a scan-efficient
+    width. Deterministic in ``m`` alone, so the serving path's jitted
+    sub-batch shapes are a closed set the AOT warmup covers."""
+    raw = os.environ.get("RAFT_TPU_TIERED_BATCH", "")  # int value
+    if raw.strip():
+        try:
+            return max(1, int(raw))
+        except ValueError:
+            pass
+    return max(32, -(-int(m) // 4))
+
+
+def host_row_reader(host_base, tenant: str = "-"
+                    ) -> Callable[[Any], jax.Array]:
+    """Build the prefetcher's ``fetch_fn`` over a host-resident base:
+    ``fetch(candidates [m_b, C] device/host ids) -> [m_b, C, d] f32
+    device rows``.
+
+    Runs on the reader thread: the ``np.asarray(candidates)`` is the
+    pipeline's only device sync (it blocks until that sub-batch's scan
+    delivers — ON the worker, while the main thread dispatches the next
+    scan). The gather reproduces ``refine.refine_gathered`` bit-for-bit
+    (clip to [0, n−1], f32 gather) so the tiered path's results match
+    the serialized host path exactly. The host read + H2D retries under
+    ``retry.IO_POLICY`` (fault point ``serve.row_read``; a recovery
+    counts ``retry.recovered{site=serve.row_read}``)."""
+    n, d = host_base.shape
+
+    def fetch(candidates) -> jax.Array:
+        cand = np.asarray(candidates)  # device sync — worker-side only
+        m_b, C = cand.shape
+
+        def attempt():
+            _faults.faultpoint("serve.row_read")
+            safe = np.clip(cand, 0, n - 1)
+            rows = np.asarray(host_base[safe.reshape(-1)],
+                              np.float32).reshape(m_b, C, d)
+            return jax.device_put(rows)
+
+        return _retry.retry_call(attempt, site="serve.row_read",
+                                 policy=_retry.IO_POLICY)
+
+    return fetch
+
+
+class RowPrefetcher:
+    """Submission-driven host→HBM candidate-row pipeline.
+
+    The serving twin of the build's :class:`~raft_tpu.parallel.build.
+    ChunkPrefetcher` — same thread/queue/counter/error contract, but
+    fed by :meth:`submit` as the scan produces candidate ids instead of
+    walking a precomputed range list (serving cannot know the ids ahead
+    of the scan). A background reader resolves each submitted candidate
+    block through ``fetch_fn`` and parks up to ``depth`` landed device
+    row blocks; :meth:`get` returns them in submit order.
+
+    Accounting (only when obs recording is on):
+
+    - ``serve.prefetch.hit{tenant=}`` — the rows were already landed
+      when requested (the host fetch fully hid under compute);
+    - ``serve.prefetch.stall{tenant=}`` — the consumer had to wait; the
+      wait runs under a ``span("h2d")`` so un-hidden transfer time
+      lands beside the scan/refine stage spans.
+
+    ``prefetch=False`` degenerates to a serialized inline fetch at each
+    :meth:`get` (same counter/span names, every get a stall) — the
+    bench's serialized-gather comparison leg.
+
+    Error contract: an exception on the reader thread (IO failure past
+    the retry budget, an injected fault) is re-raised at the consumer's
+    next :meth:`get`; the reader exits after queueing it. :meth:`close`
+    is idempotent, drains both queues and joins the thread — safe to
+    call mid-stream (the ``finally`` of an interrupted search)."""
+
+    def __init__(self, fetch_fn: Callable[[Any], jax.Array],
+                 depth: int = PREFETCH_DEPTH, tenant: str = "-",
+                 prefetch: bool = True):
+        self._fetch = fetch_fn
+        self._tenant = tenant
+        self._prefetch = bool(prefetch)
+        self._submitted = 0
+        self._taken = 0
+        self._pending: deque = deque()  # serialized mode: parked ids
+        self._work: "queue.Queue" = queue.Queue()
+        self._done: "queue.Queue" = queue.Queue(maxsize=max(1, depth))
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        if self._prefetch:
+            self._thread = threading.Thread(
+                target=self._run, name="raft_tpu-row-prefetch",
+                daemon=True)
+            self._thread.start()
+
+    def _count(self, name: str) -> None:
+        if _obs_spans.enabled():
+            _obs_spans.registry().inc(name,
+                                      labels={"tenant": self._tenant})
+
+    def _run(self) -> None:
+        while not self._stop.is_set():
+            try:
+                cand = self._work.get(timeout=0.05)
+            except queue.Empty:
+                continue
+            if cand is None:  # close() sentinel
+                return
+            try:
+                item = (self._fetch(cand), None)
+            except BaseException as e:  # propagated at the next get()
+                item = (None, e)
+            while not self._stop.is_set():
+                try:
+                    self._done.put(item, timeout=0.05)
+                    break
+                except queue.Full:
+                    continue
+            if item[1] is not None:
+                return
+
+    def submit(self, candidates) -> None:
+        """Queue one sub-batch's candidate ids for fetching. Never
+        blocks and never syncs — the ids may still be an in-flight
+        device computation; the reader thread resolves them."""
+        self._submitted += 1
+        if not self._prefetch:
+            self._pending.append(candidates)
+        else:
+            self._work.put(candidates)
+
+    def get(self) -> jax.Array:
+        """Next landed ``[m_b, C, d]`` f32 device row block (submit
+        order). Raises the reader's exception if its fetch failed;
+        ``IndexError`` when every submitted block was already taken."""
+        if self._taken >= self._submitted:
+            raise IndexError("RowPrefetcher: get() past the last submit")
+        if not self._prefetch:
+            cand = self._pending.popleft()
+            self._count("serve.prefetch.stall")
+            with span("h2d"):
+                x = self._fetch(cand)
+            self._taken += 1
+            return x
+        # benign race on empty(): a reader mid-put counts as a stall
+        # with a ~zero-length wait — the conservative side
+        if self._done.empty():
+            self._count("serve.prefetch.stall")
+            with span("h2d"):
+                x, exc = self._done.get()
+        else:
+            self._count("serve.prefetch.hit")
+            x, exc = self._done.get()
+        if exc is not None:
+            self.close()
+            raise exc
+        self._taken += 1
+        return x
+
+    def close(self) -> None:
+        """Stop the reader and release queue slots (idempotent). A
+        reader stuck inside a slow retried fetch can outlive the join
+        timeout — keep the handle (and say so) instead of dropping the
+        reference, so the still-running thread stays visible rather
+        than silently gathering rows for a search that moved on."""
+        self._stop.set()
+        for q in (self._work, self._done):
+            while True:
+                try:
+                    q.get_nowait()
+                except queue.Empty:
+                    break
+        if self._thread is not None:
+            self._thread.join(timeout=5.0)
+            if self._thread.is_alive():
+                from raft_tpu.core import logging as _log
+
+                _log.warn("RowPrefetcher.close: reader thread still "
+                          "inside a fetch after 5s (slow IO/retry "
+                          "backoff) — it will exit at its next "
+                          "stop-flag check")
+            else:
+                self._thread = None
+
+
+def tiered_refine_wanted(dataset, m: int, k_cand: int, d: int,
+                         params) -> bool:
+    """True when the prefetch-overlapped tier serves this refined
+    search: a host-resident 2-D base (jax.Array bases take the device
+    refine tiers; providers regenerate), ``refine_transfer`` not
+    pinned ``"serial"``, the ``RAFT_TPU_TIERED_REFINE`` tri-state not
+    off, and — unless forced on — at least two pipeline sub-batches
+    (one batch has nothing to overlap under). The
+    ``tiered_refine_mem_ok`` guard bounds the in-flight landed-row
+    buffers; its decline is a counted ``degrade.steps`` move to the
+    serialized host gather (``refine.mem_guard`` fault point forces
+    the decline branch for CI)."""
+    from raft_tpu.neighbors import ivf_common as ic
+
+    shape = getattr(dataset, "shape", None)
+    if (dataset is None or isinstance(dataset, jax.Array)
+            or hasattr(dataset, "_block") or shape is None
+            or len(shape) != 2):
+        return False
+    transfer = getattr(params, "refine_transfer", "auto")
+    if transfer == "serial":
+        return False
+    env = _obs_spans.env_tristate("RAFT_TPU_TIERED_REFINE")
+    if env == "off":
+        return False
+    forced_on = transfer == "tiered" or env == "on"
+    mb = pipeline_batch(m)
+    if not forced_on and m <= mb:
+        return False  # a single sub-batch cannot overlap anything
+    mem_ok = ic.tiered_refine_mem_ok(min(mb, m), k_cand, d)
+    if _faults.forced("tiered.mem_guard"):  # CI-testable decline path
+        mem_ok = False
+    if not mem_ok:
+        # the static half of the degradation policy: the guard's
+        # pre-emptive decline counts the same degrade.steps move a
+        # reactive walk would (GL15 convention)
+        _degrade.note_step("refine", "tiered_prefetch", "host_gather",
+                           "mem_guard")
+        return False
+    return True
+
+
+def search_refined_tiered(search_fn, index, queries: jax.Array, k: int,
+                          k_cand: int, scan_params, filter_bitset,
+                          host_base, metric: str,
+                          prefetch: bool = True
+                          ) -> Tuple[jax.Array, jax.Array]:
+    """The tiered refined search: pipeline sub-batches through
+    oversampled scan → candidate-row prefetch → exact re-rank, with the
+    host fetch of batch *i* overlapped under batch *i+1*'s scan and
+    batch *i−1*'s refine. Returns ``(distances [m, k], ids [m, k])``,
+    bit-equal to the serialized host-gather path (module docstring).
+
+    ``search_fn`` is the owning module's plain ``search`` (ivf_pq /
+    ivf_flat), called per sub-batch with ``scan_params`` (refine
+    already stripped); ``prefetch=False`` serializes every fetch — the
+    bench's comparison leg, same results."""
+    from raft_tpu.neighbors import refine as _refine
+
+    m = queries.shape[0]
+    mb = pipeline_batch(m)
+    tenant = current_tenant()
+    pf = RowPrefetcher(host_row_reader(host_base, tenant=tenant),
+                       depth=PREFETCH_DEPTH, tenant=tenant,
+                       prefetch=prefetch)
+    in_flight: deque = deque()  # (queries slice, candidate ids)
+    outs = []
+
+    def consume():
+        q_i, ids_i = in_flight.popleft()
+        rows = pf.get()
+        outs.append(_refine.refine_landed(rows, q_i, ids_i, k,
+                                          metric=metric))
+
+    try:
+        for a in range(0, m, mb):
+            q_i = queries[a:a + mb]
+            _, ids_i = search_fn(index, q_i, k_cand, scan_params,
+                                 filter_bitset)
+            pf.submit(ids_i)
+            in_flight.append((q_i, ids_i))
+            # keep one sub-batch's fetch in the air behind the scan we
+            # just dispatched; consume the one BEFORE it, whose rows
+            # landed while that scan ran
+            if len(in_flight) > 1:
+                consume()
+        while in_flight:
+            consume()
+    finally:
+        pf.close()
+    if len(outs) == 1:
+        return outs[0]
+    return (jnp.concatenate([o[0] for o in outs], axis=0),
+            jnp.concatenate([o[1] for o in outs], axis=0))

@@ -47,6 +47,7 @@ import numpy as np
 __all__ = [
     "list_stats", "centroid_drift", "pq_subspace_error",
     "tombstone_density", "describe_index", "note_index_stats",
+    "note_tier_bytes",
 ]
 
 
@@ -268,6 +269,28 @@ def _gauges_from(stats: Dict[str, Any]) -> Dict[str, float]:
         g["index.pq_err_max"] = float(pq.get("max", 0.0))
         g["index.pq_err_rel"] = float(pq.get("rel_error", 0.0))
     return g
+
+
+def note_tier_bytes(name: str, *, hbm_bytes: int, host_bytes: int) -> None:
+    """Publish one index's memory-tier byte split as
+    ``index.bytes{index=name,tier=hbm|host}`` gauges (ISSUE 17) — the
+    admission-math companion of the ``index.*`` health family: a
+    tenant whose raw vectors were demoted to host shows its HBM gauge
+    drop (and the host gauge rise) the moment the registry moves them,
+    so "who is actually holding HBM?" is one query. Same emission
+    contract as :func:`note_index_stats`: no-op when obs recording is
+    off, failures swallowed."""
+    spans = sys.modules.get("raft_tpu.obs.spans")
+    if spans is None or not spans.enabled():
+        return
+    try:
+        reg = spans.registry()
+        for tier, value in (("hbm", hbm_bytes), ("host", host_bytes)):
+            reg.gauge("index.bytes",
+                      labels={"index": name, "tier": tier}
+                      ).set(float(value))
+    except Exception:  # noqa: BLE001 — gauges must never fail the mover
+        pass
 
 
 def note_index_stats(index: Any, *, name: str, dataset: Any = None,

@@ -12,8 +12,8 @@ Production ANN services degrade through exactly those knobs instead of
   InjectedResourceExhausted` identically (so the ladder is CI-testable);
 - a :class:`Ladder` declares ordered :class:`Step` rungs; each
   RESOURCE_EXHAUSTED advances one rung (``halve_batch → bf16_lut →
-  fp8_lut → decline_fused → host_gather → halve_batch…``, see
-  :func:`standard_search_ladder`);
+  fp8_lut → demote_raw → decline_fused → host_gather →
+  halve_batch…``, see :func:`standard_search_ladder`);
 - :func:`run_with_degradation` drives a callable through the ladder and
   counts every move: ``degrade.steps{site=,from=,to=,reason=}``, plus
   ``degrade.recovered{site=}`` / ``degrade.exhausted{site=}``.
@@ -389,10 +389,15 @@ def _decline_fused(knobs):
     return None
 
 
-def _host_gather(knobs):
-    """Move the re-rank base off the device: the refined path then
-    routes through refine_gathered (host gather of candidate rows) and
-    the dataset's HBM residency is reclaimed."""
+def _demote_raw(knobs):
+    """Demote the re-rank base to HOST memory — the memory tier (ISSUE
+    17): the dataset's HBM residency is reclaimed while the refined
+    path keeps serving through the tiered candidate-row prefetch
+    (neighbors.tiered — the host fetch overlapped under the scan).
+    Results stay EXACT (the re-rank still runs against the same f32
+    rows; only where they are fetched from changes), so this rung is a
+    capacity move, deliberately NOT in :data:`QUALITY_RUNGS` — the
+    recall-floor quality gate never refuses it."""
     params = knobs["params"]
     dataset = knobs.get("dataset")
     if getattr(params, "refine", "none") == "none" or dataset is None:
@@ -406,18 +411,51 @@ def _host_gather(knobs):
     return knobs
 
 
+def _host_gather(knobs):
+    """The last-resort transfer rung: re-rank base on the host AND the
+    prefetch pipeline declined — refine_transfer pinned ``"serial"``
+    routes through refine_gathered's one-block-at-a-time gather, the
+    smallest possible refine footprint (one ``[m_b, C, d]`` block, no
+    parked prefetch buffers). Applies after :func:`_demote_raw` (or to
+    an already-host base still running the tiered pipeline)."""
+    params = knobs["params"]
+    dataset = knobs.get("dataset")
+    if getattr(params, "refine", "none") == "none" or dataset is None:
+        return None
+    import jax
+    import numpy as np
+
+    changed = False
+    if isinstance(dataset, jax.Array):
+        knobs["dataset"] = np.asarray(dataset)
+        changed = True
+    if getattr(params, "refine_transfer", "serial") != "serial":
+        knobs["params"] = dataclasses.replace(params,
+                                              refine_transfer="serial")
+        changed = True
+    return knobs if changed else None
+
+
 def standard_search_ladder(batch: int, has_lut: bool = False) -> Ladder:
     """The declared search ladder. ``batch`` is the incoming query
     count; ``has_lut`` adds the bf16-LUT and fp8-LUT rungs (IVF-PQ only
     — IVF-Flat has no LUT to quantize): two successive halvings of the
     LUT/codebook operand footprint between "halve batch" and "decline
     fused", each a documented precision trade rather than a tier
-    change. The terminal rung keeps halving the batch down to 1 so a
+    change. ``demote_raw`` (ISSUE 17) sits before the result-changing
+    rungs: it moves the refined search's re-rank base to host memory —
+    HBM reclaimed, answers still exact via the tiered prefetch — so
+    capacity is bought from the memory hierarchy before any quality is
+    spent. The terminal rung keeps halving the batch down to 1 so a
     pathological shape still completes, just slowly."""
     steps = [Step("halve_batch", _halve_batch(batch))]
     if has_lut:
         steps.append(Step("bf16_lut", _bf16_lut))
         steps.append(Step("fp8_lut", _fp8_lut))
+    # the memory tier (ISSUE 17): reclaim the re-rank base's HBM before
+    # touching result-changing rungs — demotion keeps answers exact
+    # (tiered prefetch), so it outranks declining the fused tier
+    steps.append(Step("demote_raw", _demote_raw))
     # repeatable: declining the fused tier is two moves (pallas select →
     # approx, then the grouped scan → the tile-bounded per_query path)
     steps.append(Step("decline_fused", _decline_fused, repeatable=True))

@@ -22,6 +22,10 @@ story that nothing upstream provides on TPU.
 - :mod:`raft_tpu.serve.errors`   — the typed refusal surface
   (``ShedError{reason=}``, ``TenantUnknown``, ``AdmissionError``) —
   every failure is a type, never a hang;
+- :mod:`raft_tpu.serve.placement` — memory-tier placement (ISSUE 17):
+  where a tenant's pieces live (scan structures HBM-resident, raw
+  re-rank vectors HBM or host), the registry's ``demote_raw`` pressure
+  valve riding on it;
 - :mod:`raft_tpu.serve.slo`      — SLO guardrails (ISSUE 16):
   multi-window burn rates over the latency/shed series, and per-tenant
   recall floors closing the loop from the shadow verifier's confidence
@@ -29,7 +33,8 @@ story that nothing upstream provides on TPU.
 
 Counters: ``serve.requests``, ``serve.shed{reason=}``,
 ``serve.batch_fill``, ``serve.latency_s``, ``serve.deadline_missed``,
-``serve.registry.{admit,evict}`` — see docs/observability.md; chaos
+``serve.registry.{admit,evict,demote,promote}``,
+``serve.prefetch.{hit,stall}`` — see docs/observability.md; chaos
 coverage in tests/test_serve.py and the CI serve smoke.
 """
 
@@ -43,9 +48,11 @@ from raft_tpu.serve.errors import (  # noqa: F401
     TenantUnknown,
 )
 from raft_tpu.serve.loadgen import record, run_step, sweep  # noqa: F401
+from raft_tpu.serve.placement import Placement  # noqa: F401
 from raft_tpu.serve.registry import (  # noqa: F401
     IndexRegistry,
     Tenant,
+    index_bytes_by_tier,
     index_device_bytes,
 )
 from raft_tpu.serve.server import (  # noqa: F401

@@ -104,9 +104,17 @@ def dispatch_batch(tenant: Tenant, queries, k: int,
     def attempt():
         # the deadline reaches BOTH layers: retry_call's backoff clamps
         # to it, and the ladder inside search_resilient draws from it —
-        # one request, one budget, no per-site stacking
-        return search(index, queries, k, tenant.params,
-                      deadline=deadline)
+        # one request, one budget, no per-site stacking. The tenant's
+        # dataset rides along as the refined search's re-rank base
+        # (ISSUE 17): a host-resident dataset routes the exact re-rank
+        # through the tiered candidate-row prefetch, labeled per
+        # tenant by the serving_tenant bracket; refine="none" tenants
+        # ignore it
+        from raft_tpu.neighbors import tiered as _tiered
+
+        with _tiered.serving_tenant(tenant.name):
+            return search(index, queries, k, tenant.params,
+                          dataset=tenant.dataset, deadline=deadline)
 
     retry_stats: dict = {}
     # the quality gate (ISSUE 16): a tenant the SLO monitor holds

@@ -1,0 +1,104 @@
+"""Per-component memory placement — where an index's pieces live.
+
+The memory-tier half of the serving story (ISSUE 17): a tenant's
+scan structures (PQ codes, centroids, norms — the small, every-query
+operands) stay HBM-resident, while its raw vectors — the big, touched-
+only-at-re-rank component — may live in host memory (a numpy array or
+memmap) and reach the chip as candidate rows through the tiered
+prefetch pipeline (:mod:`raft_tpu.neighbors.tiered`). Capacity is then
+bought with the memory hierarchy instead of with chips: demoting a
+tenant's raw vectors reclaims their HBM without evicting the tenant,
+and results stay EXACT (the re-rank still runs against full-precision
+rows — only where they are fetched from changes).
+
+:class:`Placement` is the registry's first-class record of that choice:
+
+- ``codes="hbm"`` — the scan structures. Always HBM today: every query
+  touches them, so host residency would put the host hop on the
+  latency path of every scan.
+- ``raw="hbm" | "host" | "none"`` — the re-rank base. ``"hbm"`` routes
+  refine through the fused/XLA device tiers; ``"host"`` through the
+  tiered candidate-row prefetch; ``"none"`` means the tenant carries
+  no dataset (PQ-approximate distances only, no exact re-rank and no
+  shadow recall verification).
+
+``registry.admit(placement=...)`` validates the declared placement
+against the dataset actually handed in; ``registry.demote_raw`` /
+``promote_when_clear`` move ``raw`` between the tiers under pressure.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict
+
+__all__ = ["Placement", "dataset_tier", "placement_for",
+           "to_host", "to_device"]
+
+_CODE_TIERS = ("hbm",)
+_RAW_TIERS = ("hbm", "host", "none")
+
+
+@dataclasses.dataclass(frozen=True)
+class Placement:
+    """Where each index component lives. Frozen — a tier move creates a
+    new record (``dataclasses.replace``), so a snapshot handed to
+    ``/indexz`` can never mutate under the renderer."""
+
+    codes: str = "hbm"
+    raw: str = "hbm"
+
+    def __post_init__(self):
+        if self.codes not in _CODE_TIERS:
+            raise ValueError(
+                f"Placement.codes={self.codes!r} unsupported (scan "
+                f"structures are HBM-resident: {_CODE_TIERS})")
+        if self.raw not in _RAW_TIERS:
+            raise ValueError(
+                f"Placement.raw={self.raw!r} not one of {_RAW_TIERS}")
+
+    def describe(self) -> Dict[str, str]:
+        """JSON-ready dict for /indexz and registry snapshots."""
+        return {"codes": self.codes, "raw": self.raw}
+
+
+def dataset_tier(dataset: Any) -> str:
+    """Observed residency of a re-rank base: ``"none"`` (no dataset),
+    ``"hbm"`` (a jax.Array), or ``"host"`` (numpy array, memmap, or a
+    device-chunk provider — anything the refine tiers fetch or
+    regenerate rather than index in place on device)."""
+    if dataset is None:
+        return "none"
+    import jax
+
+    return "hbm" if isinstance(dataset, jax.Array) else "host"
+
+
+def placement_for(dataset: Any) -> Placement:
+    """The placement a plain ``admit(dataset=...)`` implies: codes on
+    HBM, raw wherever the dataset already lives."""
+    return Placement(codes="hbm", raw=dataset_tier(dataset))
+
+
+def to_host(dataset: Any):
+    """Demote a re-rank base to host memory (device → one D2H copy;
+    already-host bases pass through untouched, so the call is
+    idempotent)."""
+    import jax
+    import numpy as np
+
+    if isinstance(dataset, jax.Array):
+        return np.asarray(dataset)
+    return dataset
+
+
+def to_device(dataset: Any):
+    """Promote a re-rank base to HBM (one H2D copy; device-resident
+    bases pass through). Memmap sources land as a plain device array —
+    re-promotion materializes the rows, that is the point."""
+    import jax
+    import numpy as np
+
+    if dataset is None or isinstance(dataset, jax.Array):
+        return dataset
+    return jax.device_put(np.asarray(dataset, np.float32))

@@ -12,12 +12,21 @@ policy instead of an allocator surprise:
   the backend reports one, minus a configurable headroom fraction for
   scan transients; backends that report nothing (the CPU test mesh)
   take an explicit ``budget_bytes``.
-- **eviction** — when a new tenant doesn't fit, the registry sheds the
-  least-recently-used *cold* resident (never pinned tenants) until it
-  does, or refuses with a typed :class:`~raft_tpu.serve.errors.
-  AdmissionError`. Every move is counted:
+- **demotion before eviction** — the memory tier (ISSUE 17): when a
+  new tenant doesn't fit, the registry first *demotes* resident
+  tenants' raw vectors to host memory (coldest first; the refined
+  search keeps serving EXACT answers through the tiered candidate-row
+  prefetch, :mod:`raft_tpu.neighbors.tiered`), and only then sheds the
+  least-recently-used *cold* resident (never pinned tenants), or
+  refuses with a typed :class:`~raft_tpu.serve.errors.AdmissionError`.
+  :class:`~raft_tpu.serve.placement.Placement` records where each
+  tenant's components live; ``index.bytes{index=,tier=hbm|host}``
+  gauges the split. Every move is counted:
   ``serve.registry.admit{tenant=}`` / ``serve.registry.evict{tenant=,
-  reason=}``, with ``serve.registry.resident_bytes`` gauging the fleet.
+  reason=}`` / ``serve.registry.demote{tenant=}`` /
+  ``serve.registry.promote{tenant=}`` (demote/promote also land as
+  ``degrade.steps{to=demote_raw}`` moves — one observable degradation
+  policy), with ``serve.registry.resident_bytes`` gauging the fleet.
 - **health** — each tenant carries an explicit state machine
   (``warming → serving → degraded``, terminal ``evicted`` / ``failed``)
   so dispatch can refuse, a dashboard can page, and the chaos lane can
@@ -39,10 +48,12 @@ from raft_tpu.core import logging as _log
 from raft_tpu.obs import hbm as _hbm
 from raft_tpu.obs import spans as _spans
 from raft_tpu.robust import faults as _faults
+from raft_tpu.serve import placement as _placement
 from raft_tpu.serve.errors import AdmissionError, TenantUnknown
+from raft_tpu.serve.placement import Placement
 
 __all__ = ["Tenant", "IndexRegistry", "index_device_bytes",
-           "HEALTH_STATES"]
+           "index_bytes_by_tier", "Placement", "HEALTH_STATES"]
 
 # The tenant state machine. RESIDENT states hold HBM; terminal states
 # keep the Tenant record (for "why is my tenant gone" forensics) but
@@ -57,18 +68,42 @@ DEFAULT_BUDGET_BYTES = 8 << 30
 
 
 def index_device_bytes(index: Any) -> int:
-    """HBM residency estimate for an index: the sum of every array
-    leaf's ``nbytes`` in the pytree. Host-resident leaves (numpy) count
-    too — an index admitted from host memory lands on device at first
-    dispatch, so admission must budget for where it is *going*."""
+    """HBM residency estimate for an index: the sum of every
+    DEVICE-RESIDENT (``jax.Array``) leaf's ``nbytes`` in the pytree.
+    Host-resident leaves — numpy arrays, memmaps — are the memory
+    tier's point (ISSUE 17): they cost ZERO HBM and must not be charged
+    against the admission budget, or a tenant whose raw vectors live on
+    the host would be billed for capacity it never uses. (Indexes are
+    device pytrees at admission — build/load put every component on
+    device — so nothing here "lands on device at first dispatch".)"""
     import jax
 
     total = 0
     for leaf in jax.tree_util.tree_leaves(index):
-        nbytes = getattr(leaf, "nbytes", None)
-        if nbytes is not None:
-            total += int(nbytes)
+        if isinstance(leaf, jax.Array):
+            total += int(leaf.nbytes)
     return total
+
+
+def index_bytes_by_tier(index: Any, dataset: Any = None) -> Dict[str, int]:
+    """``{"hbm": ..., "host": ...}`` byte split of an index pytree plus
+    an optional re-rank ``dataset`` — the honest-accounting twin of
+    :func:`index_device_bytes` for the ``index.bytes{tier=}`` gauges
+    and ``/indexz``: jax.Array leaves are HBM, every other
+    nbytes-bearing leaf (numpy, memmap) is host."""
+    import jax
+
+    out = {"hbm": 0, "host": 0}
+    leaves = list(jax.tree_util.tree_leaves(index))
+    if dataset is not None:
+        leaves.append(dataset)
+    for leaf in leaves:
+        nbytes = getattr(leaf, "nbytes", None)
+        if nbytes is None:
+            continue
+        tier = "hbm" if isinstance(leaf, jax.Array) else "host"
+        out[tier] += int(nbytes)
+    return out
 
 
 @dataclasses.dataclass
@@ -99,6 +134,15 @@ class Tenant:
     dataset: Any = None
     recall_floor: Optional[float] = None
     index_stats: Optional[Dict[str, Any]] = None
+    # the memory tier (ISSUE 17): where this tenant's components live.
+    # ``raw_hbm_bytes`` remembers the dataset's device footprint so a
+    # demotion knows how much HBM it returns and a re-promotion how
+    # much it must find; ``demoted`` marks raw=host as PRESSURE-driven
+    # (promote_when_clear re-promotes only these — a tenant admitted
+    # host-resident by choice stays host-resident)
+    placement: Optional[Placement] = None
+    raw_hbm_bytes: int = 0
+    demoted: bool = False
 
     def describe(self) -> Dict[str, Any]:
         """Registry snapshot row (flight dumps / debugging)."""
@@ -107,6 +151,10 @@ class Tenant:
                "requests": self.requests}
         if self.recall_floor is not None:
             out["recall_floor"] = self.recall_floor
+        if self.placement is not None:
+            out["placement"] = self.placement.describe()
+            if self.demoted:
+                out["demoted"] = True
         return out
 
 
@@ -163,34 +211,72 @@ class IndexRegistry:
                        if t.state in _RESIDENT and not t.pinned),
                       key=lambda t: t.last_used)
 
+    def _demote_candidates(self) -> List[Tenant]:
+        """Residents whose raw vectors could move to host (device
+        dataset, not pinned), coldest first — the demote-before-evict
+        plan walks these."""
+        import jax
+
+        return [t for t in self._evict_candidates()
+                if isinstance(t.dataset, jax.Array)]
+
     # -- lifecycle ----------------------------------------------------------
     def admit(self, name: str, index: Any, *, params: Any = None,
               default_k: int = 10, ks: Optional[Any] = None,
               pinned: bool = False,
               size_bytes: Optional[int] = None,
               dataset: Any = None,
-              recall_floor: Optional[float] = None) -> Tenant:
-        """Admit ``index`` as tenant ``name``, evicting LRU cold
+              recall_floor: Optional[float] = None,
+              placement: Optional[Placement] = None) -> Tenant:
+        """Admit ``index`` as tenant ``name``, demoting resident
+        tenants' raw vectors to host and then evicting LRU cold
         tenants as needed to fit under :attr:`usable_bytes`. Raises
         :class:`AdmissionError` when the index cannot fit even after
         shedding every evictable resident (or is alone too big for the
         budget). ``ks`` enumerates the tenant's served k values
         (default: just ``default_k``) — the server warms exactly this
         set and refuses others. ``dataset`` (optional) is the tenant's
-        source rows — the shadow verifier's exact ground truth — and
-        ``recall_floor`` its quality SLO (ISSUE 16): a tenant whose
-        live recall CI falls below the floor is demoted and its
-        recall-trading ladder rungs gated. Re-admitting a live name
-        replaces it.
+        source rows — the shadow verifier's exact ground truth AND the
+        refined search's re-rank base — and ``recall_floor`` its
+        quality SLO (ISSUE 16): a tenant whose live recall CI falls
+        below the floor is demoted and its recall-trading ladder rungs
+        gated. ``placement`` (ISSUE 17) declares where components
+        live; the default is inferred from the dataset's residency
+        (``Placement(codes="hbm", raw="hbm"|"host"|"none")``). A
+        declared ``raw="host"`` with a device dataset demotes it at
+        admission (one D2H copy); ``raw="hbm"`` with a host dataset is
+        a contradiction and raises. HBM sizing is honest: only
+        device-resident components count (a host-resident raw base
+        costs zero budget). Re-admitting a live name replaces it.
         Admission is
-        all-or-nothing: the eviction set (including a replaced prior)
-        is PLANNED before anything is released, so a refused admission
-        leaves every resident tenant — the prior under this name
-        included — exactly as it was (a failed hot-swap must not
-        destroy the serving tenant)."""
+        all-or-nothing: the demotion + eviction set (including a
+        replaced prior) is PLANNED before anything is released, so a
+        refused admission leaves every resident tenant — the prior
+        under this name included — exactly as it was (a failed
+        hot-swap must not destroy the serving tenant)."""
+        import jax
+
         _faults.faultpoint("serve.registry.admit")
-        size = index_device_bytes(index) if size_bytes is None \
-            else int(size_bytes)
+        if placement is None:
+            placement = _placement.placement_for(dataset)
+        elif placement.raw == "hbm" and not isinstance(dataset,
+                                                       jax.Array):
+            raise AdmissionError(
+                f"tenant {name!r} declares Placement(raw='hbm') but "
+                f"its dataset is {'missing' if dataset is None else 'host-resident'} "
+                "— hand a device array or declare raw='host'")
+        elif placement.raw == "host" and isinstance(dataset, jax.Array):
+            # declared host residency wins: demote at admission (one
+            # D2H copy) so the budget math below sees the real tiers
+            dataset = _placement.to_host(dataset)
+        elif placement.raw != "none" and dataset is None:
+            raise AdmissionError(
+                f"tenant {name!r} declares Placement(raw="
+                f"{placement.raw!r}) without a dataset")
+        raw_hbm = int(dataset.nbytes) if isinstance(dataset, jax.Array) \
+            else 0
+        size = (index_device_bytes(index) + raw_hbm) \
+            if size_bytes is None else int(size_bytes)
         with self._lock:
             if size > self.usable_bytes:
                 raise AdmissionError(
@@ -200,11 +286,22 @@ class IndexRegistry:
                     f"{self.headroom_frac:.0%} headroom)")
             prior = self._tenants.get(name)
             replacing = prior is not None and prior.state in _RESIDENT
-            # simulate first: the prior's bytes come back for free, then
-            # LRU victims until the candidate fits — or nobody moves
+            # simulate first: the prior's bytes come back for free,
+            # then raw-vector demotions (coldest first — HBM reclaimed,
+            # tenants keep serving exact answers via the tiered
+            # prefetch), then LRU victims until the candidate fits — or
+            # nobody moves
             projected = self.resident_bytes()
             if replacing:
                 projected -= prior.size_bytes
+            demotions: List[Tenant] = []
+            for cand in self._demote_candidates():
+                if projected + size <= self.usable_bytes:
+                    break
+                if cand.name == name:
+                    continue  # the prior is accounted above
+                demotions.append(cand)
+                projected -= int(cand.dataset.nbytes)
             victims: List[Tenant] = []
             for cand in self._evict_candidates():
                 if projected + size <= self.usable_bytes:
@@ -213,6 +310,11 @@ class IndexRegistry:
                     continue  # the prior is accounted above
                 victims.append(cand)
                 projected -= cand.size_bytes
+                if cand in demotions:
+                    # evicting it releases the whole tenant — do not
+                    # double-count the planned raw demotion
+                    demotions.remove(cand)
+                    projected += int(cand.dataset.nbytes)
             if projected + size > self.usable_bytes:
                 raise AdmissionError(
                     f"tenant {name!r} ({size:,} B) does not fit: "
@@ -220,6 +322,8 @@ class IndexRegistry:
                     f"or un-evictable under the {self.usable_bytes:,} B "
                     "usable budget")
             # commit: the admission is now guaranteed to succeed
+            for demo in demotions:
+                self._demote_locked(demo, reason="pressure")
             for victim in victims:
                 self._evict_locked(victim, reason="pressure")
             if replacing:
@@ -234,8 +338,10 @@ class IndexRegistry:
                             admitted_at=now, last_used=now,
                             dataset=dataset,
                             recall_floor=(None if recall_floor is None
-                                          else float(recall_floor)))
+                                          else float(recall_floor)),
+                            placement=placement, raw_hbm_bytes=raw_hbm)
             self._tenants[name] = tenant
+            self._note_tier_bytes(tenant)
             # admission-time health introspection (ISSUE 16): list skew
             # always (one [n_lists] transfer); drift + PQ quantization
             # error only when the caller handed a dataset (the quality-
@@ -258,23 +364,119 @@ class IndexRegistry:
                       len(self.resident()))
             return tenant
 
+    def _note_tier_bytes(self, tenant: Tenant) -> None:
+        """Publish the tenant's HBM-vs-host byte split as
+        ``index.bytes{index=,tier=}`` gauges (obs.index_stats owns the
+        family) — a demoted tenant is visible at a glance."""
+        if not _spans.enabled():
+            return
+        from raft_tpu.obs import index_stats as _istats
+
+        index = tenant.index
+        if index is None:  # terminal: both tiers read zero
+            _istats.note_tier_bytes(tenant.name, hbm_bytes=0,
+                                    host_bytes=0)
+            return
+        split = index_bytes_by_tier(index, tenant.dataset)
+        _istats.note_tier_bytes(tenant.name, hbm_bytes=split["hbm"],
+                                host_bytes=split["host"])
+
     def _evict_locked(self, tenant: Tenant, reason: str) -> None:
         tenant.state = "evicted"
         tenant.index = None  # drop the reference; GC frees the HBM
         _count("serve.registry.evict",
                {"tenant": tenant.name, "reason": reason})
         _gauge("serve.registry.resident_bytes", self.resident_bytes())
+        self._note_tier_bytes(tenant)
         _log.warn("registry: evicted %r (%s)", tenant.name, reason)
+
+    def _demote_locked(self, tenant: Tenant, reason: str) -> None:
+        """Move a resident tenant's raw vectors HBM → host (ISSUE 17):
+        one D2H copy, ``size_bytes`` gives back the dataset's device
+        footprint, and the refined search keeps serving EXACT answers
+        through the tiered prefetch (the dataset reference swap is
+        atomic under the GIL; an in-flight dispatch holding the device
+        array finishes on it). Counted both as the registry's own move
+        (``serve.registry.demote{tenant=}``) and as the fleet-wide
+        degradation policy's (``degrade.steps{to=demote_raw}``) — the
+        chaos lane asserts demotion fires BEFORE any eviction on that
+        one family."""
+        from raft_tpu.robust import degrade as _degrade
+
+        raw_bytes = int(tenant.dataset.nbytes)
+        tenant.dataset = _placement.to_host(tenant.dataset)
+        tenant.raw_hbm_bytes = raw_bytes
+        tenant.demoted = True
+        tenant.size_bytes = max(0, tenant.size_bytes - raw_bytes)
+        if tenant.placement is not None:
+            tenant.placement = dataclasses.replace(tenant.placement,
+                                                   raw="host")
+        _count("serve.registry.demote", {"tenant": tenant.name})
+        _degrade.note_step("serve.registry", "raw_hbm", "demote_raw",
+                           reason)
+        _gauge("serve.registry.resident_bytes", self.resident_bytes())
+        self._note_tier_bytes(tenant)
+        _log.warn("registry: demoted %r raw vectors to host "
+                  "(%s B reclaimed, %s)", tenant.name,
+                  f"{raw_bytes:,}", reason)
+
+    def demote_raw(self, name: str, reason: str = "manual") -> None:
+        """Explicitly demote a tenant's raw vectors to host memory
+        (idempotent on already-host or dataset-less tenants; unknown
+        or terminal tenants raise)."""
+        import jax
+
+        with self._lock:
+            tenant = self.peek(name)
+            if isinstance(tenant.dataset, jax.Array):
+                self._demote_locked(tenant, reason=reason)
+
+    def promote_when_clear(self) -> List[str]:
+        """Re-promote pressure-demoted raw vectors while headroom
+        allows (hottest first — the tenant paying the host hop most
+        often gets its HBM back first). Called after explicit
+        evictions free budget; returns the promoted tenant names.
+        Only PRESSURE demotions promote: a tenant admitted with
+        ``Placement(raw="host")`` chose the tier and keeps it."""
+        promoted: List[str] = []
+        with self._lock:
+            cands = sorted(
+                (t for t in self._tenants.values()
+                 if t.state in _RESIDENT and t.demoted
+                 and t.dataset is not None),
+                key=lambda t: -t.last_used)
+            for tenant in cands:
+                need = tenant.raw_hbm_bytes or int(tenant.dataset.nbytes)
+                if self.resident_bytes() + need > self.usable_bytes:
+                    continue
+                tenant.dataset = _placement.to_device(tenant.dataset)
+                tenant.size_bytes += int(tenant.dataset.nbytes)
+                tenant.demoted = False
+                if tenant.placement is not None:
+                    tenant.placement = dataclasses.replace(
+                        tenant.placement, raw="hbm")
+                _count("serve.registry.promote", {"tenant": tenant.name})
+                _gauge("serve.registry.resident_bytes",
+                       self.resident_bytes())
+                self._note_tier_bytes(tenant)
+                _log.info("registry: re-promoted %r raw vectors to HBM "
+                          "(%s B)", tenant.name,
+                          f"{int(tenant.dataset.nbytes):,}")
+                promoted.append(tenant.name)
+        return promoted
 
     def evict(self, name: str, reason: str = "manual") -> None:
         """Explicitly release a tenant's residency (idempotent on
-        already-terminal tenants; unknown names raise)."""
+        already-terminal tenants; unknown names raise). Freed budget
+        re-promotes pressure-demoted raw vectors
+        (:meth:`promote_when_clear`)."""
         with self._lock:
             tenant = self._tenants.get(name)
             if tenant is None:
                 raise TenantUnknown(name)
             if tenant.state in _RESIDENT:
                 self._evict_locked(tenant, reason=reason)
+                self.promote_when_clear()
 
     def mark(self, name: str, state: str) -> None:
         """Health transition (``warming``/``serving``/``degraded``/

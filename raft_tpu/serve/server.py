@@ -378,12 +378,23 @@ class MicroBatchServer:
         admitted before the quality plane or without a dataset)."""
         from raft_tpu.obs import index_stats as _istats
 
+        from raft_tpu.serve.registry import index_bytes_by_tier
+
         out: Dict[str, Any] = {}
         for t in self.registry.tenants():
             entry: Dict[str, Any] = {"state": t.state,
                                      "requests": t.requests}
             if t.recall_floor is not None:
                 entry["recall_floor"] = t.recall_floor
+            # the memory tier (ISSUE 17): where this tenant's pieces
+            # live and what each tier costs — a demoted tenant shows
+            # raw=host (plus demoted=true) at a glance
+            if t.placement is not None:
+                entry["placement"] = t.placement.describe()
+                if t.demoted:
+                    entry["demoted"] = True
+            if t.index is not None:
+                entry["bytes"] = index_bytes_by_tier(t.index, t.dataset)
             stats = t.index_stats
             if stats is None and t.index is not None:
                 stats = _istats.describe_index(t.index, t.dataset)

@@ -928,7 +928,7 @@ class TestRefinedSearch:
                           SearchParams(refine="sq8"),
                           dataset=jnp.asarray(x))
 
-    def test_host_dataset_routes_to_host_gather(self):
+    def test_host_dataset_routes_to_host_tier(self):
         from raft_tpu import obs
 
         x, q = self._corpus()
@@ -937,12 +937,21 @@ class TestRefinedSearch:
         reg = obs.MetricsRegistry()
         obs.enable(registry=reg, hbm=False)
         try:
+            # numpy dataset, enough queries to pipeline → tiered prefetch
             ivf_pq.search(idx, jnp.asarray(q), 10,
                           SearchParams(n_probes=8, refine="f32_regen"),
-                          dataset=x)  # numpy → host gather tier
+                          dataset=x)
+            # pinned serial transfer → the plain host gather tier
+            ivf_pq.search(idx, jnp.asarray(q), 10,
+                          SearchParams(n_probes=8, refine="f32_regen",
+                                       refine_transfer="serial"),
+                          dataset=x)
         finally:
             obs.disable()
-        assert reg.snapshot()["counters"].get(
+        counters = reg.snapshot()["counters"]
+        assert counters.get(
+            "refine.dispatch{impl=tiered_prefetch}", 0) >= 1
+        assert counters.get(
             "refine.dispatch{impl=host_gather}", 0) >= 1
 
 

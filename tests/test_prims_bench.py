@@ -85,3 +85,20 @@ def test_refine_bench_rows(monkeypatch):
     # the override must be restored, not leaked
     import os
     assert os.environ.get("RAFT_TPU_PALLAS_REFINE") == "always"
+
+
+def test_tiered_refine_bench_rows(monkeypatch):
+    """The tiered-refine microbench (ISSUE 17) must emit all three
+    residency legs, with the tiered row carrying its hit/stall split
+    and the host rows their implied h2d bandwidth."""
+    monkeypatch.setenv("RAFT_TPU_TIERED_BATCH", "16")
+    rows = prims.bench_tiered_refine(grid=[(4_000, 32, 64, 8)], iters=1)
+    impls = {r.impl for r in rows}
+    assert impls == {"hbm_resident", "tiered_prefetch",
+                     "serialized_gather"}, impls
+    assert all(r.ms > 0 and np.isfinite(r.throughput) for r in rows)
+    by = {r.impl: r for r in rows}
+    t = by["tiered_prefetch"].params
+    assert t["prefetch_hits"] + t["prefetch_stalls"] == 4  # 64/16
+    assert by["serialized_gather"].params["h2d_gibps"] > 0
+    assert "h2d_gibps" not in by["hbm_resident"].params

@@ -1,0 +1,621 @@
+"""Memory-tiered serving (ISSUE 17): host-resident raw vectors with
+candidate-row prefetch overlapped under the LUT scan.
+
+The acceptance contract under test: the tiered path's results are
+BIT-EQUAL to the HBM-resident path across metrics × pq_bits including
+a composed filter_bitset; the :class:`RowPrefetcher` honours the PR-13
+prefetcher lifecycle (exception at the next get(), clean mid-stream
+close, hit/stall accounting, ``serve.row_read`` faults recovering
+under ``retry.IO_POLICY``); the overlap is real (prefetched wall <
+serialized wall with a calibrated synthetic delay); the registry
+demotes raw vectors to host under HBM pressure instead of evicting
+(counted ``demote_raw`` rung, re-promotion when pressure clears); and
+mixed-residency byte accounting only charges HBM for device leaves.
+"""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from raft_tpu import obs, serve
+from raft_tpu.core import bitset
+from raft_tpu.neighbors import ivf_flat, ivf_pq, tiered
+from raft_tpu.obs.metrics import MetricsRegistry
+from raft_tpu.robust import degrade, faults
+from raft_tpu.serve import placement
+from tools.obsdump import parse_key
+
+N, DIM = 2000, 32
+METRICS = ["sqeuclidean", "inner_product", "cosine"]
+
+
+@pytest.fixture(autouse=True)
+def _clean():
+    faults.clear_plan()
+    degrade.clear_recent()
+    yield
+    faults.clear_plan()
+    degrade.clear_recent()
+    obs.disable()
+
+
+@pytest.fixture(scope="module")
+def data():
+    rng = np.random.default_rng(17)
+    return rng.random((N, DIM), dtype=np.float32)
+
+
+@pytest.fixture(scope="module")
+def queries(data):
+    return jnp.asarray(data[:32] + 0.01)
+
+
+def _pq(data, **kw):
+    kw.setdefault("n_lists", 16)
+    kw.setdefault("pq_dim", 16)
+    kw.setdefault("seed", 0)
+    kw.setdefault("cache_reconstruction", "never")
+    return ivf_pq.build(jnp.asarray(data), ivf_pq.IndexParams(**kw))
+
+
+@pytest.fixture(scope="module")
+def pq_index(data):
+    return _pq(data)
+
+
+REFINE_PARAMS = ivf_pq.SearchParams(
+    n_probes=16, scan_mode="per_query", lut_dtype="float32",
+    refine="f32_regen", refine_ratio=4.0)
+
+
+def _label_sum(reg, name, **want):
+    """Sum counters named ``name`` whose labels include ``want`` —
+    label-render-order-proof counter matching."""
+    total = 0.0
+    for key, v in reg.snapshot()["counters"].items():
+        kname, labels = parse_key(key)
+        if kname == name and all(labels.get(k) == w
+                                 for k, w in want.items()):
+            total += v
+    return total
+
+
+# ---------------------------------------------------------------------------
+# RowPrefetcher lifecycle (the PR-13 ChunkPrefetcher contract, serving twin)
+# ---------------------------------------------------------------------------
+
+class TestRowPrefetcher:
+    def test_submit_order_and_hit_stall_accounting(self):
+        reg = MetricsRegistry()
+        obs.enable(registry=reg, hbm=False)
+        pf = tiered.RowPrefetcher(lambda c: c * 10, depth=2, tenant="t")
+        try:
+            pf.submit(1)
+            pf.submit(2)
+            time.sleep(0.3)            # both land before anyone asks
+            assert pf.get() == 10      # hit
+            assert pf.get() == 20      # hit
+
+            slow_started = threading.Event()
+
+            def slow(c):
+                slow_started.set()
+                time.sleep(0.2)
+                return c * 10
+
+            pf._fetch = slow
+            pf.submit(3)
+            slow_started.wait(timeout=5.0)
+            assert pf.get() == 30      # consumer waited: stall
+        finally:
+            pf.close()
+        assert _label_sum(reg, "serve.prefetch.hit", tenant="t") == 2
+        assert _label_sum(reg, "serve.prefetch.stall", tenant="t") == 1
+
+    def test_serialized_mode_every_get_is_a_stall(self):
+        reg = MetricsRegistry()
+        obs.enable(registry=reg, hbm=False)
+        pf = tiered.RowPrefetcher(lambda c: c + 1, tenant="s",
+                                  prefetch=False)
+        try:
+            pf.submit(1)
+            pf.submit(2)
+            assert pf.get() == 2
+            assert pf.get() == 3
+        finally:
+            pf.close()
+        assert pf._thread is None      # no reader in serialized mode
+        assert _label_sum(reg, "serve.prefetch.hit", tenant="s") == 0
+        assert _label_sum(reg, "serve.prefetch.stall", tenant="s") == 2
+
+    def test_reader_exception_raised_at_next_get(self):
+        calls = []
+
+        def fetch(c):
+            calls.append(c)
+            if c == 2:
+                raise ValueError("disk gone")
+            return c
+
+        pf = tiered.RowPrefetcher(fetch, depth=2)
+        pf.submit(1)
+        pf.submit(2)
+        pf.submit(3)
+        assert pf.get() == 1
+        with pytest.raises(ValueError, match="disk gone"):
+            pf.get()
+        # the reader exits after queueing the error: block 3 never reads
+        assert calls == [1, 2]
+        pf.close()   # idempotent after the error path already closed
+        pf.close()
+
+    def test_get_past_last_submit_is_typed(self):
+        pf = tiered.RowPrefetcher(lambda c: c)
+        try:
+            pf.submit(1)
+            assert pf.get() == 1
+            with pytest.raises(IndexError, match="past the last submit"):
+                pf.get()
+        finally:
+            pf.close()
+
+    def test_close_mid_stream_is_clean_and_fast(self):
+        def slowish(c):
+            time.sleep(0.05)
+            return c
+
+        pf = tiered.RowPrefetcher(slowish, depth=2)
+        for i in range(8):
+            pf.submit(i)
+        t0 = time.monotonic()
+        pf.close()   # unconsumed blocks in flight: must not hang
+        assert time.monotonic() - t0 < 5.0
+        assert pf._thread is None or not pf._thread.is_alive()
+        pf.close()   # idempotent
+
+
+# ---------------------------------------------------------------------------
+# host_row_reader: gather semantics + IO fault recovery under IO_POLICY
+# ---------------------------------------------------------------------------
+
+class TestHostRowReader:
+    def test_gather_matches_refine_gathered_semantics(self, data):
+        fetch = tiered.host_row_reader(data)
+        cand = np.array([[0, 5, -3], [N - 1, N + 7, 2]], np.int32)
+        rows = np.asarray(fetch(jnp.asarray(cand)))
+        assert rows.shape == (2, 3, DIM)
+        assert rows.dtype == np.float32
+        # out-of-range ids clip exactly like refine_gathered (the
+        # refine epilogue masks id<0 rows out of the ranking anyway)
+        np.testing.assert_array_equal(rows[0, 2], data[0])
+        np.testing.assert_array_equal(rows[1, 1], data[N - 1])
+
+    def test_row_read_fault_recovers_counted(self, data):
+        reg = MetricsRegistry()
+        obs.enable(registry=reg, hbm=False)
+        faults.install_plan({"faults": [
+            {"site": "serve.row_read", "kind": "error", "times": 1}]})
+        fetch = tiered.host_row_reader(data)
+        rows = np.asarray(fetch(np.array([[1, 2]], np.int32)))
+        np.testing.assert_array_equal(rows[0, 0], data[1])
+        assert _label_sum(reg, "retry.recovered",
+                          site="serve.row_read") >= 1
+
+    def test_row_read_fault_through_the_pipeline(self, data, queries,
+                                                 monkeypatch):
+        """The whole-path chaos case: an injected serve.row_read fault
+        inside the PREFETCH READER recovers under IO_POLICY and the
+        search still returns the exact tiered results."""
+        monkeypatch.setenv("RAFT_TPU_TIERED_BATCH", "8")
+        idx = _pq(data)
+        clean = ivf_pq.search(idx, queries, 10, REFINE_PARAMS,
+                              dataset=data)
+        reg = MetricsRegistry()
+        obs.enable(registry=reg, hbm=False)
+        faults.install_plan({"faults": [
+            {"site": "serve.row_read", "kind": "error", "times": 2}]})
+        d, i = ivf_pq.search(idx, queries, 10, REFINE_PARAMS,
+                             dataset=data)
+        np.testing.assert_array_equal(np.asarray(i), np.asarray(clean[1]))
+        np.testing.assert_array_equal(np.asarray(d), np.asarray(clean[0]))
+        assert _label_sum(reg, "retry.recovered",
+                          site="serve.row_read") >= 1
+
+
+# ---------------------------------------------------------------------------
+# routing guard
+# ---------------------------------------------------------------------------
+
+class TestTieredWanted:
+    def test_ineligible_bases_decline(self, data):
+        p = REFINE_PARAMS
+        assert not tiered.tiered_refine_wanted(None, 64, 40, DIM, p)
+        assert not tiered.tiered_refine_wanted(jnp.asarray(data), 64, 40,
+                                               DIM, p)
+        assert not tiered.tiered_refine_wanted(data[0], 64, 40, DIM, p)
+
+        class Provider:
+            shape = (N, DIM)
+            _block = True
+
+        assert not tiered.tiered_refine_wanted(Provider(), 64, 40, DIM, p)
+
+    def test_pins_and_env(self, data, monkeypatch):
+        import dataclasses
+
+        serial = dataclasses.replace(REFINE_PARAMS,
+                                     refine_transfer="serial")
+        assert not tiered.tiered_refine_wanted(data, 256, 40, DIM, serial)
+        monkeypatch.setenv("RAFT_TPU_TIERED_REFINE", "0")
+        assert not tiered.tiered_refine_wanted(data, 256, 40, DIM,
+                                               REFINE_PARAMS)
+        monkeypatch.setenv("RAFT_TPU_TIERED_REFINE", "1")
+        # env "on" forces even a single-sub-batch search
+        assert tiered.tiered_refine_wanted(data, 8, 40, DIM,
+                                           REFINE_PARAMS)
+        monkeypatch.delenv("RAFT_TPU_TIERED_REFINE")
+        # auto declines when the whole batch fits one pipeline stage
+        assert not tiered.tiered_refine_wanted(data, 8, 40, DIM,
+                                               REFINE_PARAMS)
+        assert tiered.tiered_refine_wanted(data, 256, 40, DIM,
+                                           REFINE_PARAMS)
+
+    def test_mem_guard_decline_is_a_counted_degrade_step(self, data):
+        reg = MetricsRegistry()
+        obs.enable(registry=reg, hbm=False)
+        faults.install_plan({"faults": [
+            {"site": "tiered.mem_guard", "kind": "force", "times": 1}]})
+        assert not tiered.tiered_refine_wanted(data, 256, 40, DIM,
+                                               REFINE_PARAMS)
+        assert _label_sum(reg, "degrade.steps", site="refine",
+                          to="host_gather", reason="mem_guard") >= 1
+
+    def test_mem_ok_bound(self):
+        from raft_tpu.neighbors.ivf_common import (GROUPED_BYTES_CAP,
+                                                   tiered_refine_mem_ok)
+
+        # (depth+1) in-flight [m_b, C, d] f32 blocks vs the shared cap
+        assert tiered_refine_mem_ok(64, 400, 128)
+        c_huge = GROUPED_BYTES_CAP // (3 * 64 * 128 * 4) + 1
+        assert not tiered_refine_mem_ok(64, c_huge, 128)
+
+    def test_pipeline_batch(self, monkeypatch):
+        assert tiered.pipeline_batch(1000) == 250
+        assert tiered.pipeline_batch(64) == 32   # floor
+        monkeypatch.setenv("RAFT_TPU_TIERED_BATCH", "8")
+        assert tiered.pipeline_batch(1000) == 8
+
+
+# ---------------------------------------------------------------------------
+# parity: the acceptance core — bit-equal to the HBM-resident path
+# ---------------------------------------------------------------------------
+
+class TestTieredParity:
+    def _parity(self, data, queries, idx, monkeypatch, metric,
+                with_filter=False):
+        monkeypatch.setenv("RAFT_TPU_TIERED_BATCH", "8")
+        bits = None
+        if with_filter:
+            rng = np.random.default_rng(3)
+            bits = bitset.from_mask(jnp.asarray(rng.random(N) < 0.5))
+        reg = MetricsRegistry()
+        obs.enable(registry=reg, hbm=False)
+        try:
+            d_dev, i_dev = ivf_pq.search(idx, queries, 10, REFINE_PARAMS,
+                                         dataset=jnp.asarray(data),
+                                         filter_bitset=bits)
+            d_t, i_t = ivf_pq.search(idx, queries, 10, REFINE_PARAMS,
+                                     dataset=data, filter_bitset=bits)
+        finally:
+            obs.disable()
+        np.testing.assert_array_equal(np.asarray(i_t), np.asarray(i_dev))
+        np.testing.assert_array_equal(np.asarray(d_t), np.asarray(d_dev))
+        # the host leg really served on the prefetch tier
+        assert _label_sum(reg, "refine.dispatch",
+                          impl="tiered_prefetch") >= 1
+        hits = _label_sum(reg, "serve.prefetch.hit")
+        stalls = _label_sum(reg, "serve.prefetch.stall")
+        assert hits + stalls == 4    # 32 queries / sub-batch 8
+
+    @pytest.mark.parametrize("metric", METRICS)
+    def test_bit_equal_across_metrics(self, data, queries, monkeypatch,
+                                      metric):
+        idx = _pq(data, metric=metric)
+        self._parity(data, queries, idx, monkeypatch, metric)
+
+    def test_bit_equal_pq4_with_filter(self, data, queries, monkeypatch):
+        idx = _pq(data, pq_bits=4)
+        self._parity(data, queries, idx, monkeypatch, "sqeuclidean",
+                     with_filter=True)
+
+    def test_bit_equal_pq8_with_filter(self, data, queries, pq_index,
+                                       monkeypatch):
+        self._parity(data, queries, pq_index, monkeypatch,
+                     "sqeuclidean", with_filter=True)
+
+    def test_serial_equals_tiered(self, data, queries, pq_index,
+                                  monkeypatch):
+        """refine_transfer="serial" (the ladder's host_gather pin / the
+        bench's comparison leg) and the prefetch pipeline agree
+        bit-for-bit — the overlap is a schedule change, not a math
+        change."""
+        import dataclasses
+
+        monkeypatch.setenv("RAFT_TPU_TIERED_BATCH", "8")
+        serial = dataclasses.replace(REFINE_PARAMS,
+                                     refine_transfer="serial")
+        d_s, i_s = ivf_pq.search(pq_index, queries, 10, serial,
+                                 dataset=data)
+        forced = dataclasses.replace(REFINE_PARAMS,
+                                     refine_transfer="tiered")
+        d_t, i_t = ivf_pq.search(pq_index, queries, 10, forced,
+                                 dataset=data)
+        np.testing.assert_array_equal(np.asarray(i_t), np.asarray(i_s))
+        np.testing.assert_array_equal(np.asarray(d_t), np.asarray(d_s))
+
+    def test_ivf_flat_bit_equal(self, data, queries, monkeypatch):
+        monkeypatch.setenv("RAFT_TPU_TIERED_BATCH", "8")
+        idx = ivf_flat.build(jnp.asarray(data),
+                             ivf_flat.IndexParams(n_lists=16))
+        params = ivf_flat.SearchParams(n_probes=16, refine="f32_regen",
+                                       refine_ratio=4.0)
+        d_dev, i_dev = ivf_flat.search(idx, queries, 10, params,
+                                       dataset=jnp.asarray(data))
+        reg = MetricsRegistry()
+        obs.enable(registry=reg, hbm=False)
+        try:
+            d_t, i_t = ivf_flat.search(idx, queries, 10, params,
+                                       dataset=data)
+        finally:
+            obs.disable()
+        np.testing.assert_array_equal(np.asarray(i_t), np.asarray(i_dev))
+        np.testing.assert_array_equal(np.asarray(d_t), np.asarray(d_dev))
+        assert _label_sum(reg, "refine.dispatch",
+                          impl="tiered_prefetch") >= 1
+
+
+# ---------------------------------------------------------------------------
+# overlap: the perf claim, with a calibrated synthetic delay
+# ---------------------------------------------------------------------------
+
+class TestOverlap:
+    def _drive(self, prefetch, n=5, fetch_s=0.06, compute_s=0.06):
+        """The search loop's schedule against a synthetic slow fetch:
+        submit stage i, then consume stage i-1 with ``compute_s`` of
+        'refine' work. Prefetched, the fetch hides under the compute;
+        serialized, they add."""
+        pf = tiered.RowPrefetcher(
+            lambda c: time.sleep(fetch_s) or c, prefetch=prefetch)
+        t0 = time.monotonic()
+        try:
+            pending = 0
+            for i in range(n):
+                pf.submit(i)
+                pending += 1
+                if pending > 1:
+                    pf.get()
+                    pending -= 1
+                    time.sleep(compute_s)
+            while pending:
+                pf.get()
+                pending -= 1
+                time.sleep(compute_s)
+        finally:
+            pf.close()
+        return time.monotonic() - t0
+
+    def test_prefetch_beats_serialized(self):
+        wall_serial = self._drive(prefetch=False)
+        wall_pf = self._drive(prefetch=True)
+        # serialized pays fetch+compute per stage (~0.60 s); prefetched
+        # hides the fetch under the compute (~0.36 s). The 0.85 factor
+        # absorbs scheduler noise while still proving real overlap.
+        assert wall_pf < wall_serial * 0.85, (wall_pf, wall_serial)
+
+
+# ---------------------------------------------------------------------------
+# registry: placement, mixed-residency accounting, demote before evict
+# ---------------------------------------------------------------------------
+
+class TestPlacement:
+    def test_validation(self):
+        with pytest.raises(ValueError):
+            placement.Placement(codes="host")
+        with pytest.raises(ValueError):
+            placement.Placement(raw="disk")
+        p = placement.Placement(raw="host")
+        assert p.describe() == {"codes": "hbm", "raw": "host"}
+
+    def test_tier_probes(self, data):
+        assert placement.dataset_tier(None) == "none"
+        assert placement.dataset_tier(jnp.asarray(data)) == "hbm"
+        assert placement.dataset_tier(data) == "host"
+        assert placement.placement_for(data).raw == "host"
+        host = placement.to_host(jnp.asarray(data))
+        assert isinstance(host, np.ndarray)
+        dev = placement.to_device(data)
+        assert isinstance(dev, jax.Array)
+
+
+class TestRegistryTiers:
+    def test_index_device_bytes_mixed_residency(self, data):
+        dev = jnp.asarray(data)              # N*DIM*4 device bytes
+        host = np.ones((10, 8), np.float32)  # host leaf: zero HBM
+        mixed = {"codes": dev, "raw": host}
+        assert serve.index_device_bytes(mixed) == dev.nbytes
+        by = serve.index_bytes_by_tier(mixed)
+        assert by == {"hbm": dev.nbytes, "host": host.nbytes}
+        # dataset rides into the same split
+        by2 = serve.index_bytes_by_tier({"codes": dev}, dataset=host)
+        assert by2 == {"hbm": dev.nbytes, "host": host.nbytes}
+
+    def test_admit_placement_contract(self, data):
+        reg = serve.IndexRegistry(budget_bytes=1 << 30)
+        # raw="hbm" demanded but the dataset is host-resident: typed
+        with pytest.raises(serve.AdmissionError, match="raw"):
+            reg.admit("a", object(), dataset=data,
+                      placement=serve.Placement(raw="hbm"))
+        # raw="host" with a device dataset: demoted at the door
+        t = reg.admit("b", object(), dataset=jnp.asarray(data),
+                      placement=serve.Placement(raw="host"))
+        assert isinstance(t.dataset, np.ndarray)
+        assert t.placement.raw == "host"
+        # raw tier declared but no dataset to place
+        with pytest.raises(serve.AdmissionError, match="dataset"):
+            reg.admit("c", object(),
+                      placement=serve.Placement(raw="host"))
+        # default placement is inferred from the dataset's residency
+        t2 = reg.admit("d", object(), dataset=jnp.asarray(data))
+        assert t2.placement.raw == "hbm"
+
+    def _tiered_registry(self):
+        reg = serve.IndexRegistry(budget_bytes=300_000,
+                                  headroom_frac=0.0)
+        for name in ("a", "b"):
+            reg.admit(name, object(),
+                      dataset=jnp.ones((1000, 32), jnp.float32))
+        return reg  # 2 × 128 kB resident of 300 kB
+
+    def test_pressure_demotes_raw_before_evicting(self):
+        mreg = MetricsRegistry()
+        obs.enable(registry=mreg, hbm=False)
+        reg = self._tiered_registry()
+        # 256 kB incoming against 44 kB free: BOTH residents must shed
+        # their raw tier — and neither may be evicted
+        reg.admit("c", object(),
+                  dataset=jnp.ones((2000, 32), jnp.float32))
+        for name in ("a", "b"):
+            t = reg.peek(name)
+            assert t.state != "evicted"
+            assert t.demoted and t.placement.raw == "host"
+            assert isinstance(t.dataset, np.ndarray)
+            assert _label_sum(mreg, "serve.registry.demote",
+                              tenant=name) == 1
+        assert _label_sum(mreg, "degrade.steps", to="demote_raw",
+                          site="serve.registry") == 2
+        assert _label_sum(mreg, "serve.registry.evict") == 0
+        # the tier gauges show the move: raw bytes now on the host side
+        g = mreg.snapshot()["gauges"]
+        assert g.get("index.bytes{index=a,tier=host}") == 128_000
+        assert g.get("index.bytes{index=a,tier=hbm}") == 0
+
+    def test_promote_when_pressure_clears(self):
+        mreg = MetricsRegistry()
+        obs.enable(registry=mreg, hbm=False)
+        reg = self._tiered_registry()
+        reg.admit("c", object(),
+                  dataset=jnp.ones((2000, 32), jnp.float32))
+        assert reg.peek("a").demoted and reg.peek("b").demoted
+        reg.evict("c")
+        for name in ("a", "b"):
+            t = reg.peek(name)
+            assert not t.demoted
+            assert t.placement.raw == "hbm"
+            assert isinstance(t.dataset, jax.Array)
+            assert _label_sum(mreg, "serve.registry.promote",
+                              tenant=name) == 1
+
+    def test_deliberate_host_placement_is_never_promoted(self, data):
+        reg = serve.IndexRegistry(budget_bytes=1 << 30)
+        t = reg.admit("h", object(), dataset=data,
+                      placement=serve.Placement(raw="host"))
+        assert not t.demoted         # chosen, not pressured
+        assert reg.promote_when_clear() == []
+        assert isinstance(reg.peek("h").dataset, np.ndarray)
+
+    def test_demoted_tenant_serves_bit_exact(self, data, pq_index,
+                                             monkeypatch):
+        """End to end through dispatch: a demoted tenant's results are
+        identical to its HBM-resident twin's, and the prefetch counters
+        carry its tenant label (the serving_tenant bracket)."""
+        from raft_tpu.serve.dispatch import dispatch_batch
+
+        monkeypatch.setenv("RAFT_TPU_TIERED_BATCH", "8")
+        q = jnp.asarray(data[:32])
+        reg = serve.IndexRegistry(budget_bytes=1 << 30)
+        reg.admit("pq", pq_index, params=REFINE_PARAMS, default_k=10,
+                  dataset=jnp.asarray(data))
+        d_dev, i_dev = dispatch_batch(reg.get("pq"), q, 10)
+        mreg = MetricsRegistry()
+        obs.enable(registry=mreg, hbm=False)
+        reg.demote_raw("pq", reason="test")
+        t = reg.peek("pq")
+        assert t.demoted and isinstance(t.dataset, np.ndarray)
+        d_h, i_h = dispatch_batch(t, q, 10)
+        np.testing.assert_array_equal(np.asarray(i_h), np.asarray(i_dev))
+        np.testing.assert_array_equal(np.asarray(d_h), np.asarray(d_dev))
+        assert (_label_sum(mreg, "serve.prefetch.hit", tenant="pq")
+                + _label_sum(mreg, "serve.prefetch.stall",
+                             tenant="pq")) == 4
+
+
+# ---------------------------------------------------------------------------
+# degrade ladder: the demote_raw rung
+# ---------------------------------------------------------------------------
+
+class TestDemoteRawRung:
+    def test_rung_order_and_quality_exemption(self):
+        names = [s.name for s in
+                 degrade.standard_search_ladder(64, has_lut=True).steps]
+        assert names.index("demote_raw") > names.index("fp8_lut")
+        assert names.index("demote_raw") < names.index("decline_fused")
+        # exact results: demote_raw must never be quality-gated
+        assert "demote_raw" not in degrade.QUALITY_RUNGS
+
+    def test_ladder_walks_to_demote_raw_exact_results(self, data,
+                                                      pq_index,
+                                                      monkeypatch):
+        monkeypatch.setenv("RAFT_TPU_TIERED_BATCH", "8")
+        q = jnp.asarray(data[:32])
+        clean = ivf_pq.search(pq_index, q, 10, REFINE_PARAMS,
+                              dataset=jnp.asarray(data))
+        mreg = MetricsRegistry()
+        obs.enable(registry=mreg, hbm=False)
+        faults.install_plan({"faults": [
+            {"site": "ivf_pq.search", "kind": "oom", "times": 4}]})
+        d, i = ivf_pq.search_resilient(pq_index, q, 10, REFINE_PARAMS,
+                                       dataset=jnp.asarray(data))
+        np.testing.assert_array_equal(np.asarray(i), np.asarray(clean[1]))
+        np.testing.assert_array_equal(np.asarray(d), np.asarray(clean[0]))
+        assert _label_sum(mreg, "degrade.steps", to="demote_raw",
+                          site="ivf_pq.search") >= 1
+
+
+# ---------------------------------------------------------------------------
+# observability surfaces: /indexz + obsdump
+# ---------------------------------------------------------------------------
+
+class TestObsSurfaces:
+    def test_indexz_payload_shows_tiers(self, data, pq_index):
+        reg = serve.IndexRegistry(budget_bytes=1 << 30)
+        reg.admit("pq", pq_index, params=REFINE_PARAMS, default_k=10,
+                  dataset=jnp.asarray(data))
+        srv = serve.MicroBatchServer(reg)
+        body = srv._indexz_payload()
+        ten = body["tenants"]["pq"]
+        assert ten["placement"] == {"codes": "hbm", "raw": "hbm"}
+        assert ten["bytes"]["hbm"] > 0
+        reg.demote_raw("pq", reason="test")
+        ten = srv._indexz_payload()["tenants"]["pq"]
+        assert ten["placement"]["raw"] == "host"
+        assert ten["demoted"] is True
+        assert ten["bytes"]["host"] == data.nbytes
+
+    def test_obsdump_index_table_renders_tier_split(self):
+        from tools.obsdump import index_table
+
+        reg = MetricsRegistry()
+        reg.gauge("index.bytes",
+                  labels={"index": "a", "tier": "hbm"}).set(1 << 20)
+        reg.gauge("index.bytes",
+                  labels={"index": "a", "tier": "host"}).set(2 << 20)
+        out = index_table(reg.snapshot())
+        assert "hbm" in out and "host" in out
+        row = [ln for ln in out.splitlines() if ln.strip().
+               startswith("a")][0]
+        assert "1.0 MiB" in row and "2.0 MiB" in row

@@ -27,6 +27,9 @@ Proof set (the acceptance list from ISSUE 10):
 - the DISTRIBUTED build's per-shard assignment/encode pass on the
   8-device mesh (ISSUE 13): the ``rank·shard_rows + local`` global-id
   stamp plus the per-list-count allgatherv
+- the tiered refine's device epilogue (ISSUE 17):
+  ``refine.refine_landed`` over prefetched candidate rows with int64
+  candidate ids into a ≥ 2³¹ host row axis
 
 Run: ``JAX_PLATFORMS=cpu python -m tools.capacity_prove [--n N]
 [--report PATH]`` — exit 0 when every proof is clean, 1 with the
@@ -348,6 +351,33 @@ def prove_build_distributed_pass(n: int = DEFAULT_N,
         what="ivf_pq.build_distributed[assign+encode]")
 
 
+def prove_tiered_refine(n: int = DEFAULT_N) -> dict:
+    """ISSUE 17: the memory-tiered refined search's DEVICE half at
+    billion scale — candidate ids arrive from the oversampled scan in
+    the wide id dtype, the exact re-rank runs on already-landed
+    prefetched rows (``refine.refine_landed`` → the shared
+    ``_refine_rows`` program), and the returned ids must still address
+    the ≥ 2³¹-row host base. The host gather itself is numpy (clip +
+    fancy-index — 64-bit by construction); this proves the jitted
+    epilogue never narrows the id path."""
+    import jax.numpy as jnp
+    from raft_tpu.core import ids as _ids
+    from raft_tpu.neighbors import refine as _refine
+    from raft_tpu.obs import sanitize as _san
+
+    C = 16
+    idt = _ids.id_dtype(n)
+
+    def fn(rows, q, cand, marker):
+        vals, ids = _refine.refine_landed(rows, q, cand, _K)
+        return vals, ids, _address_rows(marker, ids)
+
+    return _san.assert_billion_safe(
+        fn, _sds((_M, C, _DIM), jnp.float32),
+        _sds((_M, _DIM), jnp.float32), _sds((_M, C), idt),
+        _sds((n, 1), jnp.int8), what="refine.refine_landed[tiered]")
+
+
 PROOFS = {
     "brute_force.knn": prove_brute_force,
     "ivf_pq.search": prove_ivf_pq,
@@ -359,6 +389,7 @@ PROOFS = {
         n, "allgather"),
     "build_chunked.assign_encode": prove_build_chunked_pass,
     "build_distributed.assign_encode": prove_build_distributed_pass,
+    "tiered.refine_landed": prove_tiered_refine,
 }
 
 

@@ -40,6 +40,7 @@ MODULES = [
     "raft_tpu.neighbors.brute_force", "raft_tpu.neighbors.ivf_flat",
     "raft_tpu.neighbors.ivf_pq", "raft_tpu.neighbors.cagra",
     "raft_tpu.neighbors.nn_descent", "raft_tpu.neighbors.refine",
+    "raft_tpu.neighbors.tiered",
     "raft_tpu.neighbors.ball_cover",
     "raft_tpu.neighbors.epsilon_neighborhood",
     "raft_tpu.neighbors.sample_filter",
@@ -50,6 +51,7 @@ MODULES = [
     "raft_tpu.parallel.knn", "raft_tpu.parallel.ivf",
     "raft_tpu.parallel.build",
     "raft_tpu.serve.server", "raft_tpu.serve.registry",
+    "raft_tpu.serve.placement",
     "raft_tpu.serve.dispatch", "raft_tpu.serve.loadgen",
     "raft_tpu.serve.slo", "raft_tpu.serve.errors",
     "raft_tpu.ops.pallas_kernels", "raft_tpu.native",
@@ -201,7 +203,8 @@ shape (the obs counter `refine.dispatch{impl=...}` records the pick):
 |---|---|---|---|---|
 | `pallas_gather` | device-resident f32/bf16 dataset, `k ≤ 64`, `k_cand ≥ 256`; auto on TPU for oversampled shapes (`k_cand ≥ 400` or a `[m, C, d]` buffer past 1 GB), forced with `RAFT_TPU_PALLAS_REFINE=always` (interpret mode off-TPU) | fused kernel (`ops.pallas_kernels.gather_refine_topk`): candidate ids HBM→SMEM, dataset rows streamed HBM→VMEM row-by-row, exact epilogue + running top-k on-chip | each candidate's bitset WORD rides the row-DMA queue (addressed off the same SMEM id); cleared bits poison rows to ±inf/-1 in the metric epilogue | `[m, 128]` result tables only (plus a PER-CALL `[n, ceil(d/128)·128]` pad copy when `d % 128 ≠ 0` — `ivf_common.gather_refine_mem_ok` declines the tier when that copy exceeds the cap or the gather buffer it replaces) |
 | `xla_gather` | device dataset, any other shape | `dataset[cand]` gather + one batched einsum + `select_k` | candidate table sentinel-masked BEFORE the gather (`sample_filter.passes` → `-1`) | the `[m, C, d]` f32 gather buffer (7.7 GB at batch 10000 × k_cand 2000 × d 96) |
-| `host_gather` (`refine_gathered`) | host/memmapped base (optionally SQ8 via `dequant=`) | host fancy-index of candidate rows, re-rank on device | none — oversampled callers hand these tiers pre-filtered candidates | `[m, C, d]` host rows + device copy |
+| `tiered_prefetch` (`refine_landed` via `neighbors.tiered`, ISSUE 17) | host-resident 2-D base on the oversampled search paths, ≥ 2 pipeline sub-batches (or `refine_transfer="tiered"` / `RAFT_TPU_TIERED_REFINE=1` forced), `ivf_common.tiered_refine_mem_ok` | background `RowPrefetcher` gathers ONLY each sub-batch's candidate rows host→HBM under the previous sub-batch's scan (`serve.prefetch.{hit,stall}{tenant=}`); re-rank on already-landed rows | same as host_gather — the scan tiers pre-filter | `(depth+1)` in-flight `[m_b, C, d]` landed blocks |
+| `host_gather` (`refine_gathered`) | host/memmapped base (optionally SQ8 via `dequant=`), single sub-batch or `refine_transfer="serial"` | host fancy-index of candidate rows, re-rank on device | none — oversampled callers hand these tiers pre-filtered candidates | `[m, C, d]` host rows + device copy |
 | `provider_regen` (`refine_provider`) | device-chunk provider (synthetic regen, deep-100m) | regenerate blocks on device, scatter candidate rows into one buffer | none — same contract as host_gather | `[m·C, d]` device buffer (callers chunk queries) |
 
 All tiers share the metric semantics of the einsum path (l2 / sqrt-l2

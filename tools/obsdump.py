@@ -485,18 +485,25 @@ def benchdiff_section(doc: Dict[str, Any]) -> str:
 
 
 def index_table(snap: Dict[str, Any]) -> str:
-    """The ``index.*`` gauge family (ISSUE 16): per-index structural
+    """The ``index.*`` gauge family (ISSUE 16/17): per-index structural
     health — list skew, dead lists, centroid drift, PQ quantization
-    error, tombstone density — one row per ``{index=}`` label."""
+    error, tombstone density — plus the memory-tier byte split
+    (``index.bytes{tier=hbm|host}``: a demoted tenant shows its bytes
+    under ``host`` at a glance) — one row per ``{index=}`` label."""
     per: Dict[str, Dict[str, float]] = {}
     for key, v in snap["gauges"].items():
         name, labels = parse_key(key)
         if not name.startswith("index."):
             continue
-        per.setdefault(labels.get("index", "-"),
-                       {})[name[len("index."):]] = v
+        st = per.setdefault(labels.get("index", "-"), {})
+        if name == "index.bytes":
+            st["bytes_" + labels.get("tier", "-")] = v
+        else:
+            st[name[len("index."):]] = v
     def _f(st, k, digits=4):
         return "-" if st.get(k) is None else f"{st[k]:.{digits}f}"
+    def _b(st, k):
+        return "-" if st.get(k) is None else _human_bytes(st[k])
     rows = [[idx,
              "-" if st.get("n_lists") is None else str(int(st["n_lists"])),
              "-" if st.get("size") is None else str(int(st["size"])),
@@ -506,10 +513,13 @@ def index_table(snap: Dict[str, Any]) -> str:
              else str(int(st["dead_lists"])),
              _f(st, "drift_rel"),
              _f(st, "pq_err_rel"),
-             _f(st, "tombstone_density", 3)]
+             _f(st, "tombstone_density", 3),
+             _b(st, "bytes_hbm"),
+             _b(st, "bytes_host")]
             for idx, st in sorted(per.items())]
     return _table(["index", "lists", "size", "cv", "max/mean", "dead",
-                   "drift_rel", "pq_err_rel", "tombstones"], rows)
+                   "drift_rel", "pq_err_rel", "tombstones", "hbm",
+                   "host"], rows)
 
 
 def quality_header(raw: Dict[str, Any]) -> List[str]:

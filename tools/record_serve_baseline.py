@@ -42,8 +42,11 @@ STEP_S = 2.0
 
 BASELINE_NOTE = (
     "Committed serving latency-vs-throughput baseline (ISSUE 14): the "
-    "micro-batch server on the CPU backend, two resident tenants "
-    "(ivf_pq.n64.pq16 + ivf_flat.n64), open-loop Poisson arrivals at "
+    "micro-batch server on the CPU backend, three resident tenants "
+    "(ivf_pq.n64.pq16 + ivf_flat.n64 + ivf_pq.n64.pq16.demoted - the "
+    "ISSUE 17 memory-tier leg: raw vectors demoted to host, exact "
+    "re-rank through the tiered candidate-row prefetch), open-loop "
+    "Poisson arrivals at "
     "offered loads of 25/100/400 qps for 2 s each, qps = completed "
     "requests/s with p50/p99 from the serve latency histogram. Steps "
     "sit comfortably under the batched CPU capacity (~3k qps at "
@@ -79,6 +82,20 @@ def serve_record() -> dict:
                    default_k=K)
     registry.admit("ivf_flat.n64", idx_flat,
                    params=ivf_flat.SearchParams(n_probes=8), default_k=K)
+    # the demoted-tenant leg (ISSUE 17): a refined tenant whose raw
+    # vectors sit on HOST (pressure-demoted at admit time) serves its
+    # exact re-rank through the tiered candidate-row prefetch — the
+    # curve shows what the memory tier costs under real open-loop
+    # traffic. The pipeline sub-batch is pinned to 4 so the max_batch=16
+    # micro-batches actually split into overlapping stages.
+    os.environ["RAFT_TPU_TIERED_BATCH"] = "4"
+    registry.admit("ivf_pq.n64.pq16.demoted", idx_pq,
+                   params=ivf_pq.SearchParams(
+                       n_probes=8, scan_mode="per_query",
+                       refine="f32_regen", refine_ratio=4.0,
+                       lut_dtype="float32"),
+                   default_k=K, dataset=xd)
+    registry.demote_raw("ivf_pq.n64.pq16.demoted", reason="baseline")
     server = serve.MicroBatchServer(registry, serve.ServerConfig(
         max_batch=16, queue_depth=128, linger_s=0.002,
         default_slo_s=1.0))
@@ -91,7 +108,8 @@ def serve_record() -> dict:
                    for q in queries])
     detail = []
     with server:
-        for tenant in ("ivf_pq.n64.pq16", "ivf_flat.n64"):
+        for tenant in ("ivf_pq.n64.pq16", "ivf_flat.n64",
+                       "ivf_pq.n64.pq16.demoted"):
             rows = loadgen.sweep(server, tenant, queries, K,
                                  OFFERED_STEPS, duration_s=STEP_S,
                                  ground_truth=gt)
