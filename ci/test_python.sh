@@ -23,10 +23,14 @@ echo "== graftlint static analysis (blocking; CPU-only, no device) =="
 # tree — no XLA compile cache, no pytest cache, no device backend, so
 # it cannot go stale or flake with the environment. Zero unsuppressed
 # findings is the gate (tools/graftlint, docs/developer_guide.md);
-# covers GL01–GL05, the SPMD/DMA pass GL06–GL10, and the capacity/
-# numeric-safety pass GL11–GL15. The JSON report is the CI artifact
-# (per-finding rule/path/line).
-python -m tools.graftlint raft_tpu --report /tmp/graftlint_report.json
+# covers GL01–GL05, the SPMD/DMA pass GL06–GL10, the capacity/
+# numeric-safety pass GL11–GL15, and the concurrency pass GL16–GL20
+# (lock discipline, thread lifecycle, TLS hygiene, signal-context
+# safety, future resolution). The JSON report is the CI artifact
+# (per-finding rule/path/line); --jobs fans the per-file analysis over
+# the runner's cores with a single shared AST walk per file.
+python -m tools.graftlint raft_tpu --jobs 0 \
+    --report /tmp/graftlint_report.json
 echo "graftlint report artifact: /tmp/graftlint_report.json"
 
 echo "== capacity prover (device-free eval_shape proofs, n = 2.2e9) =="
@@ -44,13 +48,19 @@ python -m pytest tests/ -q "$@"
 
 echo "== sanitizer-mode subset (RAFT_TPU_SANITIZE=1: rank-promotion raise"
 echo "   + debug_nans + transfer guards + recompile budgets + the"
-echo "   collective-schedule checker over the parallel/distributed suites) =="
+echo "   collective-schedule checker over the parallel/distributed suites,"
+echo "   + the lock-order tracker over the threaded serving plane) =="
+# test_concurrency.py is deliberately LAST: its closing test asserts
+# the process-wide lock-acquisition graph the preceding serve/quality/
+# tiered modules recorded is cycle-free and blocking-free, and its
+# seeded AB/BA negative control proves the detector actually fires
 RAFT_TPU_SANITIZE=1 python -m pytest \
     tests/test_sanitize.py tests/test_graftlint.py tests/test_core.py \
     tests/test_capacity.py \
     tests/test_parallel.py tests/test_parallel_ivf.py \
     tests/test_ring_topk.py tests/test_build_distributed.py \
-    tests/test_serve.py \
+    tests/test_serve.py tests/test_quality.py tests/test_tiered.py \
+    tests/test_concurrency.py \
     -q -p no:cacheprovider
 
 echo "== driver contract: entry() compiles, dryrun_multichip(8) executes =="

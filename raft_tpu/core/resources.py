@@ -53,7 +53,8 @@ class Resources:
             self._resources.pop(key, None)
 
     def has_resource_factory(self, key: str) -> bool:
-        return key in self._factories or key in self._resources
+        with self._lock:
+            return key in self._factories or key in self._resources
 
     def get_resource(self, key: str) -> Any:
         with self._lock:

@@ -381,12 +381,18 @@ def unpack_bits(packed: jax.Array, pq_dim: int, pq_bits: int) -> jax.Array:
     nbytes = packed.shape[-1]
     s = np.arange(pq_dim)
     byte_idx = (s * pq_bits) // 8
-    bit_off = jnp.asarray((s * pq_bits) % 8, jnp.uint16)
+    # full-rank (1, ..., pq_dim) operands: the sanitize lane runs with
+    # jax_numpy_rank_promotion="raise", so 1-D-vs-N-D broadcasts are
+    # spelled out instead of implied
+    lead = (1,) * (packed.ndim - 1)
+    bit_off = jnp.asarray(((s * pq_bits) % 8).reshape(lead + (-1,)),
+                          jnp.uint16)
     p16 = packed.astype(jnp.uint16)
     lo = jnp.take(p16, jnp.asarray(byte_idx), axis=-1)
     hi_idx = np.minimum(byte_idx + 1, nbytes - 1)
     hi = jnp.take(p16, jnp.asarray(hi_idx), axis=-1)
-    hi = jnp.where(jnp.asarray(byte_idx + 1 < nbytes), hi, 0)
+    hi = jnp.where(
+        jnp.asarray((byte_idx + 1 < nbytes).reshape(lead + (-1,))), hi, 0)
     val = ((lo | (hi << 8)) >> bit_off) & ((1 << pq_bits) - 1)
     return val.astype(jnp.uint8)
 

@@ -55,6 +55,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from raft_tpu.core.tracing import span
+from raft_tpu.obs import sanitize as _sanitize
 from raft_tpu.obs import spans as _obs_spans
 from raft_tpu.robust import degrade as _degrade
 from raft_tpu.robust import faults as _faults
@@ -253,11 +254,12 @@ class RowPrefetcher:
         # with a ~zero-length wait — the conservative side
         if self._done.empty():
             self._count("serve.prefetch.stall")
-            with span("h2d"):
+            with span("h2d"), _sanitize.blocking_region("queue.get"):
                 x, exc = self._done.get()
         else:
             self._count("serve.prefetch.hit")
-            x, exc = self._done.get()
+            with _sanitize.blocking_region("queue.get"):
+                x, exc = self._done.get()
         if exc is not None:
             self.close()
             raise exc
@@ -278,7 +280,8 @@ class RowPrefetcher:
                 except queue.Empty:
                     break
         if self._thread is not None:
-            self._thread.join(timeout=5.0)
+            with _sanitize.blocking_region("join"):
+                self._thread.join(timeout=5.0)
             if self._thread.is_alive():
                 from raft_tpu.core import logging as _log
 

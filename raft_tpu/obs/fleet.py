@@ -47,6 +47,8 @@ import threading
 import time
 from typing import Any, Dict, Iterable, List, Optional
 
+from raft_tpu.obs import sanitize as _sanitize
+
 SCHEMA = "raft_tpu.fleet/1"
 
 RUN_ID_ENV = "RAFT_TPU_RUN_ID"
@@ -57,7 +59,7 @@ RANK_ENV = "RAFT_TPU_RANK"
 #: straggler table (``comms.allgatherv``, ``comms.ring_topk``, ...)
 COLLECTIVE_PREFIXES = ("comms.",)
 
-_minted_lock = threading.Lock()
+_minted_lock = _sanitize.monitored_lock("obs.fleet.minted")
 _minted_run_id: Optional[str] = None
 
 

@@ -36,6 +36,8 @@ import time
 from collections import deque
 from typing import Any, Dict, List, Optional, Sequence
 
+from raft_tpu.obs import sanitize as _sanitize
+
 from raft_tpu.obs import fleet as _fleet
 from raft_tpu.obs import metrics as _metrics
 from raft_tpu.obs import spans as _spans
@@ -115,7 +117,7 @@ def _robust_state() -> Dict[str, Any]:
 # describe() under "serve_registry") so every dump — crash, periodic,
 # /flightz — carries their state without flight knowing their types.
 _sections: Dict[str, Any] = {}
-_sections_lock = threading.Lock()
+_sections_lock = _sanitize.monitored_rlock("obs.flight.sections")
 
 
 def set_section(name: str, provider) -> None:
@@ -165,7 +167,7 @@ class FlightRecorder:
         self._log_tail = _LogTail(last_n_log_lines)
         # RLock: a signal landing mid-dump re-enters dump() on the
         # same (main) thread — block the process' death on itself never
-        self._dump_lock = threading.RLock()
+        self._dump_lock = _sanitize.monitored_rlock("obs.flight.dump")
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
         self._atexit_registered = False
@@ -310,7 +312,8 @@ class FlightRecorder:
         (tests; production recorders live for the process)."""
         self._stop.set()
         if self._thread is not None:
-            self._thread.join(timeout=5)
+            with _sanitize.blocking_region("join"):
+                self._thread.join(timeout=5)
             self._thread = None
         for signum, prev in self._prev_handlers.items():
             try:
@@ -324,7 +327,7 @@ class FlightRecorder:
 
 
 _recorder: Optional[FlightRecorder] = None
-_recorder_lock = threading.Lock()
+_recorder_lock = _sanitize.monitored_lock("obs.flight.recorder")
 
 
 def install(dump_dir: str,

@@ -31,6 +31,8 @@ import os
 import threading
 from typing import Any, Dict, Iterable, List, Optional, Tuple
 
+from raft_tpu.obs import sanitize as _sanitize
+
 # Default histogram bucket upper bounds (seconds-oriented: spans are the
 # main histogram producer; 10 µs .. 10 min covers a dispatch through a
 # chunked 100M-row build stage).
@@ -69,7 +71,7 @@ class Counter:
         # structures ON the interrupted main thread — a plain Lock the
         # interrupted frame already holds would deadlock the dying
         # process (same for every lock on the snapshot path below)
-        self._lock = threading.RLock()
+        self._lock = _sanitize.monitored_rlock("obs.metrics.counter")
 
     def inc(self, value: float = 1.0) -> None:
         if value < 0:
@@ -79,7 +81,8 @@ class Counter:
 
     @property
     def value(self) -> float:
-        return self._value
+        with self._lock:
+            return self._value
 
 
 class Gauge:
@@ -91,7 +94,7 @@ class Gauge:
         self.name = name
         self.labels = dict(labels or {})
         self._value = 0.0
-        self._lock = threading.RLock()  # signal-snapshot path, see Counter
+        self._lock = _sanitize.monitored_rlock("obs.metrics.gauge")  # signal-snapshot path, see Counter
 
     def set(self, value: float) -> None:
         with self._lock:
@@ -105,7 +108,8 @@ class Gauge:
 
     @property
     def value(self) -> float:
-        return self._value
+        with self._lock:
+            return self._value
 
 
 class Histogram:
@@ -131,7 +135,7 @@ class Histogram:
         # per-bucket exemplar reservoirs: {bucket_index: [(value, id)]},
         # lazily created — an exemplar-less histogram pays nothing
         self._exemplars: Optional[Dict[int, List[Tuple[float, str]]]] = None
-        self._lock = threading.RLock()  # signal-snapshot path, see Counter
+        self._lock = _sanitize.monitored_rlock("obs.metrics.histogram")  # signal-snapshot path, see Counter
 
     def observe(self, value: float,
                 exemplar: Optional[str] = None) -> None:
@@ -173,11 +177,13 @@ class Histogram:
 
     @property
     def count(self) -> int:
-        return self._count
+        with self._lock:
+            return self._count
 
     @property
     def sum(self) -> float:
-        return self._sum
+        with self._lock:
+            return self._sum
 
     def quantile(self, q: float) -> Optional[float]:
         """Bucket-interpolated quantile estimate (None when empty) —
@@ -223,7 +229,7 @@ class MetricsRegistry:
     """Thread-safe named-series registry (counters/gauges/histograms)."""
 
     def __init__(self) -> None:
-        self._lock = threading.RLock()  # signal-snapshot path, see Counter
+        self._lock = _sanitize.monitored_rlock("obs.metrics.registry")  # signal-snapshot path, see Counter
         self._counters: Dict[Tuple[str, tuple], Counter] = {}
         self._gauges: Dict[Tuple[str, tuple], Gauge] = {}
         self._histograms: Dict[Tuple[str, tuple], Histogram] = {}
@@ -459,7 +465,7 @@ def load_jsonl(path: str) -> List[Dict[str, Any]]:
 
 
 _global_registry = MetricsRegistry()
-_global_lock = threading.Lock()
+_global_lock = _sanitize.monitored_lock("obs.metrics.global")
 
 
 def get_registry() -> MetricsRegistry:

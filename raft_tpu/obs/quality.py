@@ -56,6 +56,7 @@ from typing import Any, Callable, Deque, Dict, List, Optional, Tuple
 
 import numpy as np
 
+from raft_tpu.obs import sanitize as _sanitize
 from raft_tpu.obs import spans as _spans
 
 __all__ = ["VerifierConfig", "RecallVerifier", "wilson_interval",
@@ -168,7 +169,7 @@ class RecallVerifier:
         self.registry = registry
         self.config = config or VerifierConfig()
         self.on_verdict: Optional[Callable[[str], None]] = None
-        self._lock = threading.Lock()
+        self._lock = _sanitize.monitored_lock("obs.quality")
         self._cond = threading.Condition(self._lock)
         self._pending: List[Dict[str, Any]] = []
         self._seen: Dict[str, int] = {}           # accepted, per tenant

@@ -33,6 +33,8 @@ import socket
 import threading
 import time
 from collections import deque
+
+from raft_tpu.obs import sanitize as _sanitize
 from typing import Any, Dict, Iterable, List, Optional
 
 DEFAULT_CAPACITY = 65536
@@ -186,7 +188,7 @@ class EventBuffer:
         # RLock: the flight recorder snapshots the buffer from signal
         # handlers running on the interrupted main thread — a plain
         # Lock held by the interrupted record_span frame would deadlock
-        self._lock = threading.RLock()
+        self._lock = _sanitize.monitored_rlock("obs.trace.buffer")
 
     def record_span(self, name: str, ts: float, dur: float,
                     args: Optional[Dict[str, Any]] = None) -> None:
@@ -232,7 +234,7 @@ class EventBuffer:
 
 
 _global_buffer = EventBuffer()
-_global_lock = threading.Lock()
+_global_lock = _sanitize.monitored_lock("obs.trace.global")
 
 
 def get_buffer() -> EventBuffer:

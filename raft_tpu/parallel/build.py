@@ -75,6 +75,7 @@ from jax.sharding import Mesh, PartitionSpec as P
 from raft_tpu.core.errors import expects
 from raft_tpu.core.tracing import span
 from raft_tpu.core import ids as _ids
+from raft_tpu.obs import sanitize as _sanitize
 from raft_tpu.obs import spans as _obs_spans
 from raft_tpu.parallel.comms import Comms
 from raft_tpu.robust import degrade as _degrade
@@ -176,11 +177,12 @@ class ChunkPrefetcher:
         # with a ~zero-length wait — the conservative side
         if self._q.empty():
             self._count("build.prefetch.stall")
-            with span("h2d"):
+            with span("h2d"), _sanitize.blocking_region("queue.get"):
                 i, x, exc = self._q.get()
         else:
             self._count("build.prefetch.hit")
-            i, x, exc = self._q.get()
+            with _sanitize.blocking_region("queue.get"):
+                i, x, exc = self._q.get()
         if exc is not None:
             self.close()
             raise exc
@@ -200,7 +202,8 @@ class ChunkPrefetcher:
             except queue.Empty:
                 break
         if self._thread is not None:
-            self._thread.join(timeout=5.0)
+            with _sanitize.blocking_region("join"):
+                self._thread.join(timeout=5.0)
             if self._thread.is_alive():
                 from raft_tpu.core import logging as _log
                 _log.warn("ChunkPrefetcher.close: reader thread still "

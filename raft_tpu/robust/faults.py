@@ -63,6 +63,8 @@ import threading
 import time
 from typing import Any, Dict, List, Optional
 
+from raft_tpu.obs import sanitize as _sanitize
+
 __all__ = [
     "FaultInjected", "InjectedResourceExhausted", "FaultPlan",
     "install_plan", "load_plan", "clear_plan", "active_plan",
@@ -131,7 +133,7 @@ class FaultPlan:
         # (describe()/fires()) ON the interrupted main thread — a plain
         # Lock held by an interrupted check() would deadlock the dying
         # process (same rule as the metrics registry's snapshot path)
-        self._lock = threading.RLock()
+        self._lock = _sanitize.monitored_rlock("robust.faults")
         self._rng = random.Random(int(spec.get("seed", 0)))
         self._by_site: Dict[str, List[_Rule]] = {}
         for entry in spec["faults"]:
@@ -181,7 +183,7 @@ class FaultPlan:
 
 _plan: Optional[FaultPlan] = None
 _env_checked = False
-_env_lock = threading.Lock()
+_env_lock = _sanitize.monitored_lock("robust.faults.env")
 
 
 def install_plan(spec) -> FaultPlan:

@@ -46,6 +46,7 @@ from typing import Any, Dict, List, Optional
 
 from raft_tpu.core import logging as _log
 from raft_tpu.obs import hbm as _hbm
+from raft_tpu.obs import sanitize as _sanitize
 from raft_tpu.obs import spans as _spans
 from raft_tpu.robust import faults as _faults
 from raft_tpu.serve import placement as _placement
@@ -186,7 +187,7 @@ class IndexRegistry:
         self.budget_bytes = int(budget_bytes)
         self.headroom_frac = float(headroom_frac)
         self._tenants: Dict[str, Tenant] = {}
-        self._lock = threading.RLock()
+        self._lock = _sanitize.monitored_rlock("serve.registry")
         if _spans.enabled():
             # mirror the admission budget into the hbm.bytes_limit
             # family (its own {source=admission} series — never the

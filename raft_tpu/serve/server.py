@@ -50,6 +50,7 @@ from typing import Any, Deque, Dict, List, Optional, Tuple
 import numpy as np
 
 from raft_tpu.core import logging as _log
+from raft_tpu.obs import sanitize as _sanitize
 from raft_tpu.obs import spans as _spans
 from raft_tpu.obs import trace as _trace
 from raft_tpu.robust import faults as _faults
@@ -175,7 +176,7 @@ class MicroBatchServer:
         self.buckets = bucket_sizes(self.config.max_batch)
         self._queues: Dict[Tuple[str, int], Deque[_Request]] = {}
         self._total = 0
-        self._cond = threading.Condition()
+        self._cond = _sanitize.monitored_condition("serve.server")
         self._running = False
         self._thread: Optional[threading.Thread] = None
         #: the live exposition endpoint (obs.expo.ExpoServer) while
@@ -194,8 +195,9 @@ class MicroBatchServer:
         bucket set through the real dispatch path, then start the
         batcher. After ``start(warmup=True)`` returns, steady-state
         serving holds ``recompile_budget(0)``."""
-        if self._running:
-            return self
+        with self._cond:
+            if self._running:
+                return self
         if self.config.compile_cache_dir:
             self._persist_compile_cache(self.config.compile_cache_dir)
         if warmup:
@@ -328,7 +330,8 @@ class MicroBatchServer:
             self._running = False
             self._cond.notify_all()
         if self._thread is not None:
-            self._thread.join(timeout=self.config.drain_s + 5)
+            with _sanitize.blocking_region("join"):
+                self._thread.join(timeout=self.config.drain_s + 5)
             self._thread = None
         shed: List[_Request] = []
         with self._cond:
@@ -492,8 +495,9 @@ class MicroBatchServer:
                slo_s: Optional[float] = -1.0,
                timeout_s: float = 30.0):
         """Blocking convenience wrapper: ``submit().result()``."""
-        return self.submit(tenant, query, k, slo_s).result(
-            timeout=timeout_s)
+        fut = self.submit(tenant, query, k, slo_s)
+        with _sanitize.blocking_region("Future.result"):
+            return fut.result(timeout=timeout_s)
 
     # -- the batcher --------------------------------------------------------
     def _batch_loop(self) -> None:

@@ -36,6 +36,7 @@ import time
 from collections import deque
 from typing import Any, Callable, Deque, Dict, List, Optional, Tuple
 
+from raft_tpu.obs import sanitize as _sanitize
 from raft_tpu.obs import spans as _spans
 
 __all__ = ["SLOPolicy", "SLOMonitor", "set_monitor", "get_monitor",
@@ -109,7 +110,7 @@ class SLOMonitor:
         self.verifier = verifier
         self.policy = policy or SLOPolicy()
         self._clock = clock
-        self._lock = threading.Lock()
+        self._lock = _sanitize.monitored_lock("serve.slo")
         keep = max(self.policy.windows_s) * 1.5 if self.policy.windows_s \
             else 300.0
         self._keep_s = keep
@@ -239,9 +240,11 @@ class SLOMonitor:
                 _log.info("slo: tenant %r recall recovered above its "
                           "floor — serving restored", tenant.name)
         if _spans.enabled():
+            with self._lock:
+                breached = set(self._floor_breached)
             for tenant in tenants:
                 if getattr(tenant, "recall_floor", None) is not None:
-                    ok = tenant.name not in self._floor_breached
+                    ok = tenant.name not in breached
                     _spans.registry().gauge(
                         "slo.recall_floor_ok",
                         labels={"tenant": tenant.name}).set(
@@ -284,7 +287,7 @@ class SLOMonitor:
 
 
 _monitor: Optional[SLOMonitor] = None
-_monitor_lock = threading.Lock()
+_monitor_lock = _sanitize.monitored_lock("serve.slo.monitor")
 
 
 def set_monitor(monitor: Optional[SLOMonitor]) -> Optional[SLOMonitor]:

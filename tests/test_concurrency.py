@@ -1,0 +1,304 @@
+"""Thread-fuzz for the serving plane under the lock-order tracker.
+
+The runtime half of graftlint's concurrency pass (GL16–GL20, ISSUE 18):
+eight threads hammer the registry's admit/evict/demote/promote surface
+and the micro-batch server's submit/stop path while every lock in the
+plane is a ``sanitize.monitored_*`` wrapper recording per-thread
+acquisition order. The assertions are the ones single-threaded tests
+cannot make: the observed order graph stays acyclic (no interleaving of
+these operations can deadlock), no blocking call ran while a plane lock
+was held, every submitted future resolves, and resident-bytes
+accounting matches the surviving tenants exactly. Seeded AB/BA and
+blocking-while-held negatives prove the detectors actually fire — a
+tracker that never trips is indistinguishable from one that never
+looks.
+
+``test_zz_no_lock_cycles_after_suite`` is the CI lane's closer: the
+sanitize lane lists this module LAST so the assertion covers every edge
+the serve/quality/tiered modules recorded before it.
+"""
+
+import random
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from raft_tpu import serve
+from raft_tpu.neighbors import ivf_flat
+from raft_tpu.obs import sanitize
+from raft_tpu.serve.errors import AdmissionError, ShedError, TenantUnknown
+
+N, DIM = 512, 16
+THREADS = 8
+SEED = 20250806
+
+
+@pytest.fixture(scope="module")
+def data():
+    rng = np.random.default_rng(0)
+    return rng.random((N, DIM), dtype=np.float32)
+
+
+@pytest.fixture(scope="module")
+def flat_index(data):
+    return ivf_flat.build(jnp.asarray(data),
+                          ivf_flat.IndexParams(n_lists=4))
+
+
+FLAT_PARAMS = ivf_flat.SearchParams(n_probes=4)
+
+
+def _run_threads(workers):
+    threads = [threading.Thread(target=w, daemon=True) for w in workers]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=60)
+    assert not any(t.is_alive() for t in threads), "fuzz worker hung"
+
+
+# ---------------------------------------------------------------------------
+# registry fuzz
+# ---------------------------------------------------------------------------
+
+class TestRegistryFuzz:
+    def test_admit_evict_demote_promote_cycle_free(self, flat_index,
+                                                   data):
+        """8 threads × 120 seeded ops against one registry: typed
+        refusals only, acyclic lock order, honest accounting."""
+        with sanitize.force_lock_tracking():
+            reg = serve.IndexRegistry(budget_bytes=8 << 20)
+            names = [f"t{i}" for i in range(6)]
+            errors = []
+
+            def worker(seed):
+                rng = random.Random(seed)
+                dev = jnp.asarray(data)
+                for _ in range(120):
+                    name = rng.choice(names)
+                    op = rng.random()
+                    try:
+                        if op < 0.40:
+                            # half the admissions carry a device
+                            # dataset so pressure demotions and
+                            # re-promotions are real tier moves
+                            ds = dev if rng.random() < 0.5 else None
+                            reg.admit(name, flat_index,
+                                      params=FLAT_PARAMS, default_k=10,
+                                      size_bytes=1 << 20, dataset=ds)
+                        elif op < 0.55:
+                            reg.evict(name)
+                        elif op < 0.70:
+                            reg.demote_raw(name)
+                        elif op < 0.85:
+                            reg.promote_when_clear()
+                        else:
+                            reg.resident_bytes()
+                            reg.describe()
+                    except (AdmissionError, TenantUnknown):
+                        pass  # typed refusals are the contract
+                    except Exception as exc:  # noqa: BLE001
+                        errors.append(exc)
+                        return
+                    if rng.random() < 0.25:
+                        time.sleep(0)  # seeded yield point
+
+            _run_threads([lambda s=i: worker(SEED + s)
+                          for i in range(THREADS)])
+            assert not errors, errors
+            sanitize.assert_no_lock_cycles()
+            sanitize.assert_no_held_lock_blocking()
+            # accounting invariant: the gauge the evictor trusts equals
+            # the surviving residents' bytes, via the public surface
+            resident = [t for t in reg.tenants()
+                        if t.state in ("warming", "serving", "degraded")]
+            assert reg.resident_bytes() == sum(t.size_bytes
+                                               for t in resident)
+            assert reg.resident_bytes() <= reg.usable_bytes
+
+
+# ---------------------------------------------------------------------------
+# server fuzz
+# ---------------------------------------------------------------------------
+
+class TestServerFuzz:
+    def test_submit_stop_leaves_no_unresolved_future(self, flat_index):
+        """Submitters race a drain-stop: every future handed out is
+        resolved (result or typed shed), and the lock order across
+        batcher/registry/metrics stays acyclic."""
+        with sanitize.force_lock_tracking():
+            reg = serve.IndexRegistry(budget_bytes=1 << 30)
+            reg.admit("t", flat_index, params=FLAT_PARAMS, default_k=10)
+            server = serve.MicroBatchServer(reg, serve.ServerConfig(
+                max_batch=4, queue_depth=64, linger_s=0.001,
+                drain_s=2.0))
+            server.start(warmup=True)
+            futures = []
+            fut_lock = threading.Lock()
+            rng0 = np.random.default_rng(SEED)
+            queries = rng0.random((THREADS, 24, DIM), dtype=np.float32)
+
+            def submitter(idx):
+                rng = random.Random(SEED + idx)
+                for j in range(24):
+                    try:
+                        fut = server.submit("t", queries[idx, j])
+                    except ShedError:
+                        continue  # typed refusal, nothing dangling
+                    with fut_lock:
+                        futures.append(fut)
+                    if rng.random() < 0.3:
+                        time.sleep(0)
+
+            threads = [threading.Thread(target=submitter, args=(i,),
+                                        daemon=True)
+                       for i in range(THREADS)]
+            for t in threads:
+                t.start()
+            # stop mid-flood: drain resolves queued work, the post-join
+            # sweep sheds the rest — zero unresolved futures either way
+            time.sleep(0.05)
+            server.stop(drain=True)
+            for t in threads:
+                t.join(timeout=60)
+            assert not any(t.is_alive() for t in threads)
+            # anything submitted after stop() was shed at submit();
+            # everything that got a future must be resolved
+            unresolved = [f for f in futures if not f.done()]
+            assert not unresolved, f"{len(unresolved)} unresolved"
+            ok = sum(1 for f in futures if f.exception() is None)
+            assert ok > 0, "drain resolved nothing — fuzz proved nothing"
+            sanitize.assert_no_lock_cycles()
+            sanitize.assert_no_held_lock_blocking()
+
+
+# ---------------------------------------------------------------------------
+# the detectors themselves (negative controls)
+# ---------------------------------------------------------------------------
+
+class TestLockOrderTracker:
+    def test_seeded_ab_ba_deadlock_is_caught(self):
+        """The CI-lane negative control: an AB/BA inversion that never
+        actually deadlocks in this run still raises, with both witness
+        stacks in the message."""
+        with sanitize.force_lock_tracking():
+            a = sanitize.monitored_lock("seeded.A")
+            b = sanitize.monitored_lock("seeded.B")
+            with a:
+                with b:
+                    pass
+
+            def inverted():
+                with b:
+                    with a:
+                        pass
+
+            t = threading.Thread(target=inverted, daemon=True)
+            t.start()
+            t.join()
+            with pytest.raises(sanitize.LockOrderViolation) as ei:
+                sanitize.assert_no_lock_cycles()
+            msg = str(ei.value)
+            assert "seeded.A" in msg and "seeded.B" in msg
+            assert "held at" in msg and "acquired at" in msg
+
+    def test_blocking_while_held_is_caught(self):
+        with sanitize.force_lock_tracking():
+            lock = sanitize.monitored_lock("seeded.registry")
+            with lock:
+                with sanitize.blocking_region("queue.get"):
+                    pass
+            with pytest.raises(sanitize.HeldLockBlockingCall) as ei:
+                sanitize.assert_no_held_lock_blocking()
+            assert "queue.get" in str(ei.value)
+            assert "seeded.registry" in str(ei.value)
+
+    def test_blocking_with_nothing_held_is_quiet(self):
+        with sanitize.force_lock_tracking():
+            with sanitize.blocking_region("queue.get"):
+                pass
+            sanitize.assert_no_held_lock_blocking()
+
+    def test_rlock_reentrancy_is_not_an_edge(self):
+        with sanitize.force_lock_tracking():
+            r = sanitize.monitored_rlock("seeded.R")
+            with r:
+                with r:
+                    pass
+            assert sanitize.lock_order_edges() == {}
+            sanitize.assert_no_lock_cycles()
+
+    def test_condition_wait_strips_held_entries(self):
+        """A waiter parked in cond.wait() does not 'hold' its lock: the
+        notifier's acquisitions inside the wait window record no edge
+        against the waiter."""
+        with sanitize.force_lock_tracking():
+            cond = sanitize.monitored_condition("seeded.C")
+            other = sanitize.monitored_lock("seeded.other")
+            woke = []
+
+            def waiter():
+                with cond:
+                    while not woke:
+                        cond.wait(timeout=5)
+
+            t = threading.Thread(target=waiter, daemon=True)
+            t.start()
+            time.sleep(0.05)
+            with other:
+                pass  # no monitored lock held here → no edge
+            with cond:
+                woke.append(1)
+                cond.notify_all()
+            t.join(timeout=10)
+            assert not t.is_alive()
+            sanitize.assert_no_lock_cycles()
+
+    def test_counters_and_edges_are_observable(self):
+        with sanitize.force_lock_tracking():
+            a = sanitize.monitored_lock("seeded.outer")
+            b = sanitize.monitored_lock("seeded.inner")
+            with a:
+                with b:
+                    pass
+            edges = sanitize.lock_order_edges()
+            assert ("seeded.outer", "seeded.inner") in edges
+            held_at, got_at = edges[("seeded.outer", "seeded.inner")]
+            assert "test_concurrency" in held_at
+            assert "test_concurrency" in got_at
+            counts = sanitize.lock_tracker_counts()
+            assert counts["sanitize.lock.acquire"] == 2
+            sanitize.reset_lock_tracker()
+            assert sanitize.lock_order_edges() == {}
+            assert sanitize.lock_tracker_counts() == {}
+
+    def test_factories_match_lane(self):
+        """Off the sanitize lane the factories return plain stdlib
+        primitives (zero wrapper); on it, monitored wrappers."""
+        lock = sanitize.monitored_lock("lane.check")
+        if sanitize.lock_tracking_enabled():
+            assert type(lock).__name__ == "_MonitoredLock"
+        else:
+            assert isinstance(lock, type(threading.Lock()))
+        with sanitize.force_lock_tracking():
+            forced = sanitize.monitored_lock("lane.forced")
+            assert type(forced).__name__ == "_MonitoredLock"
+
+
+# ---------------------------------------------------------------------------
+# lane closer — keep this test LAST in the module (and list this module
+# last on the sanitize lane's pytest command line)
+# ---------------------------------------------------------------------------
+
+def test_zz_no_lock_cycles_after_suite():
+    """Asserts over the PROCESS-WIDE tracker: in the sanitize lane every
+    serve/quality/tiered test before this point recorded its real lock
+    acquisitions here, and none of them may have produced a cycle or a
+    blocking-while-held. Off the lane the graph is empty and this is
+    vacuously green."""
+    sanitize.assert_no_lock_cycles()
+    sanitize.assert_no_held_lock_blocking()

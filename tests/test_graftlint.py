@@ -1044,12 +1044,375 @@ def test_every_rule_has_a_suppressible_finding():
                  '    return _pk.gather_refine_topk(ds, q, cand, k, "l2")',
                  '    return _pk.gather_refine_topk(ds, q, cand, k, "l2")'
                  "  # graftlint: disable=GL15"),
+        "GL16": (GL16_BAD, "        return self._total",
+                 "        return self._total"
+                 "  # graftlint: disable=GL16"),
+        "GL17": (GL17_BAD, "    t = threading.Thread(target=fn)",
+                 "    t = threading.Thread(target=fn)"
+                 "  # graftlint: disable=GL17"),
+        "GL18": (GL18_BAD, "    _tls.tenant = name",
+                 "    _tls.tenant = name  # graftlint: disable=GL18"),
+        "GL19": (GL19_BAD, '    logging.error("dumped")',
+                 '    logging.error("dumped")'
+                 "  # graftlint: disable=GL19"),
+        "GL20": (GL20_BAD,
+                 "def run_one(job):\n    fut = Future()",
+                 "def run_one(job):\n"
+                 "    fut = Future()  # graftlint: disable=GL20"),
     }
     for rule, (src, old, new) in cases.items():
         before = [f for f in lint(src) if f.rule == rule]
         after = [f for f in lint(src.replace(old, new)) if f.rule == rule]
         assert len(after) == len(before) - 1, rule
 
+
+
+# ---------------------------------------------------------------------------
+# GL16 — lock discipline
+# ---------------------------------------------------------------------------
+
+GL16_BAD = """
+import threading
+
+class Registry:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._items = {}
+        self._total = 0
+
+    def add(self, k, v):
+        with self._lock:
+            self._items[k] = v
+            self._total += 1
+
+    def size(self):
+        return self._total
+
+    def drop(self, k):
+        self._items.pop(k, None)
+"""
+
+GL16_GOOD = """
+import threading
+
+class Registry:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._items = {}
+        self.budget = 100
+        self._name = "r"
+
+    def add(self, k, v):
+        with self._lock:
+            self._items[k] = v
+            self._grow_locked(k)
+
+    def _grow_locked(self, k):
+        self._items[k] = k
+
+    def describe(self):
+        return self._name, self.budget
+
+    def busiest(self):
+        with self._lock:
+            return max(self._items, key=lambda k: self._items[k])
+"""
+
+
+def test_gl16_fires_on_unlocked_access_to_guarded_state():
+    findings = [f for f in lint(GL16_BAD) if f.rule == "GL16"]
+    assert len(findings) == 2
+    assert any("_total" in f.message and "size" in f.message
+               for f in findings)
+    assert any("_items" in f.message and "drop" in f.message
+               for f in findings)
+
+
+def test_gl16_quiet_on_locked_helpers_constants_and_lambdas():
+    """Public attrs, read-only-after-__init__ attrs, the locked-helper
+    fixpoint, and inline lambdas inside a locked scope all stay quiet."""
+    assert not [f for f in lint(GL16_GOOD) if f.rule == "GL16"]
+
+
+# ---------------------------------------------------------------------------
+# GL17 — thread lifecycle
+# ---------------------------------------------------------------------------
+
+GL17_BAD = """
+import queue
+import threading
+
+def fire_and_forget(fn):
+    t = threading.Thread(target=fn)
+    t.start()
+    return t
+
+class Pump:
+    def __init__(self, q):
+        self._q = q
+        self._thread = threading.Thread(target=self._run, daemon=True)
+        self._thread.start()
+
+    def _run(self):
+        while True:
+            item = self._q.get()
+            if item is None:
+                break
+"""
+
+GL17_GOOD = """
+import queue
+import threading
+
+class Prefetcher:
+    def __init__(self):
+        self._q = queue.Queue(4)
+        self._stop = threading.Event()
+        self._thread = threading.Thread(target=self._run, daemon=True)
+        self._thread.start()
+
+    def _run(self):
+        while not self._stop.is_set():
+            try:
+                item = self._q.get(timeout=0.05)
+            except queue.Empty:
+                continue
+
+    def close(self):
+        self._stop.set()
+        self._thread.join(timeout=5.0)
+"""
+
+
+def test_gl17_fires_on_every_lifecycle_violation():
+    findings = [f for f in lint(GL17_BAD) if f.rule == "GL17"]
+    assert len(findings) == 3
+    msgs = " | ".join(f.message for f in findings)
+    assert "daemon=" in msgs
+    assert "close()/stop()" in msgs
+    assert "stop flag" in msgs
+
+
+def test_gl17_quiet_on_the_prefetcher_idiom():
+    """The shipped ChunkPrefetcher/RowPrefetcher shape: daemon reader,
+    stop event checked per iteration, timeout on the blocking get, and
+    an owner close() that sets + joins."""
+    assert not [f for f in lint(GL17_GOOD) if f.rule == "GL17"]
+
+
+# ---------------------------------------------------------------------------
+# GL18 — thread-local context hygiene
+# ---------------------------------------------------------------------------
+
+GL18_BAD = """
+import threading
+
+_tls = threading.local()
+
+def set_tenant(name):
+    _tls.tenant = name
+    do_work()
+"""
+
+GL18_GOOD = """
+import threading
+
+_tls = threading.local()
+
+class tenant_scope:
+    def __init__(self, name):
+        self._name = name
+
+    def __enter__(self):
+        self._prev = getattr(_tls, "tenant", None)
+        _tls.tenant = self._name
+        return self
+
+    def __exit__(self, *exc):
+        _tls.tenant = self._prev
+
+def install_tenant(name):
+    prev = getattr(_tls, "tenant", None)
+    _tls.tenant = name
+    return prev
+
+def bump():
+    _tls.n = getattr(_tls, "n", 0) + 1
+
+def scoped(name):
+    prev = install_tenant(name)
+    try:
+        do_work()
+    finally:
+        _tls.tenant = prev
+"""
+
+
+def test_gl18_fires_on_unrestored_tls_write():
+    findings = [f for f in lint(GL18_BAD) if f.rule == "GL18"]
+    assert len(findings) == 1
+    assert "restore" in findings[0].message
+
+
+def test_gl18_quiet_on_the_bracket_idioms():
+    """The four shipped shapes: a CM whose __exit__ restores, the
+    save-and-return low-level setter (trace.set_request), a pure
+    self-update counter, and install + try/finally restore in one
+    function."""
+    assert not [f for f in lint(GL18_GOOD) if f.rule == "GL18"]
+
+
+# ---------------------------------------------------------------------------
+# GL19 — signal-context safety
+# ---------------------------------------------------------------------------
+
+GL19_BAD = """
+import logging
+import signal
+import threading
+
+_lock = threading.Lock()
+
+def _flush(path, payload):
+    with _lock:
+        with open(path, "w") as f:
+            f.write(payload)
+    logging.error("dumped")
+
+def _handler(num, frame):
+    _flush("/tmp/x", "payload")
+
+signal.signal(signal.SIGTERM, _handler)
+"""
+
+GL19_GOOD = """
+import logging
+import os
+import signal
+import threading
+
+_lock = threading.RLock()
+
+def _flush(path, payload):
+    with _lock:
+        tmp = path + ".tmp"
+        with open(tmp, "w") as f:
+            f.write(payload)
+        os.replace(tmp, path)
+
+def _handler(num, frame):
+    _flush("/tmp/x", "payload")
+
+signal.signal(signal.SIGTERM, _handler)
+
+def not_on_the_signal_path():
+    logging.error("fine here")
+"""
+
+
+def test_gl19_fires_on_non_reentrant_calls_on_signal_paths():
+    findings = [f for f in lint(GL19_BAD) if f.rule == "GL19"]
+    assert len(findings) == 3
+    msgs = " | ".join(f.message for f in findings)
+    assert "plain Lock" in msgs
+    assert "logging" in msgs
+    assert "torn file" in msgs
+
+
+def test_gl19_quiet_on_rlock_tmp_rename_and_unreachable_code():
+    assert not [f for f in lint(GL19_GOOD) if f.rule == "GL19"]
+
+
+# ---------------------------------------------------------------------------
+# GL20 — future resolution
+# ---------------------------------------------------------------------------
+
+GL20_BAD = """
+from concurrent.futures import Future
+
+def run_one(job):
+    fut = Future()
+    if job.ready:
+        fut.set_result(job.run())
+    fut.result()
+
+def run_two(job):
+    fut = Future()
+    try:
+        fut.set_result(job.run())
+    except KeyError:
+        pass
+    fut.result()
+"""
+
+GL20_GOOD = """
+from concurrent.futures import Future
+
+def handoff(work, q):
+    fut = Future()
+    q.put((work, fut))
+    return fut
+
+def branches(job):
+    fut = Future()
+    if job.ready:
+        fut.set_result(job.run())
+    else:
+        fut.set_exception(RuntimeError("not ready"))
+    return fut
+
+def guarded(job):
+    fut = Future()
+    try:
+        fut.set_result(job.run())
+    except Exception as exc:
+        fut.set_exception(exc)
+    return fut
+"""
+
+
+def test_gl20_fires_on_paths_that_never_resolve():
+    findings = [f for f in lint(GL20_BAD) if f.rule == "GL20"]
+    assert len(findings) == 2
+    assert all("every path" in f.message for f in findings)
+
+
+def test_gl20_quiet_on_handoff_and_all_path_resolution():
+    """A future that ESCAPES (queued/returned for a consumer to
+    resolve — the server submit() handoff) is the consumer's contract;
+    if/else and try/except shapes that resolve every path are quiet."""
+    assert not [f for f in lint(GL20_GOOD) if f.rule == "GL20"]
+
+
+# ---------------------------------------------------------------------------
+# --jobs parallel analysis
+# ---------------------------------------------------------------------------
+
+def test_jobs_parallel_matches_sequential():
+    """--jobs fans per-file analysis over a process pool; the merged,
+    sorted finding set must be byte-identical to the sequential run."""
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    target = os.path.join(root, "tools", "graftlint")
+    seq = graftlint.lint_paths([target])
+    par = graftlint.lint_paths([target], jobs=2)
+    assert [f.render() for f in par] == [f.render() for f in seq]
+
+
+def test_cli_jobs_flag(tmp_path):
+    bad = tmp_path / "raft_tpu_mod.py"
+    bad.write_text(GL16_BAD)
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    p = subprocess.run(
+        [sys.executable, "-m", "tools.graftlint", str(bad), "--jobs", "2",
+         "--format", "json"],
+        capture_output=True, text=True, cwd=root)
+    assert p.returncode == 1
+    rows = json.loads(p.stdout)
+    assert [r["rule"] for r in rows] == ["GL16", "GL16"]
+    p = subprocess.run(
+        [sys.executable, "-m", "tools.graftlint", str(bad), "--jobs", "-2"],
+        capture_output=True, text=True, cwd=root)
+    assert p.returncode == 2
 
 # ---------------------------------------------------------------------------
 # engine / CLI

@@ -56,6 +56,16 @@ entries at n ≥ 2³¹ synthetic shapes — is
 :func:`raft_tpu.obs.sanitize.assert_billion_safe` /
 ``tools/capacity_prove.py``.
 
+Concurrency rules (GL16–GL20, :mod:`tools.graftlint.concurrency`) —
+the threading pass over the serving plane: per-class lock discipline
+(GL16), thread lifecycle/shutdown reachability (GL17), thread-local
+context save/restore brackets (GL18), signal-handler reachability of
+non-reentrant calls (GL19), and all-paths resolution of owned
+``concurrent.futures.Future``\\ s (GL20). The runtime complement — the
+lock-order tracker and held-lock-blocking detector for interleavings
+the AST cannot see — is :func:`raft_tpu.obs.sanitize.monitored_lock` /
+:func:`raft_tpu.obs.sanitize.assert_no_lock_cycles`.
+
 Suppression
 -----------
 
@@ -106,6 +116,15 @@ RULES: Dict[str, str] = {
     "GL14": "Pallas per-grid-step VMEM/SMEM budget exceeded",
     "GL15": "Pallas streaming-tier dispatch without a *_mem_ok/"
             "*_kernel_ok admission guard",
+    "GL16": "lock discipline (unlocked access to state the class lock "
+            "guards elsewhere)",
+    "GL17": "thread lifecycle (no daemon= / no reachable join or stop "
+            "event / blocking get without timeout in a thread target)",
+    "GL18": "thread-local context set without a save/restore bracket",
+    "GL19": "non-reentrant call (plain Lock / logging / torn file "
+            "write) reachable from a signal handler",
+    "GL20": "owned concurrent.futures.Future not resolved on every "
+            "path",
 }
 
 # GL02: string literals that mark an env read as *flag* parsing (vs a
@@ -203,6 +222,22 @@ class _Parents(ast.NodeVisitor):
         for child in ast.iter_child_nodes(node):
             self.parent[child] = node
             self._walk(child)
+
+
+def cached_walk(node: ast.AST) -> Tuple[ast.AST, ...]:
+    """``ast.walk`` memoized on the node — the shared-AST walk. All 20
+    rules across the four rule modules traverse the same parsed tree;
+    caching the full-tree traversal once per file (instead of one
+    ``cached_walk(tree)`` per check) is what makes a 20-rule pass cost the
+    same tree walk as a 5-rule one."""
+    cached = getattr(node, "_graftlint_walk", None)
+    if cached is None:
+        cached = tuple(ast.walk(node))
+        try:
+            node._graftlint_walk = cached  # type: ignore[attr-defined]
+        except AttributeError:
+            pass
+    return cached
 
 
 def _dotted(node: ast.AST) -> str:
@@ -415,7 +450,7 @@ def _in_bool_context(node: ast.AST, parents: _Parents) -> bool:
 
 def _check_gl02(tree: ast.Module, parents: _Parents, add) -> None:
     env_gets: List[ast.Call] = [
-        n for n in ast.walk(tree)
+        n for n in cached_walk(tree)
         if isinstance(n, ast.Call)
         and _dotted(n.func) in ("os.environ.get", "environ.get")
     ]
@@ -441,7 +476,7 @@ def _check_gl02(tree: ast.Module, parents: _Parents, add) -> None:
         if call not in flagged and _in_bool_context(call, parents):
             flagged.add(call)
     # assigned names later compared against flag vocabulary
-    for cmp in ast.walk(tree):
+    for cmp in cached_walk(tree):
         if not isinstance(cmp, ast.Compare) or not _compare_against_flags(cmp):
             continue
         for part in [cmp.left] + list(cmp.comparators):
@@ -538,7 +573,7 @@ def _check_gl04(tree: ast.Module, path: str, add) -> None:
 
 def _check_gl05(tree: ast.Module, fns: Sequence[_FnCtx], add) -> None:
     env = _const_env(tree)
-    for node in ast.walk(tree):
+    for node in cached_walk(tree):
         if not isinstance(node, ast.Call):
             continue
         callee = _dotted(node.func)
@@ -603,7 +638,7 @@ def lint_source(source: str, path: str = "<string>",
     # on the previous statement never leaks into the next function).
     fn_ranges: List[Tuple[int, int, Set[str]]] = []
     if suppress_fn:
-        for node in ast.walk(tree):
+        for node in cached_walk(tree):
             if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
                 dec_start = min([d.lineno for d in node.decorator_list]
                                 + [node.lineno])
@@ -632,7 +667,7 @@ def lint_source(source: str, path: str = "<string>",
                                 getattr(node, "col_offset", 0) + 1,
                                 rule, message))
 
-    fns = [_classify(n) for n in ast.walk(tree)
+    fns = [_classify(n) for n in cached_walk(tree)
            if isinstance(n, ast.FunctionDef)]
     for fn in fns:
         if fn.hot:
@@ -643,16 +678,32 @@ def lint_source(source: str, path: str = "<string>",
     _check_gl05(tree, fns, add)
     from tools.graftlint import spmd  # deferred: spmd imports helpers
     from tools.graftlint import capacity as _capacity
+    from tools.graftlint import concurrency as _concurrency
 
     spmd.check(tree, parents, path, add)
     _capacity.check(tree, parents, path, add)
+    _concurrency.check(tree, parents, path, add)
     findings.sort(key=lambda f: (f.path, f.line, f.col, f.rule))
     return findings
 
 
+def _lint_file(args: Tuple[str, Optional[Set[str]]]) -> List[Finding]:
+    """One file's findings — module-level so multiprocessing workers
+    can pickle it (``--jobs``)."""
+    path, select = args
+    with open(path, encoding="utf-8") as fh:
+        return lint_source(fh.read(), path=path, select=select)
+
+
 def lint_paths(paths: Iterable[str],
-               select: Optional[Set[str]] = None) -> List[Finding]:
-    """Lint files / package trees; returns all unsuppressed findings."""
+               select: Optional[Set[str]] = None,
+               jobs: int = 1) -> List[Finding]:
+    """Lint files / package trees; returns all unsuppressed findings.
+
+    ``jobs`` > 1 fans the per-file analysis out over a process pool
+    (files are independent — one parse + one shared walk each); 0 means
+    one worker per CPU. Findings come back in the same deterministic
+    (path, line, col, rule) order either way."""
     files: List[str] = []
     for p in paths:
         if os.path.isdir(p):
@@ -666,10 +717,20 @@ def lint_paths(paths: Iterable[str],
         else:
             raise FileNotFoundError(f"graftlint: not a .py file or "
                                     f"directory: {p}")
+    if jobs == 0:
+        jobs = os.cpu_count() or 1
     findings: List[Finding] = []
-    for f in files:
-        with open(f, encoding="utf-8") as fh:
-            findings += lint_source(fh.read(), path=f, select=select)
+    if jobs > 1 and len(files) > 1:
+        import multiprocessing
+
+        with multiprocessing.Pool(min(jobs, len(files))) as pool:
+            for batch in pool.map(_lint_file,
+                                  [(f, select) for f in files]):
+                findings += batch
+    else:
+        for f in files:
+            findings += _lint_file((f, select))
+    findings.sort(key=lambda f: (f.path, f.line, f.col, f.rule))
     return findings
 
 
@@ -806,8 +867,15 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
     ap.add_argument("--update-baseline", action="store_true",
                     help="with --baseline: record the current findings "
                          "as the new baseline and exit 0")
+    ap.add_argument("--jobs", type=int, default=1, metavar="N",
+                    help="analyze files on N worker processes (0 = one "
+                         "per CPU; default 1 = in-process)")
     ap.add_argument("--list-rules", action="store_true")
     args = ap.parse_args(argv)
+
+    if args.jobs < 0:
+        print("graftlint: --jobs must be >= 0", file=sys.stderr)
+        return 2
 
     if args.update_baseline and not args.baseline:
         print("graftlint: --update-baseline needs --baseline PATH",
@@ -845,9 +913,9 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
         if args.format == "human":
             print(f"graftlint: --changed → {len(targets)} file(s) in "
                   f"scope")
-        findings = lint_paths(targets, select=select)
+        findings = lint_paths(targets, select=select, jobs=args.jobs)
     else:
-        findings = lint_paths(paths, select=select)
+        findings = lint_paths(paths, select=select, jobs=args.jobs)
     baseline_matched = 0
     if args.baseline:
         if args.update_baseline:

@@ -52,7 +52,8 @@ import os
 import re
 from typing import Dict, List, Optional, Sequence, Set
 
-from tools.graftlint import _Parents, _const_env, _const_int, _dotted
+from tools.graftlint import _Parents, _const_env, _const_int, _dotted, \
+    cached_walk
 
 # GL11: names that look like dataset-row-scale quantities (row counts,
 # shard geometry). Deliberately narrow — `k`, `dim`, tile widths and
@@ -166,7 +167,7 @@ def _has_sizeish_product(expr: ast.AST) -> bool:
 
 
 def _check_gl11(tree: ast.Module, parents: _Parents, add) -> None:
-    for node in ast.walk(tree):
+    for node in cached_walk(tree):
         # (a) hard-int32 global-id arithmetic: an int32-cast operand
         # combined (+/-) with a size-like product, in an id context
         if isinstance(node, ast.BinOp) \
@@ -225,7 +226,7 @@ def _is_narrow_cast(node: ast.AST) -> bool:
 
 
 def _check_gl12(tree: ast.Module, add) -> None:
-    for fn in [n for n in ast.walk(tree)
+    for fn in [n for n in cached_walk(tree)
                if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))]:
         # names bound to narrow-cast values inside this function
         narrow_names: Set[str] = set()
@@ -305,7 +306,7 @@ def _where_guards(call: ast.Call, name: str) -> bool:
 
 
 def _check_gl13(tree: ast.Module, parents: _Parents, add) -> None:
-    for node in ast.walk(tree):
+    for node in cached_walk(tree):
         # (a) float ±inf sentinel poured into an id-array where-branch
         if isinstance(node, ast.Call) and node.func is not None \
                 and _dotted(node.func).split(".")[-1] == "where" \
@@ -324,7 +325,7 @@ def _check_gl13(tree: ast.Module, parents: _Parents, add) -> None:
                             "use the -1 integer sentinel")
                         break
     # (b) unguarded arithmetic on a -1-sentinel-bearing name
-    for fn in [n for n in ast.walk(tree)
+    for fn in [n for n in cached_walk(tree)
                if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))]:
         sentinel_names: Set[str] = set()
         for stmt in ast.walk(fn):
@@ -414,7 +415,7 @@ def _scratch_bytes(call: ast.Call, env: Dict[str, int]) -> Optional[int]:
 
 def _check_gl14(tree: ast.Module, add) -> None:
     env = _const_env(tree)
-    for fn in [n for n in ast.walk(tree)
+    for fn in [n for n in cached_walk(tree)
                if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))]:
         has_pallas_call = any(
             isinstance(c, ast.Call) and c.func is not None
@@ -472,11 +473,11 @@ def _check_gl15(tree: ast.Module, path: str, add) -> None:
     norm = path.replace(os.sep, "/")
     if "raft_tpu/" not in norm or norm.endswith("ops/pallas_kernels.py"):
         return
-    defined = {n.name for n in ast.walk(tree)
+    defined = {n.name for n in cached_walk(tree)
                if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))}
     kernel_calls = []
     has_guard = False
-    for call in ast.walk(tree):
+    for call in cached_walk(tree):
         if not isinstance(call, ast.Call) or call.func is None:
             continue
         tail = _dotted(call.func).split(".")[-1]

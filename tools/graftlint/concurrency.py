@@ -1,0 +1,787 @@
+"""graftlint concurrency rules (GL16–GL20) — the threading pass.
+
+The host serving plane (PRs 14–17) is a real multi-threaded program:
+batcher threads, prefetcher readers, the recall verifier, the SLO
+monitor, signal-handler flight dumps, and a lock-protected multi-tenant
+registry. Every concurrency bug so far was found by hand in review
+(the PR-9 FaultPlan signal-deadlock, the PR-14 registry race hardening,
+the PR-16 SIGINT test race) — these rules make those bug classes
+mechanical. The runtime complement — the lock-order tracker and
+held-lock-blocking detector the AST cannot see — lives in
+:mod:`raft_tpu.obs.sanitize` (``monitored_lock`` /
+``assert_no_lock_cycles``).
+
+GL16  lock discipline: a class whose ``self._lock`` guards SOME
+      accesses to an attribute must guard ALL of them. Per-class
+      fixpoint: accesses inside ``with self._lock:`` scopes (or inside
+      helper methods only ever called with the lock held) are guarded;
+      a bare read/write of the same mutated attribute elsewhere is the
+      unlocked-peek race. Exempt: attributes never written outside
+      ``__init__`` (immutable config), ``_``-free public attributes
+      (documented constants), and the lock objects themselves.
+GL17  thread lifecycle: ``threading.Thread(...)`` without an explicit
+      ``daemon=`` (an implicit non-daemon thread wedges interpreter
+      shutdown), a thread stored on ``self`` whose owner class has no
+      ``close()``/``stop()``/``shutdown()`` that joins it or sets a
+      stop event, and a thread-target loop draining a queue with a
+      bare blocking ``.get()`` (no ``timeout=``) — the reader that can
+      never observe its stop flag. The shipped idiom
+      (``while not self._stop.is_set(): q.get(timeout=0.05)``) stays
+      quiet.
+GL18  thread-local/context hygiene: a ``threading.local()`` attribute
+      set without a restore path leaks context across requests on a
+      pooled thread. Quiet forms are exactly the shipped brackets:
+      writes in ``__exit__``/``finally`` (the restore itself), writes
+      in a context-manager class whose ``__exit__`` restores the same
+      slot (``serving_tenant`` / ``quality_gate``), save-and-return
+      low-level setters (``trace.set_request``), and pure self-updates
+      (``tls.n = getattr(tls, "n", 0) + 1`` counters).
+GL19  signal-context safety: non-reentrant calls reachable from a
+      registered signal handler via the module-local call-graph
+      fixpoint — acquiring a plain (non-reentrant) ``threading.Lock``
+      (the PR-9 deadlock: the signal lands on the thread already
+      holding it), stdlib/`core.logging` emission (logging takes its
+      own module lock), and file writes outside the tmp+``os.replace``
+      idiom (a torn write is worse than none). RLock/monitored_rlock
+      and the atomic-rename dump path stay quiet.
+GL20  future resolution: a function that OWNS a
+      ``concurrent.futures.Future`` (it created one and never handed
+      it off — no enqueue, no return, no callback registration) must
+      resolve it (``set_result``/``set_exception``/``cancel``) on
+      every path — the PR-14 "no future left unresolved" invariant.
+      Handing the future off (the server's submit → batch-loop
+      pattern) transfers the obligation and stays quiet.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Sequence, Set, Tuple
+
+from tools.graftlint import _Parents, _dotted, cached_walk
+
+# attribute factories that create a lock-like object. "plain" locks are
+# non-reentrant (GL19 flags them in signal paths); "reentrant" are safe
+# there; Condition wraps an RLock by default and the repo's explicit
+# Condition(self._lock) sites guard the same state as the lock they
+# wrap, so either way entering it counts as holding the guard.
+_PLAIN_LOCKS = ("threading.Lock", "Lock", "monitored_lock")
+_REENTRANT_LOCKS = ("threading.RLock", "RLock", "monitored_rlock")
+_CONDITIONS = ("threading.Condition", "Condition", "monitored_condition")
+
+# method names that mutate a container in place — calling one on a
+# self attribute counts as a WRITE of that attribute for GL16
+_MUTATORS = {
+    "append", "appendleft", "extend", "insert", "pop", "popleft",
+    "popitem", "remove", "discard", "clear", "update", "add",
+    "setdefault", "put", "put_nowait",
+}
+
+_LOG_METHODS = {"debug", "info", "warning", "warn", "error", "exception",
+                "critical", "log", "fatal"}
+
+
+def _lock_kind(node: ast.AST) -> Optional[str]:
+    """'plain' / 'reentrant' / 'condition' when ``node`` constructs a
+    lock-like object, else None. Recognizes both raw ``threading.*``
+    constructors and the sanitizer's ``monitored_*`` factories."""
+    if not isinstance(node, ast.Call):
+        return None
+    callee = _dotted(node.func)
+    leaf = callee.rsplit(".", 1)[-1]
+    if callee in _PLAIN_LOCKS or leaf == "monitored_lock":
+        return "plain"
+    if callee in _REENTRANT_LOCKS or leaf == "monitored_rlock":
+        return "reentrant"
+    if callee in _CONDITIONS or leaf == "monitored_condition":
+        return "condition"
+    return None
+
+
+def _self_attr(node: ast.AST) -> Optional[str]:
+    """'X' when ``node`` is ``self.X`` (or ``_self.X`` — the bound-
+    default convention signal handlers use), else None."""
+    if isinstance(node, ast.Attribute) and isinstance(node.value, ast.Name) \
+            and node.value.id in ("self", "_self"):
+        return node.attr
+    return None
+
+
+# ---------------------------------------------------------------------------
+# GL16 — lock discipline
+# ---------------------------------------------------------------------------
+
+class _Access:
+    __slots__ = ("attr", "node", "locked", "write", "method")
+
+    def __init__(self, attr, node, locked, write, method):
+        self.attr = attr
+        self.node = node
+        self.locked = locked
+        self.write = write
+        self.method = method
+
+
+def _scan_method(method: ast.FunctionDef, lock_attrs: Set[str],
+                 accesses: List[_Access],
+                 calls: List[Tuple[str, bool]]) -> None:
+    """Collect self-attribute accesses and self-method call sites in one
+    method, each tagged with whether a ``with self.<lock>:`` scope is
+    held at that point. Nested defs reset the flag — a closure handed to
+    a Thread runs on another stack, where the creator's lock is NOT
+    held."""
+
+    def visit(node: ast.AST, locked: bool) -> None:
+        # nested defs reset the flag (a closure handed to a Thread runs
+        # on another stack); inline lambdas (sort keys etc.) run at the
+        # point of use and KEEP it
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)) \
+                and node is not method:
+            for child in node.body:
+                visit(child, False)
+            return
+        if isinstance(node, (ast.With, ast.AsyncWith)):
+            takes_lock = any(_self_attr(item.context_expr) in lock_attrs
+                             for item in node.items)
+            for item in node.items:
+                visit(item.context_expr, locked)
+                if item.optional_vars is not None:
+                    visit(item.optional_vars, locked)
+            for child in node.body:
+                visit(child, locked or takes_lock)
+            return
+        attr = _self_attr(node)
+        if attr is not None and attr not in lock_attrs:
+            write = isinstance(node.ctx, (ast.Store, ast.Del))
+            accesses.append(_Access(attr, node, locked, write, method.name))
+        if isinstance(node, ast.Subscript) \
+                and isinstance(node.ctx, (ast.Store, ast.Del)):
+            base = _self_attr(node.value)
+            if base is not None and base not in lock_attrs:
+                # self._d[k] = v mutates _d even though the Attribute
+                # itself is a Load
+                accesses.append(_Access(base, node, locked, True,
+                                        method.name))
+        if isinstance(node, ast.Call) and isinstance(node.func,
+                                                     ast.Attribute):
+            base_attr = _self_attr(node.func.value)
+            if node.func.attr in _MUTATORS and base_attr is not None \
+                    and base_attr not in lock_attrs:
+                # self._pending.append(...) mutates _pending in place
+                accesses.append(_Access(base_attr, node, locked, True,
+                                        method.name))
+            callee_attr = _self_attr(node.func)
+            if callee_attr is not None:
+                calls.append((callee_attr, locked))
+        for child in ast.iter_child_nodes(node):
+            visit(child, locked)
+
+    for stmt in method.body:
+        visit(stmt, False)
+
+
+def _check_gl16(cls: ast.ClassDef, add) -> None:
+    methods = {n.name: n for n in cls.body
+               if isinstance(n, ast.FunctionDef)}
+    init = methods.get("__init__")
+    if init is None:
+        return
+    # lock-like attributes assigned in __init__ (self._lock, self._cond)
+    lock_attrs: Set[str] = set()
+    for node in ast.walk(init):
+        if isinstance(node, ast.Assign) and len(node.targets) == 1:
+            attr = _self_attr(node.targets[0])
+            if attr is not None and _lock_kind(node.value) is not None:
+                lock_attrs.add(attr)
+    if not lock_attrs:
+        return
+
+    per_method_accesses: Dict[str, List[_Access]] = {}
+    # method → list of (locked_at_site, caller) for every self.m() call
+    call_sites: Dict[str, List[Tuple[bool, str]]] = {}
+    for name, m in methods.items():
+        accesses: List[_Access] = []
+        calls: List[Tuple[str, bool]] = []
+        _scan_method(m, lock_attrs, accesses, calls)
+        if name != "__init__":
+            per_method_accesses[name] = accesses
+        for callee, locked in calls:
+            call_sites.setdefault(callee, []).append((locked, name))
+
+    # fixpoint: a helper only ever invoked with the lock held runs in a
+    # locked context (registry's _evict_candidates pattern)
+    locked_methods: Set[str] = set()
+    changed = True
+    while changed:
+        changed = False
+        for name in methods:
+            if name in locked_methods or name == "__init__":
+                continue
+            sites = call_sites.get(name, ())
+            if sites and all(locked or caller in locked_methods
+                             for locked, caller in sites):
+                locked_methods.add(name)
+                changed = True
+
+    def effective(a: _Access) -> bool:
+        return a.locked or a.method in locked_methods
+
+    all_accesses = [a for accs in per_method_accesses.values() for a in accs]
+    mutated = {a.attr for a in all_accesses if a.write}
+    guarded = {a.attr for a in all_accesses
+               if effective(a) and a.attr in mutated}
+    seen: Set[Tuple[str, str]] = set()
+    for a in all_accesses:
+        if a.attr not in guarded or effective(a):
+            continue
+        if not a.attr.startswith("_"):
+            continue  # public attrs are documented constants/config
+        key = (a.method, a.attr)
+        if key in seen:
+            continue
+        seen.add(key)
+        add(a.node, "GL16",
+            f"unlocked access to self.{a.attr} in {cls.name}.{a.method} "
+            f"— other accesses hold the class lock; take the lock or a "
+            "locked snapshot (GL16 lock discipline)")
+
+
+# ---------------------------------------------------------------------------
+# GL17 — thread lifecycle
+# ---------------------------------------------------------------------------
+
+def _is_thread_ctor(node: ast.AST) -> bool:
+    if not isinstance(node, ast.Call):
+        return False
+    callee = _dotted(node.func)
+    return callee in ("threading.Thread", "Thread")
+
+
+def _owner_has_shutdown(cls: ast.ClassDef, thread_attr: str) -> bool:
+    """True when some close()/stop()/shutdown()/__exit__ either joins
+    ``self.<thread_attr>`` or sets a stop event / clears a run flag /
+    notifies a condition — any reachable way to end the thread."""
+    for node in cls.body:
+        if not isinstance(node, ast.FunctionDef):
+            continue
+        if node.name not in ("close", "stop", "shutdown", "__exit__",
+                             "__del__"):
+            continue
+        for sub in ast.walk(node):
+            if isinstance(sub, ast.Call) and isinstance(sub.func,
+                                                        ast.Attribute):
+                base = _self_attr(sub.func.value)
+                if sub.func.attr == "join" and base == thread_attr:
+                    return True
+                if sub.func.attr in ("set", "notify", "notify_all") \
+                        and base is not None:
+                    return True
+            if isinstance(sub, ast.Assign):
+                if any(_self_attr(t) is not None for t in sub.targets) \
+                        and isinstance(sub.value, ast.Constant) \
+                        and sub.value.value is False:
+                    return True
+    return False
+
+
+def _thread_targets(tree: ast.Module) -> List[Tuple[ast.Call, str]]:
+    """(Thread(...) call, target name) pairs; target resolves through a
+    plain Name (nested def) or ``self.m`` (method)."""
+    out = []
+    for node in cached_walk(tree):
+        if not _is_thread_ctor(node):
+            continue
+        for kw in node.keywords:
+            if kw.arg != "target":
+                continue
+            if isinstance(kw.value, ast.Name):
+                out.append((node, kw.value.id))
+            else:
+                attr = _self_attr(kw.value)
+                if attr is not None:
+                    out.append((node, attr))
+    return out
+
+
+def _check_gl17(tree: ast.Module, parents: _Parents, add) -> None:
+    threads = [n for n in cached_walk(tree) if _is_thread_ctor(n)]
+    if not threads:
+        return
+    defs: Dict[str, List[ast.FunctionDef]] = {}
+    for node in cached_walk(tree):
+        if isinstance(node, ast.FunctionDef):
+            defs.setdefault(node.name, []).append(node)
+
+    for call in threads:
+        kwargs = {kw.arg for kw in call.keywords}
+        if "daemon" not in kwargs:
+            add(call, "GL17",
+                "threading.Thread(...) without an explicit daemon= — an "
+                "implicit non-daemon thread wedges interpreter shutdown; "
+                "say daemon=True (and still join in close()) or "
+                "daemon=False deliberately")
+        # a thread stored on self must be stoppable from close()/stop()
+        par = parents.parent.get(call)
+        if isinstance(par, ast.Assign) and len(par.targets) == 1:
+            attr = _self_attr(par.targets[0])
+            if attr is not None:
+                cls = par
+                while cls is not None and not isinstance(cls, ast.ClassDef):
+                    cls = parents.parent.get(cls)
+                if isinstance(cls, ast.ClassDef) \
+                        and not _owner_has_shutdown(cls, attr):
+                    add(call, "GL17",
+                        f"thread stored on self.{attr} but {cls.name} "
+                        "has no close()/stop()/shutdown() that joins it "
+                        "or sets a stop event — the owner must be able "
+                        "to end its thread")
+
+    # blocking .get() with no timeout inside a loop in a thread target:
+    # the reader that can never observe its stop flag
+    target_names = {name for _, name in _thread_targets(tree)}
+    for name in target_names:
+        for fn in defs.get(name, ()):
+            _flag_blocking_gets(fn, add)
+
+
+def _flag_blocking_gets(fn: ast.FunctionDef, add) -> None:
+    loops = [n for n in ast.walk(fn) if isinstance(n, (ast.While, ast.For))]
+    for loop in loops:
+        for node in ast.walk(loop):
+            if not (isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Attribute)
+                    and node.func.attr == "get"):
+                continue
+            # dict.get(key, default) and friends take positional args;
+            # a queue drain is a bare .get() / .get(block=True)
+            if node.args:
+                continue
+            kwargs = {kw.arg for kw in node.keywords}
+            if "timeout" in kwargs:
+                continue
+            add(node, "GL17",
+                f"blocking .get() with no timeout inside {fn.name}'s "
+                "loop — a thread-target reader parked here never "
+                "observes its stop flag; use .get(timeout=...) and "
+                "re-check the stop event (the prefetcher idiom)")
+
+
+# ---------------------------------------------------------------------------
+# GL18 — thread-local / context hygiene
+# ---------------------------------------------------------------------------
+
+def _tls_names(tree: ast.Module) -> Set[str]:
+    names: Set[str] = set()
+    for node in cached_walk(tree):
+        if isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                and isinstance(node.targets[0], ast.Name) \
+                and isinstance(node.value, ast.Call) \
+                and _dotted(node.value.func) in ("threading.local", "local"):
+            names.add(node.targets[0].id)
+    return names
+
+
+def _reads_slot(node: ast.AST, tls: str, attr: str) -> bool:
+    """True when the expression reads ``tls.attr`` — directly or via
+    ``getattr(tls, "attr", ...)``."""
+    for sub in ast.walk(node):
+        if isinstance(sub, ast.Attribute) and sub.attr == attr \
+                and isinstance(sub.value, ast.Name) \
+                and sub.value.id == tls \
+                and isinstance(sub.ctx, ast.Load):
+            return True
+        if isinstance(sub, ast.Call) and _dotted(sub.func) == "getattr" \
+                and len(sub.args) >= 2 \
+                and isinstance(sub.args[0], ast.Name) \
+                and sub.args[0].id == tls \
+                and isinstance(sub.args[1], ast.Constant) \
+                and sub.args[1].value == attr:
+            return True
+    return False
+
+
+def _exit_restored_slots(cls: ast.ClassDef,
+                         tls_names: Set[str]) -> Set[Tuple[str, str]]:
+    slots: Set[Tuple[str, str]] = set()
+    for node in cls.body:
+        if isinstance(node, ast.FunctionDef) and node.name == "__exit__":
+            for sub in ast.walk(node):
+                if isinstance(sub, ast.Attribute) \
+                        and isinstance(sub.ctx, ast.Store) \
+                        and isinstance(sub.value, ast.Name) \
+                        and sub.value.id in tls_names:
+                    slots.add((sub.value.id, sub.attr))
+    return slots
+
+
+def _check_gl18(tree: ast.Module, parents: _Parents, add) -> None:
+    tls = _tls_names(tree)
+    if not tls:
+        return
+    exit_slots: Dict[ast.ClassDef, Set[Tuple[str, str]]] = {}
+    for node in cached_walk(tree):
+        if isinstance(node, ast.ClassDef):
+            exit_slots[node] = _exit_restored_slots(node, tls)
+
+    for node in cached_walk(tree):
+        if not (isinstance(node, ast.Assign)
+                and len(node.targets) == 1
+                and isinstance(node.targets[0], ast.Attribute)
+                and isinstance(node.targets[0].value, ast.Name)
+                and node.targets[0].value.id in tls):
+            continue
+        name = node.targets[0].value.id
+        attr = node.targets[0].attr
+        # self-update counters (tls.n = getattr(tls, "n", 0) + 1) are
+        # not a context install
+        if _reads_slot(node.value, name, attr):
+            continue
+        # climb: finally-block restores, __exit__ bodies, the CM-class
+        # bracket, and the save-and-return low-level setter are quiet
+        fn: Optional[ast.FunctionDef] = None
+        cls: Optional[ast.ClassDef] = None
+        in_finally = False
+        cur: ast.AST = node
+        while True:
+            par = parents.parent.get(cur)
+            if par is None:
+                break
+            if isinstance(par, ast.Try) and cur in par.finalbody:
+                in_finally = True
+            if isinstance(par, ast.FunctionDef) and fn is None:
+                fn = par
+            if isinstance(par, ast.ClassDef) and cls is None:
+                cls = par
+            cur = par
+        if in_finally or (fn is not None and fn.name == "__exit__"):
+            continue
+        if cls is not None and (name, attr) in exit_slots.get(cls, ()):
+            continue  # the __enter__ half of a save/restore CM
+        if fn is not None and _saves_and_returns_prev(fn, name, attr):
+            continue  # low-level setter: prev = tls.attr; ...; return prev
+        if fn is not None and _fn_finally_restores(fn, name, attr):
+            continue  # install followed by a try/finally restore
+        add(node, "GL18",
+            f"{name}.{attr} set without a restore path — thread-local "
+            "context must be installed via a save/restore bracket "
+            "(try/finally, or a CM whose __exit__ restores it); a "
+            "pooled thread otherwise leaks this context into the next "
+            "request")
+
+
+def _fn_finally_restores(fn: ast.FunctionDef, tls: str, attr: str) -> bool:
+    """True when some ``finally:`` in ``fn`` writes ``tls.attr`` back —
+    the inline install-then-restore bracket."""
+    for sub in ast.walk(fn):
+        if not isinstance(sub, ast.Try) or not sub.finalbody:
+            continue
+        for node in sub.finalbody:
+            for inner in ast.walk(node):
+                if isinstance(inner, ast.Attribute) \
+                        and isinstance(inner.ctx, ast.Store) \
+                        and inner.attr == attr \
+                        and isinstance(inner.value, ast.Name) \
+                        and inner.value.id == tls:
+                    return True
+    return False
+
+
+def _saves_and_returns_prev(fn: ast.FunctionDef, tls: str,
+                            attr: str) -> bool:
+    saved: Set[str] = set()
+    for sub in ast.walk(fn):
+        if isinstance(sub, ast.Assign) and len(sub.targets) == 1 \
+                and isinstance(sub.targets[0], ast.Name) \
+                and _reads_slot(sub.value, tls, attr):
+            saved.add(sub.targets[0].id)
+    if not saved:
+        return False
+    for sub in ast.walk(fn):
+        if isinstance(sub, ast.Return) and isinstance(sub.value, ast.Name) \
+                and sub.value.id in saved:
+            return True
+    return False
+
+
+# ---------------------------------------------------------------------------
+# GL19 — signal-context safety
+# ---------------------------------------------------------------------------
+
+def _module_locks(tree: ast.Module) -> Dict[str, str]:
+    """module-level lock name → kind ('plain'/'reentrant'/'condition')."""
+    out: Dict[str, str] = {}
+    for node in tree.body:
+        if isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                and isinstance(node.targets[0], ast.Name):
+            kind = _lock_kind(node.value)
+            if kind is not None:
+                out[node.targets[0].id] = kind
+    return out
+
+
+def _attr_locks(tree: ast.Module) -> Dict[str, str]:
+    """self-attribute lock name → kind, across every class in the
+    module (module-local resolution: ``self._lock`` in a handler path
+    is looked up here)."""
+    out: Dict[str, str] = {}
+    for node in cached_walk(tree):
+        if isinstance(node, ast.Assign) and len(node.targets) == 1:
+            attr = _self_attr(node.targets[0])
+            if attr is not None:
+                kind = _lock_kind(node.value)
+                if kind is not None:
+                    # a name bound plain anywhere poisons: conservative
+                    if out.get(attr) != "plain":
+                        out[attr] = kind
+    return out
+
+
+def _log_aliases(tree: ast.Module) -> Set[str]:
+    aliases: Set[str] = set()
+    for node in cached_walk(tree):
+        if isinstance(node, ast.Import):
+            for a in node.names:
+                if a.name == "logging":
+                    aliases.add(a.asname or "logging")
+        elif isinstance(node, ast.ImportFrom):
+            for a in node.names:
+                if a.name == "logging":
+                    aliases.add(a.asname or "logging")
+    return aliases
+
+
+def _handler_roots(tree: ast.Module) -> Set[str]:
+    roots: Set[str] = set()
+    for node in cached_walk(tree):
+        if isinstance(node, ast.Call) \
+                and _dotted(node.func) == "signal.signal" \
+                and len(node.args) >= 2:
+            h = node.args[1]
+            if isinstance(h, ast.Name):
+                roots.add(h.id)
+            else:
+                attr = _self_attr(h)
+                if attr is not None:
+                    roots.add(attr)
+    return roots
+
+
+def _check_gl19(tree: ast.Module, add) -> None:
+    roots = _handler_roots(tree)
+    if not roots:
+        return
+    defs: Dict[str, List[ast.FunctionDef]] = {}
+    for node in cached_walk(tree):
+        if isinstance(node, ast.FunctionDef):
+            defs.setdefault(node.name, []).append(node)
+    # module-local call-graph fixpoint from the handler roots: Name
+    # calls resolve to local defs; self./_self. attribute calls resolve
+    # to any same-named method (conservative)
+    reach: Set[str] = set()
+    frontier = [r for r in roots if r in defs]
+    while frontier:
+        name = frontier.pop()
+        if name in reach:
+            continue
+        reach.add(name)
+        for fn in defs[name]:
+            for sub in ast.walk(fn):
+                if not isinstance(sub, ast.Call):
+                    continue
+                callee: Optional[str] = None
+                if isinstance(sub.func, ast.Name):
+                    callee = sub.func.id
+                else:
+                    callee = _self_attr(sub.func)
+                if callee and callee in defs and callee not in reach:
+                    frontier.append(callee)
+
+    mod_locks = _module_locks(tree)
+    attr_locks = _attr_locks(tree)
+    log_aliases = _log_aliases(tree)
+
+    def lock_kind_of(expr: ast.AST) -> Optional[Tuple[str, str]]:
+        if isinstance(expr, ast.Name) and expr.id in mod_locks:
+            return expr.id, mod_locks[expr.id]
+        attr = _self_attr(expr)
+        if attr is not None and attr in attr_locks:
+            return attr, attr_locks[attr]
+        return None
+
+    for name in reach:
+        for fn in defs[name]:
+            has_replace = any(
+                isinstance(s, ast.Call)
+                and _dotted(s.func) in ("os.replace", "os.rename")
+                for s in ast.walk(fn))
+            for sub in ast.walk(fn):
+                if isinstance(sub, (ast.With, ast.AsyncWith)):
+                    for item in sub.items:
+                        got = lock_kind_of(item.context_expr)
+                        if got is not None and got[1] == "plain":
+                            add(item.context_expr, "GL19",
+                                f"plain Lock {got[0]!r} acquired in "
+                                f"{fn.name}(), reachable from a signal "
+                                "handler — a signal landing on the "
+                                "holding thread deadlocks; use an RLock "
+                                "(monitored_rlock) on signal paths")
+                if not isinstance(sub, ast.Call):
+                    continue
+                callee = _dotted(sub.func)
+                if isinstance(sub.func, ast.Attribute) \
+                        and sub.func.attr == "acquire":
+                    got = lock_kind_of(sub.func.value)
+                    if got is not None and got[1] == "plain":
+                        add(sub, "GL19",
+                            f"plain Lock {got[0]!r}.acquire() in "
+                            f"{fn.name}(), reachable from a signal "
+                            "handler — use an RLock on signal paths")
+                parts = callee.split(".")
+                if len(parts) >= 2 and parts[0] in log_aliases \
+                        and parts[-1] in _LOG_METHODS:
+                    add(sub, "GL19",
+                        f"{callee}() in {fn.name}(), reachable from a "
+                        "signal handler — logging takes a module lock "
+                        "and is not async-signal-safe")
+                if callee == "open" and len(sub.args) >= 2 \
+                        and isinstance(sub.args[1], ast.Constant) \
+                        and isinstance(sub.args[1].value, str) \
+                        and any(c in sub.args[1].value for c in "wax") \
+                        and not has_replace:
+                    add(sub, "GL19",
+                        f"file write in {fn.name}(), reachable from a "
+                        "signal handler, outside the tmp+os.replace "
+                        "idiom — a signal mid-write leaves a torn file")
+
+
+# ---------------------------------------------------------------------------
+# GL20 — future resolution
+# ---------------------------------------------------------------------------
+
+def _is_future_ctor(node: ast.AST) -> bool:
+    if not isinstance(node, ast.Call):
+        return False
+    callee = _dotted(node.func)
+    return callee == "Future" or callee.endswith(".Future")
+
+
+def _check_gl20(tree: ast.Module, add) -> None:
+    for fn in [n for n in cached_walk(tree)
+               if isinstance(n, ast.FunctionDef)]:
+        owned: Dict[str, ast.Call] = {}
+        for sub in ast.walk(fn):
+            if isinstance(sub, ast.Assign) and len(sub.targets) == 1 \
+                    and isinstance(sub.targets[0], ast.Name) \
+                    and _is_future_ctor(sub.value):
+                owned[sub.targets[0].id] = sub.value
+        for var, ctor in owned.items():
+            if _escapes(fn, var, ctor):
+                continue
+            if not _resolves(fn.body, var):
+                add(ctor, "GL20",
+                    f"Future {var!r} owned by {fn.name}() is not "
+                    "resolved on every path — set_result/set_exception "
+                    "(or a typed shed) must reach it on success, "
+                    "failure, AND early-return paths, or the waiter "
+                    "blocks forever")
+
+
+_RESOLVE = {"set_result", "set_exception", "cancel"}
+_QUERY = {"result", "done", "exception", "add_done_callback", "cancelled",
+          "running"}
+
+
+def _escapes(fn: ast.FunctionDef, var: str, ctor: ast.Call) -> bool:
+    """Ownership transfer: the future is returned, stored into a
+    container/attribute, or passed to another call — someone else now
+    holds the resolve obligation (the submit → batch-loop pattern)."""
+    for sub in ast.walk(fn):
+        if isinstance(sub, ast.Return) and sub.value is not None:
+            if any(isinstance(n, ast.Name) and n.id == var
+                   for n in ast.walk(sub.value)):
+                return True
+        if isinstance(sub, ast.Call):
+            if isinstance(sub.func, ast.Attribute) \
+                    and isinstance(sub.func.value, ast.Name) \
+                    and sub.func.value.id == var:
+                continue  # var.set_result(...) — a resolve, not escape
+            args = list(sub.args) + [kw.value for kw in sub.keywords]
+            for a in args:
+                if any(isinstance(n, ast.Name) and n.id == var
+                       for n in ast.walk(a)):
+                    return True
+        if isinstance(sub, ast.Assign) and sub.value is not ctor:
+            rhs_has = any(isinstance(n, ast.Name) and n.id == var
+                          for n in ast.walk(sub.value))
+            tgt_is_plain = all(isinstance(t, ast.Name)
+                               for t in sub.targets)
+            if rhs_has and not tgt_is_plain:
+                return True  # self.x = fut / d[k] = fut
+            if rhs_has and tgt_is_plain:
+                return True  # aliasing — give up tracking, stay quiet
+    return False
+
+
+def _stmt_resolves(stmt: ast.stmt, var: str) -> bool:
+    for sub in ast.walk(stmt):
+        if isinstance(sub, ast.Call) \
+                and isinstance(sub.func, ast.Attribute) \
+                and sub.func.attr in _RESOLVE \
+                and isinstance(sub.func.value, ast.Name) \
+                and sub.func.value.id == var:
+            return True
+    return False
+
+
+def _resolves(stmts: Sequence[ast.stmt], var: str) -> bool:
+    """True when every path through ``stmts`` resolves ``var``. A
+    ``raise`` terminates the path acceptably (the future never escaped,
+    so the exception — not a hung waiter — is the outcome); loop bodies
+    may run zero times and guarantee nothing."""
+    for stmt in stmts:
+        if isinstance(stmt, ast.Raise):
+            return True
+        if isinstance(stmt, ast.Return):
+            return _stmt_resolves(stmt, var)
+        if isinstance(stmt, ast.If):
+            if _resolves(stmt.body, var) and stmt.orelse \
+                    and _resolves(stmt.orelse, var):
+                return True
+            continue
+        if isinstance(stmt, ast.Try):
+            if stmt.finalbody and _resolves(stmt.finalbody, var):
+                return True
+            body_ok = _resolves(stmt.body, var)
+            handlers_ok = all(
+                _resolves(h.body, var) or _raises(h.body)
+                for h in stmt.handlers)
+            if body_ok and handlers_ok:
+                return True
+            continue
+        if isinstance(stmt, (ast.With, ast.AsyncWith)):
+            if _resolves(stmt.body, var):
+                return True
+            continue
+        if isinstance(stmt, (ast.For, ast.While)):
+            continue  # may run zero times
+        if _stmt_resolves(stmt, var):
+            return True
+    return False
+
+
+def _raises(stmts: Sequence[ast.stmt]) -> bool:
+    return bool(stmts) and isinstance(stmts[-1], ast.Raise)
+
+
+# ---------------------------------------------------------------------------
+# entry
+# ---------------------------------------------------------------------------
+
+def check(tree: ast.Module, parents: _Parents, path: str, add) -> None:
+    for node in cached_walk(tree):
+        if isinstance(node, ast.ClassDef):
+            _check_gl16(node, add)
+    _check_gl17(tree, parents, add)
+    _check_gl18(tree, parents, add)
+    _check_gl19(tree, add)
+    _check_gl20(tree, add)

@@ -48,7 +48,8 @@ import dataclasses
 import os
 from typing import Dict, List, Optional, Sequence, Set, Tuple, Union
 
-from tools.graftlint import _Parents, _const_env, _const_int, _dotted
+from tools.graftlint import _Parents, _const_env, _const_int, _dotted, \
+    cached_walk
 
 # Traffic-bearing collective verbs on jax.lax (axis_index / axis_size
 # carry no payload and are deliberately excluded).
@@ -73,7 +74,7 @@ def _last_seg(callee: str) -> str:
 
 
 def _fn_like_nodes(tree: ast.Module) -> List[_FnLike]:
-    return [n for n in ast.walk(tree)
+    return [n for n in cached_walk(tree)
             if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef,
                               ast.Lambda))]
 
@@ -196,7 +197,7 @@ def _declared_axes(tree: ast.Module, mod_strs: Dict[str, str]) -> Set[str]:
             if isinstance(el, ast.Constant) and isinstance(el.value, str):
                 axes.add(el.value)
 
-    for node in ast.walk(tree):
+    for node in cached_walk(tree):
         if isinstance(node, ast.Call):
             mesh_axes = _mesh_call_axes(node)
             if mesh_axes:
@@ -322,7 +323,7 @@ def _reach_set(tree: ast.Module, parents: _Parents,
 
 def _build_info(tree: ast.Module, parents: _Parents,
                 path: str) -> _ModuleInfo:
-    calls = [n for n in ast.walk(tree) if isinstance(n, ast.Call)]
+    calls = [n for n in cached_walk(tree) if isinstance(n, ast.Call)]
     by_name: Dict[str, List[ast.AST]] = {}
     for f in _fn_like_nodes(tree):
         if isinstance(f, (ast.FunctionDef, ast.AsyncFunctionDef)):
@@ -330,7 +331,7 @@ def _build_info(tree: ast.Module, parents: _Parents,
     uses, sm_calls = _shard_map_info(tree, calls)
     mod_strs = _module_strs(tree)
     comms_binds: Dict[str, List[ast.Call]] = {}
-    for node in ast.walk(tree):
+    for node in cached_walk(tree):
         if isinstance(node, ast.Assign) and len(node.targets) == 1 \
                 and isinstance(node.targets[0], ast.Name) \
                 and isinstance(node.value, ast.Call):
@@ -575,7 +576,7 @@ def _is_pl_when(fn: ast.AST) -> bool:
 
 
 def _dma_roots(tree: ast.Module) -> List[ast.FunctionDef]:
-    cands = [f for f in ast.walk(tree)
+    cands = [f for f in cached_walk(tree)
              if isinstance(f, (ast.FunctionDef, ast.AsyncFunctionDef))
              and any(_is_dma_make(n) for n in ast.walk(f))]
     roots = []
