@@ -91,8 +91,18 @@ assert {r["n_dev"] for r in rows} == {2, 4, 8}, rows
 assert all(r["measured_at"] and r["git_commit"] for r in rows), rows
 for leg in ("strong", "weak"):
     by = {r["merge"]: r["merge_bytes"] for r in rows
-          if r["leg"] == leg and r["n_dev"] == 8}
+          if r.get("leg") == leg and r["n_dev"] == 8}
     assert 2 * by["ring"] <= by["allgather"], (leg, by)
+# ISSUE 19: the hierarchical ICI→DCN merge rows — per-axis attribution
+# nonzero on BOTH axes, DCN traffic exactly the k-survivor all-to-all
+# model and strictly below the flat single-ring's cross-pod bytes, on
+# both 2-D carvings (2x4 and 4x2) of the 8-device mesh
+hrows = [r for r in rows if r.get("kind") == "hier"]
+assert {r["mesh"] for r in hrows} == {"2x4", "4x2"}, rows
+for r in hrows:
+    assert r["dcn_bytes"] == r["survivor_model_bytes"] > 0, r
+    assert r["ici_bytes"] > 0, r
+    assert r["dcn_bytes"] < r["flat_ring_bytes"], r
 # ISSUE 13: the distributed-build legs — weak+strong build-throughput
 # rows at n_dev ∈ {2,4,8}, every build's comms ALLGATHERV-ONLY (codes/
 # ids never cross shards), overlapped encode wall < serialized
@@ -990,6 +1000,88 @@ print("quality chaos OK: breach -> degraded healthz -> "
       "refused fp8 rungs -> shed -> recovery; /indexz cv "
       f"{sk['cv']:.2f} on the skewed tenant; obsdump renders the "
       "quality header, index-health table and worst-recall timelines")
+EOF
+
+echo "== fleet router smoke (ISSUE 19: two simulated pods on 4-dev halves,"
+echo "   PR-15 straggler feed -> typed steer counter, ONE Deadline across"
+echo "   the pod hop, DCN-hop pod kill mid-storm -> degraded-but-correct"
+echo "   answers with exact failover accounting) =="
+python - <<'EOF'
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from raft_tpu import obs, serve
+from raft_tpu.obs.metrics import MetricsRegistry
+from raft_tpu.parallel import make_mesh, sharded_knn
+from raft_tpu.robust import faults, retry
+
+devs = jax.devices()
+assert len(devs) >= 8, devs
+rng = np.random.default_rng(0)
+x = jnp.asarray(rng.random((2048, 32), dtype=np.float32))
+queries = np.asarray(rng.random((16, 32)), np.float32)
+k = 5
+
+seen_deadlines = []
+
+def pod_fn(mesh):
+    def fn(tenant, q, k_, deadline):
+        seen_deadlines.append(deadline)
+        v, i = sharded_knn(x, jnp.asarray(q), k_, mesh)
+        return np.asarray(v), np.asarray(i)
+    return fn
+
+mesh_a = make_mesh(devices=devs[:4])
+mesh_b = make_mesh(devices=devs[4:8])
+ref_v, ref_i = pod_fn(mesh_a)("t", queries, k, None)
+seen_deadlines.clear()
+
+reg = MetricsRegistry()
+obs.enable(registry=reg, hbm=False)
+router = serve.FleetRouter([
+    serve.Pod("a", hosts=("hostA",), dispatch_fn=pod_fn(mesh_a)),
+    serve.Pod("b", hosts=("hostB",), dispatch_fn=pod_fn(mesh_b))])
+serve.set_router(router)
+
+# the ONE Deadline object crosses the pod hop untouched
+dl = retry.Deadline(30.0)
+router.dispatch("t", queries, k, deadline=dl)
+assert seen_deadlines[-1] is dl
+
+# PR-15 straggler-table feed -> steering, visible as a typed counter
+assert router.note_stragglers([
+    {"collective": "comms.ring_topk", "slowest": "hostB",
+     "skew_frac": 0.42}]) == 1
+for _ in range(4):
+    router.dispatch("t", queries, k)
+c = reg.snapshot()["counters"]
+assert c["serve.router.steer{away_from=hostB,reason=straggler}"] >= 1, c
+assert c["serve.router.straggler{host=hostB}"] == 1.0, c
+
+# chaos: pod b's DCN hop dies mid-storm; every answer stays correct
+faults.install_plan({"faults": [
+    {"site": "serve.router.hop.b", "kind": "error", "after": 1,
+     "times": 0}]})
+try:
+    router2 = serve.FleetRouter([
+        serve.Pod("a", hosts=("hostA",), dispatch_fn=pod_fn(mesh_a)),
+        serve.Pod("b", hosts=("hostB",), dispatch_fn=pod_fn(mesh_b))])
+    answers = [router2.dispatch("t", queries, k) for _ in range(6)]
+finally:
+    faults.clear_plan()
+for v, i in answers:
+    assert np.array_equal(i, ref_i)
+    np.testing.assert_allclose(v, ref_v, rtol=1e-5)
+c = reg.snapshot()["counters"]
+assert c["serve.router.pod_down{pod=b}"] == 1.0, c
+assert c["serve.router.degraded{reason=pod_lost}"] == 1.0, c
+assert not router2.pods[1].healthy
+serve.clear_router(router)
+obs.disable()
+print("fleet router OK: steered away from hostB, one Deadline across "
+      "the hop, pod b killed mid-storm ->",
+      len(answers), "degraded-but-correct answers")
 EOF
 
 echo "== trace export round-trip (instrumented search -> Perfetto JSON) =="

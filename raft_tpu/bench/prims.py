@@ -585,19 +585,27 @@ def bench_build_encode(grid=None, iters: int = 1) -> List[PrimResult]:
 
 def measure_merge_tier(mesh, x, q, k: int, tier: str, iters: int = 3,
                        schedule: Optional[str] = None,
-                       with_cost: bool = False):
+                       with_cost: bool = False, axis="shard",
+                       per_axis: bool = False):
     """Measure ONE cross-shard merge tier through sharded kNN on
     ``mesh``: returns ``(median ms per call, merge-phase comms bytes,
     cost)`` where ``cost`` is the PR-9 roofline attribution of the
     measured ring/merge program (an ``obs.prof.ProgramCost``, or
     ``None`` when ``with_cost`` is off or the closure won't lower).
-    The single harness behind both the prims `ring_merge` rows and the
-    dryrun's MULTICHIP scaling rows — byte-model or dispatch changes
-    land in one place. Jits once so timed calls hit the cache (a bare
-    ``sharded_knn`` call rebuilds its shard_map closure and re-traces
-    every call — that would time the tracer), and enables a private
-    registry only around the tracing call so the per-trace comms
-    counters attribute exactly one merge.
+    The single harness behind both the prims `ring_merge`/`hier_merge`
+    rows and the dryrun's MULTICHIP scaling rows — byte-model or
+    dispatch changes land in one place. Jits once so timed calls hit
+    the cache (a bare ``sharded_knn`` call rebuilds its shard_map
+    closure and re-traces every call — that would time the tracer),
+    and enables a private registry only around the tracing call so the
+    per-trace comms counters attribute exactly one merge.
+
+    ``axis`` is forwarded to ``sharded_knn`` — pass the ``(outer,
+    inner)`` tuple of a 2-D hier mesh to measure the ``hier`` tier (or
+    the flat-ring comparator over the same two axes). With
+    ``per_axis=True`` the bytes slot becomes a ``{axis_name: bytes}``
+    dict split over the PR-19 per-axis attribution instead of one sum
+    — how the scaling rows prove DCN traffic is O(k·pods).
 
     ``schedule`` env-forces the ring kernel's hop schedule
     (``RAFT_TPU_RING_OVERLAP``: "overlap" → on, "serial" → off) around
@@ -610,14 +618,16 @@ def measure_merge_tier(mesh, x, q, k: int, tier: str, iters: int = 3,
     from raft_tpu.obs.metrics import MetricsRegistry
     from raft_tpu.parallel import sharded_knn
 
-    op = "ring_topk" if tier == "ring" else "allgather"
+    ops = {"ring": ("ring_topk",), "allgather": ("allgather",),
+           "hier": ("ring_topk", "alltoall")}[tier]
     prev_env = os.environ.get("RAFT_TPU_RING_OVERLAP")
     if schedule is not None:
         os.environ["RAFT_TPU_RING_OVERLAP"] = (
             "on" if schedule == "overlap" else "off")
     try:
         fn = jax.jit(
-            lambda xx, qq: sharded_knn(xx, qq, k, mesh, merge=tier))
+            lambda xx, qq: sharded_knn(xx, qq, k, mesh, merge=tier,
+                                       axis=axis))
         reg = MetricsRegistry()
         prev = _spans._state()  # a RAFT_TPU_OBS=1 enable must survive
         try:
@@ -631,9 +641,19 @@ def measure_merge_tier(mesh, x, q, k: int, tier: str, iters: int = 3,
         finally:
             _spans._restore(prev)
         c = reg.snapshot()["counters"]
-        merge_bytes = sum(
-            v for key, v in c.items()
-            if key.startswith("comms.bytes{") and f"op={op}" in key)
+        matched = [
+            (key, v) for key, v in c.items()
+            if key.startswith("comms.bytes{")
+            and any(f"op={o}" in key for o in ops)]
+        if per_axis:
+            merge_bytes: Dict[str, int] = {}
+            for key, v in matched:
+                labels = dict(kv.split("=", 1) for kv
+                              in key[key.index("{") + 1:-1].split(","))
+                ax = labels.get("axis", "")
+                merge_bytes[ax] = merge_bytes.get(ax, 0) + int(v)
+        else:
+            merge_bytes = int(sum(v for _, v in matched))
         ms = _time(lambda: compiled(x, q)[0], iters=iters, warmup=1)
         cost = None
         if with_cost:
@@ -650,7 +670,7 @@ def measure_merge_tier(mesh, x, q, k: int, tier: str, iters: int = 3,
                 os.environ.pop("RAFT_TPU_RING_OVERLAP", None)
             else:
                 os.environ["RAFT_TPU_RING_OVERLAP"] = prev_env
-    return ms, int(merge_bytes), cost
+    return ms, merge_bytes, cost
 
 
 def bench_ring_merge(grid=None, iters: int = 3) -> List[PrimResult]:
@@ -707,6 +727,51 @@ def bench_ring_merge(grid=None, iters: int = 3) -> List[PrimResult]:
     return rows
 
 
+def bench_hier_merge(grid=None, iters: int = 3) -> List[PrimResult]:
+    """Flat single-ring vs the two-level ICI→DCN merge (ISSUE 19) on a
+    2×(n_dev/2) hier mesh carved from the local devices. Both rows run
+    the SAME sharded kNN over the same two mesh axes — only the merge
+    tier differs — and decompose the merge's interconnect traffic into
+    per-axis ``dcn_bytes``/``ici_bytes`` columns from the PR-19
+    per-axis ``comms.bytes`` attribution. The load-bearing comparison
+    is the DCN column: the flat ring drags whole surviving blocks
+    across every hop including the slow cross-pod edges, while the
+    hier tier's survivor exchange moves O(k·pods) rows — ``dcn_bytes``
+    must sit strictly below the flat row's. Wall time is only
+    meaningful on real multi-pod hardware (a CPU host mesh has no slow
+    axis); the byte columns are layout-independent."""
+    from raft_tpu.parallel import hier_mesh
+
+    n_dev = len(jax.devices())
+    if n_dev < 4 or n_dev % 2:
+        return [PrimResult(
+            "hier_merge", "skipped", 0.0, 0.0, "queries/s",
+            {"reason": f"{n_dev} device(s): need an even mesh of >= 4 "
+                       "to carve into pods"})]
+    n_outer, n_inner = 2, n_dev // 2
+    mesh = hier_mesh(n_inner, n_outer)
+    axis = ("dcn", "ici")
+    if grid is None:
+        # (n, d, m, k)
+        grid = [(32_768, 64, 1024, 10), (32_768, 64, 1024, 64)]
+    rows: List[PrimResult] = []
+    rng = np.random.default_rng(0)
+    for n, d, m, k in grid:
+        x = jnp.asarray(rng.random((n, d), dtype=np.float32))
+        q = jnp.asarray(rng.random((m, d), dtype=np.float32))
+        for tier, impl in (("ring", "flat_ring"), ("hier", "hier")):
+            ms, by_axis, _ = measure_merge_tier(
+                mesh, x, q, k, tier, iters=iters, axis=axis,
+                per_axis=True)
+            p = {"n": n, "d": d, "m": m, "k": k, "n_dev": n_dev,
+                 "mesh": f"{n_outer}x{n_inner}",
+                 "dcn_bytes": by_axis.get("dcn", 0),
+                 "ici_bytes": by_axis.get("ici", 0)}
+            rows.append(PrimResult(
+                "hier_merge", impl, ms, m * 1e3 / ms, "queries/s", p))
+    return rows
+
+
 BENCHES: Dict[str, Callable[[], List[PrimResult]]] = {
     "select_k": bench_select_k,
     "fused_l2_nn": bench_fused_l2_nn,
@@ -717,6 +782,7 @@ BENCHES: Dict[str, Callable[[], List[PrimResult]]] = {
     "refine": bench_refine,
     "tiered_refine": bench_tiered_refine,
     "ring_merge": bench_ring_merge,
+    "hier_merge": bench_hier_merge,
     "build_encode": bench_build_encode,
 }
 

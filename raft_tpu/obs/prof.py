@@ -48,6 +48,8 @@ from typing import Any, Dict, Optional
 
 __all__ = [
     "DevicePeak", "DEVICE_PEAKS", "device_peak", "peak_for_kind",
+    "InterconnectPeak", "INTERCONNECT_PEAKS", "interconnect_peak",
+    "axis_peak_bw",
     "cost_analysis", "memory_analysis", "ProgramCost",
     "analyze_compiled", "analyze_jit", "record", "capture",
 ]
@@ -113,6 +115,75 @@ def device_peak(device: Optional[Any] = None) -> DevicePeak:
         return peak_for_kind(getattr(device, "device_kind", ""))
     except Exception:
         return DEVICE_PEAKS["cpu"]
+
+
+@dataclasses.dataclass(frozen=True)
+class InterconnectPeak:
+    """Per-axis interconnect bandwidth ceiling for one device kind:
+    ``ici_bw`` is per-link ICI bandwidth (bytes/s, one direction),
+    ``dcn_bw`` the per-host DCN bandwidth share — the two denominators
+    of the per-axis comm roofline (``comms.bytes{axis=...}`` / peak).
+    Like :class:`DevicePeak` these are order-of-magnitude published
+    figures, not calibrations; the CPU entry is a placeholder so the
+    CI mesh can exercise the classification."""
+
+    name: str
+    ici_bw: float
+    dcn_bw: float
+    placeholder: bool = False
+
+
+# Published per-link ICI and per-host DCN figures (one direction,
+# order of magnitude — e.g. v4 ICI ≈ 300 GB/s per link; DCN shares
+# ≈ 25 GB/s/host across generations). The asymmetry RATIO is what the
+# per-axis roofline needs to be honest about: an axis=dcn byte is
+# ~10× more expensive than an axis=ici byte.
+INTERCONNECT_PEAKS: Dict[str, InterconnectPeak] = {
+    "v4": InterconnectPeak("v4", 300e9, 25e9),
+    "v5e": InterconnectPeak("v5e", 200e9, 25e9),
+    "v5p": InterconnectPeak("v5p", 600e9, 25e9),
+    "cpu": InterconnectPeak("cpu", 1e9, 1e8, placeholder=True),
+}
+
+
+def interconnect_peak(kind: Optional[str] = None) -> InterconnectPeak:
+    """Interconnect peak entry for a PJRT ``device_kind`` string
+    (default: device 0's kind). Same substring matching and
+    CPU-placeholder degradation as :func:`peak_for_kind`."""
+    if kind is None:
+        try:
+            import jax
+
+            kind = getattr(jax.devices()[0], "device_kind", "")
+        except Exception:
+            kind = ""
+    k = (kind or "").lower().replace(" ", "")
+    if "v5p" in k or "v5pod" in k:
+        return INTERCONNECT_PEAKS["v5p"]
+    if "v5e" in k or "v5lite" in k or "v5litepod" in k:
+        return INTERCONNECT_PEAKS["v5e"]
+    if "v4" in k:
+        return INTERCONNECT_PEAKS["v4"]
+    return INTERCONNECT_PEAKS["cpu"]
+
+
+def axis_peak_bw(axis: str, peak: Optional[InterconnectPeak] = None
+                 ) -> float:
+    """Bandwidth ceiling for one ``comms.bytes{axis=...}`` label: the
+    DCN figure when the axis name is DCN-labeled
+    (:func:`raft_tpu.parallel.mesh.is_dcn_axis` — imported lazily, obs
+    must not import parallel at module scope), the ICI figure
+    otherwise. On a jax-less triage host (obsdump reading a dump) the
+    parallel package won't import; fall back to the same name-prefix
+    rule ``is_dcn_axis`` applies (mesh.DCN_AXIS_PREFIXES)."""
+    try:
+        from raft_tpu.parallel.mesh import is_dcn_axis
+
+        dcn = is_dcn_axis(axis)
+    except Exception:
+        dcn = str(axis).lower().startswith(("dcn", "pod", "slice"))
+    p = peak if peak is not None else interconnect_peak()
+    return p.dcn_bw if dcn else p.ici_bw
 
 
 # ---------------------------------------------------------------------------

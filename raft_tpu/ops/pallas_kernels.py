@@ -23,7 +23,7 @@ pick the XLA path off-TPU).
 from __future__ import annotations
 
 import functools
-from typing import Tuple
+from typing import Optional, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -1387,6 +1387,20 @@ def ring_topk_kernel_ok(m: int, k: int, n_dev: int) -> bool:
     return vmem <= _RING_VMEM_BUDGET
 
 
+def ring_topk_inner_ok(m: int, k: int, n_inner: int) -> bool:
+    """Eligibility of the ring kernel as the hier tier's per-pod
+    (inner-axis) stage. Same merge/VMEM budget as
+    :func:`ring_topk_kernel_ok`, but the exchange axis is a SUB-axis:
+    the kernel's neighbor addressing is by logical device id, so the
+    per-pod ring passes ``outer_axis`` to :func:`ring_topk_merge` and
+    offsets neighbors by the pod base — which is only the right flat id
+    when the inner axis is the MINOR (trailing) mesh axis, the layout
+    :func:`raft_tpu.parallel.mesh.hier_mesh` guarantees (logical id =
+    dcn_idx·n_inner + ici_idx). Callers on other layouts must use the
+    ppermute fallback."""
+    return ring_topk_kernel_ok(m, k, n_inner)
+
+
 def ring_topk_splits(mc: int, schedule: str) -> Tuple[Tuple[int, int], ...]:
     """Row sub-blocks of one [mc, kpad] hop block, as (offset, rows)
     pairs. The ``serial`` schedule is one block — the PR-8 bulk-
@@ -1407,7 +1421,8 @@ def _ring_topk_kernel(vals_hbm, ids_hbm, out_v_ref, out_i_ref,
                       buf_v, buf_i, run_v, run_i, loc_v, loc_i,
                       send_sems, recv_sems, cap_sems, copy_sems, *,
                       k: int, n_dev: int, mc: int, axis_name: str,
-                      flow_control: bool, splits):
+                      flow_control: bool, splits,
+                      outer_axis: Optional[str] = None):
     """One device's program of the ring reduce-scatter-of-top-k.
 
     The local [n_dev·mc, kpad] candidate table lives in HBM; chunk ``c``
@@ -1448,6 +1463,15 @@ def _ring_topk_kernel(vals_hbm, ids_hbm, out_v_ref, out_i_ref,
     my = jax.lax.axis_index(axis_name)
     right = jax.lax.rem(my + 1, n_dev)
     left = jax.lax.rem(my + n_dev - 1, n_dev)
+    if outer_axis is not None:
+        # per-pod ring on a (outer, inner) mesh: neighbor semaphores and
+        # DMAs address LOGICAL (flat) device ids, and axis_index(inner)
+        # is only pod-relative — offset by this pod's base so the ring
+        # stays inside the pod (requires inner = minor mesh axis, see
+        # ring_topk_inner_ok)
+        base = jax.lax.axis_index(outer_axis) * n_dev
+        right = base + right
+        left = base + left
     H = len(splits)
 
     if flow_control:
@@ -1556,7 +1580,8 @@ def ring_schedule(schedule: str = "auto") -> str:
 
 def ring_topk_merge(vals: jax.Array, ids: jax.Array, k: int,
                     axis_name: str, n_dev: int, select_min: bool = True,
-                    interpret: bool = False, schedule: str = "auto"
+                    interpret: bool = False, schedule: str = "auto",
+                    outer_axis: Optional[str] = None
                     ) -> Tuple[jax.Array, jax.Array]:
     """Ring reduce-scatter-of-top-k over a mesh axis — the Pallas merge
     tier replacing allgather-and-select (reference: knn_merge_parts.cuh
@@ -1564,7 +1589,10 @@ def ring_topk_merge(vals: jax.Array, ids: jax.Array, k: int,
 
     Must be called inside ``shard_map`` over ``axis_name`` (a 1-D mesh:
     neighbors are addressed by logical device id — see
-    :func:`ring_topk_kernel_ok`). ``vals``/``ids`` [m, k'] (k' ≥ k) are
+    :func:`ring_topk_kernel_ok`). On a 2-D (outer, inner) hier mesh pass
+    ``outer_axis`` so the per-pod ring offsets its neighbor ids by the
+    pod base (inner must be the minor mesh axis —
+    :func:`ring_topk_inner_ok`). ``vals``/``ids`` [m, k'] (k' ≥ k) are
     this device's local top-k table, ids -1 invalid, invalid keys at the
     select sentinel (+inf for ``select_min``, −inf otherwise). Returns
     this device's owned query chunk ([mc, k] — rows
@@ -1610,7 +1638,8 @@ def ring_topk_merge(vals: jax.Array, ids: jax.Array, k: int,
     out_v, out_i = pl.pallas_call(
         functools.partial(_ring_topk_kernel, k=k, n_dev=n_dev, mc=mc,
                           axis_name=axis_name,
-                          flow_control=not interpret, splits=splits),
+                          flow_control=not interpret, splits=splits,
+                          outer_axis=outer_axis),
         in_specs=[
             pl.BlockSpec(memory_space=pltpu.ANY),
             pl.BlockSpec(memory_space=pltpu.ANY),

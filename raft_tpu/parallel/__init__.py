@@ -7,6 +7,9 @@ raft-dask bootstrap) with JAX-native SPMD: ``Mesh`` + ``shard_map`` +
 
 from raft_tpu.parallel.comms import Comms, Op, Status, initialize_distributed  # noqa: F401
 from raft_tpu.parallel.mesh import (  # noqa: F401
+    HIER_AXIS_NAMES,
+    hier_mesh,
+    is_dcn_axis,
     make_hybrid_mesh,
     make_mesh,
     replicate,
@@ -15,10 +18,12 @@ from raft_tpu.parallel.mesh import (  # noqa: F401
 )
 from raft_tpu.parallel.merge import (  # noqa: F401
     MERGE_TIERS,
+    hier_chunk_rows,
     merge_out_spec,
     merge_tier,
     merge_topk,
     merged_rows,
+    resolve_exchange,
 )
 from raft_tpu.parallel.knn import replicated_knn, sharded_knn  # noqa: F401
 from raft_tpu.parallel.ivf import (  # noqa: F401

@@ -157,13 +157,27 @@ class Comms:
         (``comms.<verb>``, robust.faults): a fault plan can fail a
         collective *at trace time* — aborting the trace exactly where a
         wedged ICI link would abort the program — so distributed
-        failure handling is CI-testable without breaking hardware."""
+        failure handling is CI-testable without breaking hardware.
+
+        Multi-axis communicators attribute PER AXIS (ISSUE 19): a
+        collective over ``("dcn", "ici")`` lowers to one stage per
+        axis (inner reduce/gather, then outer over the inner result),
+        so it counts one op on each constituent axis — ``axis=ici`` and
+        ``axis=dcn`` series, never a lumped ``dcn+ici`` label. Fixed-
+        size verbs charge each stage the per-rank payload; gather-
+        family verbs charge each stage its materialized table (the
+        inner stage gathers size(inner)×payload, the outer stage
+        size(outer)× that) — the hierarchical-schedule byte model that
+        lets the scoreboard separate cheap-ICI from scarce-DCN traffic.
+        The sanitize-lane schedule recorder keeps the joined label (one
+        collective, one schedule slot)."""
         _faults.faultpoint(f"comms.{op_name}")
         recording = _sanitize.comms_schedule_recording()
         counting = _obs.enabled()
         if not (recording or counting):
             return
-        nbytes = _payload_bytes(*arrays)
+        payload = _payload_bytes(*arrays)
+        nbytes = payload
         if op_name in _GATHER_FAMILY:
             # the materialized gathered table (axis size is static at
             # trace time — same int() the ring perms rely on)
@@ -173,18 +187,32 @@ class Comms:
                                       _axis_label(self.axis_name), nbytes)
         if not counting:
             return
-        labels = {"op": op_name, "axis": _axis_label(self.axis_name)}
         # host identity (ISSUE 15): in a launcher-ranked pod process
         # (RAFT_TPU_RANK set) every comms series carries the host's
         # rank, so per-host flight/JSONL dumps merged by obs.fleet
         # attribute collective traffic to the process that issued it.
         # One extra label per process (its own rank) — cardinality 1.
         rank = _fleet.rank()
-        if rank is not None:
-            labels["rank"] = str(rank)
         reg = _obs.registry()
-        reg.inc("comms.ops", 1.0, labels=labels)
-        reg.inc("comms.bytes", float(nbytes), labels=labels)
+        if isinstance(self.axis_name, str):
+            per_axis = [(self.axis_name, nbytes)]
+        else:
+            per_axis = []
+            mult = 1
+            # innermost stage first: its gathered table is what the
+            # next (outer) stage's gather moves
+            for a in reversed(tuple(self.axis_name)):
+                if op_name in _GATHER_FAMILY:
+                    mult *= int(_axis_size(a))
+                    per_axis.append((a, payload * mult))
+                else:
+                    per_axis.append((a, payload))
+        for axis, stage_bytes in per_axis:
+            labels = {"op": op_name, "axis": axis}
+            if rank is not None:
+                labels["rank"] = str(rank)
+            reg.inc("comms.ops", 1.0, labels=labels)
+            reg.inc("comms.bytes", float(stage_bytes), labels=labels)
 
     # -- collectives -------------------------------------------------------
     def _allreduce_raw(self, x, op: Op):

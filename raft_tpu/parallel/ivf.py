@@ -31,7 +31,7 @@ from __future__ import annotations
 
 import dataclasses
 from functools import partial
-from typing import Optional, Tuple
+from typing import Optional, Sequence, Tuple, Union
 
 import flax.struct
 import jax
@@ -501,7 +501,7 @@ def build_ivf_pq(params: _pq.IndexParams, dataset: jax.Array, mesh: Mesh,
 
 def search_ivf_pq(params: _pq.SearchParams, index: ShardedIvfPq,
                   queries: jax.Array, k: int, mesh: Mesh,
-                  axis: str = "shard", dataset=None,
+                  axis: Union[str, Sequence[str]] = "shard", dataset=None,
                   merge: str = "auto",
                   filter_bitset=None) -> Tuple[jax.Array, jax.Array]:
     """Sharded IVF-PQ search: per-shard list scan + cross-shard top-k
@@ -539,9 +539,10 @@ def search_ivf_pq(params: _pq.SearchParams, index: ShardedIvfPq,
     _faults.faultpoint("ivf_pq.search")
     m = q.shape[0]
     n_dev = index.n_shards
-    expects(n_dev == mesh.shape[axis],
+    ax_dev, whole_mesh, hier_axes = _merge.resolve_exchange(mesh, axis)
+    expects(n_dev == ax_dev,
             "index sharded over %d devices, mesh axis has %d",
-            n_dev, mesh.shape[axis])
+            n_dev, ax_dev)
     refined = params.refine != "none"
     filtered = filter_bitset is not None
     if params.lut_dtype == "auto" and not refined:
@@ -560,7 +561,7 @@ def search_ivf_pq(params: _pq.SearchParams, index: ShardedIvfPq,
 
         fused, fused_reason = _ring_fused_wanted(
             index, m, k, n_probes, n_dev,
-            whole_mesh=n_dev == mesh.devices.size, merge=merge, mt=mt,
+            whole_mesh=whole_mesh, merge=merge, mt=mt,
             lut_dtype=params.lut_dtype, scan_select=params.scan_select,
             filtered=filtered)
         if fused:
@@ -577,7 +578,7 @@ def search_ivf_pq(params: _pq.SearchParams, index: ShardedIvfPq,
             _obs_spans.count_fallback("parallel.merge", fused_reason)
     tier, impl = _merge.merge_tier(
         n_dev, m, k, explicit=merge,
-        whole_mesh=n_dev == mesh.devices.size)
+        whole_mesh=whole_mesh, hier_axes=hier_axes)
     comms = Comms(axis)
     if refined:
         from raft_tpu.neighbors import refine as _refine
@@ -716,7 +717,8 @@ def build_ivf_flat(params: _flat.IndexParams, dataset: jax.Array, mesh: Mesh,
 
 def search_ivf_flat(params: _flat.SearchParams, index: ShardedIvfFlat,
                     queries: jax.Array, k: int, mesh: Mesh,
-                    axis: str = "shard", merge: str = "auto",
+                    axis: Union[str, Sequence[str]] = "shard",
+                    merge: str = "auto",
                     filter_bitset=None) -> Tuple[jax.Array, jax.Array]:
     """Sharded IVF-Flat search: per-shard scan + cross-shard merge
     through the shared tier (``merge`` = auto | allgather | ring).
@@ -733,12 +735,13 @@ def search_ivf_flat(params: _flat.SearchParams, index: ShardedIvfFlat,
     _faults.faultpoint("ivf_flat.search")
     m = q.shape[0]
     n_dev = index.packed_data.shape[0]
-    expects(n_dev == mesh.shape[axis],
+    ax_dev, whole_mesh, hier_axes = _merge.resolve_exchange(mesh, axis)
+    expects(n_dev == ax_dev,
             "index sharded over %d devices, mesh axis has %d",
-            n_dev, mesh.shape[axis])
+            n_dev, ax_dev)
     tier, impl = _merge.merge_tier(
         n_dev, m, k, explicit=merge,
-        whole_mesh=n_dev == mesh.devices.size)
+        whole_mesh=whole_mesh, hier_axes=hier_axes)
 
     def local_search(data, ids, norms, sizes, q, centers, *fb):
         local = _flat.IvfFlatIndex(

@@ -10,7 +10,7 @@ with ``lax`` collectives doing the merge on ICI — no NCCL, no Dask.
 from __future__ import annotations
 
 from functools import partial
-from typing import Tuple
+from typing import Sequence, Tuple, Union
 
 import jax
 import jax.numpy as jnp
@@ -38,7 +38,7 @@ def sharded_knn(
     queries: jax.Array,
     k: int,
     mesh: Mesh,
-    axis: str = "shard",
+    axis: Union[str, Sequence[str]] = "shard",
     metric="sqeuclidean",
     merge: str = "auto",
 ) -> Tuple[jax.Array, jax.Array]:
@@ -52,12 +52,19 @@ def sharded_knn(
     reference's sharded-index pattern (per-shard select +
     ``knn_merge_parts``, knn_brute_force.cuh:276) as one SPMD program.
 
+    ``axis`` may be a 2-tuple ``(outer, inner)`` over a 2-D hier mesh
+    (:func:`raft_tpu.parallel.mesh.hier_mesh`): the index shards over
+    both axes jointly (outer-major) and, when the outer axis is
+    DCN-labeled, the merge auto-escalates to the two-level ``hier``
+    tier (per-pod ring over ICI, one sparse survivor exchange over
+    DCN).
+
     Returns (distances [m, k], global indices [m, k]) — replicated
-    under the allgather tier, query-sharded under the ring tier.
+    under the allgather tier, query-sharded under the ring/hier tiers.
     """
     mt = resolve_metric(metric)
     select_min = SELECT_MIN[mt]
-    n_dev = mesh.shape[axis]
+    n_dev, whole_mesh, hier_axes = _merge.resolve_exchange(mesh, axis)
     n = dataset.shape[0]
     m = queries.shape[0]
     padded, _ = _pad_rows(dataset, n_dev)
@@ -67,7 +74,7 @@ def sharded_knn(
     comms = Comms(axis)  # counted collectives (comms.ops/comms.bytes)
     tier, impl = _merge.merge_tier(
         n_dev, m, k, explicit=merge,
-        whole_mesh=n_dev == mesh.devices.size)
+        whole_mesh=whole_mesh, hier_axes=hier_axes)
 
     def local_search(ds_shard, q):
         rank = comms.get_rank()

@@ -19,27 +19,76 @@ Two tiers return the same global top-k, with very different traffic
   identical-schedule ``ppermute`` fallback keeps semantics and
   ``comms.ops/bytes{op=ring_topk}`` accounting bit-for-bit comparable.
 
+- **hier** (ISSUE 19): the two-level cross-POD merge for 2-D
+  ``(outer=dcn, inner=ici)`` meshes. The inner (ICI) stage is the ring
+  tier per pod, exactly as today — the Pallas persistent kernel where
+  eligible (:func:`raft_tpu.ops.pallas_kernels.ring_topk_inner_ok`),
+  the ppermute schedule elsewhere — leaving each device its pod's
+  fully-merged ``[mc, k]`` survivor block. Then only those k survivors
+  — never raw candidates — cross DCN once: each device owns a
+  ``1/n_outer`` sub-chunk of its pod block and allgathers every pod's
+  survivors FOR ITS OWNED ROWS over the outer axis (the sparse
+  survivor exchange: one collective, no serial DCN hop chain), selects
+  k of ``n_outer·k`` locally. DCN traffic is the k-survivor model —
+  ``n_outer · mc_d · k`` entries per device, O(k·pods) — independent
+  of how many devices scanned, vs the flat ring's whole
+  ``(n_dev−1)·mc·k`` stream pacing on the slow links. Result is
+  query-sharded over (inner, outer); callers slice ``[:m]``.
+
 ``RAFT_TPU_RING_TOPK`` (auto | on | off, :func:`raft_tpu.obs.env_tristate`)
-picks the tier; explicit ``merge=`` arguments on the search entries
-override. Every decision lands in ``parallel.merge.dispatch{impl=...}``.
+picks the flat tier; ``RAFT_TPU_HIER_MERGE`` (auto | on | off) gates the
+hier tier, auto-on when the caller's 2-D mesh has a DCN-labeled outer
+axis (:func:`raft_tpu.parallel.mesh.is_dcn_axis`); explicit ``merge=``
+arguments on the search entries override both. Every decision lands in
+``parallel.merge.dispatch{impl=...}``.
 """
 
 from __future__ import annotations
 
-from typing import Optional, Tuple
+from typing import Optional, Sequence, Tuple, Union
 
 import jax
 import jax.numpy as jnp
 from jax.sharding import PartitionSpec as P
 
+from raft_tpu.core.compat import axis_size as _axis_size
 from raft_tpu.core.errors import expects
 from raft_tpu.core import ids as _ids
 from raft_tpu.matrix import select_k as _select_k
 from raft_tpu.obs import spans as _obs_spans
 from raft_tpu.ops import pallas_kernels as _pk
 from raft_tpu.parallel.comms import Comms
+from raft_tpu.parallel.mesh import is_dcn_axis
 
-MERGE_TIERS = ("allgather", "ring")
+MERGE_TIERS = ("allgather", "ring", "hier")
+
+# (outer_axis, inner_axis, n_outer, n_inner) — the hier tier's static
+# topology summary, built by the search entries from their mesh + a
+# 2-tuple ``axis`` argument (outer DCN-labeled). None = 1-D exchange.
+HierAxes = Tuple[str, str, int, int]
+
+
+def resolve_exchange(mesh, axis: Union[str, Sequence[str]]
+                     ) -> Tuple[int, bool, Optional[HierAxes]]:
+    """Normalize a search entry's ``axis`` argument — one mesh axis name
+    or a 2-tuple ``(outer, inner)`` — against its mesh. Returns
+    ``(n_dev, whole_mesh, hier_axes)``: device count of the exchange,
+    whether it spans the whole mesh as ONE named axis (the flat ring
+    kernel's logical-id addressing requirement), and the hier topology
+    summary when the tuple's outer axis is DCN-labeled (None otherwise —
+    flat tiers still serve DCN-unlabeled tuples, they just never
+    auto-escalate to hier)."""
+    if isinstance(axis, str):
+        n_dev = mesh.shape[axis]
+        return n_dev, n_dev == mesh.devices.size, None
+    names = tuple(axis)
+    expects(len(names) == 2,
+            "axis must be one mesh axis name or a 2-tuple "
+            "(outer, inner), got %r", axis)
+    outer, inner = names
+    n_outer, n_inner = mesh.shape[outer], mesh.shape[inner]
+    hier = (outer, inner, n_outer, n_inner) if is_dcn_axis(outer) else None
+    return n_outer * n_inner, False, hier
 
 
 def ring_auto_wanted(m: int, k: int, n_dev: int) -> bool:
@@ -54,9 +103,19 @@ def ring_auto_wanted(m: int, k: int, n_dev: int) -> bool:
     return 2 * (n_dev - 1) * mc <= n_dev * m
 
 
+def hier_chunk_rows(m: int, n_inner: int, n_outer: int) -> int:
+    """Per-device query-chunk rows of the hier tier's inner (per-pod)
+    ring: the flat ring's sublane-padded chunk for ``n_inner`` devices,
+    padded up so the outer survivor exchange splits it into ``n_outer``
+    even sub-chunks."""
+    mc = _pk.ring_chunk_rows(m, n_inner)
+    return -(-mc // n_outer) * n_outer
+
+
 def merge_tier(n_dev: int, m: int, k: int,
                explicit: Optional[str] = None,
-               whole_mesh: bool = True) -> Tuple[str, str]:
+               whole_mesh: bool = True,
+               hier_axes: Optional[HierAxes] = None) -> Tuple[str, str]:
     """Pick the merge tier + implementation for one sharded search call.
 
     ``explicit`` (a search entry's ``merge=`` argument, "auto" = defer)
@@ -67,8 +126,30 @@ def merge_tier(n_dev: int, m: int, k: int,
     addresses neighbors by logical device id, so it needs the exchange
     axis to be the ``whole_mesh``; sub-axis rings and non-TPU backends
     ride the ppermute fallback. Returns ``(tier, impl)`` with impl ∈
-    {allgather, ring_kernel, ring_ppermute}; counted per decision under
-    ``parallel.merge.dispatch{impl=...}``."""
+    {allgather, ring_kernel, ring_ppermute, hier}; counted per decision
+    under ``parallel.merge.dispatch{impl=...}``.
+
+    ``hier_axes`` (set by a search entry called with a 2-tuple
+    ``axis`` whose outer axis is DCN-labeled) enables the hier tier:
+    taken on ``merge="hier"`` or, under auto, whenever present unless
+    ``RAFT_TPU_HIER_MERGE=off`` — a topology honest enough to name its
+    slow axis should never flat-merge across it by default."""
+    hier_force = _obs_spans.env_tristate("RAFT_TPU_HIER_MERGE")
+    if explicit == "hier":
+        expects(hier_axes is not None,
+                "merge='hier' needs a 2-D (outer, inner) exchange: call "
+                "the search with axis=(dcn_axis, ici_axis) over a "
+                "hier_mesh-shaped mesh (DCN-labeled outer axis)")
+    if hier_axes is not None and (
+            explicit == "hier"
+            or (explicit in (None, "auto") and hier_force != "off")):
+        _obs_spans.count_dispatch("parallel.merge", "hier")
+        return "hier", "hier"
+    if hier_axes is None and hier_force == "on" \
+            and explicit in (None, "auto"):
+        # env asked for hier but the exchange is 1-D — fall through to
+        # the flat tiers, visibly
+        _obs_spans.count_fallback("parallel.merge", "no_hier_axes")
     force = _obs_spans.env_tristate("RAFT_TPU_RING_TOPK")
     kernel_ok = (_pk._on_tpu() and whole_mesh
                  and _pk.ring_topk_kernel_ok(m, k, n_dev))
@@ -95,18 +176,27 @@ def merge_tier(n_dev: int, m: int, k: int,
     return tier, impl
 
 
-def merge_out_spec(tier: str, axis: str) -> P:
+def merge_out_spec(tier: str, axis: Union[str, Sequence[str]]) -> P:
     """shard_map out-spec for one merged output: the allgather tier
-    replicates, the ring tier leaves results query-sharded."""
+    replicates, the ring tier leaves results query-sharded, the hier
+    tier leaves them sharded over (inner, outer) — device (d, i) owns
+    sub-chunk d of inner chunk i, so the assembled padded query order
+    is exactly the flat one and callers still slice ``[:m]``."""
+    if tier == "hier":
+        outer, inner = axis
+        return P((inner, outer), None)
     return P() if tier == "allgather" else P(axis, None)
 
 
-def merged_rows(tier: str, m: int, n_dev: int) -> int:
+def merged_rows(tier: str, m: int, n_dev: int, n_outer: int = 1) -> int:
     """Global row count of the assembled merge result (the ring tier
     pads the query axis to n_dev chunks of sublane-tiled rows; pad rows
-    sit at the END, so callers slice ``[:m]``)."""
+    sit at the END, so callers slice ``[:m]``). For the hier tier pass
+    ``n_dev`` = the INNER axis size and ``n_outer`` = the pod count."""
     if tier == "allgather":
         return m
+    if tier == "hier":
+        return hier_chunk_rows(m, n_dev, n_outer) * n_dev
     return _pk.ring_chunk_rows(m, n_dev) * n_dev
 
 
@@ -122,13 +212,16 @@ def _merge_allgather(vals, ids, comms, m: int, k: int, n_dev: int,
 
 
 def _ring_merge_fallback(vals, ids, comms, axis, m: int, k: int,
-                         n_dev: int, select_min: bool):
+                         n_dev: int, select_min: bool,
+                         mc: Optional[int] = None):
     """The ppermute ring — the kernel's schedule, collective by
     collective: device ``i`` launches chunk ``(i−1) mod n_dev``'s
     partial, ships its running block right each hop, and merges the
     incoming partial with its local block for that chunk; after
-    n_dev−1 hops device ``i`` owns chunk ``i`` fully merged."""
-    mc = _pk.ring_chunk_rows(m, n_dev)
+    n_dev−1 hops device ``i`` owns chunk ``i`` fully merged. ``mc``
+    overrides the chunk rows (the hier tier's outer-divisible pad)."""
+    if mc is None:
+        mc = _pk.ring_chunk_rows(m, n_dev)
     m_pad = mc * n_dev
     big = jnp.inf if select_min else -jnp.inf
     v = vals.astype(jnp.float32)
@@ -157,7 +250,65 @@ def _ring_merge_fallback(vals, ids, comms, axis, m: int, k: int,
     return run_v, run_i
 
 
-def merge_topk(vals: jax.Array, ids: jax.Array, axis: str, m: int, k: int,
+def _merge_hier(vals, ids, outer: str, inner: str, m: int, k: int,
+                select_min: bool, interpret: bool = False):
+    """Two-level merge (ISSUE 19) — per-pod ring over ``inner`` (ICI),
+    then ONE sparse survivor allgather over ``outer`` (DCN).
+
+    Inner stage: the flat ring tier confined to this pod — the Pallas
+    persistent kernel when the inner axis is eligible
+    (:func:`~raft_tpu.ops.pallas_kernels.ring_topk_inner_ok`), the
+    identical-schedule ppermute fallback otherwise — leaving each
+    device its pod's fully-merged ``[mc, k]`` survivor block for its
+    owned query chunk, ``mc`` padded so ``n_outer`` divides it.
+
+    Outer stage: counterpart devices across pods hold the SAME query
+    chunk, so each device takes ownership of ``mc_d = mc/n_outer`` of
+    those rows and ONE all-to-all over the DCN axis ships pod ``e``'s
+    sub-chunk ``f`` to outer-rank ``f`` — after the exchange this
+    device holds every pod's k survivors (never raw candidates) for
+    its owned rows, and selects k of ``n_outer·k`` locally. Counted
+    ``op=alltoall, axis=<outer>``: ``mc·k`` entries per device =
+    ``n_outer · mc_d · k``, the O(k·pods) k-survivor byte model the
+    scaling CI asserts against the flat ring's stream.
+
+    Each stage rides its own single-axis sub-communicator, so the
+    per-axis ``comms.bytes{axis=ici|dcn}`` attribution falls out of the
+    facade with no special casing."""
+    inner_c = Comms(inner)
+    outer_c = Comms(outer)
+    n_inner = int(_axis_size(inner))
+    n_outer = int(_axis_size(outer))
+    mc = hier_chunk_rows(m, n_inner, n_outer)
+    kernel_ok = (_pk._on_tpu()
+                 and _pk.ring_topk_inner_ok(m, k, n_inner)
+                 and mc == _pk.ring_chunk_rows(m, n_inner)
+                 and jnp.dtype(ids.dtype).itemsize < 8)
+    if kernel_ok:
+        inner_c.count_ring_topk(
+            n_inner - 1,
+            jax.ShapeDtypeStruct((mc, k), jnp.float32),
+            jax.ShapeDtypeStruct((mc, k), jnp.int32))
+        pv, pi = _pk.ring_topk_merge(vals, ids, k, inner, n_inner,
+                                     select_min, interpret=interpret,
+                                     outer_axis=outer)
+    else:
+        pv, pi = _ring_merge_fallback(vals, ids, inner_c, inner, m, k,
+                                      n_inner, select_min, mc=mc)
+    mc_d = mc // n_outer
+    # survivor exchange: one all-to-all over DCN — pod e's sub-chunk f
+    # moves to outer-rank f, so this device receives every pod's
+    # survivors for ITS sub-chunk d (row-block e of the result = pod
+    # e's rows [d·mc_d, (d+1)·mc_d) of the pod-merged chunk)
+    ex_v = outer_c.alltoall(pv).reshape(n_outer, mc_d, k)
+    ex_i = outer_c.alltoall(pi).reshape(n_outer, mc_d, k)
+    flat_v = jnp.transpose(ex_v, (1, 0, 2)).reshape(mc_d, n_outer * k)
+    flat_i = jnp.transpose(ex_i, (1, 0, 2)).reshape(mc_d, n_outer * k)
+    return _select_k(flat_v, k, select_min=select_min, input_indices=flat_i)
+
+
+def merge_topk(vals: jax.Array, ids: jax.Array,
+               axis: Union[str, Sequence[str]], m: int, k: int,
                n_dev: int, select_min: bool, tier: str = "allgather",
                impl: Optional[str] = None, interpret: bool = False
                ) -> Tuple[jax.Array, jax.Array]:
@@ -166,16 +317,25 @@ def merge_topk(vals: jax.Array, ids: jax.Array, axis: str, m: int, k: int,
     (global ids, -1 invalid, invalid keys at the select sentinel).
 
     The allgather tier returns the replicated [m, k] result; the ring
-    tier returns this device's owned query chunk (pair with
-    :func:`merge_out_spec` / :func:`merged_rows`). All traffic rides
-    the ``Comms`` facade — allgather merges count the materialized
-    table, ring merges count n_dev−1 surviving-block hops under
-    ``op=ring_topk`` — so the two tiers' merge-phase bytes are directly
-    comparable in ``comms.bytes`` (the dryrun's scaling assertion)."""
+    tier returns this device's owned query chunk; the hier tier (2-D
+    ``axis=(outer, inner)``) its owned (inner-chunk, outer-sub-chunk)
+    block (pair with :func:`merge_out_spec` / :func:`merged_rows`).
+    All traffic rides the ``Comms`` facade — allgather merges count the
+    materialized table, ring merges count n_dev−1 surviving-block hops
+    under ``op=ring_topk``, hier merges count the per-pod ring on the
+    inner axis plus one survivor allgather on the outer — so the tiers'
+    merge-phase bytes are directly comparable in ``comms.bytes`` (the
+    dryrun's scaling assertions)."""
     expects(tier in MERGE_TIERS, "unknown merge tier %r", tier)
     expects(vals.shape == (m, k) and ids.shape == (m, k),
             "merge_topk expects [m, k] local tables (got %s/%s for "
             "m=%d k=%d)", vals.shape, ids.shape, m, k)
+    if tier == "hier":
+        expects(not isinstance(axis, str) and len(tuple(axis)) == 2,
+                "hier merge needs axis=(outer, inner), got %r", axis)
+        outer, inner = axis
+        return _merge_hier(vals, ids, outer, inner, m, k, select_min,
+                           interpret=interpret)
     comms = Comms(axis)
     if tier == "allgather":
         return _merge_allgather(vals, ids, comms, m, k, n_dev, select_min)

@@ -9,11 +9,28 @@ names instead of a comms handle.
 
 from __future__ import annotations
 
+import math
 from typing import Optional, Sequence
 
 import jax
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+# Axis names the stack treats as the slow (cross-pod / data-center
+# network) interconnect. Everything topology-aware keys off the NAME:
+# the hier merge tier auto-enables when a 2-D mesh's outer axis is
+# DCN-labeled, obsdump picks the per-axis bandwidth peak by it, and
+# hier_mesh refuses outer axes that are not. Canonical 2-D naming is
+# HIER_AXIS_NAMES = (outer, inner) = ("dcn", "ici").
+DCN_AXIS_PREFIXES = ("dcn", "pod", "slice")
+HIER_AXIS_NAMES = ("dcn", "ici")
+
+
+def is_dcn_axis(name: object) -> bool:
+    """True when ``name`` labels a slow (cross-pod) mesh axis — the
+    naming convention the hier merge's auto-dispatch and the per-axis
+    roofline peaks key off (:data:`DCN_AXIS_PREFIXES`)."""
+    return isinstance(name, str) and name.lower().startswith(DCN_AXIS_PREFIXES)
 
 
 def make_mesh(shape: Optional[Sequence[int]] = None,
@@ -44,16 +61,89 @@ def make_hybrid_mesh(ici_shape: Sequence[int], dcn_shape: Sequence[int],
     return Mesh(devices, tuple(axis_names))
 
 
-def submesh(mesh: Mesh, n_dev: int, axis_names: Sequence[str] = ("shard",)
-            ) -> Mesh:
-    """A 1-D mesh over the first ``n_dev`` devices of ``mesh`` — the
+def hier_mesh(ici_size: int, dcn_size: int,
+              axis_names: Sequence[str] = HIER_AXIS_NAMES,
+              devices=None) -> Mesh:
+    """A 2-D ``(dcn_size, ici_size)`` mesh with the slow axis outermost
+    — the topology object of the hierarchical merge tier (pods of
+    ``ici_size`` devices joined over DCN).
+
+    Validation is by NAME, because everything downstream dispatches by
+    name: the outer axis must be DCN-labeled (:func:`is_dcn_axis`) and
+    the inner must not be — a mesh whose outer axis is the fast one
+    would silently route the bulky per-pod exchange over the slow
+    interconnect. On a real multislice platform build the device grid
+    with :func:`make_hybrid_mesh` and pass it via ``devices``; on one
+    slice (or the CPU CI mesh) the plain reshape below is the same
+    topology simulation the scaling legs use."""
+    outer, inner = _hier_axis_pair(axis_names)
+    if ici_size < 1 or dcn_size < 1:
+        raise ValueError(f"hier_mesh sizes must be >= 1, got "
+                         f"ici_size={ici_size} dcn_size={dcn_size}")
+    if devices is None:
+        devices = jax.devices()
+    flat = list(np.asarray(devices).reshape(-1))
+    need = ici_size * dcn_size
+    if need > len(flat):
+        raise ValueError(f"hier_mesh needs {need} devices "
+                         f"({dcn_size}x{ici_size}), have {len(flat)}")
+    return make_mesh(shape=(dcn_size, ici_size),
+                     axis_names=(outer, inner), devices=flat[:need])
+
+
+def _hier_axis_pair(axis_names: Sequence[str]) -> Sequence[str]:
+    """Validate a 2-D (outer, inner) axis naming: outer slow, inner
+    fast. Shared by :func:`hier_mesh` and the named-axis ``submesh``."""
+    names = tuple(axis_names)
+    if len(names) != 2:
+        raise ValueError(f"expected (outer, inner) axis names, "
+                         f"got {names!r}")
+    outer, inner = names
+    if not is_dcn_axis(outer):
+        raise ValueError(
+            f"outer axis {outer!r} is not DCN-labeled (prefixes "
+            f"{DCN_AXIS_PREFIXES}): the slow axis must be outermost, or "
+            "the hier tier would ship the per-pod exchange cross-pod")
+    if is_dcn_axis(inner):
+        raise ValueError(
+            f"inner axis {inner!r} is DCN-labeled: the intra-pod (fast) "
+            "axis must be innermost")
+    return names
+
+
+def submesh(mesh: Mesh, n_dev: int, axis_names: Sequence[str] = ("shard",),
+            shape: Optional[Sequence[int]] = None) -> Mesh:
+    """A mesh over the first ``n_dev`` devices of ``mesh`` — the
     scaling-study helper (weak/strong legs at n_dev ∈ {2, 4, 8} reuse
-    one device pool instead of re-enumerating the platform)."""
+    one device pool instead of re-enumerating the platform).
+
+    Default is the 1-D carve. With ``shape`` (and matching
+    ``axis_names``) it carves a named multi-axis submesh — the 2-level
+    scaling legs' ``submesh(full, 8, ("dcn", "ici"), shape=(2, 4))``;
+    2-D carves get the same outer-slow naming validation as
+    :func:`hier_mesh`."""
+    if shape is None:
+        if len(axis_names) != 1:
+            raise ValueError(
+                f"submesh with {len(axis_names)} axis names needs an "
+                "explicit shape (the 1-D default cannot be inferred)")
+        shape = (n_dev,)
+    else:
+        shape = tuple(shape)
+        if len(shape) != len(axis_names):
+            raise ValueError(f"shape {shape} does not match axis names "
+                             f"{tuple(axis_names)}")
+        if math.prod(shape) != n_dev:
+            raise ValueError(f"shape {shape} covers {math.prod(shape)} "
+                             f"devices, asked for {n_dev}")
+        if len(shape) == 2:
+            _hier_axis_pair(axis_names)
     flat = list(np.asarray(mesh.devices).reshape(-1))
     if n_dev > len(flat):
         raise ValueError(f"submesh of {n_dev} devices from a "
                          f"{len(flat)}-device mesh")
-    return make_mesh(axis_names=axis_names, devices=flat[:n_dev])
+    return make_mesh(shape=shape, axis_names=axis_names,
+                     devices=flat[:n_dev])
 
 
 def shard_rows(x: jax.Array, mesh: Mesh, axis: str = "shard") -> jax.Array:

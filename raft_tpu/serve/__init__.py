@@ -29,7 +29,12 @@ story that nothing upstream provides on TPU.
 - :mod:`raft_tpu.serve.slo`      — SLO guardrails (ISSUE 16):
   multi-window burn rates over the latency/shed series, and per-tenant
   recall floors closing the loop from the shadow verifier's confidence
-  intervals to health state and the degrade-ladder quality gate.
+  intervals to health state and the degrade-ladder quality gate;
+- :mod:`raft_tpu.serve.router`   — fleet router (ISSUE 19): tenant
+  placement across pods (replicate hot, keep sharded builds on their
+  pod), the one request Deadline carried across the pod hop, and the
+  PR-15 straggler table consumed as a steering control loop with typed
+  failover/shed accounting (``serve.router.*`` counters).
 
 Counters: ``serve.requests``, ``serve.shed{reason=}``,
 ``serve.batch_fill``, ``serve.latency_s``, ``serve.deadline_missed``,
@@ -49,6 +54,14 @@ from raft_tpu.serve.errors import (  # noqa: F401
 )
 from raft_tpu.serve.loadgen import record, run_step, sweep  # noqa: F401
 from raft_tpu.serve.placement import Placement  # noqa: F401
+from raft_tpu.serve.router import (  # noqa: F401
+    FleetRouter,
+    Pod,
+    RouterPolicy,
+    clear_router,
+    get_router,
+    set_router,
+)
 from raft_tpu.serve.registry import (  # noqa: F401
     IndexRegistry,
     Tenant,

@@ -29,7 +29,7 @@ __all__ = ["ServeError", "ShedError", "TenantUnknown", "AdmissionError",
 # (docs/observability.md). A new shed path must add its reason here so
 # the counter family stays enumerable for dashboards and the chaos lane.
 SHED_REASONS = ("queue_full", "deadline", "overload", "draining",
-                "not_running")
+                "not_running", "pod_unhealthy")
 
 
 class ServeError(RuntimeError):

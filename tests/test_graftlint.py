@@ -313,6 +313,40 @@ def test_gl06_quiet_on_wrapped_and_axis_generic_code():
     assert not [f for f in lint(GL06_GOOD) if f.rule == "GL06"]
 
 
+GL06_TUPLE = """
+from jax.sharding import PartitionSpec as P
+from raft_tpu.core.compat import shard_map
+from raft_tpu.parallel.comms import Comms
+from raft_tpu.parallel.mesh import hier_mesh
+
+HIER_AXIS_NAMES = ("dcn", "ici")
+
+
+def run(x, n_outer, n_inner):
+    mesh = hier_mesh(n_inner, n_outer, axis_names=HIER_AXIS_NAMES)
+
+    def local(v):
+        inner = Comms("ici")
+        outer = Comms("dcn")
+        return outer.allgather(inner.allreduce(v))
+
+    fn = shard_map(local, mesh=mesh,
+                   in_specs=(P(("dcn", "ici"), None),),
+                   out_specs=P(("dcn", "ici"), None), check_vma=False)
+    return fn(x)
+"""
+
+
+def test_gl06_resolves_tuple_axis_consts():
+    # the 2-D mesh idiom: axis names live in a module tuple constant
+    # handed to the mesh constructor — both constituent axes are bound
+    assert not [f for f in lint(GL06_TUPLE) if f.rule == "GL06"]
+    typo = GL06_TUPLE.replace('Comms("ici")', 'Comms("icy")')
+    findings = [f for f in lint(typo) if f.rule == "GL06"]
+    assert len(findings) == 1
+    assert "not bound" in findings[0].message
+
+
 # ---------------------------------------------------------------------------
 # GL07 — static ppermute perms
 # ---------------------------------------------------------------------------
@@ -568,6 +602,40 @@ def test_gl09_fires_on_arity_and_axis_mismatch():
                                   "P(axis, None),") \
                          .replace('P("replicas")', "P(axis)")
     assert not [f for f in lint(src_prefix) if f.rule == "GL09"]
+
+
+GL09_TUPLE = """
+from jax.sharding import PartitionSpec as P
+from raft_tpu.core.compat import shard_map
+from raft_tpu.parallel.mesh import hier_mesh
+
+HIER_AXIS_NAMES = ("dcn", "ici")
+MESH = hier_mesh(4, 2, axis_names=HIER_AXIS_NAMES)
+
+
+def local(v):
+    return v
+
+
+def run(x):
+    fn = shard_map(local, mesh=MESH,
+                   in_specs=(P(HIER_AXIS_NAMES, None),),
+                   out_specs=P(("dcn", "ici"), None), check_vma=False)
+    return fn(x)
+"""
+
+
+def test_gl09_resolves_tuple_axis_consts_via_mesh_binding():
+    # mesh axes come from a module-level hier_mesh binding whose
+    # axis_names is a tuple constant; P() joint-sharding over the tuple
+    # (literal or via the same constant) resolves against them
+    assert not [f for f in lint(GL09_TUPLE) if f.rule == "GL09"]
+    typo = GL09_TUPLE.replace('out_specs=P(("dcn", "ici")',
+                              'out_specs=P(("dcn", "icy")')
+    findings = [f for f in lint(typo) if f.rule == "GL09"]
+    assert len(findings) == 1
+    assert "'icy'" in findings[0].message
+    assert "'dcn'" in findings[0].message and "'ici'" in findings[0].message
 
 
 # ---------------------------------------------------------------------------

@@ -189,7 +189,10 @@ class TestCommsTelemetry:
 
     def test_two_axis_mesh_attributes_per_axis(self, reg):
         # DCN×ICI-shaped mesh: sub-communicator traffic must label its
-        # own axis (the per-axis attribution the MULTICHIP record needs)
+        # own axis, and a WORLD (tuple-axis) collective must decompose
+        # into one counted stage per constituent axis instead of the
+        # old lumped dcn+ici label (the per-axis attribution the
+        # MULTICHIP record and the per-axis roofline need)
         mesh2 = make_mesh(shape=(2, N_DEV // 2), axis_names=("dcn", "ici"))
         world = Comms(("dcn", "ici"))
         ici, dcn = world.comm_split("ici"), world.comm_split("dcn")
@@ -204,11 +207,29 @@ class TestCommsTelemetry:
         np.testing.assert_allclose(np.asarray(out),
                                    np.full(N_DEV, float(expect)))
         c = self._counters(reg)
-        assert c["comms.ops{axis=ici,op=allreduce}"] == 1.0
-        assert c["comms.ops{axis=dcn,op=allreduce}"] == 1.0
-        assert c["comms.ops{axis=dcn+ici,op=allreduce}"] == 1.0
-        for axis in ("ici", "dcn", "dcn+ici"):
-            assert c[f"comms.bytes{{axis={axis},op=allreduce}}"] == 4.0
+        # one explicit sub-axis allreduce each + one per-axis stage of
+        # the world allreduce = 2 ops / 2×4 B per axis; no lumped key
+        assert c["comms.ops{axis=ici,op=allreduce}"] == 2.0
+        assert c["comms.ops{axis=dcn,op=allreduce}"] == 2.0
+        for axis in ("ici", "dcn"):
+            assert c[f"comms.bytes{{axis={axis},op=allreduce}}"] == 8.0
+        assert not any("dcn+ici" in key for key in c), c
+
+    def test_world_gather_family_charges_cumulative_stages(self, reg):
+        # gather-family payload grows as it climbs the hierarchy: the
+        # inner stage materializes size(inner)×payload, the outer stage
+        # ships THAT times size(outer) — the byte model that keeps a
+        # world allgather honest about what actually crosses DCN
+        mesh2 = make_mesh(shape=(2, N_DEV // 2), axis_names=("dcn", "ici"))
+        world = Comms(("dcn", "ici"))
+        shard_map(lambda v: jnp.sum(world.allgather(v))[None],
+                  mesh=mesh2, in_specs=(P(("dcn", "ici")),),
+                  out_specs=P(("dcn", "ici")), check_vma=False)(
+            jnp.arange(N_DEV, dtype=jnp.float32))
+        c = self._counters(reg)
+        # payload 4 B: ici stage 4×4 = 16, dcn stage 16×2 = 32
+        assert c["comms.bytes{axis=ici,op=allgather}"] == 16.0
+        assert c["comms.bytes{axis=dcn,op=allgather}"] == 32.0
 
     def test_sharded_knn_and_distributed_kmeans_count(self, mesh, reg,
                                                       rng):
@@ -289,3 +310,145 @@ class TestShardedKnn:
         hits = sum(len(set(g) & set(r)) for g, r in
                    zip(np.asarray(ids), ref_i))
         assert hits / ref_i.size >= 0.99
+
+
+class TestHierMerge:
+    """The ISSUE-19 two-level merge: per-pod ring over ICI, one sparse
+    survivor exchange over DCN — identity with the flat tiers, the
+    O(k·pods) DCN byte model, and the dispatch/validation surface."""
+
+    @pytest.fixture
+    def reg(self):
+        from raft_tpu import obs
+        from raft_tpu.obs.metrics import MetricsRegistry
+
+        reg = MetricsRegistry()
+        obs.enable(registry=reg, hbm=False)
+        yield reg
+        obs.disable()
+
+    def _counters(self, reg):
+        return reg.snapshot()["counters"]
+
+    @pytest.mark.parametrize("dcn_size,ici_size", [(2, 4), (4, 2)])
+    def test_hier_matches_flat_bit_identically(self, mesh, rng,
+                                               dcn_size, ici_size):
+        from raft_tpu.parallel import hier_mesh
+
+        x = jnp.asarray(rng.random((1024, 16), dtype=np.float32))
+        q = jnp.asarray(rng.random((96, 16), dtype=np.float32))
+        fv, fi = sharded_knn(x, q, 10, mesh, merge="allgather")
+        mesh2 = hier_mesh(ici_size, dcn_size)
+        hv, hi = sharded_knn(x, q, 10, mesh2, axis=("dcn", "ici"))
+        assert np.array_equal(np.asarray(fi), np.asarray(hi))
+        assert np.array_equal(np.asarray(fv), np.asarray(hv))
+
+    def test_hier_dcn_bytes_match_survivor_model(self, rng, reg):
+        from raft_tpu.parallel import hier_chunk_rows, hier_mesh
+
+        m, k, n_inner, n_outer = 96, 10, 4, 2
+        x = jnp.asarray(rng.random((1024, 16), dtype=np.float32))
+        q = jnp.asarray(rng.random((m, 16), dtype=np.float32))
+        mesh2 = hier_mesh(n_inner, n_outer)
+        sharded_knn(x, q, k, mesh2, axis=("dcn", "ici"))
+        c = self._counters(reg)
+        assert c["parallel.merge.dispatch{impl=hier}"] == 1.0
+        mc = hier_chunk_rows(m, n_inner, n_outer)
+        # k survivors per pod × owned sub-chunk rows, f32 vals + i32 ids
+        model = n_outer * (mc // n_outer) * k * 8
+        dcn = sum(v for key, v in c.items()
+                  if key.startswith("comms.bytes{") and "axis=dcn" in key)
+        ici = sum(v for key, v in c.items()
+                  if key.startswith("comms.bytes{") and "axis=ici" in key)
+        assert dcn == model, (dcn, model, c)
+        assert ici > 0, c
+
+    def test_hier_dcn_bytes_below_flat_ring_cross_pod(self, rng):
+        from raft_tpu import obs
+        from raft_tpu.obs.metrics import MetricsRegistry
+        from raft_tpu.parallel import hier_mesh
+
+        x = jnp.asarray(rng.random((1024, 16), dtype=np.float32))
+        q = jnp.asarray(rng.random((96, 16), dtype=np.float32))
+        mesh2 = hier_mesh(4, 2)
+
+        def dcn_bytes(**kw):
+            reg = MetricsRegistry()
+            obs.enable(registry=reg, hbm=False)
+            try:
+                sharded_knn(x, q, 10, mesh2, axis=("dcn", "ici"), **kw)
+            finally:
+                obs.disable()
+            return sum(v for key, v in reg.snapshot()["counters"].items()
+                       if key.startswith("comms.bytes{")
+                       and "axis=dcn" in key)
+
+        hier = dcn_bytes()
+        # the topology-blind flat ring paces its whole stream cross-pod
+        flat_ring = dcn_bytes(merge="ring")
+        assert 0 < hier < flat_ring, (hier, flat_ring)
+
+    def test_hier_env_off_falls_back_flat(self, rng, reg, monkeypatch):
+        from raft_tpu.parallel import hier_mesh
+
+        monkeypatch.setenv("RAFT_TPU_HIER_MERGE", "off")
+        x = jnp.asarray(rng.random((256, 16), dtype=np.float32))
+        q = jnp.asarray(rng.random((16, 16), dtype=np.float32))
+        mesh2 = hier_mesh(4, 2)
+        fv, fi = sharded_knn(x, q, 5, mesh2, axis=("dcn", "ici"))
+        c = self._counters(reg)
+        assert "parallel.merge.dispatch{impl=hier}" not in c, c
+        # explicit merge="hier" still overrides the env kill switch
+        hv, hi = sharded_knn(x, q, 5, mesh2, axis=("dcn", "ici"),
+                             merge="hier")
+        assert np.array_equal(np.asarray(fi), np.asarray(hi))
+
+    def test_merge_tier_dispatch_and_validation(self, reg):
+        from raft_tpu.core.errors import LogicError
+        from raft_tpu.parallel import merge_tier
+
+        assert merge_tier(8, 256, 10,
+                          hier_axes=("dcn", "ici", 2, 4)) == ("hier",
+                                                              "hier")
+        with pytest.raises(LogicError, match="hier"):
+            merge_tier(8, 256, 10, explicit="hier")  # 1-D exchange
+        c = self._counters(reg)
+        assert c["parallel.merge.dispatch{impl=hier}"] == 1.0
+
+    def test_merge_tier_env_on_without_axes_counts_fallback(
+            self, reg, monkeypatch):
+        from raft_tpu.parallel import merge_tier
+
+        monkeypatch.setenv("RAFT_TPU_HIER_MERGE", "on")
+        tier, _ = merge_tier(8, 256, 10)
+        assert tier != "hier"
+        c = self._counters(reg)
+        assert c["parallel.merge.fallback{reason=no_hier_axes}"] == 1.0
+
+    def test_hier_mesh_validates_axis_naming(self, mesh):
+        from raft_tpu.core.errors import LogicError
+        from raft_tpu.parallel import hier_mesh, submesh
+
+        with pytest.raises(ValueError, match="slow axis must be outermost"):
+            hier_mesh(4, 2, axis_names=("fast", "ici"))
+        with pytest.raises(ValueError, match="DCN-labeled"):
+            hier_mesh(4, 2, axis_names=("dcn", "pod2"))
+        with pytest.raises(ValueError, match="slow axis must be outermost"):
+            submesh(mesh, 8, ("ici", "dcn"), shape=(2, 4))
+        with pytest.raises(ValueError, match="explicit shape"):
+            submesh(mesh, 8, ("dcn", "ici"))
+        m2 = submesh(mesh, 8, ("dcn", "ici"), shape=(2, 4))
+        assert dict(zip(m2.axis_names, m2.devices.shape)) == \
+            {"dcn": 2, "ici": 4}
+
+    def test_non_dcn_outer_tuple_stays_flat(self, rng, reg):
+        from raft_tpu.parallel import make_mesh as mk
+
+        # a 2-D exchange whose outer axis is NOT DCN-labeled merges
+        # flat (no hier auto-escalation, no hier dispatch counter)
+        mesh2 = mk(shape=(2, 4), axis_names=("rows", "cols"))
+        x = jnp.asarray(rng.random((256, 16), dtype=np.float32))
+        q = jnp.asarray(rng.random((16, 16), dtype=np.float32))
+        sharded_knn(x, q, 5, mesh2, axis=("rows", "cols"))
+        c = self._counters(reg)
+        assert "parallel.merge.dispatch{impl=hier}" not in c, c

@@ -1105,3 +1105,167 @@ class TestQualityPlane:
             server.search("flat", data[0], 10)
         assert "quality.recall{k=10,tenant=flat}" not in \
             reg.snapshot()["gauges"]
+
+
+# ---------------------------------------------------------------------------
+# fleet router (ISSUE 19)
+# ---------------------------------------------------------------------------
+
+class TestFleetRouter:
+    """Straggler-steered cross-pod routing: placement, the one Deadline
+    across the hop, the chaos pod-kill leg with exact shed/degrade
+    accounting, and the steering control loop over the PR-15 straggler
+    table feed."""
+
+    def _capture_pod(self, name, hosts=()):
+        calls = []
+
+        def fn(tenant, queries, k, deadline):
+            calls.append((tenant, deadline))
+            return np.zeros((len(queries), k)), np.zeros((len(queries), k),
+                                                         np.int64)
+
+        return serve.Pod(name, hosts=hosts, dispatch_fn=fn), calls
+
+    def test_placement_modes(self, pq_index):
+        regs = [serve.IndexRegistry(budget_bytes=1 << 30) for _ in range(2)]
+        router = serve.FleetRouter([
+            serve.Pod("a", registry=regs[0]),
+            serve.Pod("b", registry=regs[1])])
+        assert sorted(router.place("hot", pq_index, hot=True,
+                                   params=PQ_PARAMS)) == ["a", "b"]
+        assert len(router.place("big", pq_index, sharded=True,
+                                params=PQ_PARAMS)) == 1
+        # single placement balances onto the emptier pod
+        single = router.place("small", pq_index, params=PQ_PARAMS)
+        assert len(single) == 1
+        counts = {p.name: len(p.registry.resident()) for p in router.pods}
+        assert abs(counts["a"] - counts["b"]) <= 1
+
+    def test_straggler_feed_steers_dispatch(self):
+        mreg = MetricsRegistry()
+        obs.enable(registry=mreg, hbm=False)
+        pod_a, calls_a = self._capture_pod("a", hosts=("hostA",))
+        pod_b, calls_b = self._capture_pod("b", hosts=("hostB",))
+        router = serve.FleetRouter([pod_a, pod_b])
+        q = np.zeros((2, 4), np.float32)
+        router.dispatch("t", q, 3)
+        router.dispatch("t", q, 3)
+        assert calls_a and calls_b  # round-robin while both clean
+        # PR-15 straggler-table shape: hostB lags 50% over fleet mean
+        n = router.note_stragglers([
+            {"collective": "comms.ring_topk", "slowest": "hostB",
+             "skew_frac": 0.50},
+            {"collective": "comms.allreduce", "slowest": "hostB",
+             "skew_frac": 0.01}])   # below threshold: ignored
+        assert n == 1
+        before = len(calls_b)
+        for _ in range(6):
+            router.dispatch("t", q, 3)
+        assert len(calls_b) == before   # steered away from hostB's pod
+        c = _counters(mreg)
+        assert c["serve.router.straggler{host=hostB}"] == 1.0
+        assert c["serve.router.steer{away_from=hostB,reason=straggler}"] \
+            >= 1.0
+        assert router.describe()["pods"][1]["straggling"] is True
+
+    def test_straggler_sighting_expires(self):
+        now = [0.0]
+        pod_a, calls_a = self._capture_pod("a", hosts=("hostA",))
+        pod_b, calls_b = self._capture_pod("b", hosts=("hostB",))
+        router = serve.FleetRouter(
+            [pod_a, pod_b], serve.RouterPolicy(lag_window_s=60.0),
+            clock=lambda: now[0])
+        router.note_stragglers([{"slowest": "hostB", "skew_frac": 0.9}])
+        assert router.straggling_hosts() == ["hostB"]
+        now[0] = 61.0
+        assert router.straggling_hosts() == []  # recovered host wins back
+        q = np.zeros((1, 4), np.float32)
+        for _ in range(4):
+            router.dispatch("t", q, 3)
+        assert calls_b
+
+    def test_one_deadline_object_crosses_the_hop(self):
+        pod, calls = self._capture_pod("a")
+        router = serve.FleetRouter([pod])
+        dl = retry.Deadline(5.0)
+        router.dispatch("t", np.zeros((1, 4), np.float32), 3, deadline=dl)
+        assert calls[0][1] is dl    # the ONE request budget, untouched
+
+    def test_pod_kill_mid_storm_degraded_but_correct(self, data):
+        # the ISSUE-19 chaos leg: two simulated pods on 4-device halves
+        # of the 8-dev CPU mesh serve a replicated tenant; the DCN hop
+        # to pod b dies mid-query-storm; every answered request must
+        # equal the fault-free reference (degraded-but-correct from the
+        # surviving pod) with exact failover accounting
+        import jax
+        from raft_tpu.parallel import make_mesh, sharded_knn
+
+        devs = jax.devices()
+        assert len(devs) >= 8, "CPU CI mesh must present 8 devices"
+        mreg = MetricsRegistry()
+        obs.enable(registry=mreg, hbm=False)
+        x = jnp.asarray(data[:1024])
+        queries = np.asarray(data[:16], np.float32)
+        k = 5
+
+        def pod_fn(mesh):
+            def fn(tenant, q, k_, deadline):
+                v, i = sharded_knn(x, jnp.asarray(q), k_, mesh)
+                return np.asarray(v), np.asarray(i)
+            return fn
+
+        mesh_a = make_mesh(devices=devs[:4])
+        mesh_b = make_mesh(devices=devs[4:8])
+        ref_v, ref_i = pod_fn(mesh_a)("t", queries, k, None)
+        router = serve.FleetRouter([
+            serve.Pod("a", hosts=("hostA",), dispatch_fn=pod_fn(mesh_a)),
+            serve.Pod("b", hosts=("hostB",), dispatch_fn=pod_fn(mesh_b))])
+        # pod b's DCN hop dies permanently at its 3rd crossing
+        faults.install_plan({"faults": [
+            {"site": "serve.router.hop.b", "kind": "error",
+             "after": 3, "times": 0}]})
+        answers = [router.dispatch("t", queries, k) for _ in range(10)]
+        for v, i in answers:    # degraded-but-correct: every request
+            np.testing.assert_array_equal(i, ref_i)
+            np.testing.assert_allclose(v, ref_v, rtol=1e-5)
+        assert not router.pods[1].healthy
+        c = _counters(mreg)
+        assert c["serve.router.pod_down{pod=b}"] == 1.0
+        assert c["serve.router.degraded{reason=pod_lost}"] == 1.0
+        assert c["serve.router.requests{tenant=t}"] == 10.0
+        assert "serve.router.shed{reason=pod_unhealthy}" not in c
+        # now the whole fleet dies: the refusal is typed, counted once
+        faults.install_plan({"faults": [
+            {"site": "serve.router.hop.a", "kind": "error", "times": 0}]})
+        with pytest.raises(serve.ShedError) as exc:
+            router.dispatch("t", queries, k)
+        assert exc.value.reason == "pod_unhealthy"
+        c = _counters(mreg)
+        assert c["serve.router.shed{reason=pod_unhealthy}"] == 1.0
+        assert c["serve.router.pod_down{pod=a}"] == 1.0
+
+    def test_request_scoped_refusals_propagate_not_pod_down(self):
+        mreg = MetricsRegistry()
+        obs.enable(registry=mreg, hbm=False)
+
+        def fn(tenant, q, k, deadline):
+            raise retry.DeadlineExceeded("serve.dispatch",
+                                         retry.Deadline(0.0))
+
+        router = serve.FleetRouter([serve.Pod("a", dispatch_fn=fn)])
+        with pytest.raises(retry.DeadlineExceeded):
+            router.dispatch("t", np.zeros((1, 4), np.float32), 3)
+        assert router.pods[0].healthy    # the request's problem
+        assert "serve.router.pod_down{pod=a}" not in _counters(mreg)
+
+    def test_global_install_clear_races(self):
+        pod, _ = self._capture_pod("a")
+        r1 = serve.FleetRouter([pod])
+        r2 = serve.FleetRouter([pod])
+        assert serve.set_router(r1) is None
+        assert serve.get_router() is r1
+        serve.clear_router(r2)            # stale teardown: no-op
+        assert serve.get_router() is r1
+        serve.clear_router(r1)
+        assert serve.get_router() is None

@@ -53,7 +53,8 @@ MODULES = [
     "raft_tpu.serve.server", "raft_tpu.serve.registry",
     "raft_tpu.serve.placement",
     "raft_tpu.serve.dispatch", "raft_tpu.serve.loadgen",
-    "raft_tpu.serve.slo", "raft_tpu.serve.errors",
+    "raft_tpu.serve.slo", "raft_tpu.serve.router",
+    "raft_tpu.serve.errors",
     "raft_tpu.ops.pallas_kernels", "raft_tpu.native",
     "raft_tpu.bench.dataset", "raft_tpu.bench.runner",
     "raft_tpu.bench.ingest", "raft_tpu.bench.plot",

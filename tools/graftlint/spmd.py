@@ -91,14 +91,22 @@ def _enclosing(node: ast.AST, parents: _Parents) -> List[_FnLike]:
     return out
 
 
-def _module_strs(tree: ast.Module) -> Dict[str, str]:
-    out: Dict[str, str] = {}
+def _module_strs(tree: ast.Module) -> Dict[str, object]:
+    """Module-level constants usable as axis names: bare strings and
+    tuples/lists of strings (2-D mesh axis bundles like
+    ``HIER_AXIS_NAMES = ("dcn", "ici")``)."""
+    out: Dict[str, object] = {}
     for node in tree.body:
-        if isinstance(node, ast.Assign) and len(node.targets) == 1 \
-                and isinstance(node.targets[0], ast.Name) \
-                and isinstance(node.value, ast.Constant) \
-                and isinstance(node.value.value, str):
-            out[node.targets[0].id] = node.value.value
+        if not (isinstance(node, ast.Assign) and len(node.targets) == 1
+                and isinstance(node.targets[0], ast.Name)):
+            continue
+        val = node.value
+        if isinstance(val, ast.Constant) and isinstance(val.value, str):
+            out[node.targets[0].id] = val.value
+        elif isinstance(val, (ast.Tuple, ast.List)) and val.elts and all(
+                isinstance(e, ast.Constant) and isinstance(e.value, str)
+                for e in val.elts):
+            out[node.targets[0].id] = tuple(e.value for e in val.elts)
     return out
 
 
@@ -138,7 +146,7 @@ def _str_default(fn: _FnLike, name: str):
 
 
 def _resolve_axis(expr: ast.AST, chain: Sequence[_FnLike],
-                  mod_strs: Dict[str, str]):
+                  mod_strs: Dict[str, object]):
     """Statically resolve an axis-name expression to a str, a tuple of
     strs (multi-axis), or None when dynamic."""
     if isinstance(expr, ast.Constant) and isinstance(expr.value, str):
@@ -165,14 +173,17 @@ def _axis_strs(resolved) -> List[str]:
     return []
 
 
-def _mesh_call_axes(call: ast.Call) -> Optional[Set[str]]:
+def _mesh_call_axes(call: ast.Call,
+                    mod_strs: Optional[Dict[str, object]] = None
+                    ) -> Optional[Set[str]]:
     """String axis names of a mesh-constructor call (``Mesh`` /
-    ``make_mesh`` / ``make_hybrid_mesh`` with a literal ``axis_names``),
+    ``make_mesh`` / ``make_hybrid_mesh`` / ``hier_mesh`` with an
+    ``axis_names`` that is literal or a module string/tuple constant),
     or None when ``call`` is not a mesh construction / not static.
     Single source of truth for GL06's declaration set and GL09's mesh
     resolution."""
     seg = _last_seg(_dotted(call.func))
-    if seg not in ("Mesh", "make_mesh", "make_hybrid_mesh"):
+    if seg not in ("Mesh", "make_mesh", "make_hybrid_mesh", "hier_mesh"):
         return None
     cand = None
     for kw in call.keywords:
@@ -182,14 +193,21 @@ def _mesh_call_axes(call: ast.Call) -> Optional[Set[str]]:
         cand = call.args[1]
     if cand is None:
         return None
+    if isinstance(cand, ast.Name) and mod_strs is not None:
+        const = mod_strs.get(cand.id)
+        if isinstance(const, str):
+            return {const}
+        if isinstance(const, tuple):
+            return set(const)
     return {el.value for el in ast.walk(cand)
             if isinstance(el, ast.Constant) and isinstance(el.value, str)}
 
 
-def _declared_axes(tree: ast.Module, mod_strs: Dict[str, str]) -> Set[str]:
+def _declared_axes(tree: ast.Module,
+                   mod_strs: Dict[str, object]) -> Set[str]:
     """Axis names the module binds: mesh constructions with literal
     ``axis_names``, string defaults of parameters named axis/axis_name/
-    axis_names, and axis-named module string constants."""
+    axis_names, and axis-named module string/tuple constants."""
     axes: Set[str] = set()
 
     def strs_of(node: ast.AST) -> None:
@@ -199,7 +217,7 @@ def _declared_axes(tree: ast.Module, mod_strs: Dict[str, str]) -> Set[str]:
 
     for node in cached_walk(tree):
         if isinstance(node, ast.Call):
-            mesh_axes = _mesh_call_axes(node)
+            mesh_axes = _mesh_call_axes(node, mod_strs)
             if mesh_axes:
                 axes.update(mesh_axes)
         elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
@@ -214,8 +232,13 @@ def _declared_axes(tree: ast.Module, mod_strs: Dict[str, str]) -> Set[str]:
                 if p.arg in _AXIS_PARAM_NAMES and d is not None:
                     strs_of(d)
     for name, val in mod_strs.items():
-        if "axis" in name.lower():
+        low = name.lower()
+        if "axis" not in low and "axes" not in low:
+            continue
+        if isinstance(val, str):
             axes.add(val)
+        else:
+            axes.update(val)
     return axes
 
 
@@ -253,7 +276,7 @@ class _ModuleInfo:
     parents: _Parents
     path: str
     env: Dict[str, int]
-    mod_strs: Dict[str, str]
+    mod_strs: Dict[str, object]
     calls: List[ast.Call]
     lax_names: Set[str]
     declared_axes: Set[str]
@@ -848,14 +871,14 @@ def _mesh_axes(expr: ast.AST, info: _ModuleInfo) -> Set[str]:
     """Mesh axis names when statically resolvable (inline construction
     or module-level binding with literal axis_names); empty otherwise."""
     if isinstance(expr, ast.Call):
-        return _mesh_call_axes(expr) or set()
+        return _mesh_call_axes(expr, info.mod_strs) or set()
     if isinstance(expr, ast.Name):
         for node in info.tree.body:
             if isinstance(node, ast.Assign) and len(node.targets) == 1 \
                     and isinstance(node.targets[0], ast.Name) \
                     and node.targets[0].id == expr.id \
                     and isinstance(node.value, ast.Call):
-                return _mesh_call_axes(node.value) or set()
+                return _mesh_call_axes(node.value, info.mod_strs) or set()
     return set()
 
 

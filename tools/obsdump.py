@@ -252,6 +252,14 @@ def spans_table(snap: Dict[str, Any], top: int) -> str:
 
 
 def comms_table(snap: Dict[str, Any]) -> str:
+    """Comm traffic by op × axis, rooflined per axis: each row's
+    payload is divided by ITS axis's bandwidth ceiling
+    (``obs.prof.axis_peak_bw`` — the DCN figure for DCN-labeled axes,
+    ICI otherwise), so the ``s_at_peak``/``frac`` columns answer the
+    cross-pod question directly — a DCN row with 1/30th the bytes of
+    an ICI row can still dominate the interconnect time."""
+    prof = _load_obs_module("prof")
+    peak = prof.interconnect_peak()
     traffic: Dict[Tuple[str, str], Dict[str, float]] = {}
     for key, v in snap["counters"].items():
         name, labels = parse_key(key)
@@ -261,10 +269,21 @@ def comms_table(snap: Dict[str, Any]) -> str:
             (labels.get("op", "?"), labels.get("axis", "?")),
             {"ops": 0.0, "bytes": 0.0})
         slot["ops" if name == "comms.ops" else "bytes"] += v
-    rows = [[op, axis, f"{int(t['ops'])}", _human_bytes(t["bytes"])]
-            for (op, axis), t in sorted(
-                traffic.items(), key=lambda kv: -kv[1]["bytes"])]
-    return _table(["collective", "axis", "ops", "payload"], rows)
+    entries = []
+    for (op, axis), t in traffic.items():
+        bw = prof.axis_peak_bw(axis, peak)
+        entries.append((op, axis, t, bw, t["bytes"] / bw if bw else 0.0))
+    total_s = sum(e[4] for e in entries) or 1.0
+    rows = [[op, axis, f"{int(t['ops'])}", _human_bytes(t["bytes"]),
+             f"{bw / 1e9:g}GB/s", f"{s:.2e}", f"{s / total_s:.3f}"]
+            for op, axis, t, bw, s in sorted(entries,
+                                             key=lambda e: -e[4])]
+    out = _table(["collective", "axis", "ops", "payload", "peak_bw",
+                  "s_at_peak", "frac"], rows)
+    if entries and peak.placeholder:
+        out += ("\n(peak_bw: placeholder figures — no TPU device kind "
+                "in this process)")
+    return out
 
 
 def prof_table(snap: Dict[str, Any], top: int) -> str:
