@@ -145,6 +145,14 @@ def host_row_reader(host_base, tenant: str = "-"
             safe = np.clip(cand, 0, n - 1)
             rows = np.asarray(host_base[safe.reshape(-1)],
                               np.float32).reshape(m_b, C, d)
+            # cost attribution (ISSUE 20): host-tier IO bytes, charged
+            # at the single fetch chokepoint so both the direct-read
+            # and prefetched paths count. Attempt-side: a retried read
+            # re-moves the bytes, and re-moved bytes are the cost.
+            if _obs_spans.enabled():
+                _obs_spans.registry().inc(
+                    "cost.io_bytes", float(rows.nbytes),
+                    labels={"tenant": tenant})
             return jax.device_put(rows)
 
         return _retry.retry_call(attempt, site="serve.row_read",

@@ -24,6 +24,18 @@ is the zero-dependency answer: a stdlib ``http.server`` endpoint
   per-tenant list-size skew, dead centroids, centroid drift, PQ
   quantization error, and tombstone density, computed on demand by the
   serving layer and cached on the tenant.
+- ``GET /costz``    — JSON cost & capacity plane (ISSUE 20): the
+  per-tenant resource-attribution ledger
+  (:class:`raft_tpu.obs.cost.CostLedger.describe`) plus the capacity
+  model's saturation forecast.
+
+``/metrics`` additionally exposes the standard ``process_*``
+self-telemetry family (RSS, CPU seconds, open fds, uptime — stdlib
+``resource``/``os``, :func:`process_rows`) so the endpoint is
+scrapeable for its own footprint, not just the workload's. Those
+families keep their conventional unprefixed names — dashboards and
+scrape configs expect ``process_resident_memory_bytes``, not a
+``raft_tpu_``-prefixed variant.
 
 :class:`ExpoServer` is started/stopped by
 :class:`raft_tpu.serve.server.MicroBatchServer` when
@@ -36,15 +48,17 @@ through its own locks — zero instrumentation-side cost.
 from __future__ import annotations
 
 import json
+import os
 import re
 import threading
+import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Any, Callable, Dict, List, Optional
 
 from raft_tpu.obs import metrics as _metrics
 
 __all__ = ["ExpoServer", "render_prometheus", "prom_name",
-           "parse_prometheus"]
+           "parse_prometheus", "process_rows", "process_text"]
 
 #: metric-name prefix — one namespace for every raft_tpu family
 PROM_PREFIX = "raft_tpu_"
@@ -206,6 +220,63 @@ def parse_prometheus(text: str) -> Dict[str, List[Dict[str, Any]]]:
     return out
 
 
+#: process birth, for uptime (monotonic — wall-clock steps must not
+#: make the process look younger/older than it is)
+_PROC_START_MONO = time.monotonic()
+
+
+def process_rows() -> List[Dict[str, Any]]:
+    """The standard ``process_*`` self-telemetry family (ISSUE 20):
+    RSS, CPU seconds, open fds, uptime — stdlib ``resource``/``os``
+    only, best-effort (a metric whose source is unavailable on this
+    platform is omitted, never a scrape failure)."""
+    rows: List[Dict[str, Any]] = []
+    try:
+        import resource
+
+        ru = resource.getrusage(resource.RUSAGE_SELF)
+        rows.append({"kind": "counter",
+                     "name": "process_cpu_seconds_total",
+                     "value": float(ru.ru_utime + ru.ru_stime)})
+        rss = None
+        try:
+            with open("/proc/self/statm") as f:
+                rss = (int(f.read().split()[1])
+                       * os.sysconf("SC_PAGE_SIZE"))
+        except (OSError, ValueError, IndexError):
+            # ru_maxrss is the high-water mark in KB on Linux — a
+            # coarser stand-in where /proc is absent
+            rss = int(ru.ru_maxrss) * 1024
+        rows.append({"kind": "gauge",
+                     "name": "process_resident_memory_bytes",
+                     "value": float(rss)})
+    except (ImportError, OSError):
+        pass
+    try:
+        rows.append({"kind": "gauge", "name": "process_open_fds",
+                     "value": float(len(os.listdir("/proc/self/fd")))})
+    except OSError:
+        pass
+    rows.append({"kind": "gauge", "name": "process_uptime_seconds",
+                 "value": time.monotonic() - _PROC_START_MONO})
+    return rows
+
+
+def process_text() -> str:
+    """:func:`process_rows` rendered as exposition text — appended to
+    ``/metrics`` after the registry families. Names stay unprefixed
+    (the Prometheus-conventional spellings scrape configs expect), so
+    this renders directly instead of riding :func:`render_prometheus`
+    and its ``raft_tpu_`` namespace."""
+    out: List[str] = []
+    for r in process_rows():
+        name = r["name"]
+        out.append(f"# HELP {name} process self-telemetry")
+        out.append(f"# TYPE {name} {r['kind']}")
+        out.append(f"{name} {_num(r['value'])}")
+    return "\n".join(out) + "\n"
+
+
 class ExpoServer:
     """The exposition endpoint: ``start()`` binds and serves on a
     daemon thread, ``stop()`` shuts down. ``port=0`` binds an
@@ -221,19 +292,24 @@ class ExpoServer:
     default :func:`raft_tpu.obs.flight.dump_now`.
     ``indexz`` — optional zero-arg callable returning the per-tenant
     index-health dict (ISSUE 16); drives ``GET /indexz``.
+    ``costz`` — optional zero-arg callable returning the cost-plane
+    dict (per-tenant ledger + capacity forecast, ISSUE 20); drives
+    ``GET /costz``.
     """
 
     def __init__(self, port: int = 0, host: str = "127.0.0.1",
                  registry: Any = None,
                  health: Optional[Callable[[], Dict[str, Any]]] = None,
                  flight_dump: Optional[Callable[[], Optional[str]]] = None,
-                 indexz: Optional[Callable[[], Dict[str, Any]]] = None):
+                 indexz: Optional[Callable[[], Dict[str, Any]]] = None,
+                 costz: Optional[Callable[[], Dict[str, Any]]] = None):
         self._port_req = int(port)
         self.host = host
         self._registry = registry
         self._health = health
         self._flight_dump = flight_dump
         self._indexz = indexz
+        self._costz = costz
         self._httpd: Optional[ThreadingHTTPServer] = None
         self._thread: Optional[threading.Thread] = None
 
@@ -249,7 +325,8 @@ class ExpoServer:
         return reg
 
     def metrics_text(self) -> str:
-        return render_prometheus(self._resolve_registry().collect())
+        return (render_prometheus(self._resolve_registry().collect())
+                + process_text())
 
     def health_payload(self) -> (int, Dict[str, Any]):
         """(status_code, body): 200 while serving is possible — no
@@ -317,6 +394,18 @@ class ExpoServer:
         except Exception as e:
             return 500, {"status": "error", "error": repr(e)}
 
+    def costz_payload(self) -> (int, Dict[str, Any]):
+        """(status_code, body) for ``/costz`` — the per-tenant cost
+        ledger + capacity forecast (ISSUE 20). 404 when no provider is
+        wired (standalone expo), 500 when the provider throws."""
+        if self._costz is None:
+            return 404, {"status": "error",
+                         "error": "no costz provider wired"}
+        try:
+            return 200, (self._costz() or {})
+        except Exception as e:
+            return 500, {"status": "error", "error": repr(e)}
+
     # -- lifecycle ----------------------------------------------------------
     @property
     def port(self) -> Optional[int]:
@@ -359,6 +448,10 @@ class ExpoServer:
                                    "application/json")
                     elif path == "/indexz":
                         code, doc = expo.indexz_payload()
+                        self._send(code, json.dumps(doc).encode(),
+                                   "application/json")
+                    elif path == "/costz":
+                        code, doc = expo.costz_payload()
                         self._send(code, json.dumps(doc).encode(),
                                    "application/json")
                     else:

@@ -453,6 +453,22 @@ def quantile_from_state(state: Dict[str, Any], q: float
     return float(hi_clamp) if hi_clamp is not None else None
 
 
+def counter_sum(rows: List[Dict[str, Any]], name: str,
+                **match: str) -> float:
+    """Sum every counter series named ``name`` whose labels carry all
+    of ``match`` (subset match — unmatched extra labels are fine) over
+    a :meth:`MetricsRegistry.collect` row list. The delta machinery in
+    the SLO monitor, the cost ledger's counter folds, and the capacity
+    model's arrival rates all aggregate through here."""
+    total = 0.0
+    for r in rows:
+        if r.get("kind") == "counter" and r.get("name") == name:
+            labels = r.get("labels") or {}
+            if all(labels.get(k) == v for k, v in match.items()):
+                total += float(r.get("value", 0.0))
+    return total
+
+
 def load_jsonl(path: str) -> List[Dict[str, Any]]:
     """Parse a ``dump_jsonl`` file back into a list of series dicts."""
     out = []

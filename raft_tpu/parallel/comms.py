@@ -207,12 +207,26 @@ class Comms:
                     per_axis.append((a, payload * mult))
                 else:
                     per_axis.append((a, payload))
+        # cost attribution (ISSUE 20): when this trace runs on behalf
+        # of a served tenant (the dispatch path brackets searches with
+        # neighbors.tiered.serving_tenant), charge the bytes to the
+        # tenant per axis. Same trace-time semantics as the series
+        # above — static shapes only, zero host syncs (GL01-clean).
+        # sys.modules lookup, not an import: build-path traces with no
+        # serving layer loaded pay a dict probe, nothing else.
+        import sys
+
+        tiered = sys.modules.get("raft_tpu.neighbors.tiered")
+        tenant = tiered.current_tenant() if tiered is not None else "-"
         for axis, stage_bytes in per_axis:
             labels = {"op": op_name, "axis": axis}
             if rank is not None:
                 labels["rank"] = str(rank)
             reg.inc("comms.ops", 1.0, labels=labels)
             reg.inc("comms.bytes", float(stage_bytes), labels=labels)
+            if tenant != "-":
+                reg.inc("cost.comms_bytes", float(stage_bytes),
+                        labels={"tenant": tenant, "axis": axis})
 
     # -- collectives -------------------------------------------------------
     def _allreduce_raw(self, x, op: Op):

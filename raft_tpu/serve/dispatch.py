@@ -23,9 +23,12 @@ the dispatch itself.
 
 from __future__ import annotations
 
+import time
 from typing import Any, Optional, Tuple
 
+from raft_tpu.obs import cost as _cost
 from raft_tpu.obs import spans as _spans
+from raft_tpu.obs import trace as _trace
 from raft_tpu.robust import degrade as _degrade
 from raft_tpu.robust import faults as _faults
 from raft_tpu.robust import retry as _retry
@@ -124,6 +127,10 @@ def dispatch_batch(tenant: Tenant, queries, k: int,
     monitor = _slo.get_monitor()
     gate = (monitor.quality_gate_for(tenant.name)
             if monitor is not None else None)
+    # cost attribution (ISSUE 20): obs off costs exactly this flag
+    # check — no clock read, no ledger lookup (the PR-1 contract)
+    costing = _spans.enabled()
+    t0 = time.perf_counter() if costing else 0.0
     with _spans.span("serve.dispatch") as sp:
         try:
             with _degrade.quality_gate(gate):
@@ -141,6 +148,18 @@ def dispatch_batch(tenant: Tenant, queries, k: int,
         # so a drill-down sees retry pressure without counting markers
         sp.annotate(tenant=tenant.name, batch=int(queries.shape[0]), k=k,
                     attempts=retry_stats.get("attempts", 1))
+    if costing:
+        ledger = _cost.get_ledger()
+        if ledger is not None:
+            # the batch's device-inclusive wall time (the block above
+            # waited on the result), prorated across the coalesced
+            # context's live members — shed members never reached this
+            # batch, padding waste rides the members that filled it
+            ctx = _trace.current_request()
+            n = (len(ctx.trace_ids) if ctx is not None and ctx.trace_ids
+                 else int(queries.shape[0]))
+            ledger.note_batch(time.perf_counter() - t0,
+                              [tenant.name] * n)
     if _degrade.steps_seen() > degrade_mark and registry is not None:
         # the ladder moved during this dispatch: the tenant is serving,
         # but on a degraded configuration — surface it as health,

@@ -29,6 +29,7 @@ from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
+from raft_tpu.obs import cost as _cost
 from raft_tpu.obs.metrics import Histogram, exemplars_for_quantile
 from raft_tpu.robust.retry import DeadlineExceeded
 from raft_tpu.serve.errors import ShedError
@@ -78,6 +79,14 @@ def run_step(server: MicroBatchServer, tenant: str,
 
     def _mark_done(fut: Future) -> None:
         done_at[id(fut)] = time.monotonic()
+
+    # cost attribution (ISSUE 20): bracket the step with ledger reads
+    # so the row reports the device time THIS step's traffic consumed
+    # (per-tenant delta) and the tenant's fleet share at step end —
+    # None when no ledger is installed (old records join unchanged)
+    ledger = _cost.get_ledger()
+    device_s0 = (ledger.device_seconds().get(tenant, 0.0)
+                 if ledger is not None else 0.0)
 
     t_start = time.monotonic()
     next_arrival = t_start
@@ -138,6 +147,11 @@ def run_step(server: MicroBatchServer, tenant: str,
     # stopped at duration_s but queued work drains past it
     wall = max(t_last_done, deadline_end) - t_start
     slow = exemplars_for_quantile(lat.state(), 0.99)
+    device_s = cost_share = None
+    if ledger is not None:
+        device_s = round(ledger.device_seconds().get(tenant, 0.0)
+                         - device_s0, 6)
+        cost_share = round(ledger.shares().get(tenant, 0.0), 6)
     return {
         "offered_qps": offered_qps,
         "duration_s": round(wall, 4),
@@ -155,6 +169,11 @@ def run_step(server: MicroBatchServer, tenant: str,
         # over the completed requests of this step
         "recall": (round(recall_sum / recall_n, 6)
                    if recall_n else None),
+        # per-step cost columns (ISSUE 20, None without a ledger):
+        # device seconds this step's traffic consumed, and the
+        # tenant's normalized fleet share at step end
+        "device_s": device_s,
+        "cost_share": cost_share,
         # the p99 bucket's worst offenders, worst first — joinable back
         # to their timelines via obsdump --slowest on the server's dump
         "slow_trace_ids": [e["trace_id"] for e in slow],
@@ -201,6 +220,10 @@ def record(rows: List[Dict[str, Any]], dataset: str, tenant: str,
             "shed": r["shed"], "shed_reasons": r["shed_reasons"],
             "deadline_missed": r["deadline_missed"],
             "errors": r["errors"],
+            # optional cost columns (ISSUE 20): absent-tolerant on the
+            # benchdiff join so pre-ledger records stay comparable
+            "device_s": r.get("device_s"),
+            "cost_share": r.get("cost_share"),
             "slow_trace_ids": r.get("slow_trace_ids", []),
             "measured_at": measured_at, "git_commit": commit,
             "env": env,

@@ -45,6 +45,7 @@ import time
 from typing import Any, Dict, List, Optional
 
 from raft_tpu.core import logging as _log
+from raft_tpu.obs import capacity as _capacity
 from raft_tpu.obs import hbm as _hbm
 from raft_tpu.obs import sanitize as _sanitize
 from raft_tpu.obs import spans as _spans
@@ -322,9 +323,37 @@ class IndexRegistry:
                     f"{self.resident_bytes():,} B resident are pinned "
                     f"or un-evictable under the {self.usable_bytes:,} B "
                     "usable budget")
+            # capacity-forecast hook (ISSUE 20): the plan above handles
+            # the pressure cliff; the installed capacity model looks
+            # AHEAD. When the resident-bytes trend (plus this
+            # candidate) saturates HBM inside the policy horizon,
+            # demote additional raw tiers NOW — coldest first, enough
+            # to cover the projected growth — so the admission that
+            # WOULD have hit the cliff mid-horizon demotes calmly
+            # today instead. Counted apart from pressure demotions
+            # (``serve.registry.preemptive_demote{tenant=}``).
+            preemptive: List[Tenant] = []
+            model = _capacity.get_model()
+            if model is not None and model.would_saturate(
+                    extra_bytes=float(size)):
+                need = (float(projected + size)
+                        + model.projected_growth_bytes()
+                        - float(self.usable_bytes))
+                for cand in self._demote_candidates():
+                    if need <= 0.0:
+                        break
+                    if cand.name == name or cand in demotions \
+                            or cand in victims:
+                        continue
+                    preemptive.append(cand)
+                    need -= float(cand.dataset.nbytes)
             # commit: the admission is now guaranteed to succeed
             for demo in demotions:
                 self._demote_locked(demo, reason="pressure")
+            for demo in preemptive:
+                self._demote_locked(demo, reason="preemptive")
+                _count("serve.registry.preemptive_demote",
+                       {"tenant": demo.name})
             for victim in victims:
                 self._evict_locked(victim, reason="pressure")
             if replacing:

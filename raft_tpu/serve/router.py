@@ -19,6 +19,10 @@ Counters (all under ``serve.router.*``):
   skew threshold, as consumed by :meth:`FleetRouter.note_stragglers`
 - ``serve.router.steer{away_from=,reason=straggler}`` — a dispatch
   that avoided its preferred pod because of a recent straggler
+- ``serve.router.steer{away_from=,reason=capacity}`` — a placement
+  that overrode the fewest-tenants heuristic because the cost
+  ledger's share-weighted headroom ranked another pod better
+  (ISSUE 20)
 - ``serve.router.pod_down{pod=}`` — a pod marked unhealthy after a
   failed hop
 - ``serve.router.degraded{reason=pod_lost}`` — a request answered by
@@ -39,6 +43,7 @@ import dataclasses
 import time
 from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
 
+from raft_tpu.obs import cost as _cost
 from raft_tpu.obs import sanitize as _sanitize
 from raft_tpu.obs import spans as _spans
 from raft_tpu.robust import faults as _faults
@@ -169,6 +174,37 @@ class FleetRouter:
         return None
 
     # -- placement ---------------------------------------------------------
+    def _place_single(self, healthy: List[Pod]) -> Pod:
+        """Single-pod placement scoring (ISSUE 20): prefer the pod
+        with the best **cost-share-weighted headroom** — HBM headroom
+        fraction minus the fleet-normalized ``cost.share`` of the
+        tenants the pod already holds — so a pod whose few tenants
+        burn most of the fleet's device time stops looking "empty" to
+        the old fewest-tenants heuristic. Falls back to fewest-tenants
+        while no ledger is installed (or nothing has been attributed
+        yet). A capacity-steered choice that overrides the tenant-count
+        heuristic counts ``serve.router.steer{reason=capacity}``."""
+        by_count = min(healthy,
+                       key=lambda p: len(p.registry.resident()))
+        ledger = _cost.get_ledger()
+        shares = ledger.shares() if ledger is not None else {}
+        if not shares:
+            return by_count
+
+        def weighted_headroom(pod: Pod) -> float:
+            usable = float(getattr(pod.registry, "usable_bytes", 0) or 0)
+            resident = float(pod.registry.resident_bytes())
+            headroom = (1.0 - resident / usable) if usable > 0 else 0.0
+            load = sum(shares.get(t.name, 0.0)
+                       for t in pod.registry.resident())
+            return headroom - load
+
+        best = max(healthy, key=weighted_headroom)
+        if best is not by_count:
+            _count("serve.router.steer", away_from=by_count.name,
+                   reason="capacity")
+        return best
+
     def place(self, name: str, index: Any, *, hot: bool = False,
               sharded: bool = False, params: Any = None,
               **admit_kw: Any) -> List[str]:
@@ -176,8 +212,9 @@ class FleetRouter:
         healthy pod (query fan-out beats one saturated pod);
         ``sharded`` marks an index whose Sharded* build already spans
         its pod's mesh (stays on one pod — the sharding IS the spread);
-        default is single-pod placement on the least-loaded pod.
-        Returns the pod names that admitted it."""
+        default is single-pod placement by cost-share-weighted
+        headroom (:meth:`_place_single`). Returns the pod names that
+        admitted it."""
         healthy = [p for p in self.pods if p.healthy
                    and p.registry is not None]
         if not healthy:
@@ -188,8 +225,7 @@ class FleetRouter:
             mode, targets = "shard", [healthy[0]]
         else:
             mode = "single"
-            targets = [min(healthy,
-                           key=lambda p: len(p.registry.resident()))]
+            targets = [self._place_single(healthy)]
         for pod in targets:
             pod.registry.admit(name, index, params=params, **admit_kw)
         _count("serve.router.place", tenant=name, mode=mode)

@@ -187,6 +187,10 @@ class MicroBatchServer:
         self.verifier = None
         #: the SLO monitor (serve.slo.SLOMonitor) while running
         self.slo = None
+        #: the cost ledger (obs.cost.CostLedger) while running
+        self.ledger = None
+        #: the capacity model (obs.capacity.CapacityModel) while running
+        self.capacity = None
 
     # -- lifecycle ----------------------------------------------------------
     def start(self, warmup: bool = True) -> "MicroBatchServer":
@@ -245,6 +249,24 @@ class MicroBatchServer:
         if self.verifier is not None:
             self.verifier.on_verdict = self.slo.evaluate
         _slo.set_monitor(self.slo)
+        # the cost & capacity plane (ISSUE 20): the ledger attributes
+        # per-tenant resources (dispatch reaches it through the
+        # process-global install, same pattern as the SLO monitor); the
+        # capacity model forecasts saturation for admission/placement.
+        # Both live regardless of the obs flag — attribution itself is
+        # gated at the dispatch tap, so obs-off serving stays at one
+        # flag check per batch.
+        from raft_tpu.obs import capacity as _capacity
+        from raft_tpu.obs import cost as _cost
+
+        self.ledger = _cost.CostLedger()
+        _cost.set_ledger(self.ledger)
+        self.capacity = _capacity.CapacityModel(
+            resident_bytes=self.registry.resident_bytes,
+            usable_bytes=lambda: self.registry.usable_bytes,
+            ledger=self.ledger)
+        _capacity.set_model(self.capacity)
+        _flight.set_section("cost", self._costz_payload)
         if _spans.enabled():
             # re-mirror the admission budget into hbm.bytes_limit at
             # START (the registry's __init__ mirror only fires when obs
@@ -263,7 +285,8 @@ class MicroBatchServer:
                     port=self.config.expo_port,
                     host=self.config.expo_host,
                     health=self._health_payload,
-                    indexz=self._indexz_payload).start()
+                    indexz=self._indexz_payload,
+                    costz=self._costz_payload).start()
             except Exception:
                 # a failed bind (port taken, privileged port) must not
                 # leave a half-started server: the batcher thread is
@@ -360,6 +383,15 @@ class MicroBatchServer:
             # start() must not strip that server's gate
             _slo.clear_monitor(self.slo)
             self.slo = None
+        if self.ledger is not None:
+            from raft_tpu.obs import capacity as _capacity
+            from raft_tpu.obs import cost as _cost
+
+            _flight.clear_section("cost")
+            _cost.clear_ledger(self.ledger)  # ours only, same as slo
+            _capacity.clear_model(self.capacity)
+            self.ledger = None
+            self.capacity = None
 
     # -- exposition payloads (ISSUE 16) -------------------------------------
     def _health_payload(self) -> Dict[str, Any]:
@@ -374,6 +406,23 @@ class MicroBatchServer:
             except Exception:  # noqa: BLE001 — health must render
                 pass
         return desc
+
+    def _costz_payload(self) -> Dict[str, Any]:
+        """/costz body (and the ``"cost"`` flight-dump section): the
+        per-tenant attribution ledger plus the capacity forecast. The
+        scrape itself advances the HBM byte-second integrals and the
+        capacity rate windows (the healthz-drives-evaluation
+        convention), so an idle scrape still moves the clock."""
+        out: Dict[str, Any] = {}
+        if self.ledger is not None:
+            out["ledger"] = self.ledger.describe()
+        if self.capacity is not None:
+            try:
+                self.capacity.tick()
+                out["capacity"] = self.capacity.forecast()
+            except Exception as e:  # noqa: BLE001 — scrape must render
+                out["capacity"] = {"error": repr(e)}
+        return out
 
     def _indexz_payload(self) -> Dict[str, Any]:
         """/indexz body: per-tenant index-health introspection

@@ -33,11 +33,12 @@ from __future__ import annotations
 import dataclasses
 import threading
 import time
-from collections import deque
-from typing import Any, Callable, Deque, Dict, List, Optional, Tuple
+from typing import Any, Callable, Dict, List, Optional, Tuple
 
 from raft_tpu.obs import sanitize as _sanitize
 from raft_tpu.obs import spans as _spans
+from raft_tpu.obs.capacity import DeltaRing
+from raft_tpu.obs.metrics import counter_sum as _counter_sum
 
 __all__ = ["SLOPolicy", "SLOMonitor", "set_monitor", "get_monitor",
            "clear_monitor"]
@@ -58,17 +59,6 @@ class SLOPolicy:
     burn_threshold: float = 2.0
     latency_slo_s: Optional[float] = None
     min_samples: int = 8
-
-
-def _counter_sum(rows: List[Dict[str, Any]], name: str,
-                 **match: str) -> float:
-    total = 0.0
-    for r in rows:
-        if r.get("kind") == "counter" and r.get("name") == name:
-            labels = r.get("labels") or {}
-            if all(labels.get(k) == v for k, v in match.items()):
-                total += float(r.get("value", 0.0))
-    return total
 
 
 def _latency_totals(rows: List[Dict[str, Any]],
@@ -113,8 +103,9 @@ class SLOMonitor:
         self._lock = _sanitize.monitored_lock("serve.slo")
         keep = max(self.policy.windows_s) * 1.5 if self.policy.windows_s \
             else 300.0
-        self._keep_s = keep
-        self._snaps: Deque[Tuple[float, Dict[str, float]]] = deque()
+        # the multi-window delta ring, shared shape with the capacity
+        # model (ISSUE 20 extracted it to obs.capacity.DeltaRing)
+        self._ring = DeltaRing(keep)
         self._floor_breached: set = set()
 
     # -- burn rates ---------------------------------------------------------
@@ -136,9 +127,7 @@ class SLOMonitor:
         now = self._clock()
         totals = self._totals()
         with self._lock:
-            self._snaps.append((now, totals))
-            while self._snaps and now - self._snaps[0][0] > self._keep_s:
-                self._snaps.popleft()
+            self._ring.append(now, totals)
 
     def burn_rates(self) -> Dict[float, float]:
         """Per-window burn rate: (bad/total within the window) over the
@@ -146,19 +135,13 @@ class SLOMonitor:
         self.tick()
         budget = max(1.0 - self.policy.availability_target, 1e-9)
         with self._lock:
-            snaps = list(self._snaps)
+            snaps = self._ring.snaps()
         if not snaps:
             return {w: 0.0 for w in self.policy.windows_s}
         now, newest = snaps[-1]
         out: Dict[float, float] = {}
         for w in self.policy.windows_s:
-            base = None
-            for ts, totals in snaps:
-                if now - ts <= w:
-                    base = totals
-                    break
-            if base is None:
-                base = snaps[0][1]
+            base = DeltaRing.window_base(snaps, now, w)
             d_total = newest["requests"] - base["requests"]
             d_bad = newest["bad"] - base["bad"]
             burn = ((d_bad / d_total) / budget) if d_total > 0 else 0.0

@@ -1,0 +1,520 @@
+"""obs.cost + obs.capacity — the cost & capacity plane (ISSUE 20).
+
+The attribution contract under test: batch device time prorated
+equally across live members (shed members excluded by the caller;
+cross-tenant batches split by member count), the conservation
+invariant (Σ per-tenant device seconds == Σ measured batch wall, by
+construction), HBM byte-second integration via the rectangle rule over
+``index.bytes{tier=hbm}`` levels, describe() folding the registry's
+tenant-labeled counters in, and the obs-off contract (accumulates for
+unit tests, publishes nothing). The capacity half: DeltaRing
+window-base selection, utilization/headroom accounting, the
+least-squares saturation forecast (flat → inf, ramp → finite ttl,
+already-over → 0), the alert counters, and the two closed loops —
+``IndexRegistry.admit`` demoting raw tiers preemptively on a
+forecasted saturation (BEFORE any pressure eviction), and
+``FleetRouter`` placement steering by cost-share-weighted headroom.
+"""
+
+import pytest
+
+from raft_tpu import obs
+from raft_tpu.obs import capacity as capacity_mod
+from raft_tpu.obs import cost as cost_mod
+from raft_tpu.obs.capacity import (CapacityModel, CapacityPolicy,
+                                   DeltaRing)
+from raft_tpu.obs.cost import CostLedger
+from raft_tpu.obs.metrics import MetricsRegistry, counter_sum
+
+
+@pytest.fixture(autouse=True)
+def _clean():
+    cost_mod.clear_ledger()
+    capacity_mod.clear_model()
+    yield
+    cost_mod.clear_ledger()
+    capacity_mod.clear_model()
+    obs.disable()
+
+
+def _enable():
+    obs.enable(registry=MetricsRegistry(), hbm=False)
+    return obs.registry()
+
+
+# ---------------------------------------------------------------------------
+# CostLedger — proration + conservation
+# ---------------------------------------------------------------------------
+
+class TestProration:
+    def test_single_member_batch_gets_full_time(self):
+        led = CostLedger()
+        led.note_batch(0.25, ["a"])
+        assert led.device_seconds() == {"a": pytest.approx(0.25)}
+
+    def test_coalesced_batch_splits_equally(self):
+        led = CostLedger()
+        led.note_batch(0.3, ["a", "a", "a"])
+        assert led.device_seconds()["a"] == pytest.approx(0.3)
+        cons = led.conservation()
+        assert cons["attributed_device_s"] == pytest.approx(0.3)
+        assert cons["rel_err"] == pytest.approx(0.0)
+
+    def test_cross_tenant_batch_splits_by_member_count(self):
+        # two of a, one of b sharing one dispatched bucket: a pays 2/3
+        led = CostLedger()
+        led.note_batch(0.3, ["a", "a", "b"])
+        ds = led.device_seconds()
+        assert ds["a"] == pytest.approx(0.2)
+        assert ds["b"] == pytest.approx(0.1)
+        assert led.shares()["a"] == pytest.approx(2.0 / 3.0)
+
+    def test_shed_member_excluded_from_proration(self):
+        # the batch coalesced 3 requests but one was deadline-shed
+        # before dispatch: the caller hands only the 2 live members, so
+        # the survivors split the whole batch and the shed request is
+        # charged nothing — attribution follows work dispatched
+        led = CostLedger()
+        led.note_batch(0.2, ["a", "b"])          # 3rd member shed
+        ds = led.device_seconds()
+        assert ds == {"a": pytest.approx(0.1), "b": pytest.approx(0.1)}
+        assert led.conservation()["attributed_device_s"] \
+            == pytest.approx(0.2)
+
+    def test_empty_or_negative_batches_ignored(self):
+        led = CostLedger()
+        led.note_batch(0.5, [])
+        led.note_batch(-1.0, ["a"])
+        assert led.device_seconds() == {}
+        assert led.conservation()["batch_wall_s"] == 0.0
+
+    def test_conservation_over_many_batches(self):
+        led = CostLedger()
+        total = 0.0
+        for i in range(50):
+            d = 0.001 * (i + 1)
+            led.note_batch(d, [f"t{i % 3}"] * ((i % 4) + 1))
+            total += d
+        cons = led.conservation()
+        assert cons["batch_wall_s"] == pytest.approx(total)
+        assert cons["rel_err"] < 1e-9
+
+    def test_disabled_obs_accumulates_but_publishes_nothing(self):
+        # obs off: note_batch still books (unit-test contract) but no
+        # cost.* series appear anywhere — the no-attribution half of
+        # the zero-overhead contract (dispatch's tap additionally skips
+        # the ledger entirely behind one spans.enabled() check)
+        assert not obs.enabled()
+        led = CostLedger()
+        led.note_batch(0.1, ["a"])
+        assert led.device_seconds()["a"] == pytest.approx(0.1)
+        reg = _enable()
+        assert not [k for k in reg.snapshot()["gauges"]
+                    if k.startswith("cost.")]
+
+    def test_enabled_obs_publishes_device_and_share_gauges(self):
+        reg = _enable()
+        led = CostLedger()
+        led.note_batch(0.3, ["a", "b", "b"])
+        g = reg.snapshot()["gauges"]
+        assert g["cost.device_s{tenant=a}"] == pytest.approx(0.1)
+        assert g["cost.device_s{tenant=b}"] == pytest.approx(0.2)
+        assert g["cost.share{tenant=b}"] == pytest.approx(2.0 / 3.0)
+
+
+# ---------------------------------------------------------------------------
+# CostLedger — HBM byte-second integration + describe()
+# ---------------------------------------------------------------------------
+
+class TestHbmIntegration:
+    def _mk(self):
+        reg = _enable()
+        clock = {"t": 0.0}
+        led = CostLedger(clock=lambda: clock["t"])
+        return led, clock, reg
+
+    def test_rectangle_rule_integrates_previous_level(self):
+        led, clock, reg = self._mk()
+        reg.gauge("index.bytes",
+                  labels={"index": "a", "tier": "hbm"}).set(1000.0)
+        led.tick()                     # first sighting: integral += 0
+        clock["t"] = 5.0
+        led.tick()                     # 1000 B held for 5 s
+        g = reg.snapshot()["gauges"]
+        assert g["cost.hbm_byte_s{tenant=a}"] == pytest.approx(5000.0)
+        # demotion drops the level; the interval BEFORE the tick that
+        # observes it is still charged at the pre-move level
+        reg.gauge("index.bytes",
+                  labels={"index": "a", "tier": "hbm"}).set(0.0)
+        clock["t"] = 7.0
+        led.tick()                     # += 1000 * 2
+        clock["t"] = 9.0
+        led.tick()                     # += 0 * 2
+        g = reg.snapshot()["gauges"]
+        assert g["cost.hbm_byte_s{tenant=a}"] == pytest.approx(7000.0)
+
+    def test_host_tier_levels_not_charged(self):
+        led, clock, reg = self._mk()
+        reg.gauge("index.bytes",
+                  labels={"index": "a", "tier": "host"}).set(9999.0)
+        led.tick()
+        clock["t"] = 10.0
+        led.tick()
+        assert "cost.hbm_byte_s{tenant=a}" not in \
+            reg.snapshot()["gauges"]
+
+    def test_shares_fall_back_to_hbm_before_traffic(self):
+        led, clock, reg = self._mk()
+        reg.gauge("index.bytes",
+                  labels={"index": "a", "tier": "hbm"}).set(3000.0)
+        reg.gauge("index.bytes",
+                  labels={"index": "b", "tier": "hbm"}).set(1000.0)
+        led.tick()
+        clock["t"] = 10.0
+        led.tick()
+        shares = led.shares()
+        assert shares["a"] == pytest.approx(0.75)
+        assert shares["b"] == pytest.approx(0.25)
+        # the first batch flips the basis to device time
+        led.note_batch(0.1, ["b"])
+        assert led.shares() == {"b": pytest.approx(1.0)}
+
+    def test_describe_folds_registry_counters(self):
+        led, clock, reg = self._mk()
+        led.note_batch(0.4, ["a"])
+        reg.inc("serve.requests", 5, labels={"tenant": "a"})
+        reg.inc("cost.io_bytes", 123.0, labels={"tenant": "a"})
+        reg.inc("cost.comms_bytes", 64.0,
+                labels={"tenant": "a", "axis": "ici"})
+        reg.inc("serve.shed", 2, labels={"reason": "queue_full"})
+        doc = led.describe()
+        a = doc["tenants"]["a"]
+        assert a["device_s"] == pytest.approx(0.4)
+        assert a["requests"] == 5
+        assert a["io_bytes"] == pytest.approx(123.0)
+        assert a["comms_bytes"] == {"ici": pytest.approx(64.0),
+                                    "dcn": 0.0}
+        assert a["share"] == pytest.approx(1.0)
+        assert doc["totals"]["batches"] == 1
+        assert doc["totals"]["shed"] == 2
+        assert doc["conservation"]["rel_err"] == pytest.approx(0.0)
+
+    def test_counter_labeled_tenants_appear_without_batches(self):
+        led, _, reg = self._mk()
+        reg.inc("cost.io_bytes", 10.0, labels={"tenant": "io_only"})
+        doc = led.describe()
+        assert doc["tenants"]["io_only"]["io_bytes"] \
+            == pytest.approx(10.0)
+
+
+class TestGlobalLedger:
+    def test_install_get_clear(self):
+        led = CostLedger()
+        assert cost_mod.set_ledger(led) is None
+        assert cost_mod.get_ledger() is led
+        cost_mod.clear_ledger(led)
+        assert cost_mod.get_ledger() is None
+
+    def test_stale_clear_keeps_newer_ledger(self):
+        old, new = CostLedger(), CostLedger()
+        cost_mod.set_ledger(old)
+        cost_mod.set_ledger(new)
+        cost_mod.clear_ledger(old)      # a late stop() must not win
+        assert cost_mod.get_ledger() is new
+
+
+# ---------------------------------------------------------------------------
+# DeltaRing — the extracted multi-window machinery
+# ---------------------------------------------------------------------------
+
+class TestDeltaRing:
+    def test_append_prunes_past_keep_window(self):
+        ring = DeltaRing(keep_s=10.0)
+        ring.append(0.0, {"x": 1.0})
+        ring.append(5.0, {"x": 2.0})
+        ring.append(20.0, {"x": 3.0})       # 0.0 and 5.0 both expire
+        assert [ts for ts, _ in ring.snaps()] == [20.0]
+
+    def test_window_base_picks_oldest_inside_window(self):
+        snaps = [(0.0, {"x": 1.0}), (50.0, {"x": 2.0}),
+                 (90.0, {"x": 3.0})]
+        assert DeltaRing.window_base(snaps, 100.0, 60.0)["x"] == 2.0
+
+    def test_window_base_falls_back_to_oldest_held(self):
+        snaps = [(95.0, {"x": 2.0}), (100.0, {"x": 3.0})]
+        # a 30 s window on a 5 s old ring sees everything there is
+        assert DeltaRing.window_base(snaps, 100.0, 30.0)["x"] == 2.0
+        assert DeltaRing.window_base([], 100.0, 30.0) == {}
+
+
+# ---------------------------------------------------------------------------
+# CapacityModel — utilization, forecast, alerts
+# ---------------------------------------------------------------------------
+
+class _Ramp:
+    def __init__(self, v=0.0):
+        self.v = float(v)
+
+    def __call__(self):
+        return self.v
+
+
+class _FakeLedger:
+    def __init__(self):
+        self.dev = {}
+
+    def device_seconds(self):
+        return dict(self.dev)
+
+
+def _model(resident, usable=1000.0, ledger=None, **policy_kw):
+    clock = {"t": 0.0}
+    model = CapacityModel(
+        resident_bytes=resident, usable_bytes=lambda: usable,
+        ledger=ledger,
+        policy=CapacityPolicy(**policy_kw) if policy_kw else None,
+        clock=lambda: clock["t"])
+    return model, clock
+
+
+class TestCapacityModel:
+    def test_hbm_utilization_is_instantaneous_level(self):
+        model, _ = _model(_Ramp(250.0))
+        assert model.utilization()["hbm"] == pytest.approx(0.25)
+        assert model.headroom_frac() == pytest.approx(0.75)
+
+    def test_device_utilization_from_window_delta(self):
+        led = _FakeLedger()
+        model, clock = _model(_Ramp(0.0), ledger=led)
+        model.tick()
+        clock["t"] = 10.0
+        led.dev = {"a": 4.0, "b": 1.0}
+        model.tick()
+        # 5 attributed device seconds over 10 wall seconds
+        assert model.utilization()["device"] == pytest.approx(0.5)
+
+    def test_flat_trend_never_saturates(self):
+        model, clock = _model(_Ramp(500.0))
+        for t in (0.0, 10.0, 20.0):
+            clock["t"] = t
+            model.tick()
+        assert model.ttl_saturation_s() == float("inf")
+        assert model.projected_growth_bytes() == 0.0
+        assert not model.would_saturate(extra_bytes=100.0)
+
+    def test_ramp_forecasts_finite_ttl(self):
+        ramp = _Ramp(100.0)
+        model, clock = _model(ramp)
+        for t, v in ((0.0, 100.0), (10.0, 200.0), (20.0, 300.0)):
+            clock["t"] = t
+            ramp.v = v
+            model.tick()
+        # slope 10 B/s, 700 B of headroom left -> 70 s to saturation
+        assert model.ttl_saturation_s() == pytest.approx(70.0)
+        # an admission candidate burns headroom up front
+        assert model.ttl_saturation_s(extra_bytes=200.0) \
+            == pytest.approx(50.0)
+        assert model.would_saturate(horizon_s=600.0)
+        assert not model.would_saturate(horizon_s=60.0)
+        assert model.projected_growth_bytes(horizon_s=30.0) \
+            == pytest.approx(300.0)
+
+    def test_already_over_budget_is_ttl_zero(self):
+        model, _ = _model(_Ramp(1200.0))
+        assert model.ttl_saturation_s() == 0.0
+
+    def test_min_points_gates_the_trend_fit(self):
+        ramp = _Ramp(100.0)
+        model, clock = _model(ramp, min_points=3)
+        for t, v in ((0.0, 100.0), (10.0, 200.0)):
+            clock["t"] = t
+            ramp.v = v
+            model.tick()
+        # two points make a line, not a trend
+        assert model.ttl_saturation_s() == float("inf")
+
+    def test_tick_publishes_gauges_and_alerts(self):
+        reg = _enable()
+        ramp = _Ramp(900.0)
+        model, clock = _model(ramp)
+        for t, v in ((0.0, 900.0), (10.0, 910.0), (20.0, 920.0)):
+            clock["t"] = t
+            ramp.v = v
+            model.tick()
+        snap = reg.snapshot()
+        g = snap["gauges"]
+        assert g["capacity.utilization{resource=hbm}"] \
+            == pytest.approx(0.92)
+        assert g["capacity.headroom_frac"] == pytest.approx(0.08)
+        # slope 1 B/s, 80 B headroom -> 80 s, well inside the horizon
+        assert g["capacity.ttl_saturation_s"] == pytest.approx(80.0)
+        # util > 0.85 on every tick; ttl < horizon once trend is live
+        assert snap["counters"]["capacity.alert{resource=hbm}"] >= 4
+
+    def test_flat_ttl_gauge_encodes_inf_as_negative(self):
+        reg = _enable()
+        model, clock = _model(_Ramp(100.0))
+        for t in (0.0, 10.0, 20.0):
+            clock["t"] = t
+            model.tick()
+        g = reg.snapshot()["gauges"]
+        assert g["capacity.ttl_saturation_s"] == -1.0
+        assert "capacity.alert{resource=hbm}" not in \
+            reg.snapshot()["counters"]
+
+    def test_arrival_rates_split_by_tenant_proportion(self):
+        reg = _enable()
+        model, clock = _model(_Ramp(100.0))
+        reg.inc("serve.requests", 30, labels={"tenant": "a"})
+        reg.inc("serve.requests", 10, labels={"tenant": "b"})
+        model.tick()
+        clock["t"] = 10.0
+        reg.inc("serve.requests", 30, labels={"tenant": "a"})
+        model.tick()
+        rates = model.arrival_rates()
+        # 30 new requests over 10 s, split 60:10 by lifetime proportion
+        assert rates["a"] == pytest.approx(3.0 * 60.0 / 70.0)
+        assert rates["b"] == pytest.approx(3.0 * 10.0 / 70.0)
+
+    def test_forecast_payload_is_json_ready(self):
+        import json
+
+        model, clock = _model(_Ramp(100.0))
+        model.tick()
+        doc = model.forecast()
+        assert doc["ttl_saturation_s"] is None      # inf -> None
+        assert doc["utilization"]["hbm"] == pytest.approx(0.1)
+        json.dumps(doc)
+
+    def test_global_model_install_and_stale_clear(self):
+        m1, _ = _model(_Ramp(0.0))
+        m2, _ = _model(_Ramp(0.0))
+        capacity_mod.set_model(m1)
+        capacity_mod.set_model(m2)
+        capacity_mod.clear_model(m1)
+        assert capacity_mod.get_model() is m2
+        capacity_mod.clear_model()
+        assert capacity_mod.get_model() is None
+
+
+# ---------------------------------------------------------------------------
+# closed loop ① — admission consults the forecast, demotes preemptively
+# ---------------------------------------------------------------------------
+
+class TestPreemptiveDemotion:
+    def test_forecasted_saturation_demotes_before_the_cliff(self):
+        import jax.numpy as jnp
+
+        from raft_tpu import serve
+
+        reg = _enable()
+        registry = serve.IndexRegistry(budget_bytes=10_000,
+                                       headroom_frac=0.0)
+        data = jnp.ones((100, 4), dtype=jnp.float32)   # 1600 B raw
+        registry.admit("cold", object(), dataset=data, default_k=4)
+        # a capacity model whose resident trend ramps toward the
+        # budget: 100 B/s over three synthetic ticks
+        ramp = _Ramp(1000.0)
+        model, clock = _model(ramp, usable=10_000.0)
+        for t, v in ((0.0, 1000.0), (10.0, 2000.0), (20.0, 3000.0)):
+            clock["t"] = t
+            ramp.v = v
+            model.tick()
+        capacity_mod.set_model(model)
+        # "new" fits trivially (100 B under a 10 kB budget): no
+        # pressure demotion, no eviction — only the forecast acts
+        registry.admit("new", object(), size_bytes=100, default_k=4)
+        snap = reg.snapshot()["counters"]
+        assert snap["serve.registry.preemptive_demote{tenant=cold}"] \
+            == 1.0
+        cold = registry.peek("cold")
+        assert cold.demoted                      # raw moved to host
+        assert cold.state in ("warming", "serving")   # NOT evicted
+        assert registry.peek("new") is not None
+
+    def test_flat_forecast_leaves_admission_untouched(self):
+        import jax.numpy as jnp
+
+        from raft_tpu import serve
+
+        reg = _enable()
+        registry = serve.IndexRegistry(budget_bytes=10_000,
+                                       headroom_frac=0.0)
+        data = jnp.ones((100, 4), dtype=jnp.float32)
+        registry.admit("cold", object(), dataset=data, default_k=4)
+        model, clock = _model(_Ramp(1000.0), usable=10_000.0)
+        for t in (0.0, 10.0, 20.0):
+            clock["t"] = t
+            model.tick()
+        capacity_mod.set_model(model)
+        registry.admit("new", object(), size_bytes=100, default_k=4)
+        assert "serve.registry.preemptive_demote{tenant=cold}" not in \
+            reg.snapshot()["counters"]
+        assert not registry.peek("cold").demoted
+
+
+# ---------------------------------------------------------------------------
+# closed loop ② — placement by cost-share-weighted headroom
+# ---------------------------------------------------------------------------
+
+class _FakeTenant:
+    def __init__(self, name):
+        self.name = name
+
+
+class _FakePodRegistry:
+    def __init__(self, tenants, resident_bytes=100.0,
+                 usable_bytes=1000.0):
+        self._tenants = [_FakeTenant(t) for t in tenants]
+        self._resident_bytes = resident_bytes
+        self.usable_bytes = usable_bytes
+        self.admitted = []
+
+    def resident(self):
+        return list(self._tenants)
+
+    def resident_bytes(self):
+        return self._resident_bytes
+
+    def admit(self, name, index, **kw):
+        self.admitted.append(name)
+
+
+class TestCapacityPlacement:
+    def _fleet(self):
+        from raft_tpu.serve.router import FleetRouter, Pod
+
+        pod_a = Pod("a", registry=_FakePodRegistry(["hog"]))
+        pod_b = Pod("b", registry=_FakePodRegistry(["t1", "t2"]))
+        return FleetRouter([pod_a, pod_b]), pod_a, pod_b
+
+    def test_no_ledger_falls_back_to_fewest_tenants(self):
+        reg = _enable()
+        router, pod_a, pod_b = self._fleet()
+        assert router.place("new", object()) == ["a"]
+        assert pod_a.registry.admitted == ["new"]
+        assert not [k for k in reg.snapshot()["counters"]
+                    if "reason=capacity" in k]
+
+    def test_share_weighted_headroom_overrides_tenant_count(self):
+        reg = _enable()
+        router, pod_a, pod_b = self._fleet()
+        led = CostLedger()
+        # pod a's single tenant burns 90% of fleet device time: its
+        # "emptiness" by tenant count is a lie the ledger corrects
+        led.note_batch(0.90, ["hog"])
+        led.note_batch(0.05, ["t1"])
+        led.note_batch(0.05, ["t2"])
+        cost_mod.set_ledger(led)
+        assert router.place("new", object()) == ["b"]
+        assert pod_b.registry.admitted == ["new"]
+        c = reg.snapshot()["counters"]
+        assert c["serve.router.steer{away_from=a,reason=capacity}"] \
+            == 1.0
+
+    def test_unattributed_ledger_falls_back_to_fewest_tenants(self):
+        _enable()
+        router, pod_a, _ = self._fleet()
+        cost_mod.set_ledger(CostLedger())   # installed, nothing booked
+        assert router.place("new", object()) == ["a"]
+        assert pod_a.registry.admitted == ["new"]

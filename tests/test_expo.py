@@ -312,3 +312,68 @@ class TestIndexz:
                            "burn_threshold": 2.0}
             _, body = self._get(expo.url + "/healthz")
             assert json.loads(body)["status"] == "ok"
+
+
+class TestCostz:
+    def _get(self, url, timeout=10):
+        with urllib.request.urlopen(url, timeout=timeout) as r:
+            return r.status, r.read()
+
+    def test_costz_serves_provider_payload(self):
+        doc = {"ledger": {"tenants": {"a": {"device_s": 0.5}}},
+               "capacity": {"headroom_frac": 0.9}}
+        with ExpoServer(port=0, registry=_reg(),
+                        costz=lambda: doc) as expo:
+            status, body = self._get(expo.url + "/costz")
+            assert status == 200
+            assert json.loads(body) == doc
+
+    def test_costz_404_without_provider(self):
+        with ExpoServer(port=0, registry=_reg()) as expo:
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                self._get(expo.url + "/costz")
+            assert ei.value.code == 404
+
+    def test_costz_500_when_provider_throws(self):
+        def boom():
+            raise RuntimeError("ledger gone")
+
+        with ExpoServer(port=0, registry=_reg(), costz=boom) as expo:
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                self._get(expo.url + "/costz")
+            assert ei.value.code == 500
+            assert "ledger gone" in json.loads(ei.value.read())["error"]
+
+
+class TestProcessSelfTelemetry:
+    def test_process_rows_cover_the_standard_family(self):
+        from raft_tpu.obs.expo import process_rows
+
+        rows = {r["name"]: r for r in process_rows()}
+        # Linux CI has /proc and resource: the full set must be there
+        assert rows["process_cpu_seconds_total"]["kind"] == "counter"
+        assert rows["process_cpu_seconds_total"]["value"] >= 0.0
+        assert rows["process_resident_memory_bytes"]["value"] > 1 << 20
+        assert rows["process_open_fds"]["value"] >= 3  # stdio at least
+        assert rows["process_uptime_seconds"]["value"] >= 0.0
+
+    def test_process_text_parses_round_trip_unprefixed(self):
+        from raft_tpu.obs.expo import process_text
+
+        fams = parse_prometheus(process_text())
+        # the Prometheus-conventional names: NO raft_tpu_ namespace
+        for name in ("process_cpu_seconds_total",
+                     "process_resident_memory_bytes",
+                     "process_open_fds", "process_uptime_seconds"):
+            (series,) = fams[name]
+            assert series["labels"] == {}
+            assert isinstance(series["value"], float)
+
+    def test_metrics_endpoint_appends_process_family(self):
+        with ExpoServer(port=0, registry=_reg()) as expo:
+            with urllib.request.urlopen(expo.url + "/metrics",
+                                        timeout=10) as r:
+                fams = parse_prometheus(r.read().decode())
+        assert "raft_tpu_serve_requests" in fams
+        assert "process_cpu_seconds_total" in fams
+        assert "process_resident_memory_bytes" in fams

@@ -23,6 +23,7 @@ MODULES = [
     "raft_tpu.obs.trace", "raft_tpu.obs.flight", "raft_tpu.obs.expo",
     "raft_tpu.obs.fleet", "raft_tpu.obs.sanitize",
     "raft_tpu.obs.quality", "raft_tpu.obs.index_stats",
+    "raft_tpu.obs.cost", "raft_tpu.obs.capacity",
     "raft_tpu.robust.faults", "raft_tpu.robust.retry",
     "raft_tpu.robust.degrade", "raft_tpu.robust.checkpoint",
     "raft_tpu.linalg.blas", "raft_tpu.linalg.solvers",

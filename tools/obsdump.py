@@ -26,6 +26,7 @@ Usage::
     python -m tools.obsdump flight_20260803-120000_123.json
     python -m tools.obsdump flight_*.json --slowest 5   # exemplar drill-down
     python -m tools.obsdump flight_*.json --worst-recall 3  # quality drill-down
+    python -m tools.obsdump flight_*.json --cost    # who is eating the pod
     python -m tools.obsdump --fleet host0.json host1.json --merge pod.json
     python -m tools.obsdump trace_host0.json trace_host1.json --merge all.json
     python -m tools.obsdump bench_obs.jsonl --top 30
@@ -37,7 +38,11 @@ one's full timeline (queue wait, bucket fill, dispatch, search stages,
 retry attempts, ladder moves) from the dump's event ring. ``--fleet``
 merges one pod run's per-host dumps (shared run_id, clock-aligned) via
 :mod:`raft_tpu.obs.fleet` and renders the per-collective straggler
-table.
+table. ``--cost`` (ISSUE 20) renders the per-tenant resource
+attribution table (``cost.*``: device seconds, normalized share bars,
+HBM byte-seconds, host-tier IO and per-axis comms bytes) plus the
+conservation check and capacity forecast from a flight dump's
+``"cost"`` section.
 
 Stdlib + raft_tpu.obs only — runs device-free (no jax import needed to
 read a dump).
@@ -579,8 +584,88 @@ def hbm_table(snap: Dict[str, Any]) -> str:
     return _table(["gauge", "device", "value"], rows)
 
 
+def _share_bar(share: float, width: int = 20) -> str:
+    n = max(0, min(width, round(share * width)))
+    return "#" * n + "." * (width - n)
+
+
+def cost_table(snap: Dict[str, Any]) -> str:
+    """Per-tenant resource attribution (ISSUE 20): the ``cost.*``
+    families joined on the tenant label — device seconds (prorated from
+    batch wall time), HBM byte-seconds (integrated residency), host-tier
+    IO bytes, per-axis comms bytes — plus the normalized fleet share as
+    a bar, so the dump answers "who is eating the pod" at a glance."""
+    per: Dict[str, Dict[str, float]] = {}
+
+    def _fold(series: Dict[str, float]) -> None:
+        for key, v in series.items():
+            name, labels = parse_key(key)
+            if not name.startswith("cost."):
+                continue
+            tenant = labels.get("tenant")
+            if tenant is None:
+                continue
+            st = per.setdefault(tenant, {})
+            col = name[len("cost."):]
+            if col == "comms_bytes":
+                col += "_" + labels.get("axis", "-")
+            st[col] = st.get(col, 0.0) + v
+
+    _fold(snap["counters"])
+    _fold(snap["gauges"])
+
+    def _f(st, k, digits=4):
+        return "-" if st.get(k) is None else f"{st[k]:.{digits}f}"
+
+    def _b(st, k):
+        return "-" if st.get(k) is None else _human_bytes(st[k])
+
+    rows = []
+    for tenant, st in sorted(per.items(),
+                             key=lambda kv: -kv[1].get("device_s", 0.0)):
+        share = st.get("share", 0.0)
+        rows.append([tenant, _f(st, "device_s"),
+                     f"{share:.3f} {_share_bar(share)}",
+                     _f(st, "hbm_byte_s", 1),
+                     _b(st, "io_bytes"),
+                     _b(st, "comms_bytes_ici"),
+                     _b(st, "comms_bytes_dcn")])
+    return _table(["tenant", "device_s", "share", "hbm_byte_s",
+                   "io", "ici", "dcn"], rows)
+
+
+def cost_header(raw: Dict[str, Any]) -> List[str]:
+    """Header lines from a flight dump's ``"cost"`` section: the
+    ledger's conservation check and the capacity model's utilization /
+    headroom / time-to-saturation forecast at dump time."""
+    c = raw.get("cost")
+    if not c:
+        return []
+    out: List[str] = []
+    cons = (c.get("ledger") or {}).get("conservation")
+    if cons:
+        out.append(
+            f"  conservation: attributed "
+            f"{cons.get('attributed_device_s', 0):.4f}s of "
+            f"{cons.get('batch_wall_s', 0):.4f}s batch wall "
+            f"(rel_err {cons.get('rel_err', 0):.4f})")
+    cap = c.get("capacity") or {}
+    if cap and "error" not in cap:
+        util = cap.get("utilization") or {}
+        ttl = cap.get("ttl_saturation_s")
+        out.append(
+            "  capacity: "
+            + " ".join(f"util[{r}]={v:.3f}"
+                       for r, v in sorted(util.items()))
+            + f" headroom={cap.get('headroom_frac', 0):.3f}"
+            + (" ttl=inf" if ttl is None else f" ttl={ttl:.0f}s"))
+    elif cap:
+        out.append(f"  capacity: {cap['error']}")
+    return out
+
+
 def render(path: str, top: int, slowest: int = 0,
-           worst_recall: int = 0) -> str:
+           worst_recall: int = 0, cost: bool = False) -> str:
     kind, snap, raw = load_any(path)
     out = [f"== {path} ({kind}) =="]
     if kind == "benchdiff":
@@ -647,6 +732,10 @@ def render(path: str, top: int, slowest: int = 0,
             raw, worst_recall, family="quality.recall_loss",
             value_fmt=lambda v: f"recall {1.0 - v:.4f} "
                                 f"(loss {v:.4f})"))
+    if cost:
+        out.append("-- cost & capacity (cost.*) --")
+        out.extend(cost_header(raw))
+        out.append(cost_table(snap))
     if any(parse_key(k)[0].startswith("index.")
            for k in snap["gauges"]):
         out.append("-- index health (index.*) --")
@@ -685,6 +774,12 @@ def main(argv: Optional[List[str]] = None) -> int:
                          "requests: resolve quality.recall_loss "
                          "exemplar trace ids and render each request's "
                          "full timeline (flight dumps)")
+    ap.add_argument("--cost", action="store_true",
+                    help="render the per-tenant cost attribution table "
+                         "(cost.* families: device seconds, share bars, "
+                         "HBM byte-seconds, IO / comms bytes) plus the "
+                         "capacity forecast from a flight dump's cost "
+                         "section")
     ap.add_argument("--fleet", action="store_true",
                     help="treat the inputs as one pod run's per-host "
                          "flight dumps: merge them (shared run_id, "
@@ -712,7 +807,8 @@ def main(argv: Optional[List[str]] = None) -> int:
     try:
         for p in args.paths:
             print(render(p, args.top, slowest=args.slowest,
-                         worst_recall=args.worst_recall))
+                         worst_recall=args.worst_recall,
+                         cost=args.cost))
     except BrokenPipeError:  # downstream `| head` closed the pipe
         devnull = os.open(os.devnull, os.O_WRONLY)
         os.dup2(devnull, sys.stdout.fileno())

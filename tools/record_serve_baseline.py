@@ -56,7 +56,11 @@ BASELINE_NOTE = (
     "not by this record. Each row also carries measured recall@10 "
     "against exact brute-force ground truth over the query slice "
     "(ISSUE 16) - the quality column a recall-trading degrade walk "
-    "would move. CPU qps varies with machine load - compare "
+    "would move. Observability (and with it the ISSUE 20 cost ledger) "
+    "is ON for the sweep, so each row also carries the per-step "
+    "device_s / cost_share attribution columns - optional fields the "
+    "benchdiff join tolerates missing in pre-ledger records. CPU qps "
+    "varies with machine load - compare "
     "with --report-only unless the environment stamp matches AND the "
     "machine is quiet.")
 
@@ -68,6 +72,13 @@ def serve_record() -> dict:
     from raft_tpu import serve
     from raft_tpu.neighbors import ivf_flat, ivf_pq
     from raft_tpu.serve import loadgen
+
+    # the cost columns (ISSUE 20) need the ledger attributing, and the
+    # ledger's dispatch tap rides the obs flag — the baseline measures
+    # the instrumented server, which is also what production scrapes
+    from raft_tpu.obs import spans as _spans
+
+    _spans.enable(events=True)
 
     rng = np.random.default_rng(0)
     x = rng.random((N, DIM), dtype=np.float32)
@@ -140,7 +151,8 @@ def main(argv=None) -> int:
               f"qps {r['qps']:>7.1f} "
               f"p99 {p99 if p99 is None else round(p99, 4)} "
               f"recall {r['recall']} "
-              f"shed {r['shed']} missed {r['deadline_missed']}")
+              f"shed {r['shed']} missed {r['deadline_missed']} "
+              f"device_s {r['device_s']} share {r['cost_share']}")
     print(f"wrote {len(record['detail'])} serve rows -> {args.out}")
     return 0
 
